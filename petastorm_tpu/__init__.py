@@ -1,0 +1,16 @@
+"""petastorm_tpu: a TPU-native data access framework for ML training from Apache Parquet.
+
+A ground-up JAX/XLA-first re-design with the capabilities of petastorm (reference:
+/root/reference, v0.13.0): multi-framework schema with tensor/image codecs, dataset
+materialization with embedded metadata, a parallel rowgroup reader with sharding /
+shuffling / predicates / NGram sequence windowing / caching / weighted mixing, and
+framework adapters. The primary consumer is a mesh-sharded JAX input pipeline
+(``petastorm_tpu.parallel``) that assembles globally-sharded ``jax.Array`` batches with
+double-buffered host->device transfer; PyTorch and TF adapters are thin wrappers for
+capability parity (reference: petastorm/pytorch.py, petastorm/tf_utils.py).
+"""
+
+__version__ = '0.1.0'
+
+from petastorm_tpu.transform import TransformSpec  # noqa: F401
+from petastorm_tpu.unischema import Unischema, UnischemaField  # noqa: F401

@@ -1,0 +1,20 @@
+"""Framework error types (reference: petastorm/errors.py:16-17, petastorm/utils.py:50-51,
+petastorm/etl/dataset_metadata.py PetastormMetadataError)."""
+
+
+class PetastormTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class NoDataAvailableError(PetastormTpuError):
+    """Raised when a shard (or predicate-filtered view) of the dataset contains no rowgroups
+    (reference: petastorm/reader.py:580-582)."""
+
+
+class DecodeFieldError(PetastormTpuError):
+    """Raised when a codec fails to decode a field value (reference: petastorm/utils.py:50-51)."""
+
+
+class MetadataError(PetastormTpuError):
+    """Raised when dataset metadata (schema / rowgroup index) is missing or unreadable
+    (reference: petastorm/etl/dataset_metadata.py:30-33)."""

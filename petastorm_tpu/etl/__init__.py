@@ -1,0 +1,24 @@
+"""ETL layer: dataset materialization, embedded metadata, rowgroup indexing (reference:
+petastorm/etl/)."""
+
+
+class RowGroupIndexerBase(object):
+    """Base class for rowgroup indexers (reference: petastorm/etl/__init__.py)."""
+
+    @property
+    def index_name(self):
+        raise NotImplementedError()
+
+    @property
+    def column_names(self):
+        raise NotImplementedError()
+
+    @property
+    def indexed_values(self):
+        raise NotImplementedError()
+
+    def get_row_group_indexes(self, value_key):
+        raise NotImplementedError()
+
+    def build_index(self, decoded_rows, piece_index):
+        raise NotImplementedError()

@@ -1,0 +1,329 @@
+"""Dataset materialization & embedded metadata (reference: petastorm/etl/dataset_metadata.py).
+
+Differences from the reference, by design (SURVEY.md §7.1 item 3):
+
+- the writer is **pure pyarrow** — no Spark required (a Spark adapter can layer on top);
+- the Unischema is embedded in ``_common_metadata`` as **versioned JSON** under
+  ``petastorm_tpu.unischema.v1`` instead of a pickle (the reference acknowledges pickling
+  as a fragility: petastorm/etl/dataset_metadata.py:216-218, codecs.py:20-21);
+- the rowgroup index JSON stores **per-rowgroup row counts** (not just counts per file) so
+  the scheduler can plan work and ``len(reader)`` without touching footers;
+- reading datasets written by the *reference* still works: its pickled
+  ``dataset-toolkit.unischema.v1`` key is depickled through the restricted shim in
+  :mod:`petastorm_tpu.etl.legacy`.
+"""
+
+import json
+import logging
+import os
+from contextlib import contextmanager
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths, path_exists
+from petastorm_tpu.unischema import Unischema, dict_to_encoded_row
+
+logger = logging.getLogger(__name__)
+
+#: JSON-serialized Unischema (this framework's native key)
+UNISCHEMA_JSON_KEY = b'petastorm_tpu.unischema.v1'
+#: JSON map of {relative file path: [rows per rowgroup]} (native key)
+ROW_GROUPS_JSON_KEY = b'petastorm_tpu.row_groups_per_file.v2'
+
+#: Reference-compatibility keys (petastorm/etl/dataset_metadata.py:50-51,223)
+LEGACY_UNISCHEMA_PICKLE_KEY = b'dataset-toolkit.unischema.v1'
+LEGACY_ROW_GROUPS_KEY = b'dataset-toolkit.num_row_groups_per_file.v1'
+
+DEFAULT_ROW_GROUP_SIZE_MB = 32
+
+
+class RowGroupIndices(object):
+    """The unit of scheduling: one Parquet rowgroup (reference:
+    petastorm/etl/dataset_metadata.py:35-46), extended with the fragment's hive partition
+    key/values so partition-predicate pruning needs no footer access."""
+
+    __slots__ = ('fragment_index', 'fragment_path', 'row_group_id', 'row_group_num_rows',
+                 'partition_keys')
+
+    def __init__(self, fragment_index, fragment_path, row_group_id, row_group_num_rows,
+                 partition_keys=None):
+        self.fragment_index = fragment_index
+        self.fragment_path = fragment_path
+        self.row_group_id = row_group_id
+        self.row_group_num_rows = row_group_num_rows
+        self.partition_keys = partition_keys or {}
+
+    def __repr__(self):
+        return ('RowGroupIndices(fragment_index={}, fragment_path={!r}, row_group_id={}, '
+                'row_group_num_rows={}, partition_keys={})'
+                .format(self.fragment_index, self.fragment_path, self.row_group_id,
+                        self.row_group_num_rows, self.partition_keys))
+
+    def __eq__(self, other):
+        return (isinstance(other, RowGroupIndices)
+                and all(getattr(self, s) == getattr(other, s) for s in self.__slots__))
+
+    def __hash__(self):
+        return hash((self.fragment_path, self.row_group_id))
+
+
+class DatasetHandle(object):
+    """An opened Parquet dataset: filesystem + paths + pyarrow dataset object. The analog
+    of the reference's ``pq.ParquetDataset`` usage (petastorm/reader.py:422)."""
+
+    def __init__(self, filesystem, path_or_paths, arrow_dataset):
+        self.filesystem = filesystem
+        self.path_or_paths = path_or_paths
+        self.arrow_dataset = arrow_dataset
+
+    @property
+    def root_path(self):
+        if isinstance(self.path_or_paths, (list, tuple)):
+            return os.path.dirname(self.path_or_paths[0])
+        return self.path_or_paths
+
+    @property
+    def schema(self):
+        return self.arrow_dataset.schema
+
+    @property
+    def partition_field_names(self):
+        partitioning = getattr(self.arrow_dataset, 'partitioning', None)
+        if partitioning is None or partitioning.schema is None:
+            return []
+        data_names = set()
+        for fragment in self.arrow_dataset.get_fragments():
+            data_names = set(fragment.physical_schema.names)
+            break
+        return [name for name in partitioning.schema.names if name not in data_names]
+
+
+def open_dataset(dataset_url_or_urls, storage_options=None, filesystem=None):
+    """Resolve URL(s) and open a pyarrow dataset with hive-partition discovery.
+    ``_``/``.``-prefixed files (``_common_metadata`` etc.) are excluded by pyarrow's
+    default ``ignore_prefixes``."""
+    fs, path_or_paths = get_filesystem_and_path_or_paths(
+        dataset_url_or_urls, storage_options=storage_options, filesystem=filesystem)
+    arrow_dataset = pads.dataset(path_or_paths, filesystem=fs, format='parquet',
+                                 partitioning='hive')
+    return DatasetHandle(fs, path_or_paths, arrow_dataset)
+
+
+# ---------------------------------------------------------------------------
+# Write path
+# ---------------------------------------------------------------------------
+
+def rows_to_arrow_table(schema, rows):
+    """Encode a list of row dicts through the schema's codecs into an Arrow table whose
+    columns use each field's storage type."""
+    encoded = [dict_to_encoded_row(schema, row) for row in rows]
+    arrow_schema = schema.as_arrow_schema()
+    columns = []
+    for field in arrow_schema:
+        values = [row[field.name] for row in encoded]
+        columns.append(pa.array(values, type=field.type))
+    return pa.Table.from_arrays(columns, schema=arrow_schema)
+
+
+def _estimate_row_bytes(table):
+    if table.num_rows == 0:
+        return 1
+    return max(1, table.nbytes // table.num_rows)
+
+
+def write_rows(dataset_url, schema, rows, rowgroup_size_mb=DEFAULT_ROW_GROUP_SIZE_MB,
+               rows_per_file=None, n_files=None, storage_options=None, filesystem=None,
+               file_prefix='part'):
+    """One-shot materialization: encode ``rows`` (list of dicts) and write a petastorm_tpu
+    Parquet store with embedded metadata. The Spark-free equivalent of the reference's
+    materialize-with-Spark flow (petastorm/etl/dataset_metadata.py:68-147)."""
+    with materialize_dataset(dataset_url, schema, rowgroup_size_mb=rowgroup_size_mb,
+                             storage_options=storage_options, filesystem=filesystem):
+        fs, path = get_filesystem_and_path_or_paths(dataset_url,
+                                                    storage_options=storage_options,
+                                                    filesystem=filesystem)
+        fs.create_dir(path, recursive=True)
+        table = rows_to_arrow_table(schema, rows)
+        row_group_rows = max(1, (rowgroup_size_mb * (1 << 20)) // _estimate_row_bytes(table))
+        if rows_per_file is None:
+            if n_files is None:
+                n_files = 1
+            rows_per_file = max(1, (table.num_rows + n_files - 1) // max(1, n_files))
+        file_index = 0
+        for start in range(0, table.num_rows, rows_per_file):
+            chunk = table.slice(start, rows_per_file)
+            file_path = '{}/{}_{:05d}.parquet'.format(path, file_prefix, file_index)
+            with fs.open_output_stream(file_path) as sink:
+                pq.write_table(chunk, sink, row_group_size=row_group_rows)
+            file_index += 1
+
+
+@contextmanager
+def materialize_dataset(dataset_url, schema, rowgroup_size_mb=DEFAULT_ROW_GROUP_SIZE_MB,
+                        storage_options=None, filesystem=None):
+    """Context manager around any Parquet-writing code; on exit, embeds the Unischema and
+    rowgroup index into ``_common_metadata`` and verifies readability (reference:
+    petastorm/etl/dataset_metadata.py:68-147). The body may write files with pyarrow,
+    Spark, or :func:`write_rows` above."""
+    yield
+    fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options=storage_options,
+                                                filesystem=filesystem)
+    arrow_dataset = pads.dataset(path, filesystem=fs, format='parquet', partitioning='hive')
+    handle = DatasetHandle(fs, path, arrow_dataset)
+    row_groups_map = _scan_row_groups_per_file(handle)
+    metadata = {
+        UNISCHEMA_JSON_KEY: json.dumps(schema.to_json_dict()).encode('utf-8'),
+        ROW_GROUPS_JSON_KEY: json.dumps(row_groups_map).encode('utf-8'),
+        # Reference-readable count-per-file key (same JSON layout the reference writes:
+        # etl/dataset_metadata.py:223-235) so its tooling can at least count rowgroups.
+        LEGACY_ROW_GROUPS_KEY: json.dumps(
+            {rel: len(entry['row_groups'])
+             for rel, entry in row_groups_map.items()}).encode('utf-8'),
+    }
+    write_dataset_metadata(handle, metadata)
+    # Verification read (reference: etl/dataset_metadata.py:136-147).
+    loaded = load_row_groups(open_dataset(dataset_url, storage_options=storage_options,
+                                          filesystem=filesystem))
+    if not loaded:
+        raise MetadataError('Materialization verification failed: no rowgroups found '
+                            'under {!r}'.format(dataset_url))
+
+
+def _relative_path(root, full_path):
+    root = root.rstrip('/')
+    if full_path.startswith(root + '/'):
+        return full_path[len(root) + 1:]
+    return full_path
+
+
+def _scan_row_groups_per_file(handle):
+    """Read every fragment footer and build
+    ``{relative path: {'size': file_bytes, 'row_groups': [rows per rowgroup]}}``.
+    The file size lets readers detect a stale index with a stat instead of a footer read."""
+    result = {}
+    root = handle.root_path
+    for fragment in sorted(handle.arrow_dataset.get_fragments(), key=lambda f: f.path):
+        fragment.ensure_complete_metadata()
+        size = handle.filesystem.get_file_info(fragment.path).size
+        result[_relative_path(root, fragment.path)] = {
+            'size': size,
+            'row_groups': [rg.num_rows for rg in fragment.row_groups],
+        }
+    return result
+
+
+def common_metadata_path(handle):
+    return handle.root_path.rstrip('/') + '/_common_metadata'
+
+
+def read_metadata_dict(handle):
+    """Key-value metadata of ``_common_metadata``, or {} when absent (reference:
+    petastorm/utils.py:90-109)."""
+    md_path = common_metadata_path(handle)
+    if not path_exists(handle.filesystem, md_path):
+        return {}
+    with handle.filesystem.open_input_file(md_path) as f:
+        file_metadata = pq.read_metadata(f)
+    return file_metadata.metadata or {}
+
+
+def write_dataset_metadata(handle, new_keys):
+    """Merge ``new_keys`` into ``_common_metadata``'s key-value metadata, preserving
+    existing keys (reference: petastorm/utils.py:111-142)."""
+    existing = dict(read_metadata_dict(handle))
+    existing.update(new_keys)
+    base_schema = None
+    md_path = common_metadata_path(handle)
+    if path_exists(handle.filesystem, md_path):
+        with handle.filesystem.open_input_file(md_path) as f:
+            base_schema = pq.read_schema(f)
+    if base_schema is None:
+        base_schema = handle.arrow_dataset.schema
+    schema_with_md = base_schema.with_metadata(existing)
+    with handle.filesystem.open_output_stream(md_path) as sink:
+        pq.write_metadata(schema_with_md, sink)
+
+
+# ---------------------------------------------------------------------------
+# Read path
+# ---------------------------------------------------------------------------
+
+def load_row_groups(handle):
+    """List every rowgroup of the dataset in deterministic (path-sorted) order — the
+    reproducible-shuffle prerequisite (reference: petastorm/etl/dataset_metadata.py:237-275).
+    Prefers the metadata JSON index; silently recomputes from footers when it is absent or
+    stale."""
+    metadata = read_metadata_dict(handle)
+    root = handle.root_path
+    index_map = None
+    if ROW_GROUPS_JSON_KEY in metadata:
+        try:
+            index_map = json.loads(metadata[ROW_GROUPS_JSON_KEY].decode('utf-8'))
+        except (ValueError, UnicodeDecodeError):
+            logger.warning('Could not parse rowgroup index metadata; recomputing from '
+                           'footers')
+    fragments = sorted(handle.arrow_dataset.get_fragments(), key=lambda f: f.path)
+    row_groups = []
+    for fragment_index, fragment in enumerate(fragments):
+        rel = _relative_path(root, fragment.path)
+        partition_keys = _fragment_partition_keys(fragment)
+        counts = None
+        if index_map is not None and rel in index_map:
+            entry = index_map[rel]
+            actual_size = handle.filesystem.get_file_info(fragment.path).size
+            if entry.get('size') == actual_size:
+                counts = entry['row_groups']
+            else:
+                logger.warning('Rowgroup index for %s is stale (size %s != %s); '
+                               'recomputing from footer', rel, entry.get('size'), actual_size)
+        if counts is None:
+            fragment.ensure_complete_metadata()
+            counts = [rg.num_rows for rg in fragment.row_groups]
+        for row_group_id, num_rows in enumerate(counts):
+            row_groups.append(RowGroupIndices(fragment_index, fragment.path, row_group_id,
+                                              num_rows, partition_keys))
+    return row_groups
+
+
+def _fragment_partition_keys(fragment):
+    try:
+        from pyarrow.dataset import get_partition_keys
+        return get_partition_keys(fragment.partition_expression)
+    except Exception:  # pragma: no cover - older pyarrow fallback
+        return {}
+
+
+def get_schema(handle):
+    """Load the Unischema embedded in ``_common_metadata`` — native JSON key first, then
+    the reference's pickled key through the legacy shim (reference:
+    petastorm/etl/dataset_metadata.py:340-373)."""
+    metadata = read_metadata_dict(handle)
+    if UNISCHEMA_JSON_KEY in metadata:
+        return Unischema.from_json_dict(json.loads(metadata[UNISCHEMA_JSON_KEY].decode('utf-8')))
+    if LEGACY_UNISCHEMA_PICKLE_KEY in metadata:
+        from petastorm_tpu.etl.legacy import depickle_legacy_unischema
+        return depickle_legacy_unischema(metadata[LEGACY_UNISCHEMA_PICKLE_KEY])
+    raise MetadataError(
+        'Dataset at {!r} has no unischema metadata (neither {} nor legacy {}). Either it '
+        'was not written with materialize_dataset, or metadata was lost. Use '
+        'make_batch_reader / schema inference for plain Parquet stores.'
+        .format(handle.root_path, UNISCHEMA_JSON_KEY, LEGACY_UNISCHEMA_PICKLE_KEY))
+
+
+def get_schema_from_dataset_url(dataset_url_or_urls, storage_options=None, filesystem=None):
+    """Reference: petastorm/etl/dataset_metadata.py:376-395."""
+    return get_schema(open_dataset(dataset_url_or_urls, storage_options=storage_options,
+                                   filesystem=filesystem))
+
+
+def infer_or_load_unischema(handle):
+    """Embedded schema when present, else infer from the Arrow schema (reference:
+    petastorm/etl/dataset_metadata.py:398-406)."""
+    try:
+        return get_schema(handle)
+    except MetadataError:
+        logger.debug('Dataset has no embedded unischema; inferring from Arrow schema')
+        return Unischema.from_arrow_schema(handle.schema)

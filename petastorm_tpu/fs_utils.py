@@ -1,0 +1,118 @@
+"""Filesystem resolution: dataset URL -> (pyarrow filesystem, path) (reference:
+petastorm/fs_utils.py:42-239).
+
+The reference dispatches to pyarrow-legacy / libhdfs / fsspec; here everything funnels into
+the modern ``pyarrow.fs`` API: local paths map to ``LocalFileSystem``, ``hdfs://`` to
+``HadoopFileSystem``, and every other scheme (s3, gs, abfs, ...) to an fsspec filesystem
+wrapped with ``PyFileSystem(FSSpecHandler)`` so Arrow's C++ readers can consume it.
+"""
+
+from urllib.parse import urlparse
+
+import pyarrow.fs as pafs
+
+
+def normalize_dataset_url(url):
+    """Strip trailing slashes; accept plain paths (reference: petastorm/reader.py:53-59)."""
+    if not isinstance(url, str):
+        raise ValueError('dataset URL must be a string, got {!r}'.format(url))
+    return url.rstrip('/') if url != '/' else url
+
+
+def normalize_dataset_url_or_urls(url_or_urls):
+    """Normalize a URL or a non-empty list of URLs (reference: petastorm/reader.py:53-59)."""
+    if isinstance(url_or_urls, (list, tuple)):
+        if not url_or_urls:
+            raise ValueError('dataset URL list must not be empty')
+        return [normalize_dataset_url(url) for url in url_or_urls]
+    return normalize_dataset_url(url_or_urls)
+
+
+def _scheme_of(url):
+    scheme = urlparse(url).scheme
+    # Windows drive letters / plain paths have empty or 1-char schemes.
+    return scheme if len(scheme) > 1 else ''
+
+
+def _extract_path(url):
+    """Filesystem-local path for a URL, independent of how the filesystem object was
+    obtained: local paths stay as-is, hdfs drops the authority, object stores keep
+    ``bucket/key``."""
+    parsed = urlparse(url)
+    scheme = _scheme_of(url)
+    if scheme == '':
+        return url
+    if scheme == 'file':
+        return parsed.path
+    if scheme == 'hdfs':
+        return parsed.path
+    return parsed.netloc + parsed.path
+
+
+def _resolve_filesystem(url, storage_options=None):
+    scheme = _scheme_of(url)
+    if scheme in ('', 'file'):
+        return pafs.LocalFileSystem()
+    if scheme == 'hdfs':
+        parsed = urlparse(url)
+        return pafs.HadoopFileSystem(parsed.hostname or 'default', parsed.port or 0)
+    # Everything else goes through fsspec (s3/gs/abfs/...), matching the reference's
+    # catch-all branch (fs_utils.py:132-144).
+    import fsspec
+    fs = fsspec.filesystem(scheme, **(storage_options or {}))
+    return pafs.PyFileSystem(pafs.FSSpecHandler(fs))
+
+
+def _resolve_single(url, storage_options=None, filesystem=None):
+    if filesystem is None:
+        filesystem = _resolve_filesystem(url, storage_options)
+    return filesystem, _extract_path(url)
+
+
+def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None, filesystem=None):
+    """Resolve a URL (or homogeneous list of URLs) into a single pyarrow filesystem and
+    path(s) (reference: petastorm/fs_utils.py:180-219)."""
+    urls = url_or_urls if isinstance(url_or_urls, (list, tuple)) else [url_or_urls]
+    urls = [normalize_dataset_url(u) for u in urls]
+    schemes = {_scheme_of(u) for u in urls}
+    netlocs = {urlparse(u).netloc for u in urls}
+    if len(schemes) > 1 or len(netlocs) > 1:
+        raise ValueError('All dataset URLs must share one filesystem; got schemes {} '
+                         'netlocs {}'.format(sorted(schemes), sorted(netlocs)))
+    if filesystem is None:
+        filesystem = _resolve_filesystem(urls[0], storage_options)
+    paths = [_extract_path(u) for u in urls]
+    if isinstance(url_or_urls, (list, tuple)):
+        return filesystem, paths
+    return filesystem, paths[0]
+
+
+def path_exists(filesystem, path):
+    """True when the path exists on the filesystem (reference: fs_utils.py:222-230)."""
+    info = filesystem.get_file_info(path)
+    return info.type != pafs.FileType.NotFound
+
+
+def delete_path(filesystem, path, recursive=True):
+    """Delete a file or directory tree (reference: fs_utils.py:233-239)."""
+    info = filesystem.get_file_info(path)
+    if info.type == pafs.FileType.Directory:
+        filesystem.delete_dir(path) if recursive else filesystem.delete_dir_contents(path)
+    elif info.type != pafs.FileType.NotFound:
+        filesystem.delete_file(path)
+
+
+class FilesystemFactory(object):
+    """A picklable zero-arg callable re-creating the filesystem — for shipping to worker
+    processes (reference: fs_utils.py:166-172)."""
+
+    def __init__(self, url, storage_options=None):
+        self._url = url
+        self._storage_options = storage_options
+
+    def __call__(self):
+        return _resolve_single(self._url, self._storage_options)[0]
+
+
+def make_filesystem_factory(url, storage_options=None):
+    return FilesystemFactory(url, storage_options)

@@ -1,0 +1,69 @@
+"""User-defined on-worker transforms with schema mutation (reference:
+petastorm/transform.py:27-89).
+
+A :class:`TransformSpec` carries a function applied inside a reader worker — on a row dict
+for the row reader, or on a pandas DataFrame for the batch reader — plus a declaration of
+how the output schema differs from the input schema (edited / removed / selected fields).
+"""
+
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+class TransformSpec(object):
+    """Specification of a worker-side transform (reference: petastorm/transform.py:27-57).
+
+    :param func: callable applied on the worker (row dict -> row dict for ``make_reader``;
+        pandas DataFrame -> pandas DataFrame for ``make_batch_reader``). May be None when
+        only field selection/removal is desired.
+    :param edit_fields: list of 4-tuples ``(name, numpy_dtype, shape, nullable)`` or
+        :class:`UnischemaField` describing fields added or modified by ``func``.
+    :param removed_fields: list of field names deleted by the transform. Mutually exclusive
+        with ``selected_fields``.
+    :param selected_fields: ordered list of field names to keep (output column order).
+    """
+
+    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None):
+        if removed_fields and selected_fields:
+            raise ValueError('removed_fields and selected_fields are mutually exclusive '
+                             '(reference semantics: petastorm/transform.py:49-52)')
+        self.func = func
+        self.edit_fields = edit_fields or []
+        self.removed_fields = removed_fields or []
+        self.selected_fields = selected_fields
+
+
+def transform_schema(schema, transform_spec):
+    """Compute the post-transform schema (reference: petastorm/transform.py:60-89)."""
+    edited = {}
+    for edit in transform_spec.edit_fields:
+        if isinstance(edit, UnischemaField):
+            field = edit
+        else:
+            name, numpy_dtype, shape, nullable = edit
+            field = UnischemaField(name, numpy_dtype, shape, codec=None, nullable=nullable)
+        edited[field.name] = field
+
+    removed = set(transform_spec.removed_fields)
+    unknown_removed = removed - set(schema.fields) - set(edited)
+    if unknown_removed:
+        raise ValueError('removed_fields {} not present in schema {!r}'
+                         .format(sorted(unknown_removed), schema.name))
+
+    fields = {}
+    for name, field in schema.fields.items():
+        if name in removed:
+            continue
+        fields[name] = edited.pop(name, field)
+    # Net-new edited fields append after existing ones, in edit order.
+    for name, field in edited.items():
+        if name not in removed:
+            fields[name] = field
+
+    if transform_spec.selected_fields is not None:
+        unknown_selected = set(transform_spec.selected_fields) - set(fields)
+        if unknown_selected:
+            raise ValueError('selected_fields {} not present in transformed schema'
+                             .format(sorted(unknown_selected)))
+        fields = {name: fields[name] for name in transform_spec.selected_fields}
+
+    return Unischema('{}_transformed'.format(schema.name), list(fields.values()))

@@ -1,0 +1,420 @@
+"""Unischema: a single schema definition rendering to multiple framework type systems.
+
+Capability parity with petastorm/unischema.py (UnischemaField :50-85, Unischema :174-345,
+dict_to_spark_row :348-413, match_unischema_fields :426-453, from_arrow_schema inference
+:302-342), re-designed TPU-first:
+
+- primary render targets are **Arrow** (storage) and **jax.ShapeDtypeStruct** (device)
+  instead of Spark StructType / TF dtypes;
+- schemas persist as **versioned JSON** (``to_json_dict``/``from_json_dict``), not pickles;
+- rows render as namedtuples (cached per schema+field-set, like the reference's
+  _NamedtupleCache unischema.py:88-125, so type identity is stable across calls).
+"""
+
+import copy
+import re
+import threading
+from collections import namedtuple
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.codecs import (FieldCodec, ScalarCodec, NdarrayCodec, codec_from_config,
+                                  arrow_type_for_numpy)
+
+
+class UnischemaField(object):
+    """A single field: ``(name, numpy_dtype, shape, codec, nullable)``.
+
+    ``shape`` dims may be ``None`` meaning variable length (reference:
+    petastorm/unischema.py:50-85). ``numpy_dtype`` may be a numpy scalar type, ``np.dtype``,
+    ``str`` (numpy string/unicode dtypes included), or ``decimal.Decimal``.
+
+    Equality/hash are value-based over (name, dtype, shape, nullable) plus the codec's
+    *config* (not object identity) — the reference relaxed codec comparison for pickle
+    round-trip safety (petastorm/unischema.py:39-47,71-85).
+    """
+
+    __slots__ = ('name', 'numpy_dtype', 'shape', 'codec', 'nullable')
+
+    def __init__(self, name, numpy_dtype, shape=(), codec=None, nullable=False):
+        if codec is not None and not isinstance(codec, FieldCodec):
+            raise TypeError('codec must be a FieldCodec or None, got {!r}'.format(codec))
+        self.name = name
+        self.numpy_dtype = numpy_dtype
+        self.shape = tuple(shape)
+        self.codec = codec
+        self.nullable = nullable
+
+    def _key(self):
+        codec_config = self.codec.to_config() if self.codec is not None else None
+        return (self.name, _dtype_token(self.numpy_dtype), self.shape,
+                None if codec_config is None else tuple(sorted(codec_config.items())),
+                self.nullable)
+
+    def __eq__(self, other):
+        return isinstance(other, UnischemaField) and self._key() == other._key()
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return ('UnischemaField(name={!r}, numpy_dtype={}, shape={}, codec={}, nullable={})'
+                .format(self.name, _dtype_token(self.numpy_dtype), self.shape, self.codec,
+                        self.nullable))
+
+    # -- renders ------------------------------------------------------------------
+
+    def arrow_type(self):
+        """Arrow storage type of this field's encoded column."""
+        if self.codec is not None:
+            return self.codec.arrow_type(self)
+        if self.numpy_dtype is Decimal:
+            return pa.string()
+        if self.shape == ():
+            return arrow_type_for_numpy(self.numpy_dtype)
+        if len(self.shape) == 1:
+            return pa.list_(arrow_type_for_numpy(self.numpy_dtype))
+        raise ValueError('Field {} has shape {} but no codec; multidim fields require a codec'
+                         .format(self.name, self.shape))
+
+    def shape_dtype_struct(self, batch_dims=()):
+        """``jax.ShapeDtypeStruct`` render (the TPU-side analog of the reference's TF dtype
+        render, petastorm/tf_utils.py:27-43). None dims are not representable; callers must
+        pad ragged fields first."""
+        import jax
+        if any(dim is None for dim in self.shape):
+            raise ValueError('Field {} has variable shape {}; pad before device render'
+                             .format(self.name, self.shape))
+        if self.numpy_dtype is Decimal or np.dtype(self.numpy_dtype).kind in ('U', 'S', 'O'):
+            raise ValueError('Field {} dtype has no device representation'.format(self.name))
+        return jax.ShapeDtypeStruct(tuple(batch_dims) + self.shape, np.dtype(self.numpy_dtype))
+
+    # -- JSON serialization -------------------------------------------------------
+
+    def to_json_dict(self):
+        return {
+            'name': self.name,
+            'numpy_dtype': _dtype_token(self.numpy_dtype),
+            'shape': list(self.shape),
+            'codec': self.codec.to_config() if self.codec is not None else None,
+            'nullable': self.nullable,
+        }
+
+    @classmethod
+    def from_json_dict(cls, field_dict):
+        codec_config = field_dict.get('codec')
+        return cls(
+            name=field_dict['name'],
+            numpy_dtype=_dtype_from_token(field_dict['numpy_dtype']),
+            shape=tuple(field_dict['shape']),
+            codec=codec_from_config(codec_config) if codec_config is not None else None,
+            nullable=field_dict.get('nullable', False),
+        )
+
+
+def _dtype_token(numpy_dtype):
+    """Stable string token for a field dtype (JSON store + hashing)."""
+    if numpy_dtype is Decimal:
+        return 'Decimal'
+    return np.dtype(numpy_dtype).name if not _is_string_dtype(numpy_dtype) \
+        else np.dtype(numpy_dtype).str.lstrip('<>=|')
+
+
+def _is_string_dtype(numpy_dtype):
+    if numpy_dtype is Decimal:
+        return False
+    return np.dtype(numpy_dtype).kind in ('U', 'S')
+
+
+def _dtype_from_token(token):
+    if token == 'Decimal':
+        return Decimal
+    return np.dtype(token)
+
+
+class _NamedtupleCache(object):
+    """One namedtuple class per (schema-name, field-names) so adapter layers relying on type
+    identity (e.g. tf.data) see a consistent type (reference: petastorm/unischema.py:88-125)."""
+
+    _lock = threading.Lock()
+    _store = {}
+
+    @classmethod
+    def get(cls, parent_name, field_names):
+        key = (parent_name, tuple(field_names))
+        with cls._lock:
+            if key not in cls._store:
+                cls._store[key] = namedtuple(parent_name or 'UnischemaRow', field_names)
+            return cls._store[key]
+
+
+class Unischema(object):
+    """An ordered collection of :class:`UnischemaField` (reference:
+    petastorm/unischema.py:174-345). Field order is input order (the reference's
+    ``preserve_input_order`` policy, unischema.py:33-36)."""
+
+    def __init__(self, name, fields):
+        self._name = name
+        self._fields = {}
+        for field in fields:
+            if field.name in self._fields:
+                raise ValueError('Duplicate field name {!r} in schema {!r}'
+                                 .format(field.name, name))
+            self._fields[field.name] = field
+        # Dynamic attribute per field, e.g. ``schema.my_field`` (unischema.py:192-197).
+        for field_name, field in self._fields.items():
+            if not hasattr(self, field_name):
+                setattr(self, field_name, field)
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def fields(self):
+        """Ordered dict of name -> UnischemaField (insertion order preserved)."""
+        return self._fields
+
+    def __iter__(self):
+        return iter(self._fields.values())
+
+    def __len__(self):
+        return len(self._fields)
+
+    def __eq__(self, other):
+        return (isinstance(other, Unischema) and self._name == other._name
+                and list(self._fields.values()) == list(other._fields.values()))
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __hash__(self):
+        return hash((self._name, tuple(self._fields.values())))
+
+    def __repr__(self):
+        lines = ['  {!r}'.format(f) for f in self._fields.values()]
+        return 'Unischema({!r}, [\n{}\n])'.format(self._name, ',\n'.join(lines))
+
+    # -- views --------------------------------------------------------------------
+
+    def create_schema_view(self, fields_or_patterns):
+        """Subset view from UnischemaField instances, field names, or regex patterns
+        (reference: petastorm/unischema.py:199-240). Field order follows *schema* order."""
+        if isinstance(fields_or_patterns, (str, UnischemaField)):
+            fields_or_patterns = [fields_or_patterns]
+        patterns = []
+        for item in fields_or_patterns:
+            if isinstance(item, UnischemaField):
+                if item.name not in self._fields:
+                    raise ValueError('Field {!r} does not belong to schema {!r}'
+                                     .format(item.name, self._name))
+                patterns.append(re.escape(item.name))
+            elif isinstance(item, str):
+                patterns.append(item)
+            else:
+                raise ValueError('create_schema_view accepts UnischemaFields, names or '
+                                 'regex patterns; got {!r}'.format(item))
+        matched = match_unischema_fields(self, patterns)
+        matched_names = {f.name for f in matched}
+        view_fields = [f for f in self._fields.values() if f.name in matched_names]
+        if not view_fields:
+            raise ValueError('create_schema_view matched no fields of schema {!r} '
+                             'with patterns {!r}'.format(self._name, patterns))
+        return Unischema('{}_view'.format(self._name), view_fields)
+
+    # -- row rendering ------------------------------------------------------------
+
+    def make_namedtuple(self, **kwargs):
+        """Build a row namedtuple from keyword args (reference: unischema.py:245-259)."""
+        return self.namedtuple(**{k: kwargs[k] for k in self._fields})
+
+    def make_namedtuple_from_dict(self, row_dict):
+        return self.namedtuple(**{k: row_dict[k] for k in self._fields})
+
+    @property
+    def namedtuple(self):
+        """The cached namedtuple class for this schema's field set."""
+        return _NamedtupleCache.get(self._name, list(self._fields))
+
+    # -- renders ------------------------------------------------------------------
+
+    def as_arrow_schema(self):
+        """Arrow schema of the *encoded* (storage) representation."""
+        pa_fields = [pa.field(f.name, f.arrow_type(), nullable=bool(f.nullable))
+                     for f in self._fields.values()]
+        return pa.schema(pa_fields)
+
+    def as_shape_dtype_structs(self, batch_dims=()):
+        """Dict of field name -> jax.ShapeDtypeStruct for device-representable fields."""
+        return {f.name: f.shape_dtype_struct(batch_dims) for f in self._fields.values()}
+
+    # -- JSON serialization -------------------------------------------------------
+
+    def to_json_dict(self):
+        return {
+            'version': 1,
+            'name': self._name,
+            'fields': [f.to_json_dict() for f in self._fields.values()],
+        }
+
+    @classmethod
+    def from_json_dict(cls, schema_dict):
+        version = schema_dict.get('version', 1)
+        if version != 1:
+            raise ValueError('Unsupported schema version {}'.format(version))
+        return cls(schema_dict['name'],
+                   [UnischemaField.from_json_dict(f) for f in schema_dict['fields']])
+
+    # -- inference ----------------------------------------------------------------
+
+    @classmethod
+    def from_arrow_schema(cls, arrow_schema, omit_unsupported_fields=True, name='inferred'):
+        """Infer a Unischema from a plain Parquet/Arrow schema for non-petastorm stores
+        (reference: petastorm/unischema.py:302-342 + _numpy_and_codec_from_arrow_type
+        :456-491). List types become shape ``(None,)``; unsupported types are skipped with
+        a warning (or raise when ``omit_unsupported_fields=False``)."""
+        import warnings
+        fields = []
+        for arrow_field in arrow_schema:
+            try:
+                numpy_dtype, shape = _numpy_from_arrow_type(arrow_field.type)
+            except ValueError as exc:
+                if omit_unsupported_fields:
+                    warnings.warn('Surpressing unsupported field {!r}: {}'
+                                  .format(arrow_field.name, exc))
+                    continue
+                raise
+            fields.append(UnischemaField(arrow_field.name, numpy_dtype, shape,
+                                         codec=None, nullable=arrow_field.nullable))
+        return cls(name, fields)
+
+
+def _numpy_from_arrow_type(arrow_type):
+    """Map an Arrow type to (numpy_dtype, shape) (reference: unischema.py:456-491)."""
+    import pyarrow.types as patypes
+    if patypes.is_list(arrow_type) or patypes.is_large_list(arrow_type):
+        inner_dtype, inner_shape = _numpy_from_arrow_type(arrow_type.value_type)
+        if inner_shape != ():
+            raise ValueError('Nested list type {} is not supported'.format(arrow_type))
+        return inner_dtype, (None,)
+    if patypes.is_decimal(arrow_type):
+        return Decimal, ()
+    if patypes.is_string(arrow_type) or patypes.is_large_string(arrow_type):
+        return np.dtype('str_'), ()
+    if patypes.is_binary(arrow_type) or patypes.is_large_binary(arrow_type):
+        return np.dtype('bytes_'), ()
+    if patypes.is_timestamp(arrow_type) or patypes.is_date(arrow_type):
+        return np.dtype('datetime64[ns]'), ()
+    try:
+        return np.dtype(arrow_type.to_pandas_dtype()), ()
+    except (NotImplementedError, pa.ArrowNotImplementedError):
+        raise ValueError('Arrow type {} has no numpy mapping'.format(arrow_type))
+
+
+def match_unischema_fields(schema, field_regexes):
+    """Return schema fields whose names fullmatch any of the given regex patterns
+    (reference: petastorm/unischema.py:426-453 — the legacy ``re.match`` prefix behavior is
+    intentionally not reproduced; fullmatch is the documented modern semantics)."""
+    if not field_regexes:
+        return []
+    compiled = [re.compile(p) for p in field_regexes]
+    return [field for name, field in schema.fields.items()
+            if any(c.fullmatch(name) for c in compiled)]
+
+
+def dict_to_encoded_row(schema, row_dict):
+    """Validate and codec-encode one row dict into its storage representation — the analog
+    of the reference's ``dict_to_spark_row`` (petastorm/unischema.py:348-384) without the
+    Spark Row dependency: the output feeds the Arrow writer (etl.dataset_metadata).
+
+    Validates field membership and nullability; leaves ``None`` for nullable fields.
+    """
+    if not isinstance(row_dict, dict):
+        raise TypeError('row_dict must be a dict, got {!r}'.format(type(row_dict)))
+    input_names = set(row_dict)
+    schema_names = set(schema.fields)
+    unknown = input_names - schema_names
+    if unknown:
+        raise ValueError('Fields {} are not part of schema {!r}'.format(sorted(unknown),
+                                                                        schema.name))
+    full_dict = insert_explicit_nulls(schema, copy.copy(row_dict))
+    encoded = {}
+    for name, field in schema.fields.items():
+        value = full_dict[name]
+        if value is None:
+            if not field.nullable:
+                raise ValueError('Field {} is not nullable but got None'.format(name))
+            encoded[name] = None
+        elif field.codec is not None:
+            encoded[name] = field.codec.encode(field, value)
+        else:
+            encoded[name] = _default_encode(field, value)
+    return encoded
+
+
+def _default_encode(field, value):
+    """Encode a codec-less field (scalar or 1-d list column) for the Arrow writer."""
+    if isinstance(value, np.ndarray):
+        if value.ndim == 0:
+            return value.item()
+        if value.ndim == 1:
+            return value.tolist()
+        raise ValueError('Field {} has no codec; cannot store {}-dim array natively'
+                         .format(field.name, value.ndim))
+    if isinstance(value, np.generic):
+        return value.item()
+    return value
+
+
+def insert_explicit_nulls(schema, row_dict):
+    """Add explicit ``None`` entries for missing nullable fields; raise for missing
+    non-nullable ones (reference: petastorm/unischema.py:387-401)."""
+    for name, field in schema.fields.items():
+        if name not in row_dict:
+            if field.nullable:
+                row_dict[name] = None
+            else:
+                raise ValueError('Field {} is not found in row and is not nullable'
+                                 .format(name))
+    return row_dict
+
+
+def decode_row(row_dict, schema):
+    """Decode one encoded row dict back to numpy values via codecs (reference:
+    petastorm/utils.py:54-87)."""
+    from petastorm_tpu.errors import DecodeFieldError
+    decoded = {}
+    for name, value in row_dict.items():
+        field = schema.fields.get(name)
+        if field is None:
+            decoded[name] = value
+            continue
+        if value is None:
+            decoded[name] = None
+            continue
+        try:
+            if field.codec is not None:
+                decoded[name] = field.codec.decode(field, value)
+            elif field.numpy_dtype is Decimal:
+                decoded[name] = value if isinstance(value, Decimal) else Decimal(str(value))
+            elif field.shape == () and np.dtype(field.numpy_dtype).kind not in ('U', 'S', 'O'):
+                decoded[name] = np.dtype(field.numpy_dtype).type(value)
+            elif field.shape != ():
+                decoded[name] = np.asarray(value, dtype=_list_item_dtype(field))
+            else:
+                decoded[name] = value
+        except Exception as exc:
+            raise DecodeFieldError('Failed to decode field {!r}: {}'.format(name, exc))
+    return decoded
+
+
+def _list_item_dtype(field):
+    dtype = np.dtype(field.numpy_dtype)
+    if dtype.kind in ('U', 'S', 'O'):
+        return object
+    return dtype

@@ -1,0 +1,22 @@
+"""Test harness configuration.
+
+Force JAX onto a virtual 8-device CPU platform BEFORE jax initializes, so multi-chip
+sharding logic (mesh construction, make_array_from_process_local_data, collectives) is
+exercised without TPU hardware — the strategy SURVEY.md §4 prescribes. The real-TPU path
+is covered by bench.py / __graft_entry__.py which the driver runs on hardware.
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+existing = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in existing:
+    os.environ['XLA_FLAGS'] = (existing + ' --xla_force_host_platform_device_count=8').strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope='session')
+def rng():
+    return np.random.RandomState(42)

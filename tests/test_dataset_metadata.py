@@ -1,0 +1,134 @@
+"""ETL / metadata tests (model: petastorm/tests/test_dataset_metadata.py +
+test_generate_metadata.py)."""
+
+import json
+import os
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import (ROW_GROUPS_JSON_KEY, UNISCHEMA_JSON_KEY,
+                                                get_schema, get_schema_from_dataset_url,
+                                                infer_or_load_unischema, load_row_groups,
+                                                materialize_dataset, open_dataset,
+                                                read_metadata_dict, write_rows)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SCHEMA = Unischema('MdTest', [
+    UnischemaField('id', np.int64, (), ScalarCodec(), False),
+    UnischemaField('value', np.float32, (2, 2), NdarrayCodec(), False),
+])
+
+
+def _rows(n):
+    return [{'id': i, 'value': np.full((2, 2), i, dtype=np.float32)} for i in range(n)]
+
+
+@pytest.fixture
+def dataset_url(tmp_path):
+    url = str(tmp_path / 'ds')
+    write_rows(url, SCHEMA, _rows(100), rowgroup_size_mb=1, rows_per_file=50)
+    return url
+
+
+def test_write_creates_common_metadata(dataset_url):
+    assert os.path.exists(os.path.join(dataset_url, '_common_metadata'))
+    handle = open_dataset(dataset_url)
+    md = read_metadata_dict(handle)
+    assert UNISCHEMA_JSON_KEY in md
+    assert ROW_GROUPS_JSON_KEY in md
+
+
+def test_get_schema_roundtrip(dataset_url):
+    schema = get_schema_from_dataset_url(dataset_url)
+    assert schema == SCHEMA
+
+
+def test_load_row_groups(dataset_url):
+    row_groups = load_row_groups(open_dataset(dataset_url))
+    assert sum(rg.row_group_num_rows for rg in row_groups) == 100
+    assert len({rg.fragment_path for rg in row_groups}) == 2
+    # deterministic path-sorted order
+    paths = [rg.fragment_path for rg in row_groups]
+    assert paths == sorted(paths)
+
+
+def test_load_row_groups_without_metadata(tmp_path, dataset_url):
+    os.remove(os.path.join(dataset_url, '_common_metadata'))
+    row_groups = load_row_groups(open_dataset(dataset_url))
+    assert sum(rg.row_group_num_rows for rg in row_groups) == 100
+
+
+def test_get_schema_missing_metadata_raises(tmp_path, dataset_url):
+    os.remove(os.path.join(dataset_url, '_common_metadata'))
+    with pytest.raises(MetadataError):
+        get_schema(open_dataset(dataset_url))
+
+
+def test_infer_or_load_falls_back(tmp_path, dataset_url):
+    os.remove(os.path.join(dataset_url, '_common_metadata'))
+    schema = infer_or_load_unischema(open_dataset(dataset_url))
+    assert 'id' in schema.fields and 'value' in schema.fields
+    # inferred binary column has no codec
+    assert schema.value.codec is None
+
+
+def test_materialize_around_manual_write(tmp_path):
+    from petastorm_tpu.etl.dataset_metadata import rows_to_arrow_table
+    url = str(tmp_path / 'manual')
+    os.makedirs(url)
+    with materialize_dataset(url, SCHEMA):
+        table = rows_to_arrow_table(SCHEMA, _rows(10))
+        pq.write_table(table, os.path.join(url, 'part_0.parquet'), row_group_size=4)
+    row_groups = load_row_groups(open_dataset(url))
+    assert [rg.row_group_num_rows for rg in row_groups] == [4, 4, 2]
+    assert get_schema(open_dataset(url)) == SCHEMA
+
+
+def test_rowgroup_metadata_used_without_footers(dataset_url):
+    handle = open_dataset(dataset_url)
+    md = read_metadata_dict(handle)
+    index = json.loads(md[ROW_GROUPS_JSON_KEY].decode())
+    assert sum(len(v['row_groups']) for v in index.values()) == len(load_row_groups(handle))
+
+
+def test_stale_rowgroup_index_recomputed(dataset_url):
+    """A rewritten data file (size change) must not be trusted from the index."""
+    import pyarrow.parquet as _pq
+    handle = open_dataset(dataset_url)
+    a_file = sorted(os.listdir(dataset_url))[1]
+    path = os.path.join(dataset_url, a_file)
+    table = _pq.read_table(path)
+    _pq.write_table(table, path, row_group_size=7)  # rewrite in place, different rowgroups
+    row_groups = load_row_groups(open_dataset(dataset_url))
+    assert sum(rg.row_group_num_rows for rg in row_groups) == 100
+    per_file = {}
+    for rg in row_groups:
+        per_file.setdefault(os.path.basename(rg.fragment_path), []).append(
+            rg.row_group_num_rows)
+    assert per_file[a_file][0] == 7
+
+
+def test_url_list_open(dataset_url):
+    files = sorted(f for f in os.listdir(dataset_url) if f.endswith('.parquet'))
+    urls = [os.path.join(dataset_url, f) for f in files]
+    handle = open_dataset(urls)
+    row_groups = load_row_groups(handle)
+    assert sum(rg.row_group_num_rows for rg in row_groups) == 100
+
+
+REFERENCE_LEGACY_DIR = '/root/reference/petastorm/tests/data/legacy'
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_LEGACY_DIR),
+                    reason='reference legacy datasets not mounted')
+@pytest.mark.parametrize('version', ['0.4.0', '0.5.1', '0.6.0', '0.7.0', '0.7.6'])
+def test_read_reference_written_schema(version):
+    """Datasets written by petastorm itself must load through the legacy pickle shim."""
+    handle = open_dataset(os.path.join(REFERENCE_LEGACY_DIR, version))
+    schema = get_schema(handle)
+    assert 'id' in schema.fields
+    assert schema.fields['id'].codec is not None

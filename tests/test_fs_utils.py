@@ -1,0 +1,62 @@
+"""Filesystem resolution tests (model: petastorm/tests/test_fs_utils.py)."""
+
+import pyarrow.fs as pafs
+import pytest
+
+from petastorm_tpu.fs_utils import (delete_path, get_filesystem_and_path_or_paths,
+                                    make_filesystem_factory, normalize_dataset_url,
+                                    normalize_dataset_url_or_urls, path_exists)
+
+
+def test_normalize_strips_trailing_slash():
+    assert normalize_dataset_url('file:///tmp/x/') == 'file:///tmp/x'
+    assert normalize_dataset_url('/tmp/x') == '/tmp/x'
+
+
+def test_normalize_rejects_non_string():
+    with pytest.raises(ValueError):
+        normalize_dataset_url(123)
+
+
+def test_normalize_url_list():
+    assert normalize_dataset_url_or_urls(['/a/', '/b']) == ['/a', '/b']
+    with pytest.raises(ValueError):
+        normalize_dataset_url_or_urls([])
+
+
+def test_local_plain_path(tmp_path):
+    fs, path = get_filesystem_and_path_or_paths(str(tmp_path))
+    assert isinstance(fs, pafs.LocalFileSystem)
+    assert path == str(tmp_path)
+
+
+def test_local_file_scheme(tmp_path):
+    fs, path = get_filesystem_and_path_or_paths('file://' + str(tmp_path))
+    assert isinstance(fs, pafs.LocalFileSystem)
+    assert path == str(tmp_path)
+
+
+def test_url_list_same_fs(tmp_path):
+    fs, paths = get_filesystem_and_path_or_paths([str(tmp_path / 'a'), str(tmp_path / 'b')])
+    assert len(paths) == 2
+
+
+def test_url_list_mixed_schemes_raises(tmp_path):
+    with pytest.raises(ValueError):
+        get_filesystem_and_path_or_paths(['file:///a', 's3://bucket/b'])
+
+
+def test_path_exists_and_delete(tmp_path):
+    fs = pafs.LocalFileSystem()
+    target = tmp_path / 'f.txt'
+    target.write_text('hi')
+    assert path_exists(fs, str(target))
+    delete_path(fs, str(target))
+    assert not path_exists(fs, str(target))
+
+
+def test_filesystem_factory_picklable(tmp_path):
+    import pickle
+    factory = make_filesystem_factory(str(tmp_path))
+    restored = pickle.loads(pickle.dumps(factory))
+    assert isinstance(restored(), pafs.LocalFileSystem)

@@ -1,0 +1,27 @@
+"""Legacy petastorm pickle shim tests, incl. the restricted-unpickler security posture."""
+
+import pickle
+
+import pytest
+
+from petastorm_tpu.etl.legacy import depickle_legacy_unischema
+
+
+def test_malicious_builtin_callable_rejected():
+    """A crafted pickle reaching for builtins.eval (or any non-data builtin) must fail —
+    a blanket 'builtins' module allowlist would execute it."""
+    payload = b"cbuiltins\neval\n(S'1+1'\ntR."
+    with pytest.raises(pickle.UnpicklingError, match='forbidden'):
+        depickle_legacy_unischema(payload)
+
+
+def test_malicious_os_system_rejected():
+    payload = b"cos\nsystem\n(S'true'\ntR."
+    with pytest.raises(pickle.UnpicklingError, match='forbidden'):
+        depickle_legacy_unischema(payload)
+
+
+def test_non_unischema_payload_rejected():
+    blob = pickle.dumps({'not': 'a schema'})
+    with pytest.raises(pickle.UnpicklingError):
+        depickle_legacy_unischema(blob)

@@ -1,0 +1,62 @@
+"""TransformSpec / transform_schema tests (model: petastorm/tests/test_transform.py)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.transform import TransformSpec, transform_schema
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def _schema():
+    return Unischema('T', [
+        UnischemaField('a', np.int64, (), ScalarCodec(), False),
+        UnischemaField('b', np.float32, (4,), NdarrayCodec(), False),
+        UnischemaField('c', np.str_, (), ScalarCodec(), False),
+    ])
+
+
+def test_removed_and_selected_mutually_exclusive():
+    with pytest.raises(ValueError):
+        TransformSpec(removed_fields=['a'], selected_fields=['b'])
+
+
+def test_remove_field():
+    out = transform_schema(_schema(), TransformSpec(removed_fields=['b']))
+    assert list(out.fields) == ['a', 'c']
+
+
+def test_remove_unknown_raises():
+    with pytest.raises(ValueError):
+        transform_schema(_schema(), TransformSpec(removed_fields=['zz']))
+
+
+def test_edit_modifies_in_place():
+    spec = TransformSpec(edit_fields=[('b', np.float64, (2, 2), False)])
+    out = transform_schema(_schema(), spec)
+    assert list(out.fields) == ['a', 'b', 'c']
+    assert np.dtype(out.b.numpy_dtype) == np.float64
+    assert out.b.shape == (2, 2)
+
+
+def test_edit_adds_new_field():
+    spec = TransformSpec(edit_fields=[('new', np.int32, (), False)])
+    out = transform_schema(_schema(), spec)
+    assert list(out.fields) == ['a', 'b', 'c', 'new']
+
+
+def test_selected_fields_order():
+    spec = TransformSpec(selected_fields=['c', 'a'])
+    out = transform_schema(_schema(), spec)
+    assert list(out.fields) == ['c', 'a']
+
+
+def test_selected_unknown_raises():
+    with pytest.raises(ValueError):
+        transform_schema(_schema(), TransformSpec(selected_fields=['zz']))
+
+
+def test_edit_accepts_unischema_field():
+    new_field = UnischemaField('x', np.int8, (), None, True)
+    out = transform_schema(_schema(), TransformSpec(edit_fields=[new_field]))
+    assert out.x == new_field

@@ -1,0 +1,189 @@
+"""Unischema unit tests (model: petastorm/tests/test_unischema.py, 501 LoC)."""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import (Unischema, UnischemaField, decode_row,
+                                     dict_to_encoded_row, insert_explicit_nulls,
+                                     match_unischema_fields)
+
+
+def _schema():
+    return Unischema('Test', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('name', np.str_, (), ScalarCodec(), False),
+        UnischemaField('matrix', np.float32, (3, 2), NdarrayCodec(), False),
+        UnischemaField('opt', np.int32, (), ScalarCodec(), True),
+    ])
+
+
+class TestField:
+    def test_equality_value_based(self):
+        f1 = UnischemaField('a', np.int32, (), ScalarCodec(), False)
+        f2 = UnischemaField('a', np.int32, (), ScalarCodec(), False)
+        assert f1 == f2 and hash(f1) == hash(f2)
+
+    def test_inequality(self):
+        f1 = UnischemaField('a', np.int32, (), ScalarCodec(), False)
+        assert f1 != UnischemaField('b', np.int32, (), ScalarCodec(), False)
+        assert f1 != UnischemaField('a', np.int64, (), ScalarCodec(), False)
+        assert f1 != UnischemaField('a', np.int32, (2,), NdarrayCodec(), False)
+        assert f1 != UnischemaField('a', np.int32, (), ScalarCodec(), True)
+
+    def test_json_roundtrip(self):
+        f = UnischemaField('m', np.float32, (None, 4), NdarrayCodec(), True)
+        restored = UnischemaField.from_json_dict(f.to_json_dict())
+        assert restored == f
+
+    def test_json_roundtrip_decimal(self):
+        f = UnischemaField('d', Decimal, (), ScalarCodec(), False)
+        assert UnischemaField.from_json_dict(f.to_json_dict()) == f
+
+    def test_shape_dtype_struct(self):
+        f = UnischemaField('m', np.float32, (3, 2), NdarrayCodec(), False)
+        sds = f.shape_dtype_struct(batch_dims=(8,))
+        assert sds.shape == (8, 3, 2)
+        assert sds.dtype == np.float32
+
+    def test_shape_dtype_struct_rejects_ragged(self):
+        f = UnischemaField('m', np.float32, (None,), NdarrayCodec(), False)
+        with pytest.raises(ValueError):
+            f.shape_dtype_struct()
+
+
+class TestSchema:
+    def test_field_order_preserved(self):
+        schema = _schema()
+        assert list(schema.fields) == ['id', 'name', 'matrix', 'opt']
+
+    def test_attribute_access(self):
+        schema = _schema()
+        assert schema.id.name == 'id'
+        assert schema.matrix.shape == (3, 2)
+
+    def test_duplicate_field_raises(self):
+        with pytest.raises(ValueError):
+            Unischema('S', [UnischemaField('a', np.int32, (), ScalarCodec(), False),
+                            UnischemaField('a', np.int64, (), ScalarCodec(), False)])
+
+    def test_view_by_name(self):
+        view = _schema().create_schema_view(['id', 'name'])
+        assert list(view.fields) == ['id', 'name']
+
+    def test_view_by_regex(self):
+        view = _schema().create_schema_view(['.*a.*'])
+        assert list(view.fields) == ['name', 'matrix']
+
+    def test_view_by_field_instance(self):
+        schema = _schema()
+        view = schema.create_schema_view([schema.id])
+        assert list(view.fields) == ['id']
+
+    def test_view_no_match_raises(self):
+        with pytest.raises(ValueError):
+            _schema().create_schema_view(['nomatch'])
+
+    def test_view_field_not_member_raises(self):
+        other = UnischemaField('zzz', np.int32, (), ScalarCodec(), False)
+        with pytest.raises(ValueError):
+            _schema().create_schema_view([other])
+
+    def test_namedtuple_cached_identity(self):
+        s1, s2 = _schema(), _schema()
+        assert s1.namedtuple is s2.namedtuple
+
+    def test_make_namedtuple(self):
+        schema = _schema()
+        row = schema.make_namedtuple(id=1, name='a', matrix=None, opt=None)
+        assert row.id == 1 and row.name == 'a'
+
+    def test_json_roundtrip(self):
+        schema = _schema()
+        restored = Unischema.from_json_dict(schema.to_json_dict())
+        assert restored == schema
+
+    def test_arrow_schema_render(self):
+        arrow = _schema().as_arrow_schema()
+        assert arrow.field('id').type == pa.int64()
+        assert arrow.field('matrix').type == pa.binary()
+        assert arrow.field('opt').nullable
+
+
+class TestMatchFields:
+    def test_fullmatch_semantics(self):
+        schema = _schema()
+        # 'id' must not prefix-match 'idx'-like names; 'na' must not match 'name'
+        assert [f.name for f in match_unischema_fields(schema, ['na'])] == []
+        assert [f.name for f in match_unischema_fields(schema, ['name'])] == ['name']
+        assert {f.name for f in match_unischema_fields(schema, ['id', 'opt'])} == {'id', 'opt'}
+
+    def test_empty(self):
+        assert match_unischema_fields(_schema(), []) == []
+
+
+class TestEncodeDecode:
+    def test_roundtrip(self):
+        schema = _schema()
+        matrix = np.random.rand(3, 2).astype(np.float32)
+        row = {'id': 7, 'name': 'seven', 'matrix': matrix, 'opt': 3}
+        encoded = dict_to_encoded_row(schema, row)
+        assert isinstance(encoded['matrix'], bytes)
+        decoded = decode_row(encoded, schema)
+        assert decoded['id'] == 7
+        np.testing.assert_array_equal(decoded['matrix'], matrix)
+        assert decoded['opt'] == 3
+
+    def test_nullable_missing_becomes_none(self):
+        schema = _schema()
+        encoded = dict_to_encoded_row(schema, {'id': 1, 'name': 'x',
+                                               'matrix': np.zeros((3, 2), np.float32)})
+        assert encoded['opt'] is None
+
+    def test_missing_required_raises(self):
+        with pytest.raises(ValueError):
+            dict_to_encoded_row(_schema(), {'id': 1})
+
+    def test_unknown_field_raises(self):
+        with pytest.raises(ValueError, match='not part of schema'):
+            dict_to_encoded_row(_schema(), {'id': 1, 'bogus': 2, 'name': 'x',
+                                            'matrix': np.zeros((3, 2), np.float32)})
+
+    def test_insert_explicit_nulls(self):
+        schema = _schema()
+        row = {'id': 1, 'name': 'x', 'matrix': 'm'}
+        out = insert_explicit_nulls(schema, dict(row))
+        assert out['opt'] is None
+
+
+class TestArrowInference:
+    def test_infer_scalars_and_lists(self):
+        arrow_schema = pa.schema([
+            pa.field('i', pa.int32()),
+            pa.field('f', pa.float64()),
+            pa.field('s', pa.string()),
+            pa.field('v', pa.list_(pa.float32())),
+            pa.field('d', pa.decimal128(10, 2)),
+        ])
+        schema = Unischema.from_arrow_schema(arrow_schema)
+        assert np.dtype(schema.i.numpy_dtype) == np.int32
+        assert schema.v.shape == (None,)
+        assert schema.d.numpy_dtype is Decimal
+        assert schema.s.numpy_dtype == np.dtype('str_')
+
+    def test_unsupported_skipped_with_warning(self):
+        arrow_schema = pa.schema([
+            pa.field('ok', pa.int32()),
+            pa.field('bad', pa.list_(pa.list_(pa.int32()))),
+        ])
+        with pytest.warns(UserWarning):
+            schema = Unischema.from_arrow_schema(arrow_schema)
+        assert list(schema.fields) == ['ok']
+
+    def test_unsupported_raises_when_strict(self):
+        arrow_schema = pa.schema([pa.field('bad', pa.list_(pa.list_(pa.int32())))])
+        with pytest.raises(ValueError):
+            Unischema.from_arrow_schema(arrow_schema, omit_unsupported_fields=False)
