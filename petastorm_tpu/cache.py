@@ -1,0 +1,151 @@
+"""Rowgroup cache (reference: petastorm/cache.py:21-39, petastorm/local_disk_cache.py:23-66).
+
+The reference delegates to the ``diskcache`` package; this is a self-contained sharded
+disk cache with atomic writes and size-capped LRU eviction (by file mtime), so repeated
+epochs over remote storage hit local disk.
+"""
+
+import hashlib
+import os
+import pickle
+import tempfile
+import threading
+
+MB = 1 << 20
+
+
+class CacheBase(object):
+    def get(self, key, fill_cache_func):
+        """Return the cached value for ``key``, calling ``fill_cache_func()`` and storing
+        its result on a miss (reference: petastorm/cache.py:24-32)."""
+        raise NotImplementedError()
+
+    def cleanup(self):
+        """Remove cache resources (best effort)."""
+
+
+class NullCache(CacheBase):
+    """Pass-through: always calls the fill function (reference: petastorm/cache.py:35-39)."""
+
+    def get(self, key, fill_cache_func):
+        return fill_cache_func()
+
+
+class LocalDiskCache(CacheBase):
+    """File-per-key cache under ``path``, sharded into 256 subdirectories, bounded by
+    ``size_limit_bytes`` with mtime-LRU eviction (reference: local_disk_cache.py:23-66).
+
+    :param path: cache root directory (created if absent)
+    :param size_limit_bytes: max total bytes before eviction kicks in
+    :param expected_row_size_bytes: sanity check — the limit must hold many rows
+    :param cleanup: remove the whole cache directory on ``cleanup()``
+    """
+
+    def __init__(self, path, size_limit_bytes, expected_row_size_bytes=0, cleanup=False,
+                 shards=None):
+        if expected_row_size_bytes and size_limit_bytes < 100 * expected_row_size_bytes:
+            raise ValueError('Cache size_limit_bytes={} is too small for rows of ~{} bytes'
+                             .format(size_limit_bytes, expected_row_size_bytes))
+        self._path = path
+        self._size_limit_bytes = size_limit_bytes
+        self._cleanup = cleanup
+        self._lock = threading.Lock()
+        os.makedirs(path, exist_ok=True)
+        # Approximate running byte total: seeded from one scan, bumped per store; the
+        # expensive full rescan happens only when this crosses the limit.
+        self._approx_bytes = None
+
+    def __getstate__(self):
+        # Shipped to process-pool workers; the lock is per-process state.
+        state = self.__dict__.copy()
+        del state['_lock']
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def _key_path(self, key):
+        digest = hashlib.sha1(str(key).encode('utf-8')).hexdigest()
+        return os.path.join(self._path, digest[:2], digest + '.pkl')
+
+    def get(self, key, fill_cache_func):
+        file_path = self._key_path(key)
+        try:
+            with open(file_path, 'rb') as f:
+                value = pickle.load(f)
+            # touch for LRU
+            os.utime(file_path, None)
+            return value
+        except (OSError, pickle.UnpicklingError, EOFError):
+            pass
+        value = fill_cache_func()
+        self._store(file_path, value)
+        return value
+
+    def _store(self, file_path, value):
+        os.makedirs(os.path.dirname(file_path), exist_ok=True)
+        blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        if len(blob) > self._size_limit_bytes:
+            return  # single value larger than the cache: do not thrash
+        fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(file_path))
+        try:
+            with os.fdopen(fd, 'wb') as f:
+                f.write(blob)
+            os.replace(tmp_path, file_path)
+        except OSError:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+            raise
+        with self._lock:
+            if self._approx_bytes is None:
+                self._approx_bytes = sum(size for _, size, _ in self._iter_entries())
+            else:
+                self._approx_bytes += len(blob)
+            over_limit = self._approx_bytes > self._size_limit_bytes
+        if over_limit:
+            self._maybe_evict()
+
+    def _iter_entries(self):
+        for shard in os.listdir(self._path):
+            shard_path = os.path.join(self._path, shard)
+            if not os.path.isdir(shard_path):
+                continue
+            for name in os.listdir(shard_path):
+                if not name.endswith('.pkl'):
+                    continue  # skip other writers' in-progress mkstemp files
+                full = os.path.join(shard_path, name)
+                try:
+                    stat = os.stat(full)
+                except OSError:
+                    continue
+                yield full, stat.st_size, stat.st_mtime
+
+    def _maybe_evict(self):
+        with self._lock:
+            entries = list(self._iter_entries())
+            total = sum(size for _, size, _ in entries)
+            if total > self._size_limit_bytes:
+                # Evict least-recently-touched until under 90% of the limit.
+                entries.sort(key=lambda e: e[2])
+                target = int(self._size_limit_bytes * 0.9)
+                for full, size, _ in entries:
+                    if total <= target:
+                        break
+                    try:
+                        os.unlink(full)
+                        total -= size
+                    except OSError:
+                        continue
+            self._approx_bytes = total
+
+    @property
+    def size(self):
+        return sum(size for _, size, _ in self._iter_entries())
+
+    def cleanup(self):
+        if self._cleanup:
+            import shutil
+            shutil.rmtree(self._path, ignore_errors=True)

@@ -1,0 +1,177 @@
+"""NGram: sliding sequence windows over timestamp-sorted rows — the reference's
+long-context/sequence-construction feature (reference: petastorm/ngram.py:20-339;
+behavior spec in its docstring :20-100).
+
+Spec: ``fields`` maps timestep offsets to per-timestep field subsets (fields or regexes);
+``delta_threshold`` bounds the timestamp gap between *consecutive* timesteps;
+``timestamp_overlap=False`` forbids emitted windows from overlapping in timestamp range.
+Windows are formed inside one rowgroup (the reference's documented caveat — ngram.py:85-91:
+rowgroup size bounds max sequence length; make rowgroups >= window length).
+
+TPU-first extension: :meth:`form_ngram_columnar` works directly on columnar batches and
+returns gather indices, so the device layer can emit sequence batches without building
+row dicts.
+"""
+
+import re
+
+import numpy as np
+
+from petastorm_tpu.unischema import Unischema, UnischemaField, match_unischema_fields
+
+
+class NGram(object):
+    def __init__(self, fields, delta_threshold, timestamp_field, timestamp_overlap=True):
+        """
+        :param fields: dict {offset(int): list of UnischemaField or regex str}
+        :param delta_threshold: max allowed timestamp delta between consecutive timesteps
+        :param timestamp_field: UnischemaField (or name) ordering the rows
+        :param timestamp_overlap: when False, consecutive emitted windows must not overlap
+            in timestamp range (reference: ngram.py:102-125)
+        """
+        if not isinstance(fields, dict) or not fields:
+            raise ValueError('fields must be a non-empty dict of {offset: [fields]}')
+        if not all(isinstance(key, int) for key in fields):
+            raise ValueError('field keys must be integers (timestep offsets)')
+        self._fields = {key: list(value) for key, value in sorted(fields.items())}
+        self._delta_threshold = delta_threshold
+        self._timestamp_field = timestamp_field
+        self.timestamp_overlap = timestamp_overlap
+        self._resolved = all(isinstance(f, UnischemaField)
+                             for flist in self._fields.values() for f in flist)
+
+    @property
+    def length(self):
+        """Window span: max offset - min offset + 1 (reference: ngram.py:127-133)."""
+        keys = list(self._fields.keys())
+        return max(keys) - min(keys) + 1
+
+    @property
+    def fields(self):
+        return self._fields
+
+    @property
+    def delta_threshold(self):
+        return self._delta_threshold
+
+    @property
+    def timestamp_field_name(self):
+        if isinstance(self._timestamp_field, UnischemaField):
+            return self._timestamp_field.name
+        return self._timestamp_field
+
+    # -------------------------------------------------------------- resolution
+
+    def resolve_regex_field_names(self, schema):
+        """Expand any regex entries against the schema (reference: ngram.py:195-203)."""
+        for key, field_list in self._fields.items():
+            resolved = []
+            for item in field_list:
+                if isinstance(item, UnischemaField):
+                    resolved.append(item)
+                elif isinstance(item, str):
+                    matched = match_unischema_fields(schema, [item])
+                    if not matched:
+                        raise ValueError('NGram pattern {!r} matched no fields'.format(item))
+                    resolved.extend(matched)
+                else:
+                    raise ValueError('NGram fields must be UnischemaFields or regex '
+                                     'strings, got {!r}'.format(item))
+            self._fields[key] = resolved
+        self._resolved = True
+
+    def get_field_names_at_timestep(self, key):
+        return [f.name for f in self._fields.get(key, [])]
+
+    def get_field_names_at_all_timesteps(self):
+        names = []
+        for key in self._fields:
+            for name in self.get_field_names_at_timestep(key):
+                if name not in names:
+                    names.append(name)
+        ts_name = self.timestamp_field_name
+        if ts_name not in names:
+            names.append(ts_name)
+        return names
+
+    def get_schema_at_timestep(self, schema, key):
+        """Per-timestep schema view (reference: ngram.py:215-223)."""
+        names = [n for n in self.get_field_names_at_timestep(key) if n in schema.fields]
+        return schema.create_schema_view([re.escape(n) for n in names])
+
+    # -------------------------------------------------------------- formation
+
+    def form_ngram_columnar(self, timestamps):
+        """Compute window start indices over a timestamp vector (rows of ONE rowgroup,
+        sorted ascending). Returns an array of starts; window i spans
+        ``starts[i] : starts[i] + length``. Columnar analog of reference form_ngram
+        (ngram.py:225-270)."""
+        timestamps = np.asarray(timestamps)
+        n = len(timestamps)
+        length = self.length
+        if n < length:
+            return np.empty(0, dtype=np.int64)
+        if np.any(timestamps[1:] < timestamps[:-1]):
+            raise NotImplementedError(
+                'NGram assumes data sorted by {!r}, which is not the case'
+                .format(self.timestamp_field_name))
+        starts = []
+        prev_end_ts = None
+        for start in range(n - length + 1):
+            window_ts = timestamps[start:start + length]
+            if not self.timestamp_overlap and prev_end_ts is not None \
+                    and window_ts[0] <= prev_end_ts:
+                continue
+            if self._pass_threshold(window_ts):
+                starts.append(start)
+                if not self.timestamp_overlap:
+                    prev_end_ts = window_ts[-1]
+        return np.asarray(starts, dtype=np.int64)
+
+    def _pass_threshold(self, window_ts):
+        """Every consecutive delta must be <= delta_threshold (reference: ngram.py:205-213;
+        its worked example skips a delta of 5 against threshold 4)."""
+        if len(window_ts) <= 1:
+            return True
+        return bool(np.all(np.diff(window_ts) <= self._delta_threshold))
+
+    def form_ngram(self, rows):
+        """Row-dict formation: list of {offset: row_dict-subset} (reference semantics)."""
+        if not rows:
+            return []
+        ts_name = self.timestamp_field_name
+        timestamps = np.asarray([row[ts_name] for row in rows])
+        starts = self.form_ngram_columnar(timestamps)
+        base_key = min(self._fields.keys())
+        result = []
+        for start in starts:
+            window = {}
+            for position in range(self.length):
+                key = base_key + position
+                if key not in self._fields:
+                    continue
+                row = rows[start + position]
+                wanted = self.get_field_names_at_timestep(key)
+                window[key] = {k: row[k] for k in row if k in wanted}
+            result.append(window)
+        return result
+
+    def make_namedtuples(self, window, schema=None):
+        """Convert {offset: row_dict} into {offset: namedtuple} (reference:
+        ngram.py:272-297)."""
+        result = {}
+        for key, row in window.items():
+            names = sorted(row.keys())
+            cls = _timestep_namedtuple(tuple(names))
+            result[key] = cls(**row)
+        return result
+
+
+_timestep_cache = {}
+
+
+def _timestep_namedtuple(names):
+    if names not in _timestep_cache:
+        from collections import namedtuple
+        _timestep_cache[names] = namedtuple('NGramTimestep', names)
+    return _timestep_cache[names]

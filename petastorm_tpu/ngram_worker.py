@@ -1,0 +1,52 @@
+"""NGram piece processing inside the rowgroup worker (reference:
+petastorm/py_dict_reader_worker.py:179-180,271-313).
+
+One ventilated piece = one rowgroup; windows are formed within it (ngram.py:85-91 caveat:
+rowgroup size bounds max window length). Shuffle-row-drop partitions receive ``length-1``
+carry-over rows from the next partition so windows at the partition boundary survive
+(reference: py_dict_reader_worker.py:299-304).
+"""
+
+import numpy as np
+
+
+def process_ngram_piece(worker, piece_index, fragment_path, row_group_id, partition_keys,
+                        worker_predicate, shuffle_row_drop_partition):
+    from petastorm_tpu.reader_worker import _take
+    setup = worker._setup
+    ngram = setup.ngram
+    if worker_predicate is not None:
+        raise NotImplementedError('Predicates are not supported together with NGram '
+                                  '(reference semantics: reader.py:430-434)')
+
+    def load_windows():
+        fragment = worker._make_fragment(fragment_path, row_group_id)
+        table = fragment.to_table(columns=worker._storage_columns(setup.fields_to_read))
+        columns = worker._decode_table(table, partition_keys, setup.fields_to_read)
+        num_rows = table.num_rows
+
+        part_index, num_parts = shuffle_row_drop_partition
+        if num_parts > 1 and num_rows > 0:
+            partition_indexes = np.floor(
+                np.arange(num_rows) / (float(num_rows) / min(num_rows, num_parts)))
+            # Carry over length-1 rows from the next partition so boundary windows form
+            # (reference: py_dict_reader_worker.py:299-304).
+            next_part = np.nonzero(partition_indexes >= part_index + 1)[0]
+            if next_part.size:
+                partition_indexes[next_part[:ngram.length - 1]] = part_index
+            selected = np.nonzero(partition_indexes == part_index)[0]
+            columns = {name: _take(col, selected) for name, col in columns.items()}
+            num_rows = len(selected)
+
+        rows = [{name: col[i] for name, col in columns.items()} for i in range(num_rows)]
+        return ngram.form_ngram(rows)
+
+    cache_key = 'ngram:{}:{}:{}:{}'.format(setup.dataset_token, fragment_path,
+                                           row_group_id, shuffle_row_drop_partition)
+    windows = setup.cache.get(cache_key, load_windows)
+
+    if setup.shuffle_rows and windows:
+        seed = None if setup.seed is None else (setup.seed + piece_index) % (2 ** 31)
+        order = np.random.RandomState(seed).permutation(len(windows))
+        windows = [windows[i] for i in order]
+    return windows

@@ -1,0 +1,402 @@
+"""Reader factories and the Reader runtime (reference: petastorm/reader.py).
+
+``make_reader`` reads petastorm_tpu (or petastorm) datasets row-at-a-time with codec
+decode; ``make_batch_reader`` reads any Parquet store columnar-batch-at-a-time. Both drive
+the same columnar worker (petastorm_tpu/reader_worker.py) over a ventilated rowgroup
+schedule with bounded in-flight work.
+"""
+
+import logging
+import warnings
+
+import numpy as np
+
+from petastorm_tpu.cache import LocalDiskCache, NullCache
+from petastorm_tpu.errors import MetadataError, NoDataAvailableError
+from petastorm_tpu.etl import dataset_metadata
+from petastorm_tpu.fs_utils import make_filesystem_factory, normalize_dataset_url_or_urls
+from petastorm_tpu.reader_worker import RowGroupWorker, WorkerSetup
+from petastorm_tpu.unischema import Unischema, match_unischema_fields
+from petastorm_tpu.workers import EmptyResultError
+from petastorm_tpu.workers.dummy_pool import DummyPool
+from petastorm_tpu.workers.thread_pool import ThreadPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+logger = logging.getLogger(__name__)
+
+#: extra rowgroups kept in flight beyond the worker count (reference: reader.py:45-47)
+_VENTILATE_EXTRA_ROWGROUPS = 2
+
+
+def _make_pool(reader_pool_type, workers_count, results_queue_size):
+    if reader_pool_type == 'thread':
+        return ThreadPool(workers_count, results_queue_size)
+    if reader_pool_type == 'process':
+        from petastorm_tpu.workers.process_pool import ProcessPool
+        return ProcessPool(workers_count, results_queue_size)
+    if reader_pool_type == 'dummy':
+        return DummyPool()
+    raise ValueError('Unknown reader_pool_type {!r} (expected thread/process/dummy)'
+                     .format(reader_pool_type))
+
+
+def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
+                cache_extra_settings):
+    if cache_type in (None, 'null'):
+        return NullCache()
+    if cache_type == 'local-disk':
+        return LocalDiskCache(cache_location, cache_size_limit, cache_row_size_estimate or 0,
+                              **(cache_extra_settings or {}))
+    raise ValueError('Unknown cache_type {!r} (expected null/local-disk)'.format(cache_type))
+
+
+def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
+                workers_count=10, results_queue_size=50, seed=None, shuffle_rows=False,
+                shuffle_row_groups=True, shuffle_row_drop_partitions=1, predicate=None,
+                rowgroup_selector=None, num_epochs=1, cur_shard=None, shard_count=None,
+                shard_seed=None, cache_type='null', cache_location=None,
+                cache_size_limit=None, cache_row_size_estimate=None,
+                cache_extra_settings=None, transform_spec=None, storage_options=None,
+                filesystem=None):
+    """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
+    rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
+    petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
+    or an :class:`~petastorm_tpu.ngram.NGram` for sequence windows."""
+    dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
+    handle = dataset_metadata.open_dataset(dataset_url_or_urls,
+                                           storage_options=storage_options,
+                                           filesystem=filesystem)
+    try:
+        schema = dataset_metadata.get_schema(handle)
+    except MetadataError:
+        raise RuntimeError(
+            'Dataset at {!r} has no Unischema metadata. Use make_batch_reader for plain '
+            'Parquet stores.'.format(dataset_url_or_urls))
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate, cache_extra_settings)
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+    return Reader(dataset_url_or_urls, handle=handle, schema=schema,
+                  schema_fields=schema_fields,
+                  reader_pool=pool, seed=seed, shuffle_rows=shuffle_rows,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  predicate=predicate, rowgroup_selector=rowgroup_selector,
+                  num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
+                  shard_seed=shard_seed, cache=cache, transform_spec=transform_spec,
+                  is_batched_reader=False, decode=True,
+                  storage_options=storage_options, filesystem=filesystem)
+
+
+def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
+                      workers_count=10, results_queue_size=50, seed=None,
+                      shuffle_rows=False, shuffle_row_groups=True,
+                      shuffle_row_drop_partitions=1, predicate=None, num_epochs=1,
+                      cur_shard=None, shard_count=None, shard_seed=None, cache_type='null',
+                      cache_location=None, cache_size_limit=None,
+                      cache_row_size_estimate=None, cache_extra_settings=None,
+                      transform_spec=None, storage_options=None, filesystem=None):
+    """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
+    namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
+    """
+    dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
+    handle = dataset_metadata.open_dataset(dataset_url_or_urls,
+                                           storage_options=storage_options,
+                                           filesystem=filesystem)
+    try:
+        dataset_metadata.get_schema(handle)
+        warnings.warn('This store was written with a Unischema; use make_reader to get '
+                      'codec-decoded rows. make_batch_reader will emit raw stored values.')
+    except MetadataError:
+        pass
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate, cache_extra_settings)
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+    return Reader(dataset_url_or_urls, handle=handle, schema=None,
+                  schema_fields=schema_fields,
+                  reader_pool=pool, seed=seed, shuffle_rows=shuffle_rows,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  predicate=predicate, rowgroup_selector=None, num_epochs=num_epochs,
+                  cur_shard=cur_shard, shard_count=shard_count, shard_seed=shard_seed,
+                  cache=cache, transform_spec=transform_spec, is_batched_reader=True,
+                  decode=False, storage_options=storage_options, filesystem=filesystem)
+
+
+class Reader(object):
+    """The reader runtime: schedules rowgroups through a worker pool and iterates results
+    (reference: petastorm/reader.py:349-710)."""
+
+    def __init__(self, dataset_url_or_urls, handle=None, schema=None, schema_fields=None,
+                 reader_pool=None, seed=None, shuffle_rows=False, shuffle_row_groups=True,
+                 shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
+                 num_epochs=1, cur_shard=None, shard_count=None, shard_seed=None,
+                 cache=None, transform_spec=None, is_batched_reader=False, decode=True,
+                 storage_options=None, filesystem=None):
+        self.num_epochs = num_epochs
+        self.is_batched_reader = is_batched_reader
+        self.last_row_consumed = False
+        self._stopped = False
+
+        if (cur_shard is None) != (shard_count is None):
+            raise ValueError('cur_shard and shard_count must be specified together')
+        if cur_shard is not None and not 0 <= cur_shard < shard_count:
+            raise ValueError('cur_shard must be in [0, shard_count)')
+        if predicate is not None and schema_fields is not None and _is_ngram(schema_fields):
+            raise ValueError('Predicates are not supported together with NGram '
+                             '(reference semantics: reader.py:430-434)')
+
+        if handle is None:
+            handle = dataset_metadata.open_dataset(dataset_url_or_urls,
+                                                   storage_options=storage_options,
+                                                   filesystem=filesystem)
+        self._handle = handle
+        if schema is None:
+            schema = Unischema.from_arrow_schema(handle.arrow_dataset.schema)
+        self.schema = schema
+
+        ngram = None
+        if schema_fields is not None and _is_ngram(schema_fields):
+            ngram = schema_fields
+            if is_batched_reader:
+                raise ValueError('NGram is not supported by make_batch_reader '
+                                 '(reference semantics: arrow_reader_worker.py:107-108)')
+            ngram.resolve_regex_field_names(schema)
+            if not ngram.timestamp_overlap and shuffle_row_drop_partitions > 1:
+                raise NotImplementedError('timestamp_overlap=False is not supported with '
+                                          'shuffle_row_drop_partitions > 1 (reference: '
+                                          'reader.py:436-438)')
+            fields_to_read = list(ngram.get_field_names_at_all_timesteps())
+        elif schema_fields is not None:
+            view = schema.create_schema_view(schema_fields)
+            fields_to_read = list(view.fields)
+        else:
+            fields_to_read = list(schema.fields)
+        self.ngram = ngram
+
+        # Predicate fields must be loaded even if not in the requested view.
+        partition_names = set(handle.partition_field_names)
+        worker_predicate = predicate
+        main_process_predicate = None
+        if predicate is not None:
+            predicate_fields = set(predicate.get_fields())
+            if predicate_fields and predicate_fields <= partition_names:
+                # Pure partition-key predicate: prune rowgroups up front, no worker work
+                # (reference: reader.py:617-641).
+                main_process_predicate = predicate
+                worker_predicate = None
+            else:
+                missing = [f for f in predicate_fields if f not in fields_to_read]
+                fields_to_read += [f for f in missing if f in schema.fields
+                                   or f in partition_names]
+
+        url_for_factory = dataset_url_or_urls if not isinstance(dataset_url_or_urls, list) \
+            else dataset_url_or_urls[0]
+        filesystem_factory = (make_filesystem_factory(url_for_factory, storage_options)
+                              if filesystem is None else (lambda: filesystem))
+        worker_setup = WorkerSetup(
+            dataset_path_or_paths=handle.path_or_paths,
+            filesystem_factory=filesystem_factory,
+            schema=schema,
+            fields_to_read=fields_to_read,
+            transform_spec=transform_spec,
+            batched_output=is_batched_reader,
+            decode=decode,
+            ngram=ngram,
+            cache=cache,
+            shuffle_rows=shuffle_rows,
+            seed=seed,
+            partition_field_names=partition_names)
+        # Single source of truth for the emitted schema: the workers' own derivation.
+        self.result_schema = worker_setup.result_schema
+
+        # ------------------------------------------------ rowgroup schedule
+        row_groups = dataset_metadata.load_row_groups(handle)
+        if rowgroup_selector is not None:
+            # Selector piece indexes refer to the FULL load_row_groups enumeration (what
+            # build_rowgroup_index scanned) — apply before any other filtering.
+            from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
+            indexes = get_row_group_indexes(handle)
+            selected = rowgroup_selector.select_row_groups(indexes)
+            row_groups = [rg for i, rg in enumerate(row_groups) if i in selected]
+        if main_process_predicate is not None:
+            row_groups = [rg for rg in row_groups
+                          if _eval_partition_predicate(main_process_predicate, rg)]
+        self._row_groups = row_groups
+
+        shard_row_groups = self._partition_row_groups(row_groups, cur_shard, shard_count,
+                                                      shard_seed)
+        if not shard_row_groups:
+            raise NoDataAvailableError(
+                'No rowgroups available for shard {} of {} (dataset has {} rowgroups '
+                'after filtering). Use fewer shards or more files.'
+                .format(cur_shard, shard_count, len(row_groups)))
+        self._shard_row_groups = shard_row_groups
+
+        items = []
+        for piece_index, rg in enumerate(shard_row_groups):
+            for drop_part in range(shuffle_row_drop_partitions):
+                items.append({
+                    'piece_index': piece_index,
+                    'fragment_path': rg.fragment_path,
+                    'row_group_id': rg.row_group_id,
+                    'partition_keys': rg.partition_keys,
+                    'worker_predicate': worker_predicate,
+                    'shuffle_row_drop_partition': (drop_part, shuffle_row_drop_partitions),
+                })
+
+        max_in_flight = getattr(reader_pool, 'workers_count', 1) + _VENTILATE_EXTRA_ROWGROUPS
+        self._ventilator = ConcurrentVentilator(
+            ventilate_fn=reader_pool.ventilate,
+            items_to_ventilate=items,
+            iterations=num_epochs,
+            max_ventilation_queue_size=max_in_flight,
+            randomize_item_order=shuffle_row_groups,
+            random_seed=seed)
+        self._pool = reader_pool
+        self._pool.start(RowGroupWorker, worker_setup, self._ventilator)
+
+        if ngram is not None:
+            self._results_reader = _NGramResultsReader(self.result_schema, ngram)
+        elif is_batched_reader:
+            self._results_reader = _BatchResultsReader(self.result_schema)
+        else:
+            self._results_reader = _RowResultsReader(self.result_schema)
+
+    # --------------------------------------------------------------- sharding
+
+    @staticmethod
+    def _partition_row_groups(row_groups, cur_shard, shard_count, shard_seed):
+        """Deterministic modulo sharding, with optional seeded pre-shuffle so shards draw
+        from the whole dataset (reference: petastorm/reader.py:570-594)."""
+        if cur_shard is None:
+            return list(row_groups)
+        indexed = list(enumerate(row_groups))
+        if shard_seed is not None:
+            np.random.RandomState(shard_seed).shuffle(indexed)
+        return [rg for index, (orig, rg) in enumerate(indexed)
+                if index % shard_count == cur_shard]
+
+    # --------------------------------------------------------------- iterator
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stopped:
+            raise RuntimeError('Trying to read a sample from a stopped reader')
+        try:
+            result = self._results_reader.read_next(self._pool)
+            return result
+        except EmptyResultError:
+            self.last_row_consumed = True
+            raise StopIteration
+
+    next = __next__
+
+    def __len__(self):
+        """Total rows in this shard per epoch (reference: reader.py:492-494)."""
+        return sum(rg.row_group_num_rows for rg in self._shard_row_groups)
+
+    def reset(self):
+        """Re-ventilate for another ``num_epochs`` pass; only valid after full consumption
+        (reference: reader.py:496-520)."""
+        if not self.last_row_consumed:
+            raise NotImplementedError('Currently reset() can only be called after the '
+                                      'reader was fully consumed')
+        self._results_reader.reset()
+        self._ventilator.reset()
+        self.last_row_consumed = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def stop(self):
+        self._stopped = True
+        self._pool.stop()
+
+    def join(self):
+        self._pool.join()
+
+    def cleanup(self):
+        pass
+
+    @property
+    def diagnostics(self):
+        return self._pool.diagnostics
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()
+
+
+def _is_ngram(schema_fields):
+    from petastorm_tpu.ngram import NGram
+    return isinstance(schema_fields, NGram)
+
+
+def _eval_partition_predicate(predicate, row_group):
+    values = {name: value for name, value in row_group.partition_keys.items()}
+    return bool(predicate.do_include(values))
+
+
+# ---------------------------------------------------------------------------
+# Results-queue readers (reference: py_dict_reader_worker.py:66-99,
+# arrow_reader_worker.py:31-88)
+# ---------------------------------------------------------------------------
+
+class _RowResultsReader(object):
+    """Buffers a ColumnarBatch and pops one namedtuple per read (row-at-a-time API)."""
+
+    def __init__(self, result_schema):
+        self._schema = result_schema
+        self._batch = None
+        self._next_row = 0
+
+    def read_next(self, pool):
+        while self._batch is None or self._next_row >= self._batch.num_rows:
+            self._batch = pool.get_results()
+            self._next_row = 0
+        row = self._batch.row(self._next_row)
+        self._next_row += 1
+        return self._schema.make_namedtuple(**row)
+
+    def reset(self):
+        self._batch = None
+        self._next_row = 0
+
+
+class _BatchResultsReader(object):
+    """Emits one namedtuple-of-arrays per rowgroup batch."""
+
+    def __init__(self, result_schema):
+        self._schema = result_schema
+
+    def read_next(self, pool):
+        batch = pool.get_results()
+        return self._schema.make_namedtuple(**batch.columns)
+
+    def reset(self):
+        pass
+
+
+class _NGramResultsReader(object):
+    """Buffers formed ngram windows ({offset: row_dict}) and emits {offset: namedtuple}."""
+
+    def __init__(self, result_schema, ngram):
+        self._ngram = ngram
+        self._windows = []
+        self._next = 0
+
+    def read_next(self, pool):
+        while self._next >= len(self._windows):
+            self._windows = pool.get_results()
+            self._next = 0
+        window = self._windows[self._next]
+        self._next += 1
+        return self._ngram.make_namedtuples(window)
+
+    def reset(self):
+        self._windows = []
+        self._next = 0

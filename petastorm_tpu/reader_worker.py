@@ -1,0 +1,354 @@
+"""The rowgroup worker: loads one Parquet rowgroup, applies predicate/decode/shuffle/
+transform, and publishes a columnar batch.
+
+Re-design of the reference's two worker classes (petastorm/py_dict_reader_worker.py and
+petastorm/arrow_reader_worker.py) as ONE columnar pipeline: data stays as Arrow/numpy
+columns end-to-end (TPU-first — the device layer consumes host-contiguous arrays), and the
+row-dict path is a view over the columnar result produced by the results-queue reader.
+
+Pipeline per rowgroup (reference call stack: SURVEY.md §3.2):
+  load columns (two-phase when a predicate is present) -> decode codec columns ->
+  in-rowgroup seeded shuffle -> shuffle-row-drop partition slice -> TransformSpec ->
+  publish ColumnarBatch
+"""
+
+import hashlib
+import logging
+import re
+
+import numpy as np
+import pyarrow.dataset as pads
+
+from petastorm_tpu.cache import NullCache
+from petastorm_tpu.transform import transform_schema
+from petastorm_tpu.unischema import Unischema
+from petastorm_tpu.workers.worker_base import WorkerBase
+
+logger = logging.getLogger(__name__)
+
+
+class ColumnarBatch(object):
+    """Decoded columns of (a partition of) one rowgroup: ``{field_name: ndarray | list}``.
+    Arrays are ``(n,) + field.shape`` when shapes are uniform; ragged fields stay as lists
+    of per-row arrays."""
+
+    __slots__ = ('columns', 'num_rows')
+
+    def __init__(self, columns, num_rows):
+        self.columns = columns
+        self.num_rows = num_rows
+
+    def row(self, i):
+        return {name: col[i] for name, col in self.columns.items()}
+
+
+class WorkerSetup(object):
+    """Immutable per-reader configuration shipped to every worker."""
+
+    __slots__ = ('dataset_path_or_paths', 'filesystem_factory', 'schema', 'fields_to_read',
+                 'result_schema', 'transform_spec', 'batched_output', 'decode', 'ngram',
+                 'cache', 'shuffle_rows', 'seed', 'partition_field_names', 'dataset_token')
+
+    def __init__(self, dataset_path_or_paths, filesystem_factory, schema, fields_to_read,
+                 transform_spec=None, batched_output=False, decode=True, ngram=None,
+                 cache=None, shuffle_rows=False, seed=None, partition_field_names=()):
+        self.dataset_path_or_paths = dataset_path_or_paths
+        self.filesystem_factory = filesystem_factory
+        self.schema = schema
+        self.fields_to_read = list(fields_to_read)
+        self.transform_spec = transform_spec
+        self.batched_output = batched_output
+        self.decode = decode
+        self.ngram = ngram
+        self.cache = cache or NullCache()
+        self.shuffle_rows = shuffle_rows
+        self.seed = seed
+        self.partition_field_names = set(partition_field_names)
+        # Cache key token covers the dataset identity AND the read configuration: two
+        # readers with different column sets / decode modes sharing one cache_location
+        # must never serve each other's entries.
+        token_src = '{}|{}|{}|{}'.format(dataset_path_or_paths, sorted(self.fields_to_read),
+                                         decode, transform_spec is not None).encode('utf-8')
+        self.dataset_token = hashlib.md5(token_src).hexdigest()[:16]
+        read_view = schema.create_schema_view(
+            [re.escape(name) for name in self.fields_to_read]) \
+            if self.fields_to_read else schema
+        if transform_spec is not None:
+            self.result_schema = transform_schema(read_view, transform_spec)
+        else:
+            self.result_schema = read_view
+
+
+class RowGroupWorker(WorkerBase):
+    """Loads + processes one rowgroup per ventilated item (reference:
+    py_dict_reader_worker.py:102-313, arrow_reader_worker.py:91-337)."""
+
+    def __init__(self, worker_id, publish_func, args):
+        super().__init__(worker_id, publish_func, args)
+        self._setup = args
+        self._filesystem = None
+        self._parquet_format = pads.ParquetFileFormat()
+
+    def _fs(self):
+        if self._filesystem is None:
+            self._filesystem = self._setup.filesystem_factory()
+        return self._filesystem
+
+    def process(self, piece_index, fragment_path, row_group_id, partition_keys=None,
+                worker_predicate=None, shuffle_row_drop_partition=(0, 1)):
+        setup = self._setup
+        if setup.ngram is not None:
+            batch = self._process_ngram(piece_index, fragment_path, row_group_id,
+                                        partition_keys, worker_predicate,
+                                        shuffle_row_drop_partition)
+            if batch:
+                self.publish_func(batch)
+            return
+
+        predicate_token = _predicate_token(worker_predicate)
+        load = lambda: self._load_and_decode(fragment_path, row_group_id, partition_keys,  # noqa: E731
+                                             worker_predicate, shuffle_row_drop_partition)
+        if predicate_token is None:
+            # Unpicklable predicate: no stable cache identity exists — bypass the cache
+            # rather than risk serving rows filtered by a different predicate.
+            columns = load()
+        else:
+            cache_key = '{}:{}:{}:{}:{}'.format(
+                setup.dataset_token, fragment_path, row_group_id,
+                shuffle_row_drop_partition, predicate_token)
+            columns = setup.cache.get(cache_key, load)
+        num_rows = _columns_num_rows(columns)
+        if num_rows == 0:
+            return
+        columns = self._shuffle(columns, num_rows, piece_index)
+        columns, num_rows = self._apply_transform(columns, num_rows)
+        self.publish_func(ColumnarBatch(columns, num_rows))
+
+    # ------------------------------------------------------------------ load
+
+    def _make_fragment(self, fragment_path, row_group_id=None):
+        row_groups = None if row_group_id is None else [row_group_id]
+        return self._parquet_format.make_fragment(fragment_path, self._fs(),
+                                                  row_groups=row_groups)
+
+    def _storage_columns(self, field_names):
+        return [name for name in field_names
+                if name not in self._setup.partition_field_names]
+
+    def _load_and_decode(self, fragment_path, row_group_id, partition_keys,
+                         worker_predicate, shuffle_row_drop_partition):
+        setup = self._setup
+        all_fields = setup.fields_to_read
+        if worker_predicate is not None:
+            table, keep_indices = self._two_phase_load(fragment_path, row_group_id,
+                                                       partition_keys, worker_predicate,
+                                                       all_fields)
+        else:
+            fragment = self._make_fragment(fragment_path, row_group_id)
+            table = fragment.to_table(columns=self._storage_columns(all_fields))
+            keep_indices = None
+        num_rows = table.num_rows if keep_indices is None else len(keep_indices)
+
+        # shuffle-row-drop partition selection: deterministic equal split of the (post
+        # predicate) row indices; only the selected partition is materialized (reference:
+        # py_dict_reader_worker.py:290-306).
+        part_index, num_parts = shuffle_row_drop_partition
+        base_indices = np.arange(num_rows) if keep_indices is None else np.asarray(keep_indices)
+        if num_parts > 1:
+            selected = np.array_split(base_indices, num_parts)[part_index]
+        else:
+            selected = base_indices
+        if len(selected) != table.num_rows:
+            table = table.take(selected)
+
+        return self._decode_table(table, partition_keys, all_fields)
+
+    def _two_phase_load(self, fragment_path, row_group_id, partition_keys,
+                        worker_predicate, all_fields):
+        """Load predicate columns first, evaluate, then load remaining columns and filter
+        (reference: py_dict_reader_worker.py:201-269)."""
+        setup = self._setup
+        predicate_fields = sorted(worker_predicate.get_fields())
+        unknown = [f for f in predicate_fields
+                   if f not in setup.schema.fields and f not in setup.partition_field_names]
+        if unknown:
+            raise ValueError('Predicate references unknown fields {}'.format(unknown))
+        fragment = self._make_fragment(fragment_path, row_group_id)
+        predicate_table = fragment.to_table(columns=self._storage_columns(predicate_fields))
+        predicate_columns = self._decode_table(predicate_table, partition_keys,
+                                               predicate_fields)
+        mask = self._evaluate_predicate(worker_predicate, predicate_columns,
+                                        predicate_table.num_rows)
+        keep = np.nonzero(mask)[0]
+        if not len(keep):
+            # No survivors: build an empty table from the schema without reading data.
+            import pyarrow as pa
+            physical = fragment.physical_schema
+            names = self._storage_columns(all_fields)
+            empty = pa.table({name: pa.array([], type=physical.field(name).type)
+                              for name in names})
+            return empty, np.array([], dtype=np.int64)
+        # Re-read all needed columns (predicate columns included, so downstream sees one
+        # consistent table) and filter by surviving indices.
+        full_table = fragment.to_table(columns=self._storage_columns(all_fields))
+        return full_table, keep
+
+    def _evaluate_predicate(self, worker_predicate, predicate_columns, num_rows):
+        setup = self._setup
+        if setup.batched_output:
+            result = worker_predicate.do_include(
+                {k: np.asarray(v) for k, v in predicate_columns.items()})
+            mask = np.asarray(result)
+            if mask.shape != (num_rows,):
+                raise ValueError('Batched predicate must return a boolean mask of shape '
+                                 '({},); got {}'.format(num_rows, mask.shape))
+            return mask
+        mask = np.zeros(num_rows, dtype=bool)
+        for i in range(num_rows):
+            row = {k: v[i] for k, v in predicate_columns.items()}
+            mask[i] = bool(worker_predicate.do_include(row))
+        return mask
+
+    # ---------------------------------------------------------------- decode
+
+    def _decode_table(self, table, partition_keys, field_names):
+        """Arrow table -> {name: ndarray-or-list} of decoded values."""
+        setup = self._setup
+        partition_keys = partition_keys or {}
+        num_rows = table.num_rows
+        columns = {}
+        for name in field_names:
+            field = setup.schema.fields.get(name)
+            if name in setup.partition_field_names:
+                value = partition_keys.get(name)
+                columns[name] = self._partition_column(field, value, num_rows)
+                continue
+            arrow_col = table.column(name)
+            if field is not None and field.codec is not None and setup.decode:
+                values = arrow_col.to_pylist()
+                decoded = [None if v is None else field.codec.decode(field, v)
+                           for v in values]
+                columns[name] = _stack_if_uniform(decoded, field)
+            elif field is not None and field.shape != () and setup.decode:
+                values = arrow_col.to_pylist()
+                decoded = [None if v is None else np.asarray(v, dtype=field.numpy_dtype)
+                           for v in values]
+                columns[name] = _stack_if_uniform(decoded, field)
+            else:
+                columns[name] = _arrow_to_numpy(arrow_col)
+        return columns
+
+    @staticmethod
+    def _partition_column(field, value, num_rows):
+        if field is not None and np.dtype(field.numpy_dtype).kind not in ('U', 'S', 'O'):
+            value = np.dtype(field.numpy_dtype).type(value)
+            return np.full(num_rows, value)
+        return np.array([value] * num_rows, dtype=object)
+
+    # --------------------------------------------------------------- shuffle
+
+    def _shuffle(self, columns, num_rows, piece_index):
+        setup = self._setup
+        if not setup.shuffle_rows:
+            return columns
+        seed = None if setup.seed is None else (setup.seed + piece_index) % (2 ** 31)
+        permutation = np.random.RandomState(seed).permutation(num_rows)
+        return {name: _take(col, permutation) for name, col in columns.items()}
+
+    # ------------------------------------------------------------- transform
+
+    def _apply_transform(self, columns, num_rows):
+        setup = self._setup
+        spec = setup.transform_spec
+        if spec is None:
+            return columns, num_rows
+        if setup.batched_output:
+            import pandas as pd
+            frame = pd.DataFrame({name: list(col) if not isinstance(col, list) else col
+                                  for name, col in columns.items()})
+            if spec.func is not None:
+                frame = spec.func(frame)
+            out = {}
+            for name in setup.result_schema.fields:
+                field = setup.result_schema.fields[name]
+                values = list(frame[name])
+                out[name] = _stack_if_uniform(values, field)
+            return out, len(frame)
+        # Row path: func operates on one row dict at a time (reference:
+        # py_dict_reader_worker.py:40-54).
+        rows = [{name: col[i] for name, col in columns.items()} for i in range(num_rows)]
+        if spec.func is not None:
+            rows = [spec.func(row) for row in rows]
+        out = {}
+        for name in setup.result_schema.fields:
+            field = setup.result_schema.fields[name]
+            values = [row[name] for row in rows]
+            out[name] = _stack_if_uniform(values, field)
+        return out, len(rows)
+
+    # ----------------------------------------------------------------- ngram
+
+    def _process_ngram(self, piece_index, fragment_path, row_group_id, partition_keys,
+                       worker_predicate, shuffle_row_drop_partition):
+        from petastorm_tpu.ngram_worker import process_ngram_piece
+        return process_ngram_piece(self, piece_index, fragment_path, row_group_id,
+                                   partition_keys, worker_predicate,
+                                   shuffle_row_drop_partition)
+
+
+# ------------------------------------------------------------------ helpers
+
+def _predicate_token(worker_predicate):
+    """Stable cache token for a predicate; None when no stable identity exists (caller
+    must then bypass the cache)."""
+    if worker_predicate is None:
+        return 'nopred'
+    try:
+        import pickle
+        return hashlib.md5(pickle.dumps(worker_predicate)).hexdigest()[:12]
+    except Exception:
+        return None
+
+
+def _columns_num_rows(columns):
+    for col in columns.values():
+        return len(col)
+    return 0
+
+
+def _take(col, indices):
+    if isinstance(col, np.ndarray):
+        return col[indices]
+    return [col[i] for i in indices]
+
+
+def _stack_if_uniform(values, field):
+    """Stack per-row arrays into one (n,)+shape array when shapes are uniform and the
+    field declares no variable dims; otherwise keep a list (ragged)."""
+    if not values:
+        return np.empty((0,) + tuple(d or 0 for d in (field.shape if field else ())))
+    if field is not None and field.shape == ():
+        first = values[0]
+        if isinstance(first, (str, bytes)) or first is None:
+            return np.array(values, dtype=object)
+        return np.asarray(values)
+    if any(v is None for v in values):
+        return values
+    shapes = {np.asarray(v).shape for v in values}
+    if len(shapes) == 1:
+        return np.stack([np.asarray(v) for v in values])
+    return values
+
+
+def _arrow_to_numpy(arrow_col):
+    """Native column to numpy: scalars to typed arrays, strings to object arrays, lists to
+    lists of numpy arrays (reference: arrow_reader_worker.py:44-85)."""
+    import pyarrow.types as patypes
+    col_type = arrow_col.type
+    if patypes.is_list(col_type) or patypes.is_large_list(col_type):
+        return [None if v is None else np.asarray(v) for v in arrow_col.to_pylist()]
+    if (patypes.is_string(col_type) or patypes.is_large_string(col_type)
+            or patypes.is_binary(col_type) or patypes.is_large_binary(col_type)
+            or patypes.is_decimal(col_type)):
+        return np.array(arrow_col.to_pylist(), dtype=object)
+    return arrow_col.to_numpy(zero_copy_only=False)

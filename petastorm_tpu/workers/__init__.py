@@ -1,0 +1,17 @@
+"""Worker-pool runtime: ventilation, pools, and the worker protocol (reference:
+petastorm/workers_pool/)."""
+
+
+class EmptyResultError(Exception):
+    """Raised by a pool's ``get_results`` when all ventilated work completed and no more
+    results will arrive (reference: petastorm/workers_pool/__init__.py)."""
+
+
+class TimeoutWaitingForResultError(Exception):
+    """Raised when waiting on results times out."""
+
+
+class VentilatedItemProcessedMessage(object):
+    """Control message a worker publishes after fully processing one ventilated item —
+    drives the ventilator's bounded in-flight accounting (reference:
+    petastorm/workers_pool/__init__.py)."""

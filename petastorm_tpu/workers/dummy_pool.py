@@ -1,0 +1,58 @@
+"""Single-threaded pool for debugging/profiling: work executes lazily on the caller's
+thread inside ``get_results`` (reference: petastorm/workers_pool/dummy_pool.py:20-91)."""
+
+from collections import deque
+
+from petastorm_tpu.workers import EmptyResultError, VentilatedItemProcessedMessage
+
+
+class DummyPool(object):
+    def __init__(self, results_queue_size=None):
+        self._ventilator_queue = deque()
+        self._results = deque()
+        self._worker = None
+        self._ventilator = None
+        self.workers_count = 1
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        self._worker = worker_class(0, self._results.append, worker_args)
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def ventilate(self, **kwargs):
+        self._ventilator_queue.append(kwargs)
+
+    def get_results(self, timeout=None):
+        while True:
+            while self._results:
+                result = self._results.popleft()
+                if isinstance(result, VentilatedItemProcessedMessage):
+                    continue
+                return result
+            if self._ventilator_queue:
+                item = self._ventilator_queue.popleft()
+                self._worker.process(**item)
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                continue
+            if self._ventilator is not None and getattr(self._ventilator, 'error', None):
+                raise self._ventilator.error
+            if self._ventilator is None or self._ventilator.completed():
+                raise EmptyResultError()
+            # Ventilator thread may still be feeding; busy-wait briefly.
+            import time
+            time.sleep(0.005)
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        if self._worker is not None:
+            self._worker.shutdown()
+
+    def join(self):
+        pass
+
+    @property
+    def diagnostics(self):
+        return {'output_queue_size': len(self._results)}

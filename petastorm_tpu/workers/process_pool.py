@@ -1,0 +1,192 @@
+"""Process worker pool over ZeroMQ (reference: petastorm/workers_pool/process_pool.py:114-424).
+
+Socket topology (mirrors the reference's ASCII diagram, process_pool.py:52-74):
+
+    main PUSH (ventilation) ──> worker PULL
+    main PUB  (control)     ──> worker SUB      ('stop' broadcast)
+    main PULL (results)     <── worker PUSH     (handshake / result / done / error)
+
+Workers are spawned (never forked — fork breaks JVM/libhdfs state, reference
+exec_in_new_process.py:15-17) as fresh interpreters running
+``petastorm_tpu.workers.process_worker_main`` with a dill-serialized bootstrap file.
+Each worker runs a parent-watchdog thread and exits if the main process dies
+(reference: process_pool.py:320-327)."""
+
+import logging
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+
+from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
+                                   VentilatedItemProcessedMessage)
+
+logger = logging.getLogger(__name__)
+
+_WORKER_STARTUP_TIMEOUT_S = 30
+#: message kinds on the results channel
+MSG_STARTED, MSG_RESULT, MSG_DONE, MSG_ERROR = b'started', b'result', b'done', b'error'
+
+
+class WorkerTerminationError(Exception):
+    pass
+
+
+class ProcessPool(object):
+    def __init__(self, workers_count, results_queue_size=50, zmq_copy_buffers=True):
+        self._workers_count = workers_count
+        self.workers_count = workers_count
+        self._results_queue_size = results_queue_size
+        self._zmq_copy = zmq_copy_buffers
+        self._context = None
+        self._ventilator = None
+        self._processes = []
+        self._stopped = False
+        self._in_flight_done = 0
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        import zmq
+        self._context = zmq.Context()
+        self._vent_socket = self._context.socket(zmq.PUSH)
+        vent_port = self._vent_socket.bind_to_random_port('tcp://127.0.0.1')
+        self._control_socket = self._context.socket(zmq.PUB)
+        control_port = self._control_socket.bind_to_random_port('tcp://127.0.0.1')
+        self._results_socket = self._context.socket(zmq.PULL)
+        self._results_socket.set_hwm(self._results_queue_size)
+        results_port = self._results_socket.bind_to_random_port('tcp://127.0.0.1')
+
+        import dill
+        # Spawned interpreters must resolve petastorm_tpu itself (python -m resolves it at
+        # interpreter startup) AND user modules (transform fns, predicates) exactly like
+        # the parent: propagate the parent's sys.path via PYTHONPATH.
+        child_env = dict(os.environ)
+        parent_paths = [p for p in sys.path if p]
+        existing = child_env.get('PYTHONPATH')
+        child_env['PYTHONPATH'] = os.pathsep.join(
+            parent_paths + ([existing] if existing else []))
+        bootstrap = {
+            'worker_class': dill.dumps(worker_class),
+            'worker_args': dill.dumps(worker_args),
+            'vent_addr': 'tcp://127.0.0.1:{}'.format(vent_port),
+            'control_addr': 'tcp://127.0.0.1:{}'.format(control_port),
+            'results_addr': 'tcp://127.0.0.1:{}'.format(results_port),
+            'parent_pid': os.getpid(),
+        }
+        for worker_id in range(self._workers_count):
+            bootstrap['worker_id'] = worker_id
+            fd, path = tempfile.mkstemp(suffix='.petastorm-tpu-worker')
+            with os.fdopen(fd, 'wb') as f:
+                pickle.dump(bootstrap, f)
+            process = subprocess.Popen(
+                [sys.executable, '-m', 'petastorm_tpu.workers.process_worker_main', path],
+                env=child_env)
+            self._processes.append(process)
+
+        # Startup handshake (reference: process_pool.py:200-213).
+        deadline = time.time() + _WORKER_STARTUP_TIMEOUT_S
+        started = 0
+        poller = zmq.Poller()
+        poller.register(self._results_socket, zmq.POLLIN)
+        while started < self._workers_count:
+            if time.time() > deadline:
+                self.stop()
+                raise WorkerTerminationError(
+                    'Only {} of {} workers started within {}s'
+                    .format(started, self._workers_count, _WORKER_STARTUP_TIMEOUT_S))
+            if poller.poll(200):
+                kind, _ = self._recv()
+                if kind == MSG_STARTED:
+                    started += 1
+
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def _recv(self):
+        parts = self._results_socket.recv_multipart(copy=self._zmq_copy)
+        kind = bytes(memoryview(parts[0]))
+        payload = parts[1] if len(parts) > 1 else None
+        return kind, payload
+
+    def ventilate(self, **kwargs):
+        import zmq
+        if self._stopped:
+            raise WorkerTerminationError('Pool is stopped')
+        # Non-blocking with retries so a dead pool raises instead of hanging
+        # (reference: process_pool.py:215-224).
+        deadline = time.time() + 60
+        while True:
+            try:
+                self._vent_socket.send_pyobj(kwargs, flags=zmq.NOBLOCK)
+                return
+            except zmq.Again:
+                if self._stopped or time.time() > deadline:
+                    raise WorkerTerminationError('Could not ventilate: workers not '
+                                                 'consuming (stopped or dead)')
+                if any(p.poll() is not None for p in self._processes):
+                    raise WorkerTerminationError('A worker process died unexpectedly')
+                time.sleep(0.05)
+
+    def get_results(self, timeout=None):
+        import zmq
+        poller = zmq.Poller()
+        poller.register(self._results_socket, zmq.POLLIN)
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            if not poller.poll(100):
+                if self._ventilator is not None and getattr(self._ventilator, 'error', None):
+                    self.stop()
+                    raise self._ventilator.error
+                if self._ventilator is not None and self._ventilator.completed():
+                    raise EmptyResultError()
+                if deadline is not None and time.time() > deadline:
+                    raise TimeoutWaitingForResultError()
+                if any(p.poll() not in (None, 0) for p in self._processes):
+                    self.stop()
+                    raise WorkerTerminationError('A worker process died unexpectedly')
+                continue
+            kind, payload = self._recv()
+            if kind == MSG_DONE:
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                continue
+            if kind == MSG_ERROR:
+                exc, tb = pickle.loads(bytes(memoryview(payload)))
+                logger.error('Worker failure re-raised in consumer:\n%s', tb)
+                self.stop()
+                raise exc
+            if kind == MSG_RESULT:
+                return pickle.loads(bytes(memoryview(payload)))
+            if kind == MSG_STARTED:  # late joiner after restart — ignore
+                continue
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        try:
+            self._control_socket.send(b'stop')
+        except Exception:
+            pass
+
+    def join(self):
+        deadline = time.time() + 10
+        for process in self._processes:
+            remaining = max(0.1, deadline - time.time())
+            try:
+                process.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                process.kill()
+        if self._context is not None:
+            for sock in (self._vent_socket, self._control_socket, self._results_socket):
+                sock.close(linger=0)
+            self._context.term()
+            self._context = None
+
+    @property
+    def diagnostics(self):
+        return {'workers_alive': sum(1 for p in self._processes if p.poll() is None)}

@@ -1,0 +1,79 @@
+"""Entry point executed inside each spawned pool worker process (reference:
+petastorm/workers_pool/process_pool.py:330-413 _worker_bootstrap +
+exec_in_new_process.py/_entrypoint.py)."""
+
+import os
+import pickle
+import sys
+import threading
+import time
+import traceback
+
+
+def _watch_parent(parent_pid):
+    """Exit if the main process dies, so no orphan workers linger (reference:
+    process_pool.py:320-327)."""
+    import psutil
+    while True:
+        if not psutil.pid_exists(parent_pid):
+            os._exit(0)
+        time.sleep(1)
+
+
+def main(bootstrap_path):
+    with open(bootstrap_path, 'rb') as f:
+        bootstrap = pickle.load(f)
+    try:
+        os.unlink(bootstrap_path)
+    except OSError:
+        pass
+
+    import dill
+    import zmq
+
+    worker_class = dill.loads(bootstrap['worker_class'])
+    worker_args = dill.loads(bootstrap['worker_args'])
+    worker_id = bootstrap['worker_id']
+
+    threading.Thread(target=_watch_parent, args=(bootstrap['parent_pid'],),
+                     daemon=True).start()
+
+    context = zmq.Context()
+    vent_socket = context.socket(zmq.PULL)
+    vent_socket.connect(bootstrap['vent_addr'])
+    control_socket = context.socket(zmq.SUB)
+    control_socket.connect(bootstrap['control_addr'])
+    control_socket.setsockopt(zmq.SUBSCRIBE, b'')
+    results_socket = context.socket(zmq.PUSH)
+    results_socket.connect(bootstrap['results_addr'])
+
+    def publish(result):
+        results_socket.send_multipart([b'result', pickle.dumps(result, protocol=5)])
+
+    worker = worker_class(worker_id, publish, worker_args)
+    results_socket.send_multipart([b'started'])
+
+    poller = zmq.Poller()
+    poller.register(vent_socket, zmq.POLLIN)
+    poller.register(control_socket, zmq.POLLIN)
+    while True:
+        events = dict(poller.poll(1000))
+        if control_socket in events:
+            if control_socket.recv() == b'stop':
+                break
+        if vent_socket in events:
+            kwargs = vent_socket.recv_pyobj()
+            try:
+                worker.process(**kwargs)
+                results_socket.send_multipart([b'done'])
+            except Exception as exc:  # noqa: BLE001 - ship to consumer
+                blob = pickle.dumps((exc, traceback.format_exc()))
+                results_socket.send_multipart([b'error', blob])
+    worker.shutdown()
+    for sock in (vent_socket, control_socket, results_socket):
+        sock.close(linger=1000)
+    context.term()
+
+
+if __name__ == '__main__':
+    main(sys.argv[1])

@@ -1,0 +1,143 @@
+"""Work ventilation with bounded in-flight items and per-epoch reshuffling (reference:
+petastorm/workers_pool/ventilator.py:26-168).
+
+The ventilator is the scheduler's output stage: it feeds work items (rowgroup descriptors)
+into a pool at a bounded rate so memory stays bounded regardless of dataset size, and
+re-feeds them every epoch, optionally in a new seeded random order.
+"""
+
+import threading
+
+import numpy as np
+
+
+class Ventilator(object):
+    """Abstract ventilator (reference: ventilator.py:26-60)."""
+
+    def __init__(self, ventilate_fn):
+        self._ventilate_fn = ventilate_fn
+
+    def start(self):
+        raise NotImplementedError()
+
+    def processed_item(self):
+        """Feedback from the consumer that one ventilated item finished — used for
+        backpressure accounting."""
+        raise NotImplementedError()
+
+    def completed(self):
+        """True when no more items will ever be ventilated."""
+        raise NotImplementedError()
+
+    def stop(self):
+        raise NotImplementedError()
+
+
+class ConcurrentVentilator(Ventilator):
+    """Feeds ``items_to_ventilate`` (list of kwargs dicts) from a daemon thread, keeping at
+    most ``max_ventilation_queue_size`` items in flight, for ``iterations`` epochs
+    (None = infinite), optionally shuffling item order each epoch with a seeded RNG
+    (reference: ventilator.py:63-168)."""
+
+    def __init__(self, ventilate_fn, items_to_ventilate, iterations=1,
+                 max_ventilation_queue_size=None, randomize_item_order=False, random_seed=None):
+        super().__init__(ventilate_fn)
+        if iterations is not None and (not isinstance(iterations, int) or iterations < 1):
+            raise ValueError('iterations must be a positive integer or None, got {!r}'
+                             .format(iterations))
+        self._items_to_ventilate = list(items_to_ventilate)
+        self._iterations = iterations
+        self._iterations_remaining = iterations
+        self._max_ventilation_queue_size = (max_ventilation_queue_size
+                                            or len(self._items_to_ventilate) or 1)
+        self._randomize_item_order = randomize_item_order
+        self._random_state = np.random.RandomState(random_seed)
+
+        self._in_flight = 0
+        self._current_item_to_ventilate = 0
+        self._stop_requested = threading.Event()
+        self._completed = threading.Event()
+        self._lock = threading.Lock()
+        self._item_processed = threading.Condition(self._lock)
+        self._thread = None
+        #: exception raised by ventilate_fn, surfaced to the consumer via pools
+        self.error = None
+
+        if not self._items_to_ventilate:
+            # Nothing will ever be ventilated: complete immediately (empty shard case).
+            self._completed.set()
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError('Ventilator already started')
+        self._thread = threading.Thread(target=self._ventilate, daemon=True,
+                                        name='petastorm-tpu-ventilator')
+        self._thread.start()
+
+    def _ventilate(self):
+        if self._randomize_item_order:
+            self._random_state.shuffle(self._items_to_ventilate)
+        while not self._stop_requested.is_set():
+            if self._completed.is_set():
+                return
+            with self._item_processed:
+                while (self._in_flight >= self._max_ventilation_queue_size
+                       and not self._stop_requested.is_set()):
+                    self._item_processed.wait(timeout=0.1)
+                if self._stop_requested.is_set():
+                    return
+                self._in_flight += 1
+            item = self._items_to_ventilate[self._current_item_to_ventilate]
+            self._current_item_to_ventilate += 1
+            try:
+                self._ventilate_fn(**item)
+            except Exception as exc:  # noqa: BLE001 - surface to consumer, never hang
+                self.error = exc
+                self._completed.set()
+                return
+            if self._current_item_to_ventilate >= len(self._items_to_ventilate):
+                self._current_item_to_ventilate = 0
+                if self._iterations_remaining is not None:
+                    self._iterations_remaining -= 1
+                    if self._iterations_remaining <= 0:
+                        self._completed.set()
+                        return
+                if self._randomize_item_order:
+                    self._random_state.shuffle(self._items_to_ventilate)
+
+    def processed_item(self):
+        with self._item_processed:
+            if self._in_flight > 0:
+                self._in_flight -= 1
+            self._item_processed.notify()
+
+    def completed(self):
+        # All epochs dispatched AND every dispatched item acknowledged (or failed).
+        with self._lock:
+            if self.error is not None:
+                return True
+            return self._completed.is_set() and self._in_flight == 0
+
+    def reset(self):
+        """Restart ventilation for another round of ``iterations`` epochs after the
+        previous ones fully completed (reference: ventilator.py:127-136)."""
+        if not self.completed():
+            raise RuntimeError('Cannot reset a ventilator that has not completed all '
+                               'items (in-flight work remains)')
+        self._join_thread()
+        self._completed.clear()
+        self._stop_requested.clear()
+        self._current_item_to_ventilate = 0
+        self._iterations_remaining = self._iterations
+        self._thread = None
+        self.start()
+
+    def stop(self):
+        self._stop_requested.set()
+        with self._item_processed:
+            self._item_processed.notify_all()
+        self._join_thread()
+
+    def _join_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=10)

@@ -20,3 +20,40 @@ import pytest  # noqa: E402
 @pytest.fixture(scope='session')
 def rng():
     return np.random.RandomState(42)
+
+
+class SyntheticDataset(object):
+    def __init__(self, url, rows):
+        self.url = url
+        self.rows = rows
+        self.rows_by_id = {row['id']: row for row in rows}
+
+
+@pytest.fixture(scope='session')
+def synthetic_dataset(tmp_path_factory):
+    """Session-scoped synthetic petastorm_tpu dataset (model:
+    petastorm/tests/conftest.py:90-125)."""
+    from test_common import create_test_dataset
+    url = str(tmp_path_factory.mktemp('synthetic') / 'dataset')
+    rows = create_test_dataset(url, num_rows=100)
+    return SyntheticDataset(url, rows)
+
+
+@pytest.fixture(scope='session')
+def scalar_dataset(tmp_path_factory):
+    """Plain (non-unischema) Parquet store for make_batch_reader tests (model:
+    petastorm/tests/conftest.py scalar_dataset)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    url = str(tmp_path_factory.mktemp('scalar') / 'dataset')
+    os.makedirs(url)
+    data = {
+        'id': list(range(50)),
+        'float64': [i / 2.0 for i in range(50)],
+        'string': ['value_{}'.format(i) for i in range(50)],
+        'int_list': [[i, i + 1, i + 2] for i in range(50)],
+    }
+    table = pa.table(data)
+    pq.write_table(table.slice(0, 30), os.path.join(url, 'part_0.parquet'), row_group_size=10)
+    pq.write_table(table.slice(30), os.path.join(url, 'part_1.parquet'), row_group_size=10)
+    return SyntheticDataset(url, [dict(zip(data, vals)) for vals in zip(*data.values())])

@@ -1,0 +1,70 @@
+"""Cache tests (model: petastorm/tests/test_disk_cache.py / test_cache.py)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.cache import LocalDiskCache, NullCache
+
+
+def test_null_cache_always_calls():
+    cache = NullCache()
+    calls = []
+    assert cache.get('k', lambda: calls.append(1) or 42) == 42
+    assert cache.get('k', lambda: calls.append(1) or 43) == 43
+    assert len(calls) == 2
+
+
+def test_disk_cache_hit(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / 'c'), 10 << 20)
+    calls = []
+
+    def fill():
+        calls.append(1)
+        return {'a': np.arange(10)}
+
+    first = cache.get('key1', fill)
+    second = cache.get('key1', fill)
+    assert len(calls) == 1
+    np.testing.assert_array_equal(first['a'], second['a'])
+
+
+def test_disk_cache_distinct_keys(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / 'c'), 10 << 20)
+    assert cache.get('a', lambda: 1) == 1
+    assert cache.get('b', lambda: 2) == 2
+    assert cache.get('a', lambda: 99) == 1
+
+
+def test_disk_cache_eviction(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=200_000)
+    for i in range(10):
+        cache.get('key{}'.format(i), lambda i=i: np.full(10_000, i, dtype=np.int64))
+    assert cache.size <= 200_000
+
+
+def test_disk_cache_oversized_value_not_stored(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=1000)
+    value = cache.get('big', lambda: np.zeros(10_000))
+    assert value.shape == (10_000,)
+    assert cache.size == 0
+
+
+def test_disk_cache_size_sanity_check(tmp_path):
+    with pytest.raises(ValueError):
+        LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=100,
+                       expected_row_size_bytes=50)
+
+
+def test_disk_cache_cleanup(tmp_path):
+    import os
+    path = str(tmp_path / 'c')
+    cache = LocalDiskCache(path, 1 << 20, cleanup=True)
+    cache.get('k', lambda: 1)
+    cache.cleanup()
+    assert not os.path.exists(path)
+
+
+def test_disk_cache_survives_restart(tmp_path):
+    path = str(tmp_path / 'c')
+    LocalDiskCache(path, 1 << 20).get('k', lambda: 'value')
+    assert LocalDiskCache(path, 1 << 20).get('k', lambda: 'OTHER') == 'value'

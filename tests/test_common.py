@@ -1,0 +1,50 @@
+"""Synthetic test dataset shared by e2e tests (model: petastorm/tests/test_common.py —
+TestSchema with images/matrices/scalars, generated locally, no Spark)."""
+
+import numpy as np
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec)
+from petastorm_tpu.etl.dataset_metadata import write_rows
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TestSchema = Unischema('TestSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(), False),
+    UnischemaField('id2', np.int32, (), ScalarCodec(), False),
+    UnischemaField('partition_key', np.str_, (), ScalarCodec(), False),
+    UnischemaField('python_primitive_uint8', np.uint8, (), ScalarCodec(), False),
+    UnischemaField('image_png', np.uint8, (16, 12, 3), CompressedImageCodec('png'), False),
+    UnischemaField('matrix', np.float32, (4, 3), NdarrayCodec(), False),
+    UnischemaField('matrix_compressed', np.float64, (3, 2), CompressedNdarrayCodec(), False),
+    UnischemaField('matrix_var', np.int64, (None, 2), NdarrayCodec(), False),
+    UnischemaField('sensor_name', np.str_, (), ScalarCodec(), False),
+    UnischemaField('string_list', np.float64, (None,), None, False),
+    UnischemaField('nullable_int', np.int32, (), ScalarCodec(), True),
+])
+
+
+def make_test_rows(num_rows, seed=0):
+    rng = np.random.RandomState(seed)
+    rows = []
+    for i in range(num_rows):
+        rows.append({
+            'id': i,
+            'id2': i % 5,
+            'partition_key': 'p_{}'.format(i % 3),
+            'python_primitive_uint8': np.uint8(i % 255),
+            'image_png': rng.randint(0, 255, (16, 12, 3)).astype(np.uint8),
+            'matrix': rng.rand(4, 3).astype(np.float32),
+            'matrix_compressed': rng.rand(3, 2),
+            'matrix_var': rng.randint(0, 100, (rng.randint(1, 10), 2)).astype(np.int64),
+            'sensor_name': 'sensor_{}'.format(i),
+            'string_list': np.asarray(rng.rand(3)),
+            'nullable_int': None if i % 7 == 0 else np.int32(i),
+        })
+    return rows
+
+
+def create_test_dataset(url, num_rows=100, rows_per_file=None, rowgroup_size_mb=1, seed=0):
+    rows = make_test_rows(num_rows, seed)
+    write_rows(url, TestSchema, rows, rowgroup_size_mb=rowgroup_size_mb,
+               rows_per_file=rows_per_file or max(1, num_rows // 4))
+    return rows

@@ -1,0 +1,315 @@
+"""End-to-end reader tests across pool flavors (model: petastorm/tests/test_end_to_end.py
+— 54 tests parameterized over dummy/thread/process reader factories)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.errors import NoDataAvailableError
+from petastorm_tpu.predicates import in_lambda, in_pseudorandom_split, in_reduce, in_set
+from petastorm_tpu.transform import TransformSpec
+
+# 'process' is added once the process pool lands
+POOLS = ['dummy', 'thread']
+
+
+def _reader(url, **kwargs):
+    kwargs.setdefault('workers_count', 2)
+    return make_reader(url, **kwargs)
+
+
+def _check_simple_reader(reader, expected_rows, check_fields=('id', 'matrix', 'image_png')):
+    """Every row read must bit-match the generator's row with the same id (model:
+    test_end_to_end.py:61-90)."""
+    expected_by_id = {row['id']: row for row in expected_rows}
+    count = 0
+    for row in reader:
+        actual = row._asdict()
+        expected = expected_by_id[actual['id']]
+        for field in check_fields:
+            actual_value = actual[field]
+            expected_value = expected[field]
+            if isinstance(expected_value, np.ndarray):
+                np.testing.assert_array_equal(actual_value, expected_value, err_msg=field)
+            else:
+                assert actual_value == expected_value, field
+        count += 1
+    return count
+
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_simple_read(synthetic_dataset, pool):
+    with _reader(synthetic_dataset.url, reader_pool_type=pool) as reader:
+        count = _check_simple_reader(reader, synthetic_dataset.rows)
+    assert count == len(synthetic_dataset.rows)
+
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_all_fields_decoded(synthetic_dataset, pool):
+    with _reader(synthetic_dataset.url, reader_pool_type=pool) as reader:
+        row = next(reader)._asdict()
+    source = synthetic_dataset.rows_by_id[row['id']]
+    np.testing.assert_array_equal(row['matrix_compressed'], source['matrix_compressed'])
+    np.testing.assert_array_equal(row['matrix_var'], source['matrix_var'])
+    np.testing.assert_array_equal(row['string_list'], source['string_list'])
+    assert row['sensor_name'] == source['sensor_name']
+    assert row['partition_key'] == source['partition_key']
+
+
+def test_schema_fields_subset(synthetic_dataset):
+    with _reader(synthetic_dataset.url, schema_fields=['id', 'sensor_name']) as reader:
+        row = next(reader)
+        assert set(row._fields) == {'id', 'sensor_name'}
+
+
+def test_schema_fields_regex(synthetic_dataset):
+    with _reader(synthetic_dataset.url, schema_fields=['id.*']) as reader:
+        row = next(reader)
+        assert set(row._fields) == {'id', 'id2'}
+
+
+def test_reader_len(synthetic_dataset):
+    with _reader(synthetic_dataset.url) as reader:
+        assert len(reader) == len(synthetic_dataset.rows)
+
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_multiple_epochs(synthetic_dataset, pool):
+    with _reader(synthetic_dataset.url, num_epochs=3, reader_pool_type=pool) as reader:
+        ids = [row.id for row in reader]
+    assert len(ids) == 3 * len(synthetic_dataset.rows)
+    assert set(ids) == {row['id'] for row in synthetic_dataset.rows}
+
+
+def test_infinite_epochs_stops_on_demand(synthetic_dataset):
+    with _reader(synthetic_dataset.url, num_epochs=None) as reader:
+        taken = [next(reader).id for _ in range(250)]
+    assert len(taken) == 250
+
+
+def test_reset_rereads(synthetic_dataset):
+    with _reader(synthetic_dataset.url) as reader:
+        first = sorted(row.id for row in reader)
+        reader.reset()
+        second = sorted(row.id for row in reader)
+    assert first == second
+
+
+def test_reset_before_consumed_raises(synthetic_dataset):
+    with _reader(synthetic_dataset.url) as reader:
+        next(reader)
+        with pytest.raises(NotImplementedError):
+            reader.reset()
+
+
+def test_read_after_stop_raises(synthetic_dataset):
+    reader = _reader(synthetic_dataset.url)
+    reader.stop()
+    reader.join()
+    with pytest.raises(RuntimeError):
+        next(reader)
+
+
+# ----------------------------------------------------------------- sharding
+
+def test_sharding_disjoint_and_complete(synthetic_dataset):
+    ids = []
+    for shard in range(3):
+        with _reader(synthetic_dataset.url, cur_shard=shard, shard_count=3,
+                     shuffle_row_groups=False) as reader:
+            ids.extend(row.id for row in reader)
+    assert sorted(ids) == sorted(r['id'] for r in synthetic_dataset.rows)
+
+
+def test_sharding_seeded_shuffle_deterministic(synthetic_dataset):
+    def read_shard():
+        with _reader(synthetic_dataset.url, cur_shard=0, shard_count=2, shard_seed=123,
+                     shuffle_row_groups=False) as reader:
+            return sorted(row.id for row in reader)
+    assert read_shard() == read_shard()
+
+
+def test_sharding_invalid_args(synthetic_dataset):
+    with pytest.raises(ValueError):
+        _reader(synthetic_dataset.url, cur_shard=0)
+    with pytest.raises(ValueError):
+        _reader(synthetic_dataset.url, cur_shard=5, shard_count=2)
+
+
+def test_empty_shard_raises(tmp_path):
+    from test_common import create_test_dataset
+    url = str(tmp_path / 'tiny')
+    create_test_dataset(url, num_rows=2, rows_per_file=2)
+    with pytest.raises(NoDataAvailableError):
+        _reader(url, cur_shard=5, shard_count=10, shuffle_row_groups=False)
+
+
+# ----------------------------------------------------------------- shuffling
+
+def test_shuffle_row_groups_changes_order(synthetic_dataset):
+    with _reader(synthetic_dataset.url, shuffle_row_groups=False) as reader:
+        ordered = [row.id for row in reader]
+    with _reader(synthetic_dataset.url, shuffle_row_groups=True, seed=7,
+                 shuffle_rows=True) as reader:
+        shuffled = [row.id for row in reader]
+    assert sorted(ordered) == sorted(shuffled)
+    assert ordered != shuffled
+
+
+def test_seeded_shuffle_reproducible(synthetic_dataset):
+    def read_ids():
+        with _reader(synthetic_dataset.url, shuffle_row_groups=True, shuffle_rows=True,
+                     seed=42, reader_pool_type='dummy') as reader:
+            return [row.id for row in reader]
+    assert read_ids() == read_ids()
+
+
+def test_shuffle_row_drop_partitions(synthetic_dataset):
+    with _reader(synthetic_dataset.url, shuffle_row_drop_partitions=2) as reader:
+        ids = [row.id for row in reader]
+    assert sorted(ids) == sorted(r['id'] for r in synthetic_dataset.rows)
+
+
+# ---------------------------------------------------------------- predicates
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_predicate_in_set(synthetic_dataset, pool):
+    with _reader(synthetic_dataset.url, reader_pool_type=pool,
+                 predicate=in_set({1, 2, 3}, 'id')) as reader:
+        ids = {row.id for row in reader}
+    assert ids == {1, 2, 3}
+
+
+def test_predicate_in_lambda(synthetic_dataset):
+    with _reader(synthetic_dataset.url,
+                 predicate=in_lambda(['id2'], lambda id2: id2 == 0)) as reader:
+        values = {row.id2 for row in reader}
+    assert values == {0}
+
+
+def test_predicate_on_field_outside_view(synthetic_dataset):
+    """Predicate field doesn't need to be in schema_fields."""
+    with _reader(synthetic_dataset.url, schema_fields=['sensor_name'],
+                 predicate=in_set({5}, 'id')) as reader:
+        rows = list(reader)
+    assert len(rows) == 1
+    assert rows[0].sensor_name == 'sensor_5'
+
+
+def test_predicate_reduce(synthetic_dataset):
+    pred = in_reduce([in_set(set(range(10)), 'id'),
+                      in_lambda(['id2'], lambda x: x == 1)], all)
+    with _reader(synthetic_dataset.url, predicate=pred) as reader:
+        ids = {row.id for row in reader}
+    assert ids == {1, 6}
+
+
+def test_pseudorandom_split_partitions(synthetic_dataset):
+    all_ids = []
+    for subset in range(2):
+        pred = in_pseudorandom_split([0.5, 0.5], subset, 'sensor_name')
+        with _reader(synthetic_dataset.url, predicate=pred) as reader:
+            all_ids.extend(row.id for row in reader)
+    assert sorted(all_ids) == sorted(r['id'] for r in synthetic_dataset.rows)
+
+
+def test_predicate_no_match_yields_nothing(synthetic_dataset):
+    with _reader(synthetic_dataset.url, predicate=in_set({-1}, 'id')) as reader:
+        assert list(reader) == []
+
+
+# ----------------------------------------------------------------- transform
+
+def test_transform_spec_row_fn(synthetic_dataset):
+    def double_matrix(row):
+        row['matrix'] = row['matrix'] * 2
+        return row
+
+    spec = TransformSpec(double_matrix)
+    with _reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                 transform_spec=spec) as reader:
+        row = next(reader)
+    source = synthetic_dataset.rows_by_id[row.id]
+    np.testing.assert_array_almost_equal(row.matrix, source['matrix'] * 2)
+
+
+def test_transform_spec_removes_field(synthetic_dataset):
+    spec = TransformSpec(removed_fields=['matrix'])
+    with _reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                 transform_spec=spec) as reader:
+        row = next(reader)
+    assert set(row._fields) == {'id'}
+
+
+# --------------------------------------------------------------------- cache
+
+def test_local_disk_cache(synthetic_dataset, tmp_path):
+    for _ in range(2):
+        with _reader(synthetic_dataset.url, cache_type='local-disk',
+                     cache_location=str(tmp_path / 'cache'),
+                     cache_size_limit=1 << 30, num_epochs=2) as reader:
+            count = _check_simple_reader(reader, synthetic_dataset.rows)
+        assert count == 2 * len(synthetic_dataset.rows)
+
+
+# --------------------------------------------------------------- url lists
+
+def test_url_list_read(synthetic_dataset):
+    import os
+    files = sorted(os.path.join(synthetic_dataset.url, f)
+                   for f in os.listdir(synthetic_dataset.url) if f.endswith('.parquet'))
+    with _reader(files) as reader:
+        count = _check_simple_reader(reader, synthetic_dataset.rows, check_fields=('id',))
+    assert count == len(synthetic_dataset.rows)
+
+
+# ----------------------------------------------------------- make_batch_reader
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_batch_reader_scalar_store(scalar_dataset, pool):
+    ids = []
+    with make_batch_reader(scalar_dataset.url, reader_pool_type=pool,
+                           workers_count=2) as reader:
+        for batch in reader:
+            assert isinstance(batch.id, np.ndarray)
+            ids.extend(batch.id.tolist())
+            assert batch.float64.dtype == np.float64
+    assert sorted(ids) == [row['id'] for row in scalar_dataset.rows]
+
+
+def test_batch_reader_string_and_list_columns(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, workers_count=2) as reader:
+        batch = next(reader)
+    assert batch.string[0].startswith('value_')
+    assert list(batch.int_list[0]) == list(scalar_dataset.rows[batch.id[0]]['int_list'])
+
+
+def test_batch_reader_batched_predicate(scalar_dataset):
+    pred = in_lambda(['id'], lambda id_col: id_col % 2 == 0)
+    with make_batch_reader(scalar_dataset.url, predicate=pred, workers_count=2) as reader:
+        ids = np.concatenate([b.id for b in reader])
+    assert sorted(ids.tolist()) == [i for i in range(50) if i % 2 == 0]
+
+
+def test_batch_reader_transform_on_dataframe(scalar_dataset):
+    def add_one(df):
+        df['float64'] = df['float64'] + 1.0
+        return df
+
+    with make_batch_reader(scalar_dataset.url, transform_spec=TransformSpec(add_one),
+                           workers_count=2) as reader:
+        batch = next(reader)
+    expected = scalar_dataset.rows[batch.id[0]]['float64'] + 1.0
+    assert batch.float64[0] == pytest.approx(expected)
+
+
+def test_batch_reader_warns_on_unischema_store(synthetic_dataset):
+    with pytest.warns(UserWarning, match='make_reader'):
+        reader = make_batch_reader(synthetic_dataset.url, workers_count=1)
+    reader.stop()
+    reader.join()
+
+
+def test_make_reader_on_plain_store_raises(scalar_dataset):
+    with pytest.raises(RuntimeError, match='make_batch_reader'):
+        make_reader(scalar_dataset.url)

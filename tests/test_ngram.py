@@ -1,0 +1,117 @@
+"""NGram tests (model: petastorm/tests/test_ngram_end_to_end.py, 630 LoC)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import write_rows
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SeqSchema = Unischema('SeqSchema', [
+    UnischemaField('ts', np.int64, (), ScalarCodec(), False),
+    UnischemaField('value', np.float32, (2,), NdarrayCodec(), False),
+    UnischemaField('label', np.int32, (), ScalarCodec(), False),
+])
+
+
+def _seq_rows(timestamps):
+    return [{'ts': int(t), 'value': np.array([t, t * 2], dtype=np.float32),
+             'label': np.int32(t % 3)} for t in timestamps]
+
+
+@pytest.fixture(scope='module')
+def seq_dataset(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp('seq') / 'ds')
+    # one file, one rowgroup: windows can span the full range
+    write_rows(url, SeqSchema, _seq_rows(range(20)), rows_per_file=20,
+               rowgroup_size_mb=64)
+    return url
+
+
+class TestFormNgram:
+    def test_docstring_example(self):
+        """The reference's worked example (ngram.py:60-85): threshold 4, ids
+        0,3,8,10,11,20,30 -> windows (0,3),(8,10),(10,11)."""
+        ngram = NGram({-1: ['.*'], 0: ['.*']}, delta_threshold=4, timestamp_field='ts')
+        ngram.resolve_regex_field_names(SeqSchema)
+        rows = [{'ts': t, 'value': None, 'label': 0} for t in [0, 3, 8, 10, 11, 20, 30]]
+        windows = ngram.form_ngram(rows)
+        pairs = [(w[-1]['ts'], w[0]['ts']) for w in windows]
+        assert pairs == [(0, 3), (8, 10), (10, 11)]
+
+    def test_no_overlap(self):
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=100, timestamp_field='ts',
+                      timestamp_overlap=False)
+        ngram.resolve_regex_field_names(SeqSchema)
+        rows = [{'ts': t, 'value': None, 'label': 0} for t in range(6)]
+        windows = ngram.form_ngram(rows)
+        starts = [w[0]['ts'] for w in windows]
+        assert starts == [0, 2, 4]
+
+    def test_unsorted_raises(self):
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=5, timestamp_field='ts')
+        ngram.resolve_regex_field_names(SeqSchema)
+        rows = [{'ts': t, 'value': None, 'label': 0} for t in [3, 1, 2]]
+        with pytest.raises(NotImplementedError):
+            ngram.form_ngram(rows)
+
+    def test_length(self):
+        assert NGram({-2: ['a'], 0: ['a']}, 1, 'ts').length == 3
+        assert NGram({0: ['a'], 1: ['a']}, 1, 'ts').length == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            NGram({}, 1, 'ts')
+        with pytest.raises(ValueError):
+            NGram({'a': ['x']}, 1, 'ts')
+
+
+class TestNgramEndToEnd:
+    def test_consecutive_windows(self, seq_dataset):
+        ngram = NGram({0: ['ts', 'value'], 1: ['ts', 'label']}, delta_threshold=1,
+                      timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False) as reader:
+            windows = list(reader)
+        assert len(windows) == 19
+        first = windows[0]
+        assert set(first.keys()) == {0, 1}
+        assert first[1].ts == first[0].ts + 1
+        # per-timestep field subsets
+        assert set(first[0]._fields) == {'ts', 'value'}
+        assert set(first[1]._fields) == {'ts', 'label'}
+        np.testing.assert_array_almost_equal(
+            first[0].value, [first[0].ts, first[0].ts * 2])
+
+    def test_per_timestep_schema(self, seq_dataset):
+        ngram = NGram({0: ['value'], 1: ['label']}, delta_threshold=2,
+                      timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1) as reader:
+            w = next(reader)
+        assert 'value' in w[0]._fields and 'label' in w[1]._fields
+
+    def test_ngram_with_batch_reader_rejected(self, seq_dataset):
+        from petastorm_tpu import make_batch_reader
+        ngram = NGram({0: ['ts']}, 1, 'ts')
+        with pytest.raises(ValueError):
+            with pytest.warns(UserWarning):
+                make_batch_reader(seq_dataset, schema_fields=ngram)
+
+    def test_ngram_shuffle_drop_partitions(self, seq_dataset):
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         shuffle_row_drop_partitions=2,
+                         shuffle_row_groups=False) as reader:
+            windows = list(reader)
+        # carry-over rows preserve boundary windows: all 19 consecutive pairs survive
+        starts = sorted(w[0].ts for w in windows)
+        assert len(starts) == 19
+
+    def test_ngram_epochs(self, seq_dataset):
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         num_epochs=2, shuffle_row_groups=False) as reader:
+            windows = list(reader)
+        assert len(windows) == 38

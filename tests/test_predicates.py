@@ -1,0 +1,84 @@
+"""Predicate unit tests (model: petastorm/tests/test_predicates.py)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.predicates import (in_intersection, in_lambda, in_negate,
+                                      in_pseudorandom_split, in_reduce, in_set)
+
+
+def test_in_set_scalar():
+    pred = in_set({1, 2}, 'x')
+    assert pred.get_fields() == {'x'}
+    assert pred.do_include({'x': 1})
+    assert not pred.do_include({'x': 3})
+
+
+def test_in_set_vectorized():
+    pred = in_set({1, 2}, 'x')
+    mask = pred.do_include({'x': np.array([0, 1, 2, 3])})
+    np.testing.assert_array_equal(mask, [False, True, True, False])
+
+
+def test_in_intersection():
+    pred = in_intersection({'a', 'b'}, 'tags')
+    assert pred.do_include({'tags': ['b', 'c']})
+    assert not pred.do_include({'tags': ['c', 'd']})
+
+
+def test_in_lambda_with_state():
+    seen = set()
+    pred = in_lambda(['x'], lambda x, state: state.add(x) or x > 0, seen)
+    assert pred.do_include({'x': 1})
+    assert not pred.do_include({'x': -1})
+    assert seen == {1, -1}
+
+
+def test_in_lambda_rejects_bad_fields():
+    with pytest.raises(ValueError):
+        in_lambda('x', lambda x: True)
+
+
+def test_in_negate_scalar_and_mask():
+    pred = in_negate(in_set({1}, 'x'))
+    assert not pred.do_include({'x': 1})
+    np.testing.assert_array_equal(pred.do_include({'x': np.array([1, 2])}), [False, True])
+
+
+def test_in_reduce_all_any():
+    p1, p2 = in_set({1, 2}, 'x'), in_set({2, 3}, 'x')
+    assert in_reduce([p1, p2], all).do_include({'x': 2})
+    assert not in_reduce([p1, p2], all).do_include({'x': 1})
+    assert in_reduce([p1, p2], any).do_include({'x': 3})
+    mask = in_reduce([p1, p2], all).do_include({'x': np.array([1, 2, 3])})
+    np.testing.assert_array_equal(mask, [False, True, False])
+
+
+def test_in_reduce_collects_fields():
+    pred = in_reduce([in_set({1}, 'a'), in_set({1}, 'b')], any)
+    assert pred.get_fields() == {'a', 'b'}
+
+
+def test_pseudorandom_split_deterministic_and_partitioning():
+    keys = ['key_{}'.format(i) for i in range(1000)]
+    assignments = {}
+    for subset in range(3):
+        pred = in_pseudorandom_split([0.3, 0.3, 0.4], subset, 'k')
+        for key in keys:
+            if pred.do_include({'k': key}):
+                assert key not in assignments
+                assignments[key] = subset
+    assert len(assignments) == 1000  # total partition
+    counts = [sum(1 for s in assignments.values() if s == i) for i in range(3)]
+    assert 200 < counts[0] < 400 and 200 < counts[1] < 400 and 300 < counts[2] < 500
+    # deterministic across instances
+    pred = in_pseudorandom_split([0.3, 0.3, 0.4], 0, 'k')
+    again = {key for key in keys if pred.do_include({'k': key})}
+    assert again == {k for k, s in assignments.items() if s == 0}
+
+
+def test_pseudorandom_split_validation():
+    with pytest.raises(ValueError):
+        in_pseudorandom_split([0.5, 0.5], 2, 'k')
+    with pytest.raises(ValueError):
+        in_pseudorandom_split([0.8, 0.8], 0, 'k')

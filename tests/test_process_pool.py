@@ -1,0 +1,30 @@
+"""Process-pool e2e (kept to a few tests: spawned-interpreter startup is slow on this
+1-core box; model: the reference's pytest-forked process-pool pass, unittest.yml:104-108)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.transform import TransformSpec
+
+
+@pytest.mark.slow
+def test_process_pool_reads_and_decodes(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='process',
+                     workers_count=2) as reader:
+        rows = {row.id: row for row in reader}
+    assert len(rows) == len(synthetic_dataset.rows)
+    source = synthetic_dataset.rows_by_id[0]
+    np.testing.assert_array_equal(rows[0].matrix, source['matrix'])
+    np.testing.assert_array_equal(rows[0].image_png, source['image_png'])
+
+
+@pytest.mark.slow
+def test_process_pool_worker_exception_propagates(synthetic_dataset):
+    def bad(row):
+        raise RuntimeError('cross-process boom')
+
+    with pytest.raises(RuntimeError, match='cross-process boom'):
+        with make_reader(synthetic_dataset.url, reader_pool_type='process',
+                         workers_count=2, transform_spec=TransformSpec(bad)) as reader:
+            list(reader)
