@@ -1,0 +1,128 @@
+"""Throughput benchmark: MNIST-shaped end-to-end input pipeline on the real chip.
+
+Writes a synthetic MNIST dataset (28x28 uint8 NdarrayCodec images + labels — the
+reference's examples/mnist/schema.py shape), then measures steady-state rows/sec of
+``make_reader -> JaxDataLoader -> jitted MnistCNN train step`` on the default JAX device,
+with input-stall%% from the loader's own instrumentation.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the ratio to the reference's published hello_world reader throughput
+(709.84 samples/sec — docs/benchmarks_tutorial.rst:20-21; BASELINE.md).
+
+Extra diagnostics go to stderr only.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REFERENCE_BASELINE_ROWS_PER_SEC = 709.84
+NUM_ROWS = int(os.environ.get('BENCH_ROWS', 50000))
+BATCH_SIZE = int(os.environ.get('BENCH_BATCH', 2048))
+WORKERS = int(os.environ.get('BENCH_WORKERS', 4))
+EPOCHS = int(os.environ.get('BENCH_EPOCHS', 3))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_dataset(url):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_rows
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('MnistBench', [
+        UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+        UnischemaField('digit', np.int64, (), ScalarCodec(), False),
+        UnischemaField('image', np.uint8, (28, 28), NdarrayCodec(), False),
+    ])
+    rng = np.random.RandomState(0)
+    rows = [{'idx': i, 'digit': int(rng.randint(10)),
+             'image': rng.randint(0, 255, (28, 28), dtype=np.uint8)}
+            for i in range(NUM_ROWS)]
+    write_rows(url, schema, rows, rowgroup_size_mb=8, n_files=4)
+    return schema
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.models import MnistCNN
+    from petastorm_tpu.ops.image import normalize_image
+    from petastorm_tpu.parallel import JaxDataLoader
+
+    device = jax.devices()[0]
+    log('bench device: {}'.format(device))
+
+    url = os.path.join(tempfile.gettempdir(), 'petastorm_tpu_bench_mnist_{}'.format(NUM_ROWS))
+    if not os.path.exists(os.path.join(url, '_common_metadata')):
+        log('materializing {} rows to {}'.format(NUM_ROWS, url))
+        build_dataset(url)
+
+    model = MnistCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((BATCH_SIZE, 28, 28, 1)))
+    optimizer = optax.sgd(0.01)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, images_u8, labels):
+        images = normalize_image(images_u8[..., None], mean=[0.1307], std=[0.3081],
+                                 dtype=jnp.bfloat16)
+
+        def loss_fn(p):
+            logits = model.apply(p, images)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state2 = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    def run_epoch(measure):
+        nonlocal params, opt_state
+        reader = make_reader(url, workers_count=WORKERS, shuffle_row_groups=True,
+                             seed=42, num_epochs=1)
+        loader = JaxDataLoader(reader, batch_size=BATCH_SIZE, prefetch=2)
+        rows = 0
+        start = time.perf_counter()
+        loss = None
+        for batch in loader:
+            params, opt_state, loss = train_step(params, opt_state,
+                                                 batch['image'], batch['digit'])
+            rows += BATCH_SIZE
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - start
+        reader.stop()
+        reader.join()
+        if measure:
+            log('epoch: {} rows in {:.2f}s -> {:.1f} rows/s; loader stats {}'
+                .format(rows, elapsed, rows / elapsed, loader.stats.as_dict()))
+        return rows / elapsed, loader.stats.input_stall_fraction
+
+    log('warmup epoch (compile + cache)...')
+    run_epoch(measure=False)
+    rates, stalls = [], []
+    for _ in range(EPOCHS):
+        rate, stall = run_epoch(measure=True)
+        rates.append(rate)
+        stalls.append(stall)
+    value = float(np.mean(rates))
+    stall = float(np.mean(stalls))
+    log('input_stall_fraction: {:.3f}'.format(stall))
+    print(json.dumps({
+        'metric': 'mnist_e2e_rows_per_sec_per_chip',
+        'value': round(value, 2),
+        'unit': 'rows/s/chip',
+        'vs_baseline': round(value / REFERENCE_BASELINE_ROWS_PER_SEC, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

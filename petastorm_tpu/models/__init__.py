@@ -1,0 +1,5 @@
+"""Reference consumer models for the input pipeline's examples/benchmarks (the analog of
+the reference's examples/mnist and examples/imagenet model code, re-done in flax)."""
+
+from petastorm_tpu.models.mnist import MnistCNN  # noqa: F401
+from petastorm_tpu.models.resnet import ResNet50  # noqa: F401

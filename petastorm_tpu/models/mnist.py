@@ -1,0 +1,28 @@
+"""MNIST CNN in flax (consumer model for examples/mnist parity — reference:
+examples/mnist/pytorch_example.py:34-54's two-conv net, re-designed for the MXU: NHWC
+layout, bfloat16-friendly convs, no data-dependent control flow)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    """Two conv blocks + two dense layers, NHWC."""
+
+    num_classes: int = 10
+    dtype: type = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,66 @@
+"""ResNet-50 in flax (consumer model for examples/imagenet parity — reference:
+examples/imagenet feeds torchvision's ResNet; re-designed MXU-first: NHWC, bfloat16
+compute with float32 batch-norm statistics, no python-loop over data)."""
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: tuple = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name='conv_proj')(residual)
+            residual = self.norm(name='norm_proj')(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: type = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       epsilon=1e-5, dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name='conv_init')(x)
+        x = norm(name='bn_init')(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        for stage, block_count in enumerate(self.stage_sizes):
+            for block in range(block_count):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BottleneckBlock(self.num_filters * 2 ** stage, conv=conv, norm=norm,
+                                    act=nn.relu, strides=strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])

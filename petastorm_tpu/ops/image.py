@@ -1,0 +1,41 @@
+"""On-device image preprocessing (the TransformSpec-on-chip path the north star asks for:
+decode/normalize/augment as jitted ops instead of host numpy — BASELINE.json north_star).
+
+All ops are shape-static and jit/vmap-friendly; they compose with the JaxDataLoader by
+running on already-device-resident uint8 batches, keeping host->device traffic at 1
+byte/pixel and doing the float conversion on-chip.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def normalize_image(images, mean, std, dtype=jnp.bfloat16):
+    """uint8 [B,H,W,C] -> normalized ``dtype``; mean/std are per-channel sequences.
+    On-chip analog of the host-side transform in examples (e.g. MNIST's transform)."""
+    mean = jnp.asarray(mean, dtype=jnp.float32)
+    std = jnp.asarray(std, dtype=jnp.float32)
+    x = images.astype(jnp.float32) / 255.0
+    return ((x - mean) / std).astype(dtype)
+
+
+def random_crop_flip(rng, images, crop_hw, flip=True):
+    """Random crop to ``crop_hw`` + horizontal flip, batched, shape-static (the imagenet
+    training augmentation, on-chip)."""
+    b, h, w, c = images.shape
+    ch, cw = crop_hw
+    rng_crop, rng_flip = jax.random.split(rng)
+    max_y = h - ch
+    max_x = w - cw
+    offsets_y = jax.random.randint(rng_crop, (b,), 0, max_y + 1)
+    offsets_x = jax.random.randint(jax.random.fold_in(rng_crop, 1), (b,), 0, max_x + 1)
+
+    def crop_one(image, oy, ox):
+        return jax.lax.dynamic_slice(image, (oy, ox, 0), (ch, cw, c))
+
+    cropped = jax.vmap(crop_one)(images, offsets_y, offsets_x)
+    if flip:
+        do_flip = jax.random.bernoulli(rng_flip, 0.5, (b,))
+        flipped = jnp.flip(cropped, axis=2)
+        cropped = jnp.where(do_flip[:, None, None, None], flipped, cropped)
+    return cropped

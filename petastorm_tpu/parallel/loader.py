@@ -1,0 +1,363 @@
+"""JaxDataLoader: reader -> mesh-sharded ``jax.Array`` batches with double-buffered
+host->device transfer and input-stall instrumentation.
+
+This is the TPU-native flagship adapter (the role petastorm/pytorch.py:126-496 plays for
+torch), designed per SURVEY.md §7.1 item 5:
+
+- batches are assembled columnar on the host (numpy), optionally through a seeded
+  shuffling buffer (the reference's shuffling-queue semantics, pytorch.py:178-186);
+- each batch becomes a pytree of globally-sharded ``jax.Array`` via
+  ``jax.make_array_from_process_local_data`` over an arbitrary ``PartitionSpec`` — batch
+  axis DP by default, but any TP/SP layout is accepted (SURVEY.md §2.8);
+- a background producer thread keeps ``prefetch`` batches in flight so host IO/decode and
+  H2D transfer overlap device compute (double buffering);
+- ``stats.input_stall_fraction`` measures the time the consumer blocked waiting on the
+  input pipeline — the BASELINE.md north-star metric — from inside the loader, where
+  async dispatch can't hide it.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from petastorm_tpu.parallel.shuffling_buffer import (NoopShufflingBuffer,
+                                                     RandomShufflingBuffer)
+
+_END = object()
+
+
+class LoaderStats(object):
+    def __init__(self):
+        self.batches = 0
+        self.rows = 0
+        self.wait_time_s = 0.0
+        self.total_time_s = 0.0
+
+    @property
+    def input_stall_fraction(self):
+        if self.total_time_s <= 0:
+            return 0.0
+        return min(1.0, self.wait_time_s / self.total_time_s)
+
+    def as_dict(self):
+        return {'batches': self.batches, 'rows': self.rows,
+                'wait_time_s': round(self.wait_time_s, 4),
+                'total_time_s': round(self.total_time_s, 4),
+                'input_stall_fraction': round(self.input_stall_fraction, 4)}
+
+
+class JaxDataLoader(object):
+    """Iterates pytrees (dicts) of device-sharded arrays assembled from a Reader.
+
+    :param reader: a petastorm_tpu Reader (row or batched).
+    :param batch_size: rows per emitted batch **on this host**. With a multi-host mesh the
+        global batch is ``batch_size * jax.process_count()``.
+    :param mesh: optional ``jax.sharding.Mesh``; None = single default device.
+    :param partition_spec: ``PartitionSpec`` for every batch array (default: batch axis
+        over the mesh's first axis). Accepts any layout for TP/SP consumers.
+    :param shuffling_queue_capacity: >0 enables a RandomShufflingBuffer of that capacity.
+    :param min_after_retrieve: decorrelation floor (default capacity//2).
+    :param pad_ragged: {field: padded_shape_tuple} — ragged fields are zero-padded to the
+        given per-row shape and an ``<field>_len`` int32 column is emitted. Required for
+        any variable-shape field reaching the device (XLA static shapes;
+        SURVEY.md §7.3 pad-and-mask).
+    :param prefetch: device batches kept in flight (2 = double buffering).
+    :param drop_last: drop the final partial batch (keeps shapes static under jit).
+    :param device_put: False returns host numpy batches (debugging / CPU consumers).
+    """
+
+    def __init__(self, reader, batch_size, mesh=None, partition_spec=None,
+                 shuffling_queue_capacity=0, min_after_retrieve=None, seed=None,
+                 pad_ragged=None, prefetch=2, drop_last=True, device_put=True):
+        if batch_size < 1:
+            raise ValueError('batch_size must be >= 1')
+        self.reader = reader
+        self.batch_size = batch_size
+        self.stats = LoaderStats()
+        self._mesh = mesh
+        self._partition_spec = partition_spec
+        self._pad_ragged = dict(pad_ragged or {})
+        self._prefetch = max(1, prefetch)
+        self._drop_last = drop_last
+        self._device_put = device_put
+        self._seed = seed
+        self._shuffling_queue_capacity = shuffling_queue_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._sharding = None
+        self._in_iter = False
+        self._error = None
+        self._queue = None
+        self._producer = None
+        self._stop_event = threading.Event()
+
+    # ------------------------------------------------------------------ sharding
+
+    def _resolve_sharding(self):
+        if not self._device_put:
+            return None
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
+        if self._mesh is None:
+            if self._partition_spec is not None:
+                raise ValueError('partition_spec requires a mesh')
+            return SingleDeviceSharding(jax.devices()[0])
+        spec = self._partition_spec
+        if spec is None:
+            spec = PartitionSpec(self._mesh.axis_names[0])
+        return NamedSharding(self._mesh, spec)
+
+    # ------------------------------------------------------------------ iteration
+
+    def __iter__(self):
+        if self._in_iter:
+            raise RuntimeError('Concurrent iteration of a JaxDataLoader is not allowed '
+                               '(reference semantics: pytorch.py:98-123)')
+        if self.stats.batches and getattr(self.reader, 'last_row_consumed', False):
+            # Re-iteration after full consumption: reset the reader like the reference's
+            # LoaderBase (pytorch.py:104-123).
+            self.reader.reset()
+        self._in_iter = True
+        self._error = None
+        self._stop_event.clear()
+        self._queue = queue.Queue(self._prefetch)
+        self._sharding = self._resolve_sharding()
+        self._producer = threading.Thread(target=self._produce, daemon=True,
+                                          name='petastorm-tpu-loader-producer')
+        self._producer.start()
+        try:
+            last_emit = time.monotonic()
+            while True:
+                wait_start = time.monotonic()
+                item = self._queue.get()
+                now = time.monotonic()
+                if item is _END:
+                    if self._error is not None:
+                        raise self._error
+                    return
+                self.stats.wait_time_s += now - wait_start
+                self.stats.total_time_s += now - last_emit
+                last_emit = now
+                self.stats.batches += 1
+                self.stats.rows += self._batch_rows(item)
+                yield item
+        finally:
+            self._stop_event.set()
+            self._in_iter = False
+            # Drain so the producer's bounded put never deadlocks.
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+
+    @staticmethod
+    def _batch_rows(batch):
+        for value in batch.values():
+            return int(value.shape[0])
+        return 0
+
+    # ------------------------------------------------------------------ producer
+
+    def _make_buffer(self):
+        if self._shuffling_queue_capacity and self._shuffling_queue_capacity > 0:
+            min_after = self._min_after_retrieve
+            if min_after is None:
+                min_after = self._shuffling_queue_capacity // 2
+            return RandomShufflingBuffer(self._shuffling_queue_capacity, min_after,
+                                         seed=self._seed)
+        return NoopShufflingBuffer()
+
+    def _produce(self):
+        try:
+            buffer = self._make_buffer()
+            for columns in self._reader_chunks():
+                # Feed the buffer in batch_size slices so a whole-rowgroup chunk (the
+                # iter_columnar fast path) cannot blow past the shuffling buffer's
+                # configured capacity; slices of ndarrays are views, so this is cheap.
+                for part in _iter_column_slices(columns, self.batch_size):
+                    buffer.add_many(part)
+                    while buffer.can_retrieve(self.batch_size):
+                        if self._stop_event.is_set():
+                            return
+                        self._emit(buffer.retrieve(self.batch_size))
+            buffer.finish()
+            while buffer.can_retrieve(self.batch_size) and not self._stop_event.is_set():
+                batch = buffer.retrieve(self.batch_size)
+                if self._batch_cols_rows(batch) < self.batch_size and self._drop_last:
+                    break
+                self._emit(batch)
+        except Exception as exc:  # noqa: BLE001 - surface in consumer
+            if not self._stop_event.is_set():
+                self._error = exc
+        finally:
+            self._put(_END)
+
+    @staticmethod
+    def _batch_cols_rows(columns):
+        for col in columns.values():
+            return len(col)
+        return 0
+
+    def _reader_chunks(self):
+        """Yield sanitized columnar chunks from the reader. Readers exposing the
+        ``iter_columnar`` fast path feed worker batches straight through (no per-row
+        namedtuple round-trip); other iterables fall back to row accumulation."""
+        iter_columnar = getattr(self.reader, 'iter_columnar', None)
+        if iter_columnar is not None and getattr(self.reader, 'ngram', None) is None:
+            for batch in iter_columnar():
+                yield self._sanitize(dict(batch.columns))
+        elif getattr(self.reader, 'is_batched_reader', False):
+            for batch in self.reader:
+                yield self._sanitize(batch._asdict())
+        else:
+            pending = []
+            for row in self.reader:
+                pending.append(row._asdict())
+                if len(pending) >= self.batch_size:
+                    yield self._sanitize(_rows_to_columns(pending))
+                    pending = []
+            if pending:
+                yield self._sanitize(_rows_to_columns(pending))
+
+    def _sanitize(self, columns):
+        """Dtype sanitization for the device (the analog of the torch/tf sanitizers,
+        pytorch.py:40-65 / tf_utils.py:57-96): datetimes -> int64 ns, ragged fields padded
+        per ``pad_ragged``, strings/objects rejected with the field named."""
+        out = {}
+        for name, col in columns.items():
+            if name in self._pad_ragged:
+                padded, lengths = _pad_column(col, self._pad_ragged[name], name)
+                out[name] = padded
+                out[name + '_len'] = lengths
+                continue
+            if isinstance(col, list):
+                raise ValueError(
+                    'Field {!r} is ragged (variable shape); pass pad_ragged={{{!r}: '
+                    '(max_shape...)}} to pad it, or drop it via schema_fields'
+                    .format(name, name))
+            if col.dtype.kind == 'M':
+                out[name] = col.astype('datetime64[ns]').astype(np.int64)
+            elif col.dtype.kind in ('U', 'S', 'O'):
+                if self._device_put:
+                    raise ValueError(
+                        'Field {!r} has dtype {} which has no device representation; '
+                        'drop it via schema_fields or use device_put=False'
+                        .format(name, col.dtype))
+                out[name] = col
+            else:
+                out[name] = np.ascontiguousarray(col)
+        return out
+
+    def _emit(self, columns):
+        if self._device_put:
+            import jax
+            sharding = self._sharding
+            if self._mesh is not None:
+                batch = {name: jax.make_array_from_process_local_data(sharding, col)
+                         for name, col in columns.items()}
+            else:
+                batch = jax.device_put(columns, sharding)
+        else:
+            batch = columns
+        self._put(batch)
+
+    def _put(self, item):
+        while not self._stop_event.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+        if item is _END:
+            try:
+                self._queue.put_nowait(_END)
+            except queue.Full:
+                pass
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def stop(self):
+        self._stop_event.set()
+        self.reader.stop()
+
+    def join(self):
+        self.reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()
+
+
+def _iter_column_slices(columns, slice_rows):
+    n = 0
+    for col in columns.values():
+        n = len(col)
+        break
+    if n <= slice_rows:
+        yield columns
+        return
+    for start in range(0, n, slice_rows):
+        yield {name: col[start:start + slice_rows] for name, col in columns.items()}
+
+
+def _rows_to_columns(rows):
+    columns = {}
+    for name in rows[0]:
+        values = [row[name] for row in rows]
+        first = values[0]
+        if isinstance(first, np.ndarray) and first.ndim >= 1:
+            shapes = {v.shape for v in values}
+            if len(shapes) == 1:
+                columns[name] = np.stack(values)
+            else:
+                columns[name] = values  # ragged: stays a list until pad_ragged
+        elif isinstance(first, (str, bytes)) or first is None:
+            columns[name] = np.array(values, dtype=object)
+        else:
+            columns[name] = np.asarray(values)
+    return columns
+
+
+def _pad_column(col, target_shape, name):
+    """Zero-pad each row of a ragged column to ``target_shape``; return (padded array,
+    int32 first-dim lengths)."""
+    values = list(col)
+    target_shape = tuple(target_shape)
+    first = np.asarray(values[0])
+    padded = np.zeros((len(values),) + target_shape, dtype=first.dtype)
+    lengths = np.zeros(len(values), dtype=np.int32)
+    for i, value in enumerate(values):
+        value = np.asarray(value)
+        if value.ndim != len(target_shape):
+            raise ValueError('pad_ragged[{!r}]={} rank mismatch with value shape {}'
+                             .format(name, target_shape, value.shape))
+        if any(v > t for v, t in zip(value.shape, target_shape)):
+            raise ValueError('Value of field {!r} with shape {} exceeds pad_ragged '
+                             'target {}'.format(name, value.shape, target_shape))
+        region = tuple(slice(0, s) for s in value.shape)
+        padded[(i,) + region] = value
+        lengths[i] = value.shape[0]
+    return padded, lengths
+
+
+def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, partition_spec=None,
+                    batched=True, loader_kwargs=None, **reader_kwargs):
+    """Convenience factory: reader + JaxDataLoader in one call. ``batched=True`` uses
+    make_batch_reader (native Parquet, fastest); ``batched=False`` uses make_reader
+    (codec decode)."""
+    from petastorm_tpu.parallel.mesh import distributed_shard_info
+    from petastorm_tpu.reader import make_batch_reader, make_reader
+    cur_shard, shard_count = distributed_shard_info(
+        reader_kwargs.pop('cur_shard', None), reader_kwargs.pop('shard_count', None))
+    if shard_count is not None:
+        reader_kwargs['cur_shard'] = cur_shard
+        reader_kwargs['shard_count'] = shard_count
+    factory = make_batch_reader if batched else make_reader
+    reader = factory(dataset_url_or_urls, **reader_kwargs)
+    return JaxDataLoader(reader, batch_size, mesh=mesh, partition_spec=partition_spec,
+                         **(loader_kwargs or {}))

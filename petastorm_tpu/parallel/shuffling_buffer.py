@@ -1,0 +1,150 @@
+"""Columnar shuffling buffers (reference: petastorm/reader_impl/shuffling_buffer.py:23-180
+and pytorch_shuffling_buffer.py:22-279, unified).
+
+One numpy-columnar implementation serves every adapter (JAX, torch, TF): batches are
+dicts of ``(n, ...)`` arrays; retrieval gathers random indices. The random buffer keeps a
+``min_after_retrieve`` floor so samples stay decorrelated, exactly the reference's
+semantics. Not thread safe (same contract as the reference, shuffling_buffer.py:24-26).
+"""
+
+import numpy as np
+
+
+class ShufflingBufferBase(object):
+    def add_many(self, columns):
+        raise NotImplementedError()
+
+    def retrieve(self, n):
+        """Return a dict of columns with ``n`` rows (fewer only after ``finish``)."""
+        raise NotImplementedError()
+
+    @property
+    def size(self):
+        raise NotImplementedError()
+
+    def can_retrieve(self, n):
+        raise NotImplementedError()
+
+    def finish(self):
+        """No more adds; drain whatever remains."""
+        raise NotImplementedError()
+
+
+def _concat_columns(parts):
+    out = {}
+    for name in parts[0]:
+        values = [p[name] for p in parts]
+        if isinstance(values[0], np.ndarray) and values[0].ndim >= 1:
+            out[name] = np.concatenate(values)
+        else:
+            merged = []
+            for v in values:
+                merged.extend(list(v))
+            out[name] = merged
+    return out
+
+
+def _gather(columns, indices):
+    return {name: (col[indices] if isinstance(col, np.ndarray)
+                   else [col[i] for i in indices])
+            for name, col in columns.items()}
+
+
+def _num_rows(columns):
+    for col in columns.values():
+        return len(col)
+    return 0
+
+
+class NoopShufflingBuffer(ShufflingBufferBase):
+    """FIFO pass-through (reference: shuffling_buffer.py:29-77)."""
+
+    def __init__(self):
+        self._parts = []
+        self._size = 0
+        self._finished = False
+
+    def add_many(self, columns):
+        if self._finished:
+            raise RuntimeError('Cannot add to a finished shuffling buffer')
+        n = _num_rows(columns)
+        if n:
+            self._parts.append(columns)
+            self._size += n
+
+    def retrieve(self, n):
+        take = min(n, self._size) if self._finished else n
+        if take > self._size:
+            raise RuntimeError('Not enough rows buffered: asked {}, have {}'
+                               .format(n, self._size))
+        merged = _concat_columns(self._parts) if self._parts else {}
+        result = _gather(merged, np.arange(take))
+        rest_indices = np.arange(take, _num_rows(merged))
+        self._parts = [_gather(merged, rest_indices)] if len(rest_indices) else []
+        self._size -= take
+        return result
+
+    @property
+    def size(self):
+        return self._size
+
+    def can_retrieve(self, n):
+        return self._size >= n or (self._finished and self._size > 0)
+
+    def finish(self):
+        self._finished = True
+
+
+class RandomShufflingBuffer(ShufflingBufferBase):
+    """Random-order buffer with a decorrelation floor (reference:
+    shuffling_buffer.py:80-180): holds up to ``shuffling_buffer_capacity`` rows; retrieval
+    is blocked until ``min_after_retrieve`` rows are present (until ``finish``)."""
+
+    def __init__(self, shuffling_buffer_capacity, min_after_retrieve, seed=None):
+        if min_after_retrieve > shuffling_buffer_capacity:
+            raise ValueError('min_after_retrieve must be <= capacity')
+        self._capacity = shuffling_buffer_capacity
+        self._min_after = min_after_retrieve
+        self._random = np.random.default_rng(seed)
+        self._store = None
+        self._size = 0
+        self._finished = False
+
+    def add_many(self, columns):
+        if self._finished:
+            raise RuntimeError('Cannot add to a finished shuffling buffer')
+        n = _num_rows(columns)
+        if not n:
+            return
+        self._store = columns if self._store is None \
+            else _concat_columns([self._store, columns])
+        self._size = _num_rows(self._store)
+
+    def can_add(self):
+        return self._size < self._capacity and not self._finished
+
+    def retrieve(self, n):
+        available = self._size if self._finished else self._size - self._min_after
+        take = min(n, max(0, available)) if self._finished else n
+        if not self._finished and self._size - n < self._min_after:
+            raise RuntimeError('Retrieval would drop below min_after_retrieve; buffer '
+                               'more rows first (size={}, min={})'
+                               .format(self._size, self._min_after))
+        permutation = self._random.permutation(self._size)
+        pick, keep = permutation[:take], permutation[take:]
+        result = _gather(self._store, pick)
+        self._store = _gather(self._store, keep) if len(keep) else None
+        self._size = len(keep)
+        return result
+
+    @property
+    def size(self):
+        return self._size
+
+    def can_retrieve(self, n):
+        if self._finished:
+            return self._size > 0
+        return self._size - n >= self._min_after
+
+    def finish(self):
+        self._finished = True

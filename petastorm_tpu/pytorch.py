@@ -1,0 +1,254 @@
+"""PyTorch adapters (reference: petastorm/pytorch.py:126-496) — thin wrappers over the
+columnar core for capability parity (SURVEY.md §7.1 item 8); the JAX loader
+(petastorm_tpu.parallel) is the primary device path.
+
+``DataLoader`` — row-based with optional shuffling buffer and decimal-friendly collate.
+``BatchedDataLoader`` — columnar fast path over batched readers.
+``InMemBatchedDataLoader`` — loads once, then epochs of in-memory random batches.
+All yield dicts of torch tensors.
+"""
+
+import decimal
+from collections.abc import Mapping
+
+import numpy as np
+
+from petastorm_tpu.parallel.shuffling_buffer import (NoopShufflingBuffer,
+                                                     RandomShufflingBuffer)
+
+
+def _sanitize_value(name, value):
+    """Dtype sanitization (reference: pytorch.py:40-65): bool->uint8, unsigned promote,
+    Decimal->float64; None and strings are rejected with the field named."""
+    if value is None:
+        raise TypeError('Field {!r} is None; use a TransformSpec or schema_fields to '
+                        'drop nullable fields before the torch loader'.format(name))
+    if isinstance(value, decimal.Decimal):
+        return np.float64(value)
+    if isinstance(value, (str, bytes)):
+        raise TypeError('Field {!r} is a string; torch tensors cannot hold strings — '
+                        'drop it via schema_fields'.format(name))
+    arr = np.asarray(value)
+    if arr.dtype == np.bool_:
+        return arr.astype(np.uint8)
+    if arr.dtype == np.uint16:
+        return arr.astype(np.int32)
+    if arr.dtype == np.uint32:
+        return arr.astype(np.int64)
+    if arr.dtype.kind == 'M':
+        return arr.astype('datetime64[ns]').astype(np.int64)
+    if arr.dtype == object:
+        raise TypeError('Field {!r} has object dtype (strings/None?); drop it via '
+                        'schema_fields'.format(name))
+    return arr
+
+
+def decimal_friendly_collate(rows):
+    """Collate a list of row dicts into a dict of stacked torch tensors (reference:
+    pytorch.py:68-90)."""
+    import torch
+    first = rows[0]
+    if isinstance(first, Mapping):
+        return {name: decimal_friendly_collate([row[name] for row in rows])
+                for name in first}
+    sanitized = [_sanitize_value('<collate>', v) for v in rows]
+    return torch.as_tensor(np.stack(sanitized))
+
+
+class LoaderBase(object):
+    """Iteration guards shared by all loaders (reference: pytorch.py:98-123): no
+    concurrent iteration, auto reader reset on re-iteration, error latching."""
+
+    def __init__(self, reader):
+        self.reader = reader
+        self._in_iter = False
+        self._error = None
+        self._started = False
+
+    def __iter__(self):
+        if self._in_iter:
+            raise RuntimeError('Concurrent iteration of a loader is not allowed')
+        if self._error is not None:
+            raise RuntimeError('Loader previously failed') from self._error
+        if self._started and getattr(self.reader, 'last_row_consumed', False):
+            self.reader.reset()
+        self._started = True
+        self._in_iter = True
+        try:
+            yield from self._iter_impl()
+        except Exception as exc:
+            self._error = exc
+            raise
+        finally:
+            self._in_iter = False
+
+    def _iter_impl(self):
+        raise NotImplementedError()
+
+    def stop(self):
+        self.reader.stop()
+
+    def join(self):
+        self.reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()
+
+
+class DataLoader(LoaderBase):
+    """Row-based loader: reader rows -> optional RandomShufflingBuffer -> fixed-size
+    collated batches (reference: pytorch.py:126-251)."""
+
+    def __init__(self, reader, batch_size=1, collate_fn=decimal_friendly_collate,
+                 shuffling_queue_capacity=0, seed=None):
+        super().__init__(reader)
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.shuffling_queue_capacity = shuffling_queue_capacity
+        self._seed = seed
+
+    def _iter_impl(self):
+        batch = []
+        for window in self._row_stream():
+            batch.append(window)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch:
+            yield self.collate_fn(batch)
+
+    def _row_stream(self):
+        if self.shuffling_queue_capacity > 0:
+            rng = np.random.default_rng(self._seed)
+            buffer = []
+            for row in self.reader:
+                row_dict = {k: _sanitize_value(k, v) for k, v in row._asdict().items()}
+                if len(buffer) < self.shuffling_queue_capacity:
+                    buffer.append(row_dict)
+                    continue
+                index = rng.integers(len(buffer))
+                yield buffer[index]
+                buffer[index] = row_dict
+            rng.shuffle(buffer)
+            yield from buffer
+        else:
+            for row in self.reader:
+                yield {k: _sanitize_value(k, v) for k, v in row._asdict().items()}
+
+
+class BatchedDataLoader(LoaderBase):
+    """Columnar fast path over a batched reader (reference: pytorch.py:254-365):
+    per-column ``transform_fn`` (default torch.as_tensor), columnar shuffling buffers."""
+
+    def __init__(self, reader, batch_size=1, transform_fn=None,
+                 shuffling_queue_capacity=0, seed=None):
+        super().__init__(reader)
+        if not getattr(reader, 'is_batched_reader', False):
+            raise ValueError('BatchedDataLoader requires a make_batch_reader reader')
+        self.batch_size = batch_size
+        self.shuffling_queue_capacity = shuffling_queue_capacity
+        self._seed = seed
+        if transform_fn is None:
+            import torch
+            transform_fn = torch.as_tensor
+        self.transform_fn = transform_fn
+
+    def _iter_impl(self):
+        if self.shuffling_queue_capacity > 0:
+            buffer = RandomShufflingBuffer(self.shuffling_queue_capacity,
+                                           self.shuffling_queue_capacity // 2,
+                                           seed=self._seed)
+        else:
+            buffer = NoopShufflingBuffer()
+        for batch in self.reader:
+            columns = {name: _sanitize_value(name, col)
+                       for name, col in batch._asdict().items()}
+            buffer.add_many(columns)
+            while buffer.can_retrieve(self.batch_size):
+                yield self._to_torch(buffer.retrieve(self.batch_size))
+        buffer.finish()
+        while buffer.can_retrieve(1):
+            yield self._to_torch(buffer.retrieve(self.batch_size))
+
+    def _to_torch(self, columns):
+        return {name: self.transform_fn(np.ascontiguousarray(col))
+                for name, col in columns.items()}
+
+
+class InMemBatchedDataLoader(LoaderBase):
+    """Loads up to ``rows_capacity`` rows once, then serves ``num_epochs`` of seeded
+    random (or sequential) batches from memory — avoids re-IO across epochs (reference:
+    pytorch.py:368-496)."""
+
+    def __init__(self, reader, batch_size=1, rows_capacity=None, num_epochs=1,
+                 shuffle=True, seed=0):
+        super().__init__(reader)
+        self.batch_size = batch_size
+        self._num_epochs = num_epochs
+        self._shuffle = shuffle
+        self._seed = seed
+        self._columns = None
+        self._rows = 0
+        self._capacity = rows_capacity
+
+    def _fill(self):
+        import torch
+        parts = []
+        count = 0
+        if getattr(self.reader, 'is_batched_reader', False):
+            for batch in self.reader:
+                columns = {k: _sanitize_value(k, v) for k, v in batch._asdict().items()}
+                parts.append(columns)
+                count += len(next(iter(columns.values())))
+                if self._capacity is not None and count >= self._capacity:
+                    break
+        else:
+            rows = []
+            for row in self.reader:
+                rows.append({k: _sanitize_value(k, v) for k, v in row._asdict().items()})
+                count += 1
+                if self._capacity is not None and count >= self._capacity:
+                    break
+            if rows:
+                parts.append({name: np.stack([r[name] for r in rows])
+                              for name in rows[0]})
+        # Stop the reader right away: avoids deadlocking an infinite-epoch reader
+        # (reference: pytorch.py:420-424).
+        self.reader.stop()
+        self.reader.join()
+        if not parts:
+            raise ValueError('Reader produced no rows to preload')
+        merged = {name: np.concatenate([p[name] for p in parts])[:self._capacity]
+                  for name in parts[0]}
+        self._columns = {name: torch.as_tensor(col) for name, col in merged.items()}
+        self._rows = len(next(iter(merged.values())))
+
+    def _iter_impl(self):
+        import torch
+        if self._columns is None:
+            self._fill()
+        for epoch in range(self._num_epochs):
+            if self._shuffle:
+                generator = torch.Generator()
+                generator.manual_seed(self._seed + epoch)
+                order = torch.randperm(self._rows, generator=generator)
+            else:
+                order = torch.arange(self._rows)
+            for start in range(0, self._rows - self.batch_size + 1, self.batch_size):
+                indices = order[start:start + self.batch_size]
+                yield {name: col[indices] for name, col in self._columns.items()}
+
+    def __iter__(self):
+        # Unlike the streaming loaders, re-iteration is always allowed (data is in
+        # memory) and the reader is already stopped.
+        if self._in_iter:
+            raise RuntimeError('Concurrent iteration of a loader is not allowed')
+        self._in_iter = True
+        try:
+            yield from self._iter_impl()
+        finally:
+            self._in_iter = False

@@ -297,6 +297,22 @@ class Reader(object):
         """Total rows in this shard per epoch (reference: reader.py:492-494)."""
         return sum(rg.row_group_num_rows for rg in self._shard_row_groups)
 
+    def iter_columnar(self):
+        """Iterate raw :class:`ColumnarBatch` results straight off the worker pool —
+        the zero-copy fast path for columnar consumers (JaxDataLoader), skipping the
+        per-row namedtuple conversion of ``__next__``. Do not interleave with ``next()``;
+        not available for NGram readers."""
+        if self.ngram is not None:
+            raise ValueError('iter_columnar is not supported with NGram windows')
+        while True:
+            if self._stopped:
+                raise RuntimeError('Trying to read from a stopped reader')
+            try:
+                yield self._pool.get_results()
+            except EmptyResultError:
+                self.last_row_consumed = True
+                return
+
     def reset(self):
         """Re-ventilate for another ``num_epochs`` pass; only valid after full consumption
         (reference: reader.py:496-520)."""

@@ -226,8 +226,7 @@ class RowGroupWorker(WorkerBase):
             arrow_col = table.column(name)
             if field is not None and field.codec is not None and setup.decode:
                 values = arrow_col.to_pylist()
-                decoded = [None if v is None else field.codec.decode(field, v)
-                           for v in values]
+                decoded = field.codec.decode_column(field, values)
                 columns[name] = _stack_if_uniform(decoded, field)
             elif field is not None and field.shape != () and setup.decode:
                 values = arrow_col.to_pylist()

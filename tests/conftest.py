@@ -8,10 +8,18 @@ is covered by bench.py / __graft_entry__.py which the driver runs on hardware.
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Unconditional override: the ambient environment may point JAX at real accelerator
+# hardware (e.g. JAX_PLATFORMS=axon); tests must run on the virtual CPU mesh. The env
+# var alone is not enough on this image (the accelerator plugin pins the platform at
+# import), so the config update below is load-bearing.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 existing = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in existing:
     os.environ['XLA_FLAGS'] = (existing + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

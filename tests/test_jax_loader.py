@@ -1,0 +1,191 @@
+"""JaxDataLoader + mesh tests on the virtual 8-device CPU platform (SURVEY.md §4
+'Implication for the TPU build': multi-host logic without hardware)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.parallel import JaxDataLoader, batch_sharding, make_mesh
+from petastorm_tpu.parallel.mesh import distributed_shard_info
+
+
+def test_virtual_devices_present():
+    assert len(jax.devices()) == 8  # conftest forces 8 CPU devices
+
+
+class TestMesh:
+    def test_make_mesh_single_axis(self):
+        mesh = make_mesh(('data',))
+        assert mesh.shape == {'data': 8}
+
+    def test_make_mesh_two_axes(self):
+        mesh = make_mesh(('data', 'model'), (4, 2))
+        assert mesh.shape == {'data': 4, 'model': 2}
+
+    def test_make_mesh_bad_sizes(self):
+        with pytest.raises(ValueError):
+            make_mesh(('data',), (3,))
+
+    def test_batch_sharding_default(self):
+        mesh = make_mesh(('data',))
+        sharding = batch_sharding(mesh)
+        assert sharding.spec == PartitionSpec('data')
+
+    def test_distributed_shard_info_explicit(self):
+        assert distributed_shard_info(2, 4) == (2, 4)
+        with pytest.raises(ValueError):
+            distributed_shard_info(2, None)
+
+    def test_distributed_shard_info_single_process(self):
+        assert distributed_shard_info() == (None, None)
+
+
+class TestLoader:
+    def test_batched_reader_to_device(self, scalar_dataset):
+        mesh = make_mesh(('data',))
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id', 'float64'],
+                               workers_count=2) as reader:
+            loader = JaxDataLoader(reader, batch_size=16, mesh=mesh)
+            batches = list(loader)
+        assert batches, 'no batches emitted'
+        for batch in batches:
+            assert isinstance(batch['id'], jax.Array)
+            assert batch['id'].shape == (16,)
+            assert batch['id'].sharding.spec == PartitionSpec('data')
+        ids = np.concatenate([np.asarray(b['id']) for b in batches])
+        assert len(set(ids.tolist())) == len(ids)
+
+    def test_row_reader_decoded_fields(self, synthetic_dataset):
+        mesh = make_mesh(('data',))
+        with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                         workers_count=2) as reader:
+            loader = JaxDataLoader(reader, batch_size=8, mesh=mesh)
+            batch = next(iter(loader))
+        assert batch['matrix'].shape == (8, 4, 3)
+        # values round-trip to device correctly
+        host = np.asarray(batch['matrix'])
+        ids = np.asarray(batch['id'])
+        source = synthetic_dataset.rows_by_id[int(ids[0])]
+        np.testing.assert_array_almost_equal(host[0], source['matrix'])
+
+    def test_no_mesh_single_device(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                               workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=10)
+            batch = next(iter(loader))
+        assert isinstance(batch['id'], jax.Array)
+
+    def test_drop_last(self, scalar_dataset):
+        # 50 rows, batch 16 -> 3 batches of 16, partial 2 dropped
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                               workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=16)
+            batches = list(loader)
+        assert len(batches) == 3
+
+    def test_keep_last_partial(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                               workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=16, drop_last=False)
+            batches = list(loader)
+        assert sum(b['id'].shape[0] for b in batches) == 50
+
+    def test_string_field_rejected_with_name(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id', 'string'],
+                               workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=10)
+            with pytest.raises(ValueError, match='string'):
+                list(loader)
+
+    def test_string_field_ok_host_mode(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id', 'string'],
+                               workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=10, device_put=False)
+            batch = next(iter(loader))
+        assert batch['string'][0].startswith('value_')
+
+    def test_ragged_requires_pad(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix_var'],
+                         workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=8)
+            with pytest.raises(ValueError, match='pad_ragged'):
+                list(loader)
+
+    def test_pad_ragged_emits_padded_and_lengths(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix_var'],
+                         workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=8,
+                                   pad_ragged={'matrix_var': (10, 2)})
+            batch = next(iter(loader))
+        assert batch['matrix_var'].shape == (8, 10, 2)
+        assert batch['matrix_var_len'].shape == (8,)
+        lengths = np.asarray(batch['matrix_var_len'])
+        ids = np.asarray(batch['id'])
+        source = synthetic_dataset.rows_by_id[int(ids[0])]['matrix_var']
+        assert lengths[0] == source.shape[0]
+        np.testing.assert_array_equal(np.asarray(batch['matrix_var'])[0, :lengths[0]],
+                                      source)
+
+    def test_shuffling_buffer_changes_order(self, scalar_dataset):
+        def read_ids(shuffle_capacity):
+            with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                                   shuffle_row_groups=False, workers_count=1) as reader:
+                loader = JaxDataLoader(reader, batch_size=10,
+                                       shuffling_queue_capacity=shuffle_capacity,
+                                       seed=3, drop_last=False)
+                return np.concatenate([np.asarray(b['id']) for b in loader]).tolist()
+        ordered = read_ids(0)
+        shuffled = read_ids(30)
+        assert sorted(ordered) == sorted(shuffled)
+        assert ordered != shuffled
+
+    def test_stats_collected(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                               workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=10)
+            list(loader)
+        stats = loader.stats.as_dict()
+        assert stats['batches'] == 5
+        assert stats['rows'] == 50
+        assert 0.0 <= stats['input_stall_fraction'] <= 1.0
+
+    def test_reiteration_resets_reader(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                               workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=25)
+            first = list(loader)
+            second = list(loader)
+        assert len(first) == len(second) == 2
+
+    def test_error_propagates_from_producer(self, synthetic_dataset):
+        from petastorm_tpu.transform import TransformSpec
+
+        def bad(row):
+            raise RuntimeError('producer boom')
+
+        with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         transform_spec=TransformSpec(bad), workers_count=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=8)
+            with pytest.raises(RuntimeError, match='producer boom'):
+                list(loader)
+
+    def test_training_step_consumes_sharded_batch(self, synthetic_dataset):
+        """A jitted data-parallel train step over the 8-device mesh consumes loader
+        batches without resharding (the end-to-end contract)."""
+        import jax.numpy as jnp
+        mesh = make_mesh(('data',))
+        with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                         workers_count=2) as reader:
+            loader = JaxDataLoader(reader, batch_size=16, mesh=mesh)
+
+            @jax.jit
+            def step(batch):
+                x = batch['matrix'].astype(jnp.float32).reshape(16, -1)
+                return jnp.mean(x ** 2)
+
+            losses = [float(step(b)) for b in loader]
+        assert len(losses) == 6  # 100 rows, batch 16, drop_last
+        assert all(np.isfinite(l) for l in losses)

@@ -1,0 +1,133 @@
+"""PyTorch adapter tests (model: petastorm/tests/test_pytorch_dataloader.py, 333 LoC)."""
+
+import numpy as np
+import pytest
+import torch
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.pytorch import BatchedDataLoader, DataLoader, InMemBatchedDataLoader
+
+
+FIELDS = ['id', 'matrix', 'python_primitive_uint8']
+
+
+def test_dataloader_batches(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=FIELDS,
+                     workers_count=2) as reader:
+        loader = DataLoader(reader, batch_size=10)
+        batches = list(loader)
+    assert sum(b['id'].shape[0] for b in batches) == 100
+    batch = batches[0]
+    assert isinstance(batch['matrix'], torch.Tensor)
+    assert batch['matrix'].shape[1:] == (4, 3)
+
+
+def test_dataloader_values_roundtrip(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=FIELDS,
+                     workers_count=1) as reader:
+        batch = next(iter(DataLoader(reader, batch_size=4)))
+    i = int(batch['id'][0])
+    source = synthetic_dataset.rows_by_id[i]
+    np.testing.assert_array_almost_equal(batch['matrix'][0].numpy(), source['matrix'])
+
+
+def test_dataloader_shuffling_queue(synthetic_dataset):
+    def read(shuffle):
+        with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         shuffle_row_groups=False, workers_count=1) as reader:
+            loader = DataLoader(reader, batch_size=100,
+                                shuffling_queue_capacity=50 if shuffle else 0, seed=1)
+            return torch.cat([b['id'] for b in loader]).tolist()
+    plain, shuffled = read(False), read(True)
+    assert sorted(plain) == sorted(shuffled)
+    assert plain != shuffled
+
+
+def test_dataloader_rejects_strings(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['id', 'sensor_name'],
+                     workers_count=1) as reader:
+        loader = DataLoader(reader, batch_size=4)
+        with pytest.raises(TypeError, match='sensor_name'):
+            next(iter(loader))
+
+
+def test_dataloader_no_concurrent_iteration(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     workers_count=1, num_epochs=None) as reader:
+        loader = DataLoader(reader, batch_size=4)
+        it = iter(loader)
+        next(it)
+        with pytest.raises(RuntimeError, match='Concurrent'):
+            next(iter(loader))
+
+
+def test_batched_dataloader(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, schema_fields=['id', 'float64'],
+                           workers_count=1) as reader:
+        loader = BatchedDataLoader(reader, batch_size=16)
+        batches = list(loader)
+    assert sum(len(b['id']) for b in batches) == 50
+    assert isinstance(batches[0]['float64'], torch.Tensor)
+
+
+def test_batched_dataloader_requires_batch_reader(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, workers_count=1) as reader:
+        with pytest.raises(ValueError):
+            BatchedDataLoader(reader, batch_size=4)
+
+
+def test_batched_dataloader_shuffle(scalar_dataset):
+    def read(capacity):
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                               shuffle_row_groups=False, workers_count=1) as reader:
+            loader = BatchedDataLoader(reader, batch_size=10,
+                                       shuffling_queue_capacity=capacity, seed=5)
+            return torch.cat([b['id'] for b in loader]).tolist()
+    plain, shuffled = read(0), read(40)
+    assert sorted(plain) == sorted(shuffled)
+    assert plain != shuffled
+
+
+def test_inmem_loader_epochs(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                           workers_count=1, num_epochs=1) as reader:
+        loader = InMemBatchedDataLoader(reader, batch_size=10, num_epochs=3, seed=7)
+        batches = list(loader)
+    assert len(batches) == 15  # 50 rows / 10 per batch * 3 epochs
+    first_epoch = torch.cat([b['id'] for b in batches[:5]]).tolist()
+    second_epoch = torch.cat([b['id'] for b in batches[5:10]]).tolist()
+    assert sorted(first_epoch) == sorted(second_epoch)
+    assert first_epoch != second_epoch  # different seeded permutation per epoch
+
+
+def test_inmem_loader_capacity(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                           workers_count=1) as reader:
+        loader = InMemBatchedDataLoader(reader, batch_size=10, rows_capacity=20,
+                                        num_epochs=1)
+        total = sum(len(b['id']) for b in loader)
+    assert total == 20
+
+
+def test_weighted_sampling_reader(synthetic_dataset):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    r1 = make_reader(synthetic_dataset.url, schema_fields=['id'], workers_count=1,
+                     num_epochs=None)
+    r2 = make_reader(synthetic_dataset.url, schema_fields=['id'], workers_count=1,
+                     num_epochs=None)
+    with WeightedSamplingReader([r1, r2], [0.8, 0.2], seed=0) as mixed:
+        rows = [next(mixed) for _ in range(50)]
+    assert len(rows) == 50
+
+
+def test_weighted_sampling_validates_schemas(synthetic_dataset):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    r1 = make_reader(synthetic_dataset.url, schema_fields=['id'], workers_count=1)
+    r2 = make_reader(synthetic_dataset.url, schema_fields=['id', 'id2'], workers_count=1)
+    try:
+        with pytest.raises(ValueError, match='same fields'):
+            WeightedSamplingReader([r1, r2], [0.5, 0.5])
+    finally:
+        for r in (r1, r2):
+            r.stop()
+            r.join()
