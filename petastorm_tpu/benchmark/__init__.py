@@ -1,0 +1,3 @@
+"""Throughput benchmarking (reference: petastorm/benchmark/)."""
+
+from petastorm_tpu.benchmark.throughput import BenchmarkResult, reader_throughput  # noqa: F401

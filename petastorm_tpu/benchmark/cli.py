@@ -1,0 +1,47 @@
+"""Throughput CLI: ``python -m petastorm_tpu.benchmark.cli <dataset_url>`` (reference:
+petastorm/benchmark/cli.py / petastorm-throughput.py console script)."""
+
+import argparse
+import logging
+import sys
+
+from petastorm_tpu.benchmark.throughput import READ_JAX, READ_PYTHON, reader_throughput
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Measure petastorm_tpu reader throughput on a dataset')
+    parser.add_argument('dataset_url')
+    parser.add_argument('-f', '--field-regex', nargs='+',
+                        help='read only fields matching these regexes')
+    parser.add_argument('-w', '--workers-count', type=int, default=3)
+    parser.add_argument('-p', '--pool-type', choices=['thread', 'process', 'dummy'],
+                        default='thread')
+    parser.add_argument('-m', '--warmup-cycles', type=int, default=200)
+    parser.add_argument('-n', '--measure-cycles', type=int, default=1000)
+    parser.add_argument('-d', '--read-method', choices=[READ_PYTHON, READ_JAX],
+                        default=READ_PYTHON)
+    parser.add_argument('-q', '--spawn-new-process', action='store_true',
+                        help='measure in a fresh interpreter for a clean RSS reading')
+    parser.add_argument('--jax-batch-size', type=int, default=256)
+    parser.add_argument('--no-shuffle-row-groups', action='store_true')
+    parser.add_argument('-v', '--verbose', action='store_true')
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    result = reader_throughput(
+        args.dataset_url, field_regex=args.field_regex,
+        warmup_cycles_count=args.warmup_cycles,
+        measure_cycles_count=args.measure_cycles, pool_type=args.pool_type,
+        loaders_count=args.workers_count, read_method=args.read_method,
+        shuffle_row_groups=not args.no_shuffle_row_groups,
+        jax_batch_size=args.jax_batch_size, spawn_new_process=args.spawn_new_process)
+    print('Throughput: {:.2f} samples/sec; RSS: {:.2f} MB; CPU: {:.2f}%{}'.format(
+        result.samples_per_second, result.memory_info.rss / (1 << 20), result.cpu,
+        '; input-stall: {:.1%}'.format(result.input_stall_fraction)
+        if result.input_stall_fraction else ''))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

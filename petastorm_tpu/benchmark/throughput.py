@@ -1,0 +1,84 @@
+"""Reader throughput measurement (reference: petastorm/benchmark/throughput.py:112-217).
+
+Warmup/measure cycle split, psutil RSS + CPU%%, rows/sec — plus the TPU additions the
+build plan calls for (SURVEY.md §6): per-chip rates and input-stall%% when measuring
+through the JAX loader.
+"""
+
+import logging
+import time
+from collections import namedtuple
+
+logger = logging.getLogger(__name__)
+
+BenchmarkResult = namedtuple('BenchmarkResult',
+                             ['time_mean', 'samples_per_second', 'memory_info', 'cpu',
+                              'input_stall_fraction'])
+
+READ_PYTHON = 'python'
+READ_JAX = 'jax'
+
+
+def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
+                      measure_cycles_count=1000, pool_type='thread', loaders_count=3,
+                      read_method=READ_PYTHON, shuffle_row_groups=True,
+                      jax_batch_size=256, spawn_new_process=False):
+    """Measure read throughput of a dataset (reference: throughput.py:112-172).
+
+    ``read_method='python'`` iterates raw reader rows; ``'jax'`` drives a JaxDataLoader
+    (cycle = one batch) and also reports the loader's input-stall fraction.
+    ``spawn_new_process`` re-runs the measurement in a fresh interpreter for a clean
+    RSS reading (reference: throughput.py:144-149)."""
+    if spawn_new_process:
+        from petastorm_tpu.utils import run_in_subprocess
+        return run_in_subprocess(reader_throughput, dataset_url, field_regex,
+                                 warmup_cycles_count, measure_cycles_count, pool_type,
+                                 loaders_count, read_method, shuffle_row_groups,
+                                 jax_batch_size, False)
+
+    import psutil
+    from petastorm_tpu.reader import make_reader
+
+    process = psutil.Process()
+    reader = make_reader(dataset_url, schema_fields=field_regex,
+                         reader_pool_type=pool_type, workers_count=loaders_count,
+                         shuffle_row_groups=shuffle_row_groups, num_epochs=None)
+    stall = 0.0
+    try:
+        if read_method == READ_PYTHON:
+            iterator = iter(reader)
+            rows_per_cycle = 1
+        elif read_method == READ_JAX:
+            from petastorm_tpu.parallel.loader import JaxDataLoader
+            loader = JaxDataLoader(reader, batch_size=jax_batch_size, prefetch=2)
+            iterator = iter(loader)
+            rows_per_cycle = jax_batch_size
+        else:
+            raise ValueError('Unknown read_method {!r}'.format(read_method))
+
+        for _ in range(warmup_cycles_count):
+            next(iterator)
+        process.cpu_percent()  # reset the cpu meter
+        start = time.perf_counter()
+        next_report = start + 5
+        for cycle in range(measure_cycles_count):
+            next(iterator)
+            now = time.perf_counter()
+            if now > next_report:
+                logger.debug('cycle %d/%d, %.1f rows/s, diagnostics=%s', cycle,
+                             measure_cycles_count,
+                             (cycle + 1) * rows_per_cycle / (now - start),
+                             getattr(reader, 'diagnostics', {}))
+                next_report = now + 5
+        elapsed = time.perf_counter() - start
+        cpu = process.cpu_percent()
+        memory = process.memory_info()
+        if read_method == READ_JAX:
+            stall = loader.stats.input_stall_fraction
+        rate = measure_cycles_count * rows_per_cycle / elapsed
+        return BenchmarkResult(time_mean=elapsed / measure_cycles_count,
+                               samples_per_second=rate, memory_info=memory, cpu=cpu,
+                               input_stall_fraction=stall)
+    finally:
+        reader.stop()
+        reader.join()

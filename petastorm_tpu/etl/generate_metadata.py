@@ -1,0 +1,59 @@
+"""Regenerate/attach petastorm_tpu metadata on an existing Parquet store (reference:
+petastorm/etl/petastorm_generate_metadata.py:48-160). CLI:
+``python -m petastorm_tpu.etl.generate_metadata <dataset_url> [--unischema-class path]``.
+"""
+
+import argparse
+import logging
+import sys
+from pydoc import locate
+
+from petastorm_tpu.etl import dataset_metadata
+from petastorm_tpu.unischema import Unischema
+
+logger = logging.getLogger(__name__)
+
+
+def generate_metadata(dataset_url, unischema_class=None, storage_options=None):
+    """(Re)write ``_common_metadata`` for an existing store. Schema source priority:
+    explicit dotted-path class > already-embedded schema (incl. legacy petastorm pickles,
+    which get upgraded to the JSON key) > Arrow-schema inference."""
+    if unischema_class:
+        schema = locate(unischema_class)
+        if schema is None or not isinstance(schema, Unischema):
+            raise ValueError('{} does not resolve to a Unischema instance'
+                             .format(unischema_class))
+    else:
+        handle = dataset_metadata.open_dataset(dataset_url,
+                                               storage_options=storage_options)
+        schema = dataset_metadata.infer_or_load_unischema(handle)
+        logger.info('Using %s schema: %s',
+                    'embedded' if _has_embedded(handle) else 'inferred', schema.name)
+    with dataset_metadata.materialize_dataset(dataset_url, schema,
+                                              storage_options=storage_options):
+        pass  # data already exists; the context manager writes metadata on exit
+    return schema
+
+
+def _has_embedded(handle):
+    try:
+        dataset_metadata.get_schema(handle)
+        return True
+    except Exception:
+        return False
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('dataset_url')
+    parser.add_argument('--unischema-class',
+                        help='dotted path to a Unischema instance, e.g. '
+                             'examples.mnist.schema.MnistSchema')
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    generate_metadata(args.dataset_url, args.unischema_class)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

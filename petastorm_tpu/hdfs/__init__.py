@@ -1,0 +1,1 @@
+"""HDFS namenode resolution + failover (reference: petastorm/hdfs/)."""

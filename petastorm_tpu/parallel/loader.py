@@ -114,16 +114,29 @@ class JaxDataLoader(object):
         if self._in_iter:
             raise RuntimeError('Concurrent iteration of a JaxDataLoader is not allowed '
                                '(reference semantics: pytorch.py:98-123)')
+        if self._producer is not None and self._producer.is_alive():
+            # Previous iteration broken off early: stop and JOIN the old producer before
+            # touching queue/stop state, or it would write stale batches into the new
+            # iteration's queue.
+            self._stop_event.set()
+            self._drain_queue()
+            self._producer.join(timeout=30)
+            if self._producer.is_alive():
+                raise RuntimeError('Previous producer thread did not stop')
         if self.stats.batches and getattr(self.reader, 'last_row_consumed', False):
             # Re-iteration after full consumption: reset the reader like the reference's
             # LoaderBase (pytorch.py:104-123).
             self.reader.reset()
         self._in_iter = True
         self._error = None
-        self._stop_event.clear()
+        # Fresh Event per iteration: a (joined or straggling) old producer keeps its own
+        # already-set event and can never interfere with the new run.
+        self._stop_event = threading.Event()
         self._queue = queue.Queue(self._prefetch)
         self._sharding = self._resolve_sharding()
-        self._producer = threading.Thread(target=self._produce, daemon=True,
+        self._producer = threading.Thread(target=self._produce,
+                                          args=(self._queue, self._stop_event),
+                                          daemon=True,
                                           name='petastorm-tpu-loader-producer')
         self._producer.start()
         try:
@@ -145,12 +158,16 @@ class JaxDataLoader(object):
         finally:
             self._stop_event.set()
             self._in_iter = False
-            # Drain so the producer's bounded put never deadlocks.
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
+            self._drain_queue()
+
+    def _drain_queue(self):
+        if self._queue is None:
+            return
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
 
     @staticmethod
     def _batch_rows(batch):
@@ -169,7 +186,7 @@ class JaxDataLoader(object):
                                          seed=self._seed)
         return NoopShufflingBuffer()
 
-    def _produce(self):
+    def _produce(self, out_queue, stop_event):
         try:
             buffer = self._make_buffer()
             for columns in self._reader_chunks():
@@ -179,20 +196,22 @@ class JaxDataLoader(object):
                 for part in _iter_column_slices(columns, self.batch_size):
                     buffer.add_many(part)
                     while buffer.can_retrieve(self.batch_size):
-                        if self._stop_event.is_set():
+                        if stop_event.is_set():
                             return
-                        self._emit(buffer.retrieve(self.batch_size))
+                        self._emit(buffer.retrieve(self.batch_size), out_queue, stop_event)
+                if stop_event.is_set():
+                    return
             buffer.finish()
-            while buffer.can_retrieve(self.batch_size) and not self._stop_event.is_set():
+            while buffer.can_retrieve(self.batch_size) and not stop_event.is_set():
                 batch = buffer.retrieve(self.batch_size)
                 if self._batch_cols_rows(batch) < self.batch_size and self._drop_last:
                     break
-                self._emit(batch)
+                self._emit(batch, out_queue, stop_event)
         except Exception as exc:  # noqa: BLE001 - surface in consumer
-            if not self._stop_event.is_set():
+            if not stop_event.is_set():
                 self._error = exc
         finally:
-            self._put(_END)
+            self._put(_END, out_queue, stop_event)
 
     @staticmethod
     def _batch_cols_rows(columns):
@@ -250,7 +269,7 @@ class JaxDataLoader(object):
                 out[name] = np.ascontiguousarray(col)
         return out
 
-    def _emit(self, columns):
+    def _emit(self, columns, out_queue, stop_event):
         if self._device_put:
             import jax
             sharding = self._sharding
@@ -261,18 +280,18 @@ class JaxDataLoader(object):
                 batch = jax.device_put(columns, sharding)
         else:
             batch = columns
-        self._put(batch)
+        self._put(batch, out_queue, stop_event)
 
-    def _put(self, item):
-        while not self._stop_event.is_set():
+    def _put(self, item, out_queue, stop_event):
+        while not stop_event.is_set():
             try:
-                self._queue.put(item, timeout=0.1)
+                out_queue.put(item, timeout=0.1)
                 return
             except queue.Full:
                 continue
         if item is _END:
             try:
-                self._queue.put_nowait(_END)
+                out_queue.put_nowait(_END)
             except queue.Full:
                 pass
 

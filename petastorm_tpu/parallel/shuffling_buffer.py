@@ -2,10 +2,15 @@
 and pytorch_shuffling_buffer.py:22-279, unified).
 
 One numpy-columnar implementation serves every adapter (JAX, torch, TF): batches are
-dicts of ``(n, ...)`` arrays; retrieval gathers random indices. The random buffer keeps a
-``min_after_retrieve`` floor so samples stay decorrelated, exactly the reference's
-semantics. Not thread safe (same contract as the reference, shuffling_buffer.py:24-26).
+dicts of ``(n, ...)`` arrays (or lists for ragged fields). Both buffers hold added chunks
+as separate *parts* and only materialize the rows a retrieve touches — ``add_many`` never
+re-copies the whole store, so cost is amortized O(rows moved), not O(buffer) per call
+(the reference achieves the same with swap-to-end pops, shuffling_buffer.py:116-140).
+The random buffer keeps a ``min_after_retrieve`` decorrelation floor. Not thread safe
+(same contract as the reference, shuffling_buffer.py:24-26).
 """
+
+from collections import deque
 
 import numpy as np
 
@@ -30,12 +35,18 @@ class ShufflingBufferBase(object):
         raise NotImplementedError()
 
 
-def _concat_columns(parts):
+def _gather(columns, indices):
+    return {name: (col[indices] if isinstance(col, np.ndarray)
+                   else [col[i] for i in indices])
+            for name, col in columns.items()}
+
+
+def _concat_parts(parts):
     out = {}
     for name in parts[0]:
         values = [p[name] for p in parts]
         if isinstance(values[0], np.ndarray) and values[0].ndim >= 1:
-            out[name] = np.concatenate(values)
+            out[name] = np.concatenate(values) if len(values) > 1 else values[0]
         else:
             merged = []
             for v in values:
@@ -44,23 +55,23 @@ def _concat_columns(parts):
     return out
 
 
-def _gather(columns, indices):
-    return {name: (col[indices] if isinstance(col, np.ndarray)
-                   else [col[i] for i in indices])
-            for name, col in columns.items()}
-
-
 def _num_rows(columns):
     for col in columns.values():
         return len(col)
     return 0
 
 
+def _slice_columns(columns, start, stop):
+    return {name: col[start:stop] for name, col in columns.items()}
+
+
 class NoopShufflingBuffer(ShufflingBufferBase):
-    """FIFO pass-through (reference: shuffling_buffer.py:29-77)."""
+    """FIFO pass-through: deque of parts + read cursor into the head part (reference:
+    shuffling_buffer.py:29-77)."""
 
     def __init__(self):
-        self._parts = []
+        self._parts = deque()
+        self._head_offset = 0
         self._size = 0
         self._finished = False
 
@@ -77,12 +88,21 @@ class NoopShufflingBuffer(ShufflingBufferBase):
         if take > self._size:
             raise RuntimeError('Not enough rows buffered: asked {}, have {}'
                                .format(n, self._size))
-        merged = _concat_columns(self._parts) if self._parts else {}
-        result = _gather(merged, np.arange(take))
-        rest_indices = np.arange(take, _num_rows(merged))
-        self._parts = [_gather(merged, rest_indices)] if len(rest_indices) else []
+        pieces = []
+        needed = take
+        while needed > 0:
+            head = self._parts[0]
+            head_rows = _num_rows(head) - self._head_offset
+            use = min(head_rows, needed)
+            pieces.append(_slice_columns(head, self._head_offset,
+                                         self._head_offset + use))
+            needed -= use
+            self._head_offset += use
+            if self._head_offset >= _num_rows(head):
+                self._parts.popleft()
+                self._head_offset = 0
         self._size -= take
-        return result
+        return _concat_parts(pieces) if pieces else {}
 
     @property
     def size(self):
@@ -98,7 +118,12 @@ class NoopShufflingBuffer(ShufflingBufferBase):
 class RandomShufflingBuffer(ShufflingBufferBase):
     """Random-order buffer with a decorrelation floor (reference:
     shuffling_buffer.py:80-180): holds up to ``shuffling_buffer_capacity`` rows; retrieval
-    is blocked until ``min_after_retrieve`` rows are present (until ``finish``)."""
+    is blocked until ``min_after_retrieve`` rows would remain (until ``finish``).
+
+    Each added chunk stays a separate part with an array of still-alive row positions;
+    a retrieve samples uniformly over the global alive set (exact, without replacement)
+    and removes only the picked positions — no whole-store reshuffle or copy.
+    """
 
     def __init__(self, shuffling_buffer_capacity, min_after_retrieve, seed=None):
         if min_after_retrieve > shuffling_buffer_capacity:
@@ -106,7 +131,8 @@ class RandomShufflingBuffer(ShufflingBufferBase):
         self._capacity = shuffling_buffer_capacity
         self._min_after = min_after_retrieve
         self._random = np.random.default_rng(seed)
-        self._store = None
+        self._parts = []        # list of column dicts
+        self._alive = []        # list of int arrays: still-alive row positions per part
         self._size = 0
         self._finished = False
 
@@ -116,26 +142,36 @@ class RandomShufflingBuffer(ShufflingBufferBase):
         n = _num_rows(columns)
         if not n:
             return
-        self._store = columns if self._store is None \
-            else _concat_columns([self._store, columns])
-        self._size = _num_rows(self._store)
+        self._parts.append(columns)
+        self._alive.append(np.arange(n))
+        self._size += n
 
     def can_add(self):
         return self._size < self._capacity and not self._finished
 
     def retrieve(self, n):
-        available = self._size if self._finished else self._size - self._min_after
-        take = min(n, max(0, available)) if self._finished else n
-        if not self._finished and self._size - n < self._min_after:
-            raise RuntimeError('Retrieval would drop below min_after_retrieve; buffer '
-                               'more rows first (size={}, min={})'
-                               .format(self._size, self._min_after))
-        permutation = self._random.permutation(self._size)
-        pick, keep = permutation[:take], permutation[take:]
-        result = _gather(self._store, pick)
-        self._store = _gather(self._store, keep) if len(keep) else None
-        self._size = len(keep)
-        return result
+        if self._finished:
+            take = min(n, self._size)
+        else:
+            take = n
+            if self._size - n < self._min_after:
+                raise RuntimeError('Retrieval would drop below min_after_retrieve; '
+                                   'buffer more rows first (size={}, min={})'
+                                   .format(self._size, self._min_after))
+        counts = np.array([len(a) for a in self._alive])
+        cum = np.concatenate([[0], np.cumsum(counts)])
+        ranks = self._random.choice(self._size, size=take, replace=False)
+        part_ids = np.searchsorted(cum, ranks, side='right') - 1
+        pieces = []
+        for part_id in np.unique(part_ids):
+            local_ranks = ranks[part_ids == part_id] - cum[part_id]
+            positions = self._alive[part_id][local_ranks]
+            pieces.append(_gather(self._parts[part_id], positions))
+            self._alive[part_id] = np.delete(self._alive[part_id], local_ranks)
+        self._parts = [p for p, a in zip(self._parts, self._alive) if len(a)]
+        self._alive = [a for a in self._alive if len(a)]
+        self._size -= take
+        return _concat_parts(pieces) if pieces else {}
 
     @property
     def size(self):

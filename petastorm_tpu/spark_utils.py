@@ -1,0 +1,26 @@
+"""Spark adapter (reference: petastorm/spark_utils.py:24-52) — gated on pyspark being
+installed; the rest of the framework has no Spark dependency."""
+
+
+def dataset_as_rdd(dataset_url, spark_session, schema_fields=None, storage_options=None):
+    """Load a dataset as a Spark RDD of decoded namedtuples (reference:
+    spark_utils.py:24-52)."""
+    try:
+        import pyspark  # noqa: F401
+    except ImportError:
+        raise ImportError('dataset_as_rdd requires pyspark, which is not installed; '
+                          'use make_reader / make_batch_reader instead')
+    from petastorm_tpu.etl import dataset_metadata
+    from petastorm_tpu.unischema import decode_row
+
+    schema = dataset_metadata.get_schema_from_dataset_url(
+        dataset_url, storage_options=storage_options)
+    view = schema.create_schema_view(schema_fields) if schema_fields else schema
+    dataframe = spark_session.read.parquet(dataset_url)
+    dataframe = dataframe.select(*list(view.fields))
+
+    def _to_namedtuple(record):
+        decoded = decode_row(record.asDict(), view)
+        return view.make_namedtuple(**decoded)
+
+    return dataframe.rdd.map(_to_namedtuple)

@@ -1,0 +1,3 @@
+"""Test scaffolding shipped with the package (reference: petastorm/test_util/)."""
+
+from petastorm_tpu.test_util.reader_mock import ReaderMock  # noqa: F401

@@ -1,0 +1,61 @@
+"""A fake Reader for adapter tests — generates rows from a schema without any IO
+(reference: petastorm/test_util/reader_mock.py:19-84)."""
+
+import numpy as np
+
+from petastorm_tpu.generator import generate_random_datapoint
+from petastorm_tpu.unischema import decode_row, dict_to_encoded_row
+
+
+def schema_data_generator_example(schema, rng=None):
+    """Default generator: random datapoint per row, round-tripped through codecs so the
+    values look exactly like real reader output."""
+    rng = rng or np.random.RandomState(0)
+
+    def generate(row_index):
+        row = generate_random_datapoint(schema, rng)
+        return decode_row(dict_to_encoded_row(schema, row), schema)
+
+    return generate
+
+
+class ReaderMock(object):
+    """Mimics a Reader: iterates namedtuples produced by ``row_generator(index)``
+    forever (reference: reader_mock.py:19-84)."""
+
+    def __init__(self, schema, row_generator=None, num_rows=None):
+        self.schema = schema
+        self.result_schema = schema
+        self.is_batched_reader = False
+        self.ngram = None
+        self.last_row_consumed = False
+        self._row_generator = row_generator or schema_data_generator_example(schema)
+        self._num_rows = num_rows
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._num_rows is not None and self._index >= self._num_rows:
+            self.last_row_consumed = True
+            raise StopIteration
+        row = self._row_generator(self._index)
+        self._index += 1
+        return self.schema.make_namedtuple(**row)
+
+    next = __next__
+
+    def reset(self):
+        self._index = 0
+        self.last_row_consumed = False
+
+    def stop(self):
+        pass
+
+    def join(self):
+        pass
+
+    @property
+    def diagnostics(self):
+        return {}

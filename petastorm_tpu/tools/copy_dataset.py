@@ -1,0 +1,83 @@
+"""Dataset copy tool: column-subset / not-null filter / repartition + re-materialize with
+metadata (reference: petastorm/tools/copy_dataset.py:35-153 — Spark job there; a pure
+Arrow streaming copy here). Usable as a CLI:
+``python -m petastorm_tpu.tools.copy_dataset <source_url> <target_url> [options]``.
+"""
+
+import argparse
+import logging
+import sys
+
+import pyarrow.compute as pc
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+
+from petastorm_tpu.etl import dataset_metadata
+from petastorm_tpu.unischema import match_unischema_fields
+
+logger = logging.getLogger(__name__)
+
+
+def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
+                 rowgroup_size_mb=32, rows_per_file=None, storage_options=None):
+    """Copy a (petastorm_tpu or petastorm) dataset, optionally selecting a column subset
+    and dropping rows with nulls in ``not_null_fields``; the target gets fresh
+    metadata."""
+    source = dataset_metadata.open_dataset(source_url, storage_options=storage_options)
+    schema = dataset_metadata.infer_or_load_unischema(source)
+    if field_regex:
+        fields = match_unischema_fields(schema, field_regex)
+        if not fields:
+            raise ValueError('field_regex {} matched no fields of {}'
+                             .format(field_regex, list(schema.fields)))
+        schema = schema.create_schema_view(fields)
+    column_names = list(schema.fields)
+
+    filter_expr = None
+    for field_name in (not_null_fields or []):
+        expr = ~pc.field(field_name).is_null()
+        filter_expr = expr if filter_expr is None else (filter_expr & expr)
+
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    target_fs, target_path = get_filesystem_and_path_or_paths(
+        target_url, storage_options=storage_options)
+
+    with dataset_metadata.materialize_dataset(target_url, schema,
+                                              rowgroup_size_mb=rowgroup_size_mb,
+                                              storage_options=storage_options):
+        target_fs.create_dir(target_path, recursive=True)
+        scanner = pads.Scanner.from_dataset(source.arrow_dataset, columns=column_names,
+                                            filter=filter_expr)
+        table = scanner.to_table()
+        row_group_rows = max(1, (rowgroup_size_mb << 20)
+                             // max(1, table.nbytes // max(1, table.num_rows)))
+        if rows_per_file is None:
+            rows_per_file = table.num_rows or 1
+        for index, start in enumerate(range(0, table.num_rows, rows_per_file)):
+            chunk = table.slice(start, rows_per_file)
+            file_path = '{}/part_{:05d}.parquet'.format(target_path, index)
+            with target_fs.open_output_stream(file_path) as sink:
+                pq.write_table(chunk, sink, row_group_size=row_group_rows)
+    logger.info('Copied %d rows to %s', table.num_rows, target_url)
+    return table.num_rows
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('source_url')
+    parser.add_argument('target_url')
+    parser.add_argument('--field-regex', nargs='+')
+    parser.add_argument('--not-null-fields', nargs='+')
+    parser.add_argument('--rowgroup-size-mb', type=int, default=32)
+    parser.add_argument('--rows-per-file', type=int)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    copy_dataset(args.source_url, args.target_url, field_regex=args.field_regex,
+                 not_null_fields=args.not_null_fields,
+                 rowgroup_size_mb=args.rowgroup_size_mb,
+                 rows_per_file=args.rows_per_file)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,37 @@
+"""Misc utilities (reference: petastorm/utils.py:30-47 run_in_subprocess)."""
+
+import pickle
+
+
+def _subprocess_entry(serialized, result_queue):
+    import dill
+    func, args, kwargs = dill.loads(serialized)
+    try:
+        result_queue.put(('ok', pickle.dumps(func(*args, **kwargs))))
+    except Exception as exc:  # noqa: BLE001
+        import traceback
+        result_queue.put(('error', pickle.dumps((exc, traceback.format_exc()))))
+
+
+def run_in_subprocess(func, *args, **kwargs):
+    """Run ``func(*args, **kwargs)`` in a freshly spawned interpreter and return its
+    result (reference: petastorm/utils.py:30-47; spawn avoids fork-related breakage of
+    JVM / accelerator runtimes)."""
+    import multiprocessing as mp
+
+    import dill
+    ctx = mp.get_context('spawn')
+    result_queue = ctx.Queue()
+    serialized = dill.dumps((func, args, kwargs))
+    process = ctx.Process(target=_subprocess_entry, args=(serialized, result_queue))
+    process.start()
+    try:
+        status, payload = result_queue.get(timeout=600)
+    finally:
+        process.join(timeout=30)
+        if process.is_alive():
+            process.kill()
+    if status == 'error':
+        exc, tb = pickle.loads(payload)
+        raise RuntimeError('Subprocess failed:\n{}'.format(tb)) from exc
+    return pickle.loads(payload)

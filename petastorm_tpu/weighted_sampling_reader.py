@@ -54,8 +54,11 @@ class WeightedSamplingReader(object):
         return any(getattr(r, 'last_row_consumed', False) for r in self._readers)
 
     def reset(self):
+        # Mixing stops when ANY reader exhausts, so the others are mid-stream; only the
+        # exhausted ones can (and need to) restart — the rest keep their position.
         for reader in self._readers:
-            reader.reset()
+            if getattr(reader, 'last_row_consumed', False):
+                reader.reset()
 
     def __iter__(self):
         return self

@@ -143,9 +143,10 @@ class ProcessPool(object):
                     raise EmptyResultError()
                 if deadline is not None and time.time() > deadline:
                     raise TimeoutWaitingForResultError()
-                if any(p.poll() not in (None, 0) for p in self._processes):
+                if any(p.poll() is not None for p in self._processes):
                     self.stop()
-                    raise WorkerTerminationError('A worker process died unexpectedly')
+                    raise WorkerTerminationError('A worker process exited while results '
+                                                 'were still expected')
                 continue
             kind, payload = self._recv()
             if kind == MSG_DONE:

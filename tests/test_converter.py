@@ -1,0 +1,97 @@
+"""Converter tests (model: petastorm/tests/test_spark_dataset_converter.py, Spark-free)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from petastorm_tpu.converter import make_converter
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    return str(tmp_path / 'converter_cache')
+
+
+def _frame(n=60):
+    return pd.DataFrame({'x': np.arange(n, dtype=np.float32),
+                         'y': np.arange(n, dtype=np.int64) % 5})
+
+
+def test_requires_cache_dir(monkeypatch):
+    monkeypatch.delenv('PETASTORM_TPU_CONVERTER_CACHE_DIR', raising=False)
+    with pytest.raises(ValueError, match='cache dir'):
+        make_converter(_frame())
+
+
+def test_materialize_and_len(cache_dir):
+    converter = make_converter(_frame(), parent_cache_dir_url=cache_dir)
+    assert len(converter) == 60
+    assert converter.file_urls
+    converter.delete()
+
+
+def test_dedup_cache_hit(cache_dir):
+    c1 = make_converter(_frame(), parent_cache_dir_url=cache_dir)
+    c2 = make_converter(_frame(), parent_cache_dir_url=cache_dir)
+    assert c1.cache_dir_url == c2.cache_dir_url
+    c3 = make_converter(_frame(61), parent_cache_dir_url=cache_dir)
+    assert c3.cache_dir_url != c1.cache_dir_url
+    for c in (c1, c3):
+        c.delete()
+
+
+def test_env_var_cache_dir(cache_dir, monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_CONVERTER_CACHE_DIR', cache_dir)
+    converter = make_converter(_frame())
+    assert converter.cache_dir_url.startswith(cache_dir)
+    converter.delete()
+
+
+def test_delete_removes_store(cache_dir):
+    converter = make_converter(_frame(), parent_cache_dir_url=cache_dir)
+    path = converter.cache_dir_url
+    assert os.path.exists(path)
+    converter.delete()
+    assert not os.path.exists(path)
+
+
+def test_make_torch_dataloader(cache_dir):
+    converter = make_converter(_frame(), parent_cache_dir_url=cache_dir)
+    with converter.make_torch_dataloader(batch_size=20, workers_count=1) as loader:
+        batches = list(loader)
+    assert sum(len(b['x']) for b in batches) == 60
+    converter.delete()
+
+
+def test_make_tf_dataset(cache_dir):
+    pytest.importorskip('tensorflow')
+    converter = make_converter(_frame(), parent_cache_dir_url=cache_dir)
+    with converter.make_tf_dataset(batch_size=15, workers_count=1) as dataset:
+        batches = list(dataset)
+    assert sum(int(b['x'].shape[0]) for b in batches) == 60
+    converter.delete()
+
+
+def test_make_jax_loader(cache_dir):
+    converter = make_converter(_frame(64), parent_cache_dir_url=cache_dir)
+    with converter.make_jax_loader(batch_size=16, workers_count=1) as loader:
+        batches = list(loader)
+    assert len(batches) == 4
+    import jax
+    assert isinstance(batches[0]['x'], jax.Array)
+    converter.delete()
+
+
+def test_accepts_arrow_table(cache_dir):
+    import pyarrow as pa
+    table = pa.table({'a': [1, 2, 3]})
+    converter = make_converter(table, parent_cache_dir_url=cache_dir)
+    assert len(converter) == 3
+    converter.delete()
+
+
+def test_rejects_unknown_type(cache_dir):
+    with pytest.raises(TypeError):
+        make_converter([1, 2, 3], parent_cache_dir_url=cache_dir)

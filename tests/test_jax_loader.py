@@ -152,6 +152,22 @@ class TestLoader:
         assert stats['rows'] == 50
         assert 0.0 <= stats['input_stall_fraction'] <= 1.0
 
+    def test_reiteration_after_early_break(self, scalar_dataset):
+        """Breaking mid-epoch then re-iterating must not leak the old producer's batches
+        into the new iteration."""
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                               workers_count=1, num_epochs=None) as reader:
+            loader = JaxDataLoader(reader, batch_size=10, prefetch=2)
+            for batch in loader:
+                break  # abandon the epoch mid-way (closes the generator)
+            seen = []
+            for i, batch in enumerate(iter(loader)):
+                seen.append(np.asarray(batch['id']))
+                if i == 4:
+                    break
+            assert all(len(b) == 10 for b in seen)
+        loader.stop()
+
     def test_reiteration_resets_reader(self, scalar_dataset):
         with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
                                workers_count=1) as reader:

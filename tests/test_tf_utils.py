@@ -1,0 +1,84 @@
+"""TF adapter tests (model: petastorm/tests/test_tf_dataset.py + test_tf_utils.py)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip('tensorflow')
+
+from petastorm_tpu import make_batch_reader, make_reader  # noqa: E402
+from petastorm_tpu.ngram import NGram  # noqa: E402
+from petastorm_tpu.tf_utils import make_petastorm_dataset, tf_tensors  # noqa: E402
+
+FIELDS = ['id', 'matrix', 'sensor_name']
+
+
+def test_dataset_row_reader(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=FIELDS,
+                     workers_count=2) as reader:
+        dataset = make_petastorm_dataset(reader)
+        rows = list(dataset.take(100))
+    assert len(rows) == 100
+    first = rows[0]
+    assert first['matrix'].shape == (4, 3)
+    an_id = int(first['id'].numpy())
+    source = synthetic_dataset.rows_by_id[an_id]
+    np.testing.assert_array_almost_equal(first['matrix'].numpy(), source['matrix'])
+    assert first['sensor_name'].numpy().decode() == source['sensor_name']
+
+
+def test_dataset_batch_reader(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, schema_fields=['id', 'float64'],
+                           workers_count=1) as reader:
+        dataset = make_petastorm_dataset(reader)
+        batches = list(dataset)
+    total = sum(int(b['id'].shape[0]) for b in batches)
+    assert total == 50
+
+
+def test_dataset_pipeline_ops(scalar_dataset):
+    """unbatch/shuffle/batch like the converter wires it."""
+    with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                           workers_count=1) as reader:
+        dataset = make_petastorm_dataset(reader).unbatch().shuffle(16).batch(10)
+        batches = list(dataset)
+    assert sum(int(b['id'].shape[0]) for b in batches) == 50
+
+
+def test_dataset_regeneration_resets(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     workers_count=1) as reader:
+        dataset = make_petastorm_dataset(reader)
+        first = len(list(dataset))
+        second = len(list(dataset))  # generator re-created -> reader reset
+    assert first == second == 100
+
+
+def test_dataset_ngram(tmp_path):
+    from test_common import create_test_dataset  # noqa: F401
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_rows
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('S', [UnischemaField('ts', np.int64, (), ScalarCodec(), False),
+                             UnischemaField('v', np.float32, (), ScalarCodec(), False)])
+    url = str(tmp_path / 'seq')
+    write_rows(url, schema, [{'ts': t, 'v': float(t)} for t in range(10)],
+               rows_per_file=10, rowgroup_size_mb=64)
+    ngram = NGram({0: ['ts', 'v'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+    with make_reader(url, schema_fields=ngram, workers_count=1,
+                     shuffle_row_groups=False) as reader:
+        dataset = make_petastorm_dataset(reader)
+        windows = list(dataset)
+    assert len(windows) == 9
+    assert int(windows[0][1]['ts'].numpy()) == int(windows[0][0]['ts'].numpy()) + 1
+
+
+def test_tf_tensors_graph_mode(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                     workers_count=1) as reader:
+        with tf.Graph().as_default():
+            row_tensors = tf_tensors(reader)
+            assert row_tensors.matrix.shape.as_list() == [4, 3]
+            with tf.compat.v1.Session() as session:
+                value = session.run(row_tensors)
+    source = synthetic_dataset.rows_by_id[int(value.id)]
+    np.testing.assert_array_almost_equal(value.matrix, source['matrix'])

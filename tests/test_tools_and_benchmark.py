@@ -1,0 +1,201 @@
+"""Tools / CLI / benchmark-harness / generator / mock / hdfs-resolver tests (model:
+petastorm tests for copy_dataset, generate_metadata, metadata_util, throughput,
+reader_mock, hdfs namenode)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.etl.dataset_metadata import get_schema, open_dataset
+
+
+class TestCopyDataset:
+    def test_full_copy(self, synthetic_dataset, tmp_path):
+        from petastorm_tpu.tools.copy_dataset import copy_dataset
+        target = str(tmp_path / 'copy')
+        count = copy_dataset(synthetic_dataset.url, target)
+        assert count == 100
+        with make_reader(target, workers_count=1) as reader:
+            assert len({row.id for row in reader}) == 100
+
+    def test_field_subset(self, synthetic_dataset, tmp_path):
+        from petastorm_tpu.tools.copy_dataset import copy_dataset
+        target = str(tmp_path / 'subset')
+        copy_dataset(synthetic_dataset.url, target, field_regex=['id.*'])
+        schema = get_schema(open_dataset(target))
+        assert set(schema.fields) == {'id', 'id2'}
+
+    def test_not_null_filter(self, synthetic_dataset, tmp_path):
+        from petastorm_tpu.tools.copy_dataset import copy_dataset
+        target = str(tmp_path / 'notnull')
+        count = copy_dataset(synthetic_dataset.url, target,
+                             field_regex=['id', 'nullable_int'],
+                             not_null_fields=['nullable_int'])
+        expected = sum(1 for r in synthetic_dataset.rows if r['nullable_int'] is not None)
+        assert count == expected
+
+    def test_cli(self, synthetic_dataset, tmp_path):
+        from petastorm_tpu.tools.copy_dataset import main
+        target = str(tmp_path / 'cli_copy')
+        assert main([synthetic_dataset.url, target, '--field-regex', 'id']) == 0
+
+
+class TestGenerateMetadata:
+    def test_regenerate_after_metadata_loss(self, tmp_path):
+        from test_common import create_test_dataset
+        from petastorm_tpu.etl.generate_metadata import generate_metadata
+        url = str(tmp_path / 'ds')
+        create_test_dataset(url, num_rows=10)
+        schema_before = get_schema(open_dataset(url))
+        os.remove(os.path.join(url, '_common_metadata'))
+        generate_metadata(url)  # infers (no codecs) but restores readability
+        handle = open_dataset(url)
+        assert get_schema(handle) is not None
+
+    def test_upgrades_legacy_pickle(self, tmp_path):
+        """A reference-written store gets its pickled schema upgraded to the JSON key."""
+        reference_dir = '/root/reference/petastorm/tests/data/legacy/0.7.6'
+        if not os.path.isdir(reference_dir):
+            pytest.skip('reference datasets not mounted')
+        import shutil
+        from petastorm_tpu.etl.dataset_metadata import (UNISCHEMA_JSON_KEY,
+                                                        read_metadata_dict)
+        from petastorm_tpu.etl.generate_metadata import generate_metadata
+        url = str(tmp_path / 'legacy_copy')
+        shutil.copytree(reference_dir, url)
+        generate_metadata(url)
+        md = read_metadata_dict(open_dataset(url))
+        assert UNISCHEMA_JSON_KEY in md
+        schema = get_schema(open_dataset(url))
+        assert schema.fields['matrix'].codec is not None  # codecs preserved
+
+    def test_metadata_util_cli(self, synthetic_dataset, capsys):
+        from petastorm_tpu.etl.metadata_util import main
+        assert main([synthetic_dataset.url]) == 0
+        out = capsys.readouterr().out
+        assert 'TestSchema' in out and 'rowgroups' in out
+
+
+class TestThroughput:
+    def test_reader_throughput(self, synthetic_dataset):
+        from petastorm_tpu.benchmark.throughput import reader_throughput
+        result = reader_throughput(synthetic_dataset.url, field_regex=['id'],
+                                   warmup_cycles_count=10, measure_cycles_count=30,
+                                   loaders_count=1)
+        assert result.samples_per_second > 0
+        assert result.memory_info.rss > 0
+
+    def test_jax_read_method(self, synthetic_dataset):
+        from petastorm_tpu.benchmark.throughput import READ_JAX, reader_throughput
+        result = reader_throughput(synthetic_dataset.url, field_regex=['id', 'matrix'],
+                                   warmup_cycles_count=2, measure_cycles_count=5,
+                                   loaders_count=1, read_method=READ_JAX,
+                                   jax_batch_size=8)
+        assert result.samples_per_second > 0
+        assert 0 <= result.input_stall_fraction <= 1
+
+    def test_cli(self, synthetic_dataset, capsys):
+        from petastorm_tpu.benchmark.cli import main
+        assert main([synthetic_dataset.url, '-f', 'id', '-m', '5', '-n', '20',
+                     '-w', '1']) == 0
+        assert 'Throughput' in capsys.readouterr().out
+
+
+class TestGeneratorAndMock:
+    def test_generate_random_datapoint(self):
+        from test_common import TestSchema
+        from petastorm_tpu.generator import generate_random_datapoint
+        row = generate_random_datapoint(TestSchema, np.random.RandomState(0))
+        assert set(row) == set(TestSchema.fields)
+        assert row['matrix'].shape == (4, 3)
+        assert row['matrix_var'].shape[1] == 2
+
+    def test_reader_mock_feeds_adapters(self):
+        from test_common import TestSchema
+        from petastorm_tpu.test_util.reader_mock import ReaderMock
+        view = TestSchema.create_schema_view(['id', 'matrix'])
+        mock = ReaderMock(view, num_rows=20)
+        from petastorm_tpu.pytorch import DataLoader
+        batches = list(DataLoader(mock, batch_size=5))
+        assert len(batches) == 4
+        assert batches[0]['matrix'].shape == (5, 4, 3)
+
+
+class TestBatchingTableQueue:
+    def test_rechunk(self):
+        import pyarrow as pa
+        from petastorm_tpu.arrow_helpers import BatchingTableQueue
+        queue = BatchingTableQueue(7)
+        queue.put(pa.table({'a': list(range(10))}))
+        assert not queue.empty()
+        first = queue.get()
+        assert first.num_rows == 7
+        assert queue.empty()
+        queue.put(pa.table({'a': list(range(10, 20))}))
+        second = queue.get()
+        assert second.num_rows == 7
+        assert second.column('a').to_pylist() == [7, 8, 9, 10, 11, 12, 13]
+
+
+class TestHdfsResolver:
+    CONFIG = {
+        'fs.defaultFS': 'hdfs://nameservice1',
+        'dfs.nameservices': 'nameservice1',
+        'dfs.ha.namenodes.nameservice1': 'nn1,nn2',
+        'dfs.namenode.rpc-address.nameservice1.nn1': 'host1:8020',
+        'dfs.namenode.rpc-address.nameservice1.nn2': 'host2:8020',
+    }
+
+    def test_resolve_ha_nameservice(self):
+        from petastorm_tpu.hdfs.namenode import HdfsNamenodeResolver
+        resolver = HdfsNamenodeResolver(self.CONFIG)
+        service, namenodes = resolver.resolve_default_hdfs_service()
+        assert service == 'nameservice1'
+        assert namenodes == ['host1:8020', 'host2:8020']
+
+    def test_direct_host_passthrough(self):
+        from petastorm_tpu.hdfs.namenode import HdfsNamenodeResolver
+        resolver = HdfsNamenodeResolver(self.CONFIG)
+        assert resolver.resolve_hdfs_name_service('other:9000') == ['other:9000']
+
+    def test_missing_rpc_address_raises(self):
+        from petastorm_tpu.hdfs.namenode import HdfsConfigError, HdfsNamenodeResolver
+        config = dict(self.CONFIG)
+        del config['dfs.namenode.rpc-address.nameservice1.nn2']
+        with pytest.raises(HdfsConfigError):
+            HdfsNamenodeResolver(config).resolve_hdfs_name_service('nameservice1')
+
+    def test_failover_connects_second_namenode(self):
+        from petastorm_tpu.hdfs.namenode import HdfsConnector
+
+        class MockConnector(HdfsConnector):
+            attempts = []
+
+            @classmethod
+            def hdfs_connect_namenode(cls, address, user=None):
+                cls.attempts.append(address)
+                if address.startswith('host1'):
+                    raise IOError('nn1 down')
+                return 'fs-{}'.format(address)
+
+        fs = MockConnector.connect_to_either_namenode(['host1:8020', 'host2:8020'])
+        assert fs == 'fs-host2:8020'
+        assert MockConnector.attempts.count('host1:8020') == 2  # retried then failed over
+
+    def test_all_down_raises(self):
+        from petastorm_tpu.hdfs.namenode import HdfsConnectError, HdfsConnector
+
+        class DeadConnector(HdfsConnector):
+            @classmethod
+            def hdfs_connect_namenode(cls, address, user=None):
+                raise IOError('down')
+
+        with pytest.raises(HdfsConnectError):
+            DeadConnector.connect_to_either_namenode(['host1:8020', 'host2:8020'])
+
+
+def test_run_in_subprocess():
+    from petastorm_tpu.utils import run_in_subprocess
+    assert run_in_subprocess(sum, [1, 2, 3]) == 6
