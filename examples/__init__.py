@@ -1,0 +1,3 @@
+"""Runnable examples for petastorm_tpu (parity target: reference examples/ tree —
+hello_world, mnist, imagenet, spark_dataset_converter). Every example runs offline on
+synthetic data; the JAX variants are the primary path, torch/TF show the parity adapters."""

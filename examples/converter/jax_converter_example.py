@@ -1,0 +1,66 @@
+"""Dataframe -> TPU in three lines: ``make_converter`` materializes a (pandas / Arrow /
+Spark) dataframe to Parquet once, then hands out mesh-sharded JAX loaders. TPU-native
+analog of the reference's Spark converter examples
+(examples/spark_dataset_converter/*_converter_example.py).
+
+Run: ``python -m examples.converter.jax_converter_example``
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pandas as pd
+
+from petastorm_tpu.converter import make_converter
+
+
+def run(cache_dir='/tmp/converter_cache', rows=1024, steps=30):
+    rng = np.random.default_rng(0)
+    features = rng.normal(size=(rows, 8)).astype(np.float32)
+    true_w = rng.normal(size=(8,)).astype(np.float32)
+    df = pd.DataFrame({
+        **{'f{}'.format(i): features[:, i] for i in range(8)},
+        'y': features @ true_w + 0.01 * rng.normal(size=rows).astype(np.float32),
+    })
+
+    converter = make_converter(df, parent_cache_dir_url='file://{}'.format(cache_dir))
+    print('materialized {} rows'.format(len(converter)))
+
+    import jax
+    w = jnp.zeros(8)
+    optimizer = optax.sgd(0.1)
+    opt_state = optimizer.init(w)
+
+    @jax.jit
+    def train_step(w, opt_state, x, y):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(w)
+        updates, opt_state = optimizer.update(grads, opt_state, w)
+        return optax.apply_updates(w, updates), opt_state, loss
+
+    loader = converter.make_jax_loader(batch_size=128, num_epochs=None)
+    loss = None
+    for step, batch in enumerate(loader):
+        if step >= steps:
+            break
+        x = jnp.stack([batch['f{}'.format(i)] for i in range(8)], axis=1)
+        w, opt_state, loss = train_step(w, opt_state, x, batch['y'])
+    loader.stop()
+    print('final loss {:.5f}; w error {:.4f}'.format(
+        loss, float(jnp.linalg.norm(w - true_w))))
+    converter.delete()
+    return float(loss)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--cache-dir', default='/tmp/converter_cache')
+    args = parser.parse_args()
+    run(args.cache_dir)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,46 @@
+"""Dataframe -> torch DataLoader via the converter (parity: reference
+examples/spark_dataset_converter/pytorch_converter_example.py)."""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+import torch
+
+from petastorm_tpu.converter import make_converter
+
+
+def run(cache_dir='/tmp/converter_cache_torch', rows=512, steps=20):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(rows, 4)).astype(np.float32)
+    df = pd.DataFrame({**{'x{}'.format(i): x[:, i] for i in range(4)},
+                       'y': (x.sum(axis=1) > 0).astype(np.int64)})
+    converter = make_converter(df, parent_cache_dir_url='file://{}'.format(cache_dir))
+
+    model = torch.nn.Sequential(torch.nn.Linear(4, 16), torch.nn.ReLU(),
+                                torch.nn.Linear(16, 2))
+    optimizer = torch.optim.Adam(model.parameters(), lr=1e-2)
+    loss = None
+    with converter.make_torch_dataloader(batch_size=64, num_epochs=None) as loader:
+        for step, batch in enumerate(loader):
+            if step >= steps:
+                break
+            inputs = torch.stack([batch['x{}'.format(i)] for i in range(4)], dim=1)
+            optimizer.zero_grad()
+            loss = torch.nn.functional.cross_entropy(model(inputs), batch['y'])
+            loss.backward()
+            optimizer.step()
+    print('final loss {:.4f}'.format(loss.item()))
+    converter.delete()
+    return loss.item()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--cache-dir', default='/tmp/converter_cache_torch')
+    args = parser.parse_args()
+    run(args.cache_dir)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,42 @@
+"""Dataframe -> tf.data via the converter (parity: reference
+examples/spark_dataset_converter/tensorflow_converter_example.py)."""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+from petastorm_tpu.converter import make_converter
+
+
+def run(cache_dir='/tmp/converter_cache_tf', rows=512, steps=20):
+    import tensorflow as tf
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(rows, 4)).astype(np.float32)
+    df = pd.DataFrame({**{'x{}'.format(i): x[:, i] for i in range(4)},
+                       'y': (x.sum(axis=1) > 0).astype(np.int64)})
+    converter = make_converter(df, parent_cache_dir_url='file://{}'.format(cache_dir))
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(16, activation='relu'),
+                                 tf.keras.layers.Dense(2, activation='softmax')])
+    model.compile(optimizer='adam', loss='sparse_categorical_crossentropy')
+    with converter.make_tf_dataset(batch_size=64, num_epochs=None) as dataset:
+        features = dataset.map(
+            lambda row: (tf.stack([row.x0, row.x1, row.x2, row.x3], axis=1), row.y))
+        history = model.fit(features, steps_per_epoch=steps, epochs=1, verbose=0)
+    loss = history.history['loss'][-1]
+    print('final loss {:.4f}'.format(loss))
+    converter.delete()
+    return loss
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--cache-dir', default='/tmp/converter_cache_tf')
+    args = parser.parse_args()
+    run(args.cache_dir)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,24 @@
+"""Feed a plain Parquet store to JAX: batched reader → JaxDataLoader → device arrays."""
+
+import argparse
+
+from petastorm_tpu import make_batch_reader
+from petastorm_tpu.parallel.loader import JaxDataLoader
+
+
+def jax_hello_world(dataset_url='file:///tmp/external_dataset'):
+    with make_batch_reader(dataset_url, num_epochs=1) as reader:
+        loader = JaxDataLoader(reader, batch_size=16, drop_last=False)
+        for batch in loader:
+            print('ids', batch['id'][:4], 'value1 mean', float(batch['value1'].mean()))
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-d', '--dataset-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    jax_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

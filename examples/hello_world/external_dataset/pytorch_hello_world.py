@@ -1,0 +1,24 @@
+"""PyTorch read of a plain Parquet store (parity: reference
+examples/hello_world/external_dataset/pytorch_hello_world.py)."""
+
+import argparse
+
+from petastorm_tpu import make_batch_reader
+from petastorm_tpu.pytorch import DataLoader
+
+
+def pytorch_hello_world(dataset_url='file:///tmp/external_dataset'):
+    with DataLoader(make_batch_reader(dataset_url), batch_size=8) as train_loader:
+        sample = next(iter(train_loader))
+        print(sample['id'])
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-d', '--dataset-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    pytorch_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

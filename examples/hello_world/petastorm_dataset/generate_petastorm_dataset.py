@@ -1,0 +1,50 @@
+"""Generate a tiny "hello world" petastorm_tpu dataset — the smallest end-to-end write
+path demo (parity: reference examples/hello_world/petastorm_dataset/
+generate_petastorm_dataset.py, which needs a Spark session; here the pure-pyarrow
+``write_rows`` path makes Spark optional per SURVEY.md §7.1 step 3).
+
+Run: ``python -m examples.hello_world.petastorm_dataset.generate_petastorm_dataset -o file:///tmp/hello_world_dataset``
+"""
+
+import argparse
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import write_rows
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+HelloWorldSchema = Unischema('HelloWorldSchema', [
+    UnischemaField('id', np.int32, (), ScalarCodec(np.int32), False),
+    UnischemaField('image1', np.uint8, (128, 256, 3), CompressedImageCodec('png'), False),
+    UnischemaField('array_4d', np.uint8, (None, 128, 30, None), NdarrayCodec(), False),
+])
+
+
+def row_generator(x):
+    """Returns a single entry in the generated dataset. Keyed by the ``id`` field."""
+    return {'id': x,
+            'image1': np.asarray(x % 255, dtype=np.uint8) *
+            np.ones((128, 256, 3), dtype=np.uint8),
+            'array_4d': np.random.randint(0, 255, dtype=np.uint8,
+                                          size=(4, 128, 30, 3))}
+
+
+def generate_petastorm_dataset(output_url='file:///tmp/hello_world_dataset',
+                               rows_count=10, rowgroup_size_mb=1):
+    rows = [row_generator(x) for x in range(rows_count)]
+    write_rows(output_url, HelloWorldSchema, rows, rowgroup_size_mb=rowgroup_size_mb)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-o', '--output-url', default='file:///tmp/hello_world_dataset',
+                        help='file:/// or s3://... url the dataset is written to')
+    parser.add_argument('-n', '--rows-count', type=int, default=10)
+    args = parser.parse_args()
+    generate_petastorm_dataset(args.output_url, args.rows_count)
+    print('wrote {} rows to {}'.format(args.rows_count, args.output_url))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,23 @@
+"""Minimal pure-python read of a petastorm_tpu dataset (parity: reference
+examples/hello_world/petastorm_dataset/python_hello_world.py)."""
+
+import argparse
+
+from petastorm_tpu import make_reader
+
+
+def python_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    with make_reader(dataset_url) as reader:
+        for sample in reader:
+            print(sample.id)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-d', '--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    python_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,24 @@
+"""Minimal PyTorch DataLoader read of a petastorm_tpu dataset (parity: reference
+examples/hello_world/petastorm_dataset/pytorch_hello_world.py)."""
+
+import argparse
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.pytorch import DataLoader
+
+
+def pytorch_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    with DataLoader(make_reader(dataset_url)) as train_loader:
+        sample = next(iter(train_loader))
+        print(sample['id'])
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-d', '--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    pytorch_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,26 @@
+"""Minimal tf.data read of a petastorm_tpu dataset (parity: reference
+examples/hello_world/petastorm_dataset/tensorflow_hello_world.py; eager tf.data only —
+graph-mode ``tf_tensors`` is demonstrated in petastorm_tpu.tf_utils docs)."""
+
+import argparse
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+
+def tensorflow_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    with make_reader(dataset_url) as reader:
+        dataset = make_petastorm_dataset(reader)
+        for sample in dataset.take(3):
+            print(sample.id.numpy())
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-d', '--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    tensorflow_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,103 @@
+"""Train ResNet from an ImageNet-style petastorm_tpu dataset — TPU-native flagship image
+pipeline (no direct reference analog: the reference only materializes ImageNet,
+examples/imagenet/generate_petastorm_imagenet.py; here we also consume it). Variable-size
+stored images are center-cropped/resized on the host worker (TransformSpec) to a static
+shape so every device batch is XLA-friendly; normalization + augmentation run on-chip
+(petastorm_tpu.ops.image).
+
+Run: ``python -m examples.imagenet.jax_example --dataset-url file:///tmp/imagenet``
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from examples.imagenet.schema import ImagenetSchema  # noqa: F401  (schema parity anchor)
+from petastorm_tpu import make_reader
+from petastorm_tpu.models.resnet import ResNet
+from petastorm_tpu.ops.image import normalize_image, random_crop_flip
+from petastorm_tpu.parallel.loader import JaxDataLoader
+from petastorm_tpu.transform import TransformSpec
+
+IMAGE_HW = 64
+
+
+def make_transform(class_to_label, image_hw=IMAGE_HW):
+    def _transform(row):
+        image = row['image']
+        h, w = image.shape[:2]
+        side = min(h, w)
+        top, left = (h - side) // 2, (w - side) // 2
+        square = image[top:top + side, left:left + side]
+        # Nearest-neighbor host resize (index gather) — cheap and codec-agnostic.
+        idx = (np.arange(image_hw) * side // image_hw)
+        row['image'] = square[idx][:, idx]
+        row['label'] = np.int32(class_to_label[row['noun_id']])
+        return row
+
+    return TransformSpec(_transform,
+                         edit_fields=[('image', np.uint8, (image_hw, image_hw, 3), False),
+                                      ('label', np.int32, (), False)],
+                         selected_fields=['image', 'label'])
+
+
+def train(dataset_url, batch_size=8, epochs=1, learning_rate=1e-3,
+          stage_sizes=(1, 1, 1, 1), num_filters=16):
+    with make_reader(dataset_url, schema_fields=['noun_id'], num_epochs=1,
+                     shuffle_row_groups=False) as scan_reader:
+        nouns = sorted({row.noun_id for row in scan_reader})
+    class_to_label = {noun: i for i, noun in enumerate(nouns)}
+
+    model = ResNet(stage_sizes=list(stage_sizes), num_classes=len(nouns),
+                   num_filters=num_filters)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, IMAGE_HW, IMAGE_HW, 3)))
+    params, batch_stats = variables['params'], variables['batch_stats']
+    optimizer = optax.adam(learning_rate)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, rng, images, labels):
+        # On-chip preprocessing: crop/flip augment + bf16 normalize (ops/image.py).
+        images = random_crop_flip(rng, images, (IMAGE_HW - 8, IMAGE_HW - 8))
+        images = normalize_image(images, mean=127.5, std=127.5)
+
+        def loss_fn(p):
+            logits, updates = model.apply({'params': p, 'batch_stats': batch_stats},
+                                          images, train=True, mutable=['batch_stats'])
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+            return loss, updates['batch_stats']
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    rng = jax.random.PRNGKey(1)
+    loss = None
+    transform = make_transform(class_to_label)
+    with make_reader(dataset_url, num_epochs=epochs, transform_spec=transform,
+                     shuffle_rows=True, seed=0) as reader:
+        loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
+        for step, batch in enumerate(loader):
+            rng, step_rng = jax.random.split(rng)
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, step_rng,
+                batch['image'], batch['label'])
+            print('step {} loss {:.4f}'.format(step, loss))
+    return params, batch_stats, (float(loss) if loss is not None else None)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/imagenet')
+    parser.add_argument('--batch-size', type=int, default=8)
+    parser.add_argument('--epochs', type=int, default=1)
+    args = parser.parse_args()
+    train(args.dataset_url, batch_size=args.batch_size, epochs=args.epochs)
+
+
+if __name__ == '__main__':
+    main()

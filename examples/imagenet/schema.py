@@ -1,0 +1,13 @@
+"""ImageNet Unischema (parity: reference examples/imagenet/schema.py — a noun id, the
+label text, and a variable-size RGB image stored through CompressedImageCodec png)."""
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ImagenetSchema = Unischema('ImagenetSchema', [
+    UnischemaField('noun_id', np.str_, (), ScalarCodec(np.str_), False),
+    UnischemaField('text', np.str_, (), ScalarCodec(np.str_), False),
+    UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('png'), False),
+])

@@ -1,0 +1,3 @@
+import os
+
+DEFAULT_MNIST_DATA_PATH = os.path.join(os.path.abspath(os.sep), 'tmp', 'mnist')

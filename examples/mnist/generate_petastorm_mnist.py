@@ -1,0 +1,72 @@
+"""Materialize MNIST (train + test splits) as petastorm_tpu datasets (parity: reference
+examples/mnist/generate_petastorm_mnist.py, minus its Spark dependency).
+
+Two sources:
+- ``--source torchvision`` downloads real MNIST via torchvision (requires network).
+- ``--source synthetic`` (default) generates MNIST-shaped random digits offline —
+  each digit's image is a noisy constant block so a model can actually learn to
+  separate the classes in smoke tests.
+
+Run: ``python -m examples.mnist.generate_petastorm_mnist -o file:///tmp/mnist``
+"""
+
+import argparse
+
+import numpy as np
+
+from examples.mnist import DEFAULT_MNIST_DATA_PATH
+from examples.mnist.schema import MnistSchema
+from petastorm_tpu.etl.dataset_metadata import write_rows
+
+
+def synthetic_mnist_rows(count, seed=0):
+    """MNIST-shaped rows: label-dependent mean intensity + noise (learnable)."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for idx in range(count):
+        digit = int(rng.integers(10))
+        base = np.full((28, 28), 20 + digit * 23, dtype=np.float32)
+        noise = rng.normal(0, 10, size=(28, 28)).astype(np.float32)
+        image = np.clip(base + noise, 0, 255).astype(np.uint8)
+        rows.append({'idx': idx, 'digit': digit, 'image': image})
+    return rows
+
+
+def torchvision_mnist_rows(download_dir, train=True):
+    from torchvision import datasets
+    data = datasets.MNIST(download_dir, train=train, download=True)
+    return [{'idx': idx, 'digit': int(digit), 'image': np.array(image, dtype=np.uint8)}
+            for idx, (image, digit) in enumerate(data)]
+
+
+def mnist_data_to_petastorm_dataset(output_url, source='synthetic', download_dir=None,
+                                    train_count=600, test_count=100,
+                                    rowgroup_size_mb=1):
+    for split, count in (('train', train_count), ('test', test_count)):
+        if source == 'torchvision':
+            rows = torchvision_mnist_rows(download_dir, train=(split == 'train'))
+        else:
+            rows = synthetic_mnist_rows(count, seed=0 if split == 'train' else 1)
+        split_url = '{}/{}'.format(output_url.rstrip('/'), split)
+        write_rows(split_url, MnistSchema, rows, rowgroup_size_mb=rowgroup_size_mb)
+        print('wrote {} rows to {}'.format(len(rows), split_url))
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-o', '--output-url',
+                        default='file://{}'.format(DEFAULT_MNIST_DATA_PATH))
+    parser.add_argument('-s', '--source', choices=['synthetic', 'torchvision'],
+                        default='synthetic')
+    parser.add_argument('-d', '--download-dir', default='/tmp/mnist_download')
+    parser.add_argument('--train-count', type=int, default=600)
+    parser.add_argument('--test-count', type=int, default=100)
+    args = parser.parse_args()
+    mnist_data_to_petastorm_dataset(args.output_url, source=args.source,
+                                    download_dir=args.download_dir,
+                                    train_count=args.train_count,
+                                    test_count=args.test_count)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,93 @@
+"""Train a small torch CNN from a petastorm_tpu dataset (parity: reference
+examples/mnist/pytorch_example.py — kept as an adapter demo; the JAX example is the
+primary TPU path)."""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as tnn
+import torch.nn.functional as F
+
+from examples.mnist import DEFAULT_MNIST_DATA_PATH
+from petastorm_tpu import make_reader
+from petastorm_tpu.pytorch import DataLoader
+from petastorm_tpu.transform import TransformSpec
+
+
+class Net(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = tnn.Conv2d(10, 20, kernel_size=5)
+        self.fc1 = tnn.Linear(320, 50)
+        self.fc2 = tnn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def _transform_row(row):
+    row['image'] = ((row['image'].astype(np.float32) - 127.5) / 127.5)[None, ...]
+    return row
+
+
+TRANSFORM = TransformSpec(_transform_row,
+                          edit_fields=[('image', np.float32, (1, 28, 28), False)])
+
+
+def train(model, device, train_loader, optimizer, log_interval=50):
+    model.train()
+    for batch_idx, batch in enumerate(train_loader):
+        data, target = batch['image'].to(device), batch['digit'].to(device)
+        optimizer.zero_grad()
+        loss = F.nll_loss(model(data), target)
+        loss.backward()
+        optimizer.step()
+        if batch_idx % log_interval == 0:
+            print('train batch {} loss {:.4f}'.format(batch_idx, loss.item()))
+
+
+def test(model, device, test_loader):
+    model.eval()
+    correct = total = 0
+    with torch.no_grad():
+        for batch in test_loader:
+            data, target = batch['image'].to(device), batch['digit'].to(device)
+            pred = model(data).argmax(dim=1)
+            correct += int((pred == target).sum())
+            total += int(target.shape[0])
+    print('test accuracy: {}/{}'.format(correct, total))
+    return correct / max(1, total)
+
+
+def main(args=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url',
+                        default='file://{}'.format(DEFAULT_MNIST_DATA_PATH))
+    parser.add_argument('--batch-size', type=int, default=64)
+    parser.add_argument('--epochs', type=int, default=1)
+    parser.add_argument('--lr', type=float, default=1e-3)
+    opts = parser.parse_args(args)
+
+    device = torch.device('cpu')
+    model = Net().to(device)
+    optimizer = torch.optim.Adam(model.parameters(), lr=opts.lr)
+    base = opts.dataset_url.rstrip('/')
+    for _ in range(opts.epochs):
+        with DataLoader(make_reader('{}/train'.format(base), transform_spec=TRANSFORM,
+                                    num_epochs=1),
+                        batch_size=opts.batch_size) as train_loader:
+            train(model, device, train_loader, optimizer)
+    with DataLoader(make_reader('{}/test'.format(base), transform_spec=TRANSFORM,
+                                num_epochs=1),
+                    batch_size=opts.batch_size) as test_loader:
+        return test(model, device, test_loader)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,13 @@
+"""MNIST Unischema (parity: reference examples/mnist/schema.py:21-25 — idx/digit scalars
+plus a (28, 28) uint8 image stored through NdarrayCodec)."""
+
+import numpy as np
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+MnistSchema = Unischema('MnistSchema', [
+    UnischemaField('idx', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('digit', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('image', np.uint8, (28, 28), NdarrayCodec(), False),
+])

@@ -1,0 +1,67 @@
+"""Train a small Keras CNN from a petastorm_tpu dataset via tf.data (parity: reference
+examples/mnist/tf_example.py — adapter demo; the JAX example is the primary TPU path)."""
+
+import argparse
+
+import numpy as np
+
+from examples.mnist import DEFAULT_MNIST_DATA_PATH
+from petastorm_tpu import make_reader
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+from petastorm_tpu.transform import TransformSpec
+
+
+def _transform_row(row):
+    row['image'] = ((row['image'].astype(np.float32) - 127.5) / 127.5)[..., None]
+    return row
+
+
+TRANSFORM = TransformSpec(_transform_row,
+                          edit_fields=[('image', np.float32, (28, 28, 1), False)],
+                          selected_fields=['digit', 'image'])
+
+
+def train_and_test(dataset_url, batch_size=64, epochs=1, steps=50):
+    import tensorflow as tf
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(16, 3, activation='relu', input_shape=(28, 28, 1)),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation='relu'),
+        tf.keras.layers.Dense(10, activation='softmax'),
+    ])
+    model.compile(optimizer='adam', loss='sparse_categorical_crossentropy',
+                  metrics=['accuracy'])
+
+    base = dataset_url.rstrip('/')
+    with make_reader('{}/train'.format(base), transform_spec=TRANSFORM,
+                     num_epochs=None) as train_reader:
+        with make_reader('{}/test'.format(base), transform_spec=TRANSFORM,
+                         num_epochs=None) as test_reader:
+            train_ds = (make_petastorm_dataset(train_reader)
+                        .map(lambda row: (row.image, row.digit))
+                        .batch(batch_size))
+            test_ds = (make_petastorm_dataset(test_reader)
+                       .map(lambda row: (row.image, row.digit))
+                       .batch(batch_size))
+            model.fit(train_ds, epochs=epochs, steps_per_epoch=steps, verbose=1)
+            metrics = model.evaluate(test_ds, steps=max(1, steps // 5), verbose=0)
+    print('test loss/accuracy:', metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url',
+                        default='file://{}'.format(DEFAULT_MNIST_DATA_PATH))
+    parser.add_argument('--batch-size', type=int, default=64)
+    parser.add_argument('--epochs', type=int, default=1)
+    parser.add_argument('--steps', type=int, default=50)
+    args = parser.parse_args()
+    train_and_test(args.dataset_url, batch_size=args.batch_size, epochs=args.epochs,
+                   steps=args.steps)
+
+
+if __name__ == '__main__':
+    main()

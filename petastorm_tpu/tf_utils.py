@@ -61,6 +61,9 @@ def _tf_dtype_for_field(field):
 
 
 def _output_signature(schema, batched):
+    """Namedtuple-of-TensorSpecs so dataset elements support ``row.field`` access and
+    keep a stable nest type across generator re-creation (reference's cached-namedtuple
+    contract for tf.data type equality: unischema.py:88-111)."""
     import tensorflow as tf
     signature = {}
     for name, field in schema.fields.items():
@@ -69,7 +72,7 @@ def _output_signature(schema, batched):
             shape = (None,) + shape
         tf_shape = tf.TensorShape([None if d is None else d for d in shape])
         signature[name] = tf.TensorSpec(shape=tf_shape, dtype=_tf_dtype_for_field(field))
-    return signature
+    return schema.namedtuple(**signature)
 
 
 def make_petastorm_dataset(reader):
@@ -86,8 +89,12 @@ def make_petastorm_dataset(reader):
         signature = {offset: _output_signature(
             ngram.get_schema_at_timestep(reader.result_schema, offset), False)
             for offset in ngram.fields}
+        # tf.nest matches namedtuples by type name + fields: re-wrap worker rows into the
+        # exact classes used in the signature.
+        step_types = {offset: type(spec) for offset, spec in signature.items()}
     else:
         signature = _output_signature(reader.result_schema, batched)
+        row_type = type(signature)
 
     def generator():
         if getattr(reader, 'last_row_consumed', False):
@@ -96,11 +103,12 @@ def make_petastorm_dataset(reader):
             reader.reset()
         for item in reader:
             if ngram is not None:
-                yield {offset: {k: _sanitize_field_value(v)
-                                for k, v in step._asdict().items()}
-                       for offset, step in item.items()}
+                yield {offset: step_types[offset](
+                    **{k: _sanitize_field_value(v) for k, v in step._asdict().items()})
+                    for offset, step in item.items()}
             else:
-                yield {k: _sanitize_field_value(v) for k, v in item._asdict().items()}
+                yield row_type(**{k: _sanitize_field_value(v)
+                                  for k, v in item._asdict().items()})
 
     return tf.data.Dataset.from_generator(generator, output_signature=signature)
 
@@ -142,6 +150,9 @@ def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
         # Well-known op name so queue depth is observable (reference: tf_utils.py:45-47).
         tf.identity(queue.size(), name='random_shuffling_queue_size')
         values = queue.dequeue()
+        if len(field_names) == 1:
+            # dequeue() returns a lone Tensor (not a list) for single-component queues.
+            values = [values]
         for value, name in zip(values, field_names):
             field = schema.fields[name]
             if not any(d is None for d in field.shape):

@@ -70,7 +70,7 @@ def test_make_tf_dataset(cache_dir):
     converter = make_converter(_frame(), parent_cache_dir_url=cache_dir)
     with converter.make_tf_dataset(batch_size=15, workers_count=1) as dataset:
         batches = list(dataset)
-    assert sum(int(b['x'].shape[0]) for b in batches) == 60
+    assert sum(int(b.x.shape[0]) for b in batches) == 60
     converter.delete()
 
 

@@ -1,0 +1,172 @@
+"""Smoke tests for every example (model: the reference's per-example tests —
+examples/mnist/tests/test_pytorch_mnist.py:92 train-one-epoch style)."""
+
+import numpy as np
+import pytest
+
+from examples.hello_world.external_dataset.generate_external_dataset import (
+    generate_external_dataset)
+from examples.hello_world.petastorm_dataset.generate_petastorm_dataset import (
+    HelloWorldSchema, generate_petastorm_dataset)
+from examples.imagenet.generate_petastorm_imagenet import generate_petastorm_imagenet
+from examples.mnist.generate_petastorm_mnist import mnist_data_to_petastorm_dataset
+from petastorm_tpu import make_batch_reader, make_reader
+
+
+@pytest.fixture(scope='module')
+def hello_world_dataset(tmp_path_factory):
+    url = 'file://{}'.format(tmp_path_factory.mktemp('hello_world'))
+    generate_petastorm_dataset(url, rows_count=6)
+    return url
+
+
+@pytest.fixture(scope='module')
+def external_dataset(tmp_path_factory):
+    url = 'file://{}'.format(tmp_path_factory.mktemp('external'))
+    generate_external_dataset(url, rows_count=40)
+    return url
+
+
+@pytest.fixture(scope='module')
+def mnist_dataset(tmp_path_factory):
+    url = 'file://{}'.format(tmp_path_factory.mktemp('mnist'))
+    mnist_data_to_petastorm_dataset(url, train_count=192, test_count=64)
+    return url
+
+
+@pytest.fixture(scope='module')
+def imagenet_dataset(tmp_path_factory):
+    url = 'file://{}'.format(tmp_path_factory.mktemp('imagenet'))
+    generate_petastorm_imagenet(url, synthetic=True)
+    return url
+
+
+# ---------------------------------------------------------------- hello world
+
+def test_hello_world_roundtrip(hello_world_dataset):
+    with make_reader(hello_world_dataset) as reader:
+        rows = list(reader)
+    assert sorted(r.id for r in rows) == list(range(6))
+    assert rows[0].image1.shape == (128, 256, 3)
+    assert rows[0].array_4d.ndim == 4
+    assert set(rows[0]._fields) == {f.name for f in HelloWorldSchema.fields.values()}
+
+
+def test_hello_world_python_example(hello_world_dataset, capsys):
+    from examples.hello_world.petastorm_dataset.python_hello_world import (
+        python_hello_world)
+    python_hello_world(hello_world_dataset)
+    assert capsys.readouterr().out.strip()
+
+
+def test_hello_world_jax_example(hello_world_dataset):
+    from examples.hello_world.petastorm_dataset.jax_hello_world import jax_hello_world
+    jax_hello_world(hello_world_dataset)
+
+
+def test_hello_world_pytorch_example(hello_world_dataset):
+    from examples.hello_world.petastorm_dataset.pytorch_hello_world import (
+        pytorch_hello_world)
+    pytorch_hello_world(hello_world_dataset)
+
+
+def test_hello_world_tensorflow_example(hello_world_dataset):
+    pytest.importorskip('tensorflow')
+    from examples.hello_world.petastorm_dataset.tensorflow_hello_world import (
+        tensorflow_hello_world)
+    tensorflow_hello_world(hello_world_dataset)
+
+
+# ---------------------------------------------------------------- external store
+
+def test_external_roundtrip(external_dataset):
+    with make_batch_reader(external_dataset) as reader:
+        ids = np.concatenate([batch.id for batch in reader])
+    assert sorted(ids.tolist()) == list(range(40))
+
+
+def test_external_python_example(external_dataset, capsys):
+    from examples.hello_world.external_dataset.python_hello_world import (
+        python_hello_world)
+    python_hello_world(external_dataset)
+    assert 'batch of' in capsys.readouterr().out
+
+
+def test_external_jax_example(external_dataset):
+    from examples.hello_world.external_dataset.jax_hello_world import jax_hello_world
+    jax_hello_world(external_dataset)
+
+
+def test_external_pytorch_example(external_dataset):
+    from examples.hello_world.external_dataset.pytorch_hello_world import (
+        pytorch_hello_world)
+    pytorch_hello_world(external_dataset)
+
+
+def test_external_tensorflow_example(external_dataset):
+    pytest.importorskip('tensorflow')
+    from examples.hello_world.external_dataset.tensorflow_hello_world import (
+        tensorflow_hello_world)
+    tensorflow_hello_world(external_dataset)
+
+
+# ---------------------------------------------------------------- mnist
+
+def test_mnist_jax_trains(mnist_dataset):
+    from examples.mnist import jax_example
+    params, loss, accuracy = jax_example.train(mnist_dataset, batch_size=64, epochs=2)
+    assert np.isfinite(loss)
+    test_accuracy = jax_example.evaluate(params, mnist_dataset, batch_size=32)
+    # Synthetic digits are linearly separable by intensity: training must beat chance.
+    assert test_accuracy > 0.3
+
+
+def test_mnist_pytorch_trains(mnist_dataset):
+    from examples.mnist import pytorch_example
+    accuracy = pytorch_example.main(['--dataset-url', mnist_dataset, '--epochs', '6',
+                                     '--lr', '5e-3'])
+    assert accuracy > 0.2
+
+
+def test_mnist_tf_trains(mnist_dataset):
+    pytest.importorskip('tensorflow')
+    from examples.mnist import tf_example
+    metrics = tf_example.train_and_test(mnist_dataset, batch_size=32, steps=6)
+    assert np.isfinite(metrics[0])
+
+
+# ---------------------------------------------------------------- imagenet
+
+def test_imagenet_roundtrip(imagenet_dataset):
+    with make_reader(imagenet_dataset) as reader:
+        rows = list(reader)
+    assert len(rows) == 12
+    assert all(r.image.ndim == 3 and r.image.shape[2] == 3 for r in rows)
+    assert len({r.noun_id for r in rows}) == 3
+
+
+def test_imagenet_jax_trains(imagenet_dataset):
+    from examples.imagenet.jax_example import train
+    _, _, loss = train(imagenet_dataset, batch_size=4, epochs=1)
+    assert loss is not None and np.isfinite(loss)
+
+
+# ---------------------------------------------------------------- converter
+
+def test_converter_jax_example(tmp_path):
+    from examples.converter.jax_converter_example import run
+    loss = run(cache_dir=str(tmp_path), steps=15)
+    assert np.isfinite(loss)
+
+
+def test_converter_pytorch_example(tmp_path):
+    from examples.converter.pytorch_converter_example import run
+    loss = run(cache_dir=str(tmp_path), steps=10)
+    assert np.isfinite(loss)
+
+
+def test_converter_tensorflow_example(tmp_path):
+    pytest.importorskip('tensorflow')
+    from examples.converter.tensorflow_converter_example import run
+    loss = run(cache_dir=str(tmp_path), steps=5)
+    assert np.isfinite(loss)

@@ -19,11 +19,11 @@ def test_dataset_row_reader(synthetic_dataset):
         rows = list(dataset.take(100))
     assert len(rows) == 100
     first = rows[0]
-    assert first['matrix'].shape == (4, 3)
-    an_id = int(first['id'].numpy())
+    assert first.matrix.shape == (4, 3)
+    an_id = int(first.id.numpy())
     source = synthetic_dataset.rows_by_id[an_id]
-    np.testing.assert_array_almost_equal(first['matrix'].numpy(), source['matrix'])
-    assert first['sensor_name'].numpy().decode() == source['sensor_name']
+    np.testing.assert_array_almost_equal(first.matrix.numpy(), source['matrix'])
+    assert first.sensor_name.numpy().decode() == source['sensor_name']
 
 
 def test_dataset_batch_reader(scalar_dataset):
@@ -31,7 +31,7 @@ def test_dataset_batch_reader(scalar_dataset):
                            workers_count=1) as reader:
         dataset = make_petastorm_dataset(reader)
         batches = list(dataset)
-    total = sum(int(b['id'].shape[0]) for b in batches)
+    total = sum(int(b.id.shape[0]) for b in batches)
     assert total == 50
 
 
@@ -41,7 +41,7 @@ def test_dataset_pipeline_ops(scalar_dataset):
                            workers_count=1) as reader:
         dataset = make_petastorm_dataset(reader).unbatch().shuffle(16).batch(10)
         batches = list(dataset)
-    assert sum(int(b['id'].shape[0]) for b in batches) == 50
+    assert sum(int(b.id.shape[0]) for b in batches) == 50
 
 
 def test_dataset_regeneration_resets(synthetic_dataset):
@@ -69,7 +69,7 @@ def test_dataset_ngram(tmp_path):
         dataset = make_petastorm_dataset(reader)
         windows = list(dataset)
     assert len(windows) == 9
-    assert int(windows[0][1]['ts'].numpy()) == int(windows[0][0]['ts'].numpy()) + 1
+    assert int(windows[0][1].ts.numpy()) == int(windows[0][0].ts.numpy()) + 1
 
 
 def test_tf_tensors_graph_mode(synthetic_dataset):
