@@ -61,7 +61,7 @@ def _table_fingerprint(table):
         for chunk in column.chunks:
             for buf in chunk.buffers():
                 if buf is not None:
-                    h.update(memoryview(buf)[:4096])
+                    h.update(memoryview(buf))
                     h.update(str(buf.size).encode())
     return h.hexdigest()[:24]
 
@@ -229,29 +229,29 @@ def make_converter(df, parent_cache_dir_url=None, rowgroup_size_mb=32, compressi
         return _make_converter_spark(df, _parent_cache_dir(parent_cache_dir_url),
                                      rowgroup_size_mb)
     import pyarrow as pa
-    import pyarrow.parquet as pq
     table = _to_arrow_table(df)
     parent = _parent_cache_dir(parent_cache_dir_url)
     fingerprint = _table_fingerprint(table)
     cache_dir = '{}/{}'.format(parent, fingerprint)
 
-    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths, path_exists
+    from petastorm_tpu.fs_utils import (delete_path, get_filesystem_and_path_or_paths,
+                                        path_exists)
     fs, cache_path = get_filesystem_and_path_or_paths(cache_dir)
     success_marker = cache_path + '/_SUCCESS'
     if path_exists(fs, success_marker):
         logger.info('Converter cache hit: %s', cache_dir)
     else:
+        if path_exists(fs, cache_path):
+            # A dir without _SUCCESS is a crashed partial conversion: its leftover part
+            # files would be globbed into file_urls below. Start clean.
+            logger.warning('Removing partial converter cache %s', cache_dir)
+            delete_path(fs, cache_path)
         fs.create_dir(cache_path, recursive=True)
-        row_group_rows = max(1, (rowgroup_size_mb << 20)
-                             // max(1, table.nbytes // max(1, table.num_rows)))
-        if rows_per_file is None:
-            rows_per_file = table.num_rows or 1
-        for index, start in enumerate(range(0, table.num_rows, rows_per_file)):
-            chunk = table.slice(start, rows_per_file)
-            file_path = '{}/part_{:05d}.parquet'.format(cache_path, index)
-            with fs.open_output_stream(file_path) as sink:
-                pq.write_table(chunk, sink, row_group_size=row_group_rows,
-                               compression=compression or 'snappy')
+        from petastorm_tpu.etl.dataset_metadata import write_table_files
+        write_table_files(fs, cache_path, table.schema, table.to_batches(),
+                          rowgroup_size_mb=rowgroup_size_mb,
+                          rows_per_file=rows_per_file,
+                          compression=compression or 'snappy')
         with fs.open_output_stream(success_marker) as sink:
             sink.write(b'')
     file_infos = fs.get_file_info(pa.fs.FileSelector(cache_path))

@@ -134,6 +134,66 @@ def _estimate_row_bytes(table):
     return max(1, table.nbytes // table.num_rows)
 
 
+def write_table_files(filesystem, path, arrow_schema, batches,
+                      rowgroup_size_mb=DEFAULT_ROW_GROUP_SIZE_MB, rows_per_file=None,
+                      compression='snappy', file_prefix='part'):
+    """Stream record batches into ``<path>/<prefix>_NNNNN.parquet`` files at bounded
+    memory: rowgroups of ~``rowgroup_size_mb`` are flushed through a ``ParquetWriter`` as
+    they fill, files roll over at ``rows_per_file`` (None = one file). The single write
+    loop behind :func:`write_rows`, the converter, and the copy tool. Returns total rows
+    written."""
+    state = {'writer': None, 'sink': None, 'file_index': 0, 'file_rows': 0, 'total': 0,
+             'pending': [], 'pending_rows': 0, 'row_group_rows': None}
+
+    def _flush_rowgroup():
+        if not state['pending']:
+            return
+        rowgroup = pa.Table.from_batches(state['pending'], schema=arrow_schema)
+        if state['writer'] is None:
+            file_path = '{}/{}_{:05d}.parquet'.format(path, file_prefix,
+                                                      state['file_index'])
+            state['sink'] = filesystem.open_output_stream(file_path)
+            state['writer'] = pq.ParquetWriter(state['sink'], arrow_schema,
+                                               compression=compression)
+        state['writer'].write_table(rowgroup, row_group_size=rowgroup.num_rows)
+        state['file_rows'] += rowgroup.num_rows
+        state['total'] += rowgroup.num_rows
+        state['pending'], state['pending_rows'] = [], 0
+
+    def _close_file():
+        _flush_rowgroup()
+        if state['writer'] is not None:
+            state['writer'].close()
+            state['sink'].close()
+            state['writer'] = state['sink'] = None
+            state['file_index'] += 1
+            state['file_rows'] = 0
+
+    for batch in batches:
+        if batch.num_rows == 0:
+            continue
+        if state['row_group_rows'] is None:
+            per_row = max(1, batch.nbytes // max(1, batch.num_rows))
+            state['row_group_rows'] = max(1, (rowgroup_size_mb << 20) // per_row)
+        offset = 0
+        while offset < batch.num_rows:
+            take = min(batch.num_rows - offset,
+                       state['row_group_rows'] - state['pending_rows'])
+            if rows_per_file is not None:
+                take = min(take,
+                           rows_per_file - state['file_rows'] - state['pending_rows'])
+            state['pending'].append(batch.slice(offset, take))
+            state['pending_rows'] += take
+            offset += take
+            if state['pending_rows'] >= state['row_group_rows']:
+                _flush_rowgroup()
+            if rows_per_file is not None and \
+                    state['file_rows'] + state['pending_rows'] >= rows_per_file:
+                _close_file()
+    _close_file()
+    return state['total']
+
+
 def write_rows(dataset_url, schema, rows, rowgroup_size_mb=DEFAULT_ROW_GROUP_SIZE_MB,
                rows_per_file=None, n_files=None, storage_options=None, filesystem=None,
                file_prefix='part'):
@@ -147,18 +207,13 @@ def write_rows(dataset_url, schema, rows, rowgroup_size_mb=DEFAULT_ROW_GROUP_SIZ
                                                     filesystem=filesystem)
         fs.create_dir(path, recursive=True)
         table = rows_to_arrow_table(schema, rows)
-        row_group_rows = max(1, (rowgroup_size_mb * (1 << 20)) // _estimate_row_bytes(table))
         if rows_per_file is None:
             if n_files is None:
                 n_files = 1
             rows_per_file = max(1, (table.num_rows + n_files - 1) // max(1, n_files))
-        file_index = 0
-        for start in range(0, table.num_rows, rows_per_file):
-            chunk = table.slice(start, rows_per_file)
-            file_path = '{}/{}_{:05d}.parquet'.format(path, file_prefix, file_index)
-            with fs.open_output_stream(file_path) as sink:
-                pq.write_table(chunk, sink, row_group_size=row_group_rows)
-            file_index += 1
+        write_table_files(fs, path, table.schema, table.to_batches(),
+                          rowgroup_size_mb=rowgroup_size_mb, rows_per_file=rows_per_file,
+                          file_prefix=file_prefix)
 
 
 @contextmanager

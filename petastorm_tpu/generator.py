@@ -22,8 +22,15 @@ def _random_value(field, shape, rng, string_length):
     dtype = np.dtype(field.numpy_dtype)
     if dtype.kind in ('U', 'S'):
         letters = np.array(list('abcdefghijklmnopqrstuvwxyz'))
-        value = ''.join(rng.choice(letters, string_length))
-        return value.encode('utf-8') if dtype.kind == 'S' else value
+
+        def _one_string():
+            value = ''.join(rng.choice(letters, string_length))
+            return value.encode('utf-8') if dtype.kind == 'S' else value
+
+        if shape == ():
+            return _one_string()
+        count = int(np.prod(shape))
+        return np.array([_one_string() for _ in range(count)]).reshape(shape)
     if dtype.kind == 'b':
         data = rng.randint(0, 2, shape).astype(bool)
     elif dtype.kind in ('i', 'u'):
@@ -35,7 +42,8 @@ def _random_value(field, shape, rng, string_length):
     elif dtype.kind == 'f':
         data = rng.rand(*shape).astype(dtype) if shape else dtype.type(rng.rand())
     elif dtype.kind == 'M':
-        data = np.datetime64('2020-01-01') + np.timedelta64(int(rng.randint(0, 10000)), 'h')
+        data = (np.datetime64('2020-01-01') +
+                np.timedelta64(1, 'h') * rng.randint(0, 10000, size=shape or None))
     else:
         raise ValueError('Cannot generate data for dtype {}'.format(dtype))
     if shape == ():

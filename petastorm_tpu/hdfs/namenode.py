@@ -135,8 +135,11 @@ class HdfsConnector(object):
 
 
 def namenode_failover(func):
-    """Decorator retrying an HDFS operation once after re-resolving namenodes (reference:
-    the reference's namenode_failover decorator)."""
+    """Decorator retrying an HDFS operation once after a connection failure (reference:
+    petastorm's namenode_failover decorator). If the bound object exposes
+    ``reconnect()``, it is invoked between attempts so the retry actually targets the
+    standby namenode; otherwise this only covers transient errors on the existing
+    connection."""
     import functools
 
     @functools.wraps(func)
@@ -144,8 +147,14 @@ def namenode_failover(func):
         try:
             return func(*args, **kwargs)
         except OSError:
-            logger.warning('HDFS operation %s failed; retrying once after failover',
-                           func.__name__)
+            reconnect = getattr(args[0], 'reconnect', None) if args else None
+            if callable(reconnect):
+                logger.warning('HDFS operation %s failed; reconnecting and retrying',
+                               func.__name__)
+                reconnect()
+            else:
+                logger.warning('HDFS operation %s failed; retrying once on the same '
+                               'connection', func.__name__)
             return func(*args, **kwargs)
 
     return wrapper

@@ -10,7 +10,7 @@ import sys
 
 import pyarrow.compute as pc
 import pyarrow.dataset as pads
-import pyarrow.parquet as pq
+
 
 from petastorm_tpu.etl import dataset_metadata
 from petastorm_tpu.unischema import match_unischema_fields
@@ -48,18 +48,12 @@ def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
         target_fs.create_dir(target_path, recursive=True)
         scanner = pads.Scanner.from_dataset(source.arrow_dataset, columns=column_names,
                                             filter=filter_expr)
-        table = scanner.to_table()
-        row_group_rows = max(1, (rowgroup_size_mb << 20)
-                             // max(1, table.nbytes // max(1, table.num_rows)))
-        if rows_per_file is None:
-            rows_per_file = table.num_rows or 1
-        for index, start in enumerate(range(0, table.num_rows, rows_per_file)):
-            chunk = table.slice(start, rows_per_file)
-            file_path = '{}/part_{:05d}.parquet'.format(target_path, index)
-            with target_fs.open_output_stream(file_path) as sink:
-                pq.write_table(chunk, sink, row_group_size=row_group_rows)
-    logger.info('Copied %d rows to %s', table.num_rows, target_url)
-    return table.num_rows
+        # Stream batches -> files: the whole source is never resident in memory.
+        total_rows = dataset_metadata.write_table_files(
+            target_fs, target_path, scanner.projected_schema, scanner.to_batches(),
+            rowgroup_size_mb=rowgroup_size_mb, rows_per_file=rows_per_file)
+    logger.info('Copied %d rows to %s', total_rows, target_url)
+    return total_rows
 
 
 def main(argv=None):

@@ -5,8 +5,8 @@ import pickle
 
 def _subprocess_entry(serialized, result_queue):
     import dill
-    func, args, kwargs = dill.loads(serialized)
     try:
+        func, args, kwargs = dill.loads(serialized)
         result_queue.put(('ok', pickle.dumps(func(*args, **kwargs))))
     except Exception as exc:  # noqa: BLE001
         import traceback
@@ -26,7 +26,22 @@ def run_in_subprocess(func, *args, **kwargs):
     process = ctx.Process(target=_subprocess_entry, args=(serialized, result_queue))
     process.start()
     try:
-        status, payload = result_queue.get(timeout=600)
+        # Poll so a child that dies without replying (OOM-kill, segfault, import crash
+        # during spawn) surfaces immediately instead of a 10-minute queue.Empty.
+        import queue as queue_mod
+        import time
+        deadline = time.monotonic() + 600
+        while True:
+            try:
+                status, payload = result_queue.get(timeout=1)
+                break
+            except queue_mod.Empty:
+                if not process.is_alive():
+                    raise RuntimeError(
+                        'Subprocess died with exit code {} before returning a result'
+                        .format(process.exitcode)) from None
+                if time.monotonic() > deadline:
+                    raise TimeoutError('Subprocess produced no result within 600s')
     finally:
         process.join(timeout=30)
         if process.is_alive():
