@@ -45,6 +45,13 @@ class FieldCodec(object):
         path exists (None cells pass through)."""
         return [None if v is None else self.decode(unischema_field, v) for v in values]
 
+    def decode_arrow_column(self, unischema_field, arrow_col):
+        """Decode straight from the Arrow column. Returns either a fully-stacked ndarray
+        of shape ``(n,) + field.shape`` (fast path) or a per-cell list like
+        :meth:`decode_column`. Codecs override this to avoid the Arrow->Python-object
+        round-trip on the hot read path."""
+        return self.decode_column(unischema_field, arrow_col.to_pylist())
+
     def arrow_type(self, unischema_field):
         """Arrow storage type of the encoded column."""
         raise NotImplementedError()
@@ -64,6 +71,23 @@ class FieldCodec(object):
 
     def __hash__(self):
         return hash(tuple(sorted(self.to_config().items(), key=lambda kv: kv[0])))
+
+
+def _parse_npy_header(blob):
+    """Parse a ``.npy`` blob's header. Returns (header_len, shape, fortran_order, dtype),
+    or None for unknown format versions / malformed headers."""
+    f = BytesIO(blob)
+    try:
+        version = np.lib.format.read_magic(f)
+        if version == (1, 0):
+            shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+        elif version == (2, 0):
+            shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+        else:
+            return None
+    except Exception:  # noqa: BLE001 - malformed header falls back to np.load
+        return None
+    return f.tell(), shape, fortran, dtype
 
 
 _NUMPY_TO_ARROW = {
@@ -135,6 +159,14 @@ class ScalarCodec(FieldCodec):
             return value
         return np.dtype(dtype).type(value)
 
+    def decode_arrow_column(self, unischema_field, arrow_col):
+        """Vectorized scalar decode: numeric/bool/datetime columns convert through Arrow's
+        native ``to_numpy`` in one shot instead of per-cell ``np.dtype.type`` calls."""
+        dtype = np.dtype(unischema_field.numpy_dtype)
+        if dtype.kind in ('U', 'S', 'O', 'M') or arrow_col.null_count:
+            return self.decode_column(unischema_field, arrow_col.to_pylist())
+        return arrow_col.to_numpy(zero_copy_only=False).astype(dtype, copy=False)
+
     def arrow_type(self, unischema_field):
         if self._arrow_dtype is not None:
             return self._arrow_dtype
@@ -198,6 +230,65 @@ class NdarrayCodec(FieldCodec):
         memfile = BytesIO(value)
         return np.ascontiguousarray(np.load(memfile, allow_pickle=False))
 
+    def decode_arrow_column(self, unischema_field, arrow_col):
+        """Whole-column decode straight from Arrow buffers: when every ``.npy`` blob in a
+        chunk has the same length and header (the common fixed-shape-field case), the
+        chunk's data buffer is reinterpreted as an ``(n, blob_len)`` byte matrix and the
+        payload region becomes the stacked output in ONE copy — no per-row Python at all.
+        Ragged/mixed chunks fall back to the per-cell path."""
+        chunks = arrow_col.chunks if isinstance(arrow_col, pa.ChunkedArray) else [arrow_col]
+        pieces = []
+        all_stacked = True
+        for chunk in chunks:
+            fast = self._decode_chunk_matrix(chunk)
+            if fast is None:
+                pieces.append(self.decode_column(unischema_field, chunk.to_pylist()))
+                all_stacked = False
+            else:
+                pieces.append(fast)
+        if len(pieces) == 1:
+            return pieces[0]
+        if all_stacked and len({p.shape[1:] for p in pieces}) == 1:
+            return np.concatenate(pieces, axis=0)
+        out = []
+        for piece in pieces:
+            out.extend(list(piece))
+        return out
+
+    @staticmethod
+    def _decode_chunk_matrix(chunk):
+        if len(chunk) == 0 or chunk.null_count:
+            return None
+        if pa.types.is_large_binary(chunk.type):
+            off_dtype = np.dtype(np.int64)
+        elif pa.types.is_binary(chunk.type):
+            off_dtype = np.dtype(np.int32)
+        else:
+            return None
+        buffers = chunk.buffers()
+        offsets = np.frombuffer(buffers[1], dtype=off_dtype, count=len(chunk) + 1,
+                                offset=chunk.offset * off_dtype.itemsize)
+        lengths = np.diff(offsets)
+        blob_len = int(lengths[0]) if len(lengths) else 0
+        if blob_len == 0 or not (lengths == blob_len).all():
+            return None
+        data = np.frombuffer(buffers[2], dtype=np.uint8)
+        matrix = data[int(offsets[0]):int(offsets[0]) + len(chunk) * blob_len] \
+            .reshape(len(chunk), blob_len)
+        parsed = _parse_npy_header(matrix[0].tobytes())
+        if parsed is None:
+            return None
+        header_len, shape, fortran, dtype = parsed
+        if fortran or dtype.hasobject or not dtype.isnative:
+            return None
+        if header_len + int(np.prod(shape, dtype=np.int64)) * dtype.itemsize != blob_len:
+            return None
+        header = matrix[0, :header_len]
+        if not (matrix[:, :header_len] == header).all():
+            return None
+        payload = np.ascontiguousarray(matrix[:, header_len:])
+        return payload.view(dtype).reshape((len(chunk),) + shape)
+
     #: distinct-header cache cap: ragged columns with per-row shapes must not grow it
     _HEADER_CACHE_MAX = 1024
 
@@ -212,29 +303,18 @@ class NdarrayCodec(FieldCodec):
         """
         header_cache = {}
 
-        def parse_header(blob):
-            f = BytesIO(blob)
-            version = np.lib.format.read_magic(f)
-            if version == (1, 0):
-                shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
-            elif version == (2, 0):
-                shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
-            else:  # unknown future version: fall back to np.load for this blob
-                return None
-            return blob[:f.tell()], shape, fortran, dtype, f.tell()
-
         def lookup(blob):
             probe = bytes(blob[:64])
             for prefix, meta in header_cache.get(probe, ()):
                 if blob[:len(prefix)] == prefix:
                     return meta
-            parsed = parse_header(blob)
+            parsed = _parse_npy_header(blob)
             if parsed is None:
                 return None
-            prefix, shape, fortran, dtype, offset = parsed
+            offset, shape, fortran, dtype = parsed
             meta = (shape, fortran, dtype, offset)
             if len(header_cache) < self._HEADER_CACHE_MAX:
-                header_cache.setdefault(probe, []).append((bytes(prefix), meta))
+                header_cache.setdefault(probe, []).append((bytes(blob[:offset]), meta))
             return meta
 
         out = []

@@ -225,9 +225,11 @@ class RowGroupWorker(WorkerBase):
                 continue
             arrow_col = table.column(name)
             if field is not None and field.codec is not None and setup.decode:
-                values = arrow_col.to_pylist()
-                decoded = field.codec.decode_column(field, values)
-                columns[name] = _stack_if_uniform(decoded, field)
+                decoded = field.codec.decode_arrow_column(field, arrow_col)
+                if isinstance(decoded, np.ndarray):
+                    columns[name] = decoded  # codec returned a stacked fast-path column
+                else:
+                    columns[name] = _stack_if_uniform(decoded, field)
             elif field is not None and field.shape != () and setup.decode:
                 values = arrow_col.to_pylist()
                 decoded = [None if v is None else np.asarray(v, dtype=field.numpy_dtype)
