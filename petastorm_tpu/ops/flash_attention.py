@@ -1,0 +1,148 @@
+"""Pallas TPU flash attention: O(T)-memory blockwise attention on the MXU.
+
+Forward pass is a Pallas kernel (grid over [batch*heads, q-blocks, kv-blocks], online
+log-sum-exp softmax accumulated in VMEM scratch, matmuls in fp32 on the MXU). Backward
+is a ``jax.custom_vjp`` that recomputes attention blockwise with XLA ops — correct and
+memory-bounded, with the forward savings where they matter most for inference/serving.
+
+Falls back to the XLA path (:func:`petastorm_tpu.ops.ring_attention.dense_attention`)
+when shapes don't tile (T % block != 0, head_dim not lane-aligned) and runs in Pallas
+interpret mode on CPU so tests exercise the same kernel logic without a TPU.
+
+No reference analog (petastorm is data-layer only; SURVEY.md §5.7) — this is the compute
+side of the long-context story next to :mod:`petastorm_tpu.ops.ring_attention`.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+_LANE = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, causal,
+                  block_q, block_k, scale):
+    """One (bh, qi, ki) grid step: fold K/V block ``ki`` into the online softmax
+    accumulator for Q block ``qi``."""
+    from jax.experimental import pallas as pl
+
+    # program_id must be read at kernel top level: inside a pl.when closure it does not
+    # substitute under the CPU interpreter.
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _fold():
+        q = q_ref[0].astype(jnp.float32)                       # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)                       # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)                       # [Bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                                  # [Bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                                 # [Bq, Bk]
+        corr = jnp.exp(m_prev - m_new)                         # [Bq, 1]
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing: skip their matmuls.
+        @pl.when(ki * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            _fold()
+    else:
+        _fold()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    """q/k/v: [BH, T, D] -> o: [BH, T, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    nq, nk = t // block_q, tk // block_k
+    scale = d ** -0.5
+    kernel = functools.partial(_flash_kernel, causal=causal, block_q=block_q,
+                               block_k=block_k, scale=scale)
+    grid = (bh, nq, nk)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max (lane-replicated)
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running denominator
+            pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _tiles(t, d, block_q, block_k):
+    return t % block_q == 0 and t % block_k == 0 and d % _LANE == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=False, block_q=256, block_k=256):
+    """Flash attention over ``[B, T, H, D]`` inputs (same layout as
+    :func:`~petastorm_tpu.ops.ring_attention.dense_attention`). Exact; forward runs as a
+    Pallas TPU kernel when shapes tile, XLA blockwise otherwise."""
+    return _attention_impl(q, k, v, causal, block_q, block_k)
+
+
+def _attention_impl(q, k, v, causal, block_q, block_k):
+    from petastorm_tpu.ops.ring_attention import dense_attention
+    b, t, h, d = q.shape
+    if not _tiles(t, d, block_q, block_k) or t != k.shape[1]:
+        return dense_attention(q, k, v, causal=causal)
+    interpret = jax.default_backend() != 'tpu'
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    o = _flash_forward(to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k, interpret)
+    return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _fwd(q, k, v, causal, block_q, block_k):
+    return _attention_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, residuals, g):
+    """Recompute-backward in XLA: correct gradients at O(T^2) flops, O(T^2) attention
+    matrix rematerialized under XLA fusion (not stored from forward)."""
+    from petastorm_tpu.ops.ring_attention import dense_attention
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda a, b_, c: dense_attention(a, b_, c, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
