@@ -90,20 +90,12 @@ def ring_attention_sharded(mesh, seq_axis, causal=False):
     sharded over ``mesh[seq_axis]``; batch stays replicated or sharded by the caller's
     in_specs. Inputs/outputs are GLOBAL arrays of shape [B, T, H, D]."""
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+
+    from petastorm_tpu.parallel.mesh import shard_map_compat
 
     spec = P(None, seq_axis, None, None)
     inner = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    try:
-        sharded = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec, check_vma=False)
-    except TypeError:  # pre-0.8 jax spelled it check_rep
-        sharded = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec, check_rep=False)
-    return jax.jit(sharded)
+    return jax.jit(shard_map_compat(inner, mesh, (spec, spec, spec), spec))
 
 
 def dense_attention(q, k, v, causal=False):
