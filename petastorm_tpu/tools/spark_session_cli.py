@@ -1,0 +1,58 @@
+"""argparse plumbing for tools that optionally run against a Spark cluster
+(reference: petastorm/tools/spark_session_cli.py — ``--master`` /
+``--spark-session-config k=v`` flags feeding a SparkSession builder).
+
+petastorm_tpu's own tools are Arrow-native and do not need Spark, but users
+migrating Spark-driven ETL jobs can reuse this helper to keep their CLI
+contracts. Importing this module is safe without pyspark; only
+:func:`configure_spark` requires it.
+"""
+
+import argparse
+
+
+def add_configure_spark_arguments(parser):
+    """Add ``--master`` and ``--spark-session-config`` arguments to ``parser``."""
+    group = parser.add_argument_group('spark')
+    group.add_argument('--master', type=str, default=None,
+                       help='Spark master URL (e.g. local[4]). Default: whatever '
+                            'the environment provides.')
+    group.add_argument('--spark-session-config', type=str, nargs='+', default=[],
+                       metavar='KEY=VALUE',
+                       help='Extra SparkSession config entries, each KEY=VALUE.')
+    return parser
+
+
+def _parse_config_pairs(pairs):
+    config = {}
+    for pair in pairs:
+        key, sep, value = pair.partition('=')
+        if not sep or not key:
+            raise argparse.ArgumentTypeError(
+                'spark-session-config entries must be KEY=VALUE, got {!r}'.format(pair))
+        config[key] = value
+    return config
+
+
+def configure_spark(builder_or_args, args=None):
+    """Apply parsed CLI args to a ``SparkSession.Builder`` and return it.
+
+    Can be called either as ``configure_spark(args)`` (a builder is created) or
+    ``configure_spark(builder, args)`` (reference signature shape). Requires
+    pyspark.
+    """
+    if args is None:
+        args = builder_or_args
+        try:
+            from pyspark.sql import SparkSession
+        except ImportError:
+            raise ImportError('configure_spark requires pyspark, which is not '
+                              'installed; pip install pyspark')
+        builder = SparkSession.builder
+    else:
+        builder = builder_or_args
+    if getattr(args, 'master', None):
+        builder = builder.master(args.master)
+    for key, value in _parse_config_pairs(getattr(args, 'spark_session_config', [])).items():
+        builder = builder.config(key, value)
+    return builder

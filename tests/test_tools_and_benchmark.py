@@ -199,3 +199,25 @@ class TestHdfsResolver:
 def test_run_in_subprocess():
     from petastorm_tpu.utils import run_in_subprocess
     assert run_in_subprocess(sum, [1, 2, 3]) == 6
+
+
+def test_spark_session_cli_arguments_parse():
+    import argparse
+    from petastorm_tpu.tools import spark_session_cli
+
+    parser = argparse.ArgumentParser()
+    spark_session_cli.add_configure_spark_arguments(parser)
+    args = parser.parse_args(['--master', 'local[2]',
+                              '--spark-session-config', 'a.b=1', 'c.d=x'])
+    assert args.master == 'local[2]'
+    assert spark_session_cli._parse_config_pairs(args.spark_session_config) == \
+        {'a.b': '1', 'c.d': 'x'}
+
+
+def test_spark_session_cli_bad_pair_rejected():
+    import argparse
+    import pytest
+    from petastorm_tpu.tools import spark_session_cli
+
+    with pytest.raises(argparse.ArgumentTypeError):
+        spark_session_cli._parse_config_pairs(['no_equals_sign'])
