@@ -11,27 +11,28 @@ contracts. Importing this module is safe without pyspark; only
 import argparse
 
 
+def _config_pair(pair):
+    key, sep, value = pair.partition('=')
+    if not sep or not key:
+        raise argparse.ArgumentTypeError(
+            'spark-session-config entries must be KEY=VALUE, got {!r}'.format(pair))
+    return key, value
+
+
 def add_configure_spark_arguments(parser):
     """Add ``--master`` and ``--spark-session-config`` arguments to ``parser``."""
     group = parser.add_argument_group('spark')
     group.add_argument('--master', type=str, default=None,
                        help='Spark master URL (e.g. local[4]). Default: whatever '
                             'the environment provides.')
-    group.add_argument('--spark-session-config', type=str, nargs='+', default=[],
+    group.add_argument('--spark-session-config', type=_config_pair, nargs='+', default=[],
                        metavar='KEY=VALUE',
                        help='Extra SparkSession config entries, each KEY=VALUE.')
     return parser
 
 
 def _parse_config_pairs(pairs):
-    config = {}
-    for pair in pairs:
-        key, sep, value = pair.partition('=')
-        if not sep or not key:
-            raise argparse.ArgumentTypeError(
-                'spark-session-config entries must be KEY=VALUE, got {!r}'.format(pair))
-        config[key] = value
-    return config
+    return dict(_config_pair(p) if isinstance(p, str) else p for p in pairs)
 
 
 def configure_spark(builder_or_args, args=None):
@@ -39,10 +40,13 @@ def configure_spark(builder_or_args, args=None):
 
     Can be called either as ``configure_spark(args)`` (a builder is created) or
     ``configure_spark(builder, args)`` (reference signature shape). Requires
-    pyspark.
+    pyspark for the one-argument form.
     """
     if args is None:
         args = builder_or_args
+        if hasattr(args, 'config') and hasattr(args, 'getOrCreate'):
+            raise TypeError('configure_spark(builder) needs the parsed CLI args too: '
+                            'call configure_spark(builder, args)')
         try:
             from pyspark.sql import SparkSession
         except ImportError:
@@ -51,8 +55,9 @@ def configure_spark(builder_or_args, args=None):
         builder = SparkSession.builder
     else:
         builder = builder_or_args
-    if getattr(args, 'master', None):
-        builder = builder.master(args.master)
+    master = getattr(args, 'master', None)
+    if isinstance(master, str) and master:
+        builder = builder.master(master)
     for key, value in _parse_config_pairs(getattr(args, 'spark_session_config', [])).items():
         builder = builder.config(key, value)
     return builder

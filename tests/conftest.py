@@ -34,7 +34,7 @@ class SyntheticDataset(object):
     def __init__(self, url, rows):
         self.url = url
         self.rows = rows
-        self.rows_by_id = {row['id']: row for row in rows}
+        self.rows_by_id = {row['id']: row for row in rows if 'id' in row}
 
 
 @pytest.fixture(scope='session')
@@ -64,4 +64,19 @@ def scalar_dataset(tmp_path_factory):
     table = pa.table(data)
     pq.write_table(table.slice(0, 30), os.path.join(url, 'part_0.parquet'), row_group_size=10)
     pq.write_table(table.slice(30), os.path.join(url, 'part_1.parquet'), row_group_size=10)
+    return SyntheticDataset(url, [dict(zip(data, vals)) for vals in zip(*data.values())])
+
+
+@pytest.fixture(scope='session')
+def many_columns_dataset(tmp_path_factory):
+    """1000-column plain Parquet store (model: petastorm/tests/conftest.py
+    many_columns_non_petastorm_dataset, :248-294) — exercises wide-schema namedtuple
+    rendering and columnar reads."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    url = str(tmp_path_factory.mktemp('wide') / 'dataset')
+    os.makedirs(url)
+    # column-distinct values so column-mixup/reorder bugs are caught
+    data = {'col_{}'.format(i): [r + i * 10 for r in range(10)] for i in range(1000)}
+    pq.write_table(pa.table(data), os.path.join(url, 'part_0.parquet'), row_group_size=5)
     return SyntheticDataset(url, [dict(zip(data, vals)) for vals in zip(*data.values())])
