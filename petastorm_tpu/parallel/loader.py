@@ -16,6 +16,7 @@ torch), designed per SURVEY.md §7.1 item 5:
   async dispatch can't hide it.
 """
 
+import collections
 import queue
 import threading
 import time
@@ -91,6 +92,15 @@ class JaxDataLoader(object):
         self._queue = None
         self._producer = None
         self._stop_event = threading.Event()
+        # Delivery-exact checkpoint accounting: the producer appends
+        # [item_id, rows_pending] per reader chunk (FIFO order == emission order for the
+        # no-shuffle path); the consumer decrements as batches are yielded and marks an
+        # item delivered only when every one of its rows reached the training loop.
+        self._delivery_fifo = collections.deque()
+        self._fifo_lock = threading.Lock()
+        self._delivery_supported = None
+        self._epochs_delivered = 0
+        self._delivered_by_epoch = {}
 
     # ------------------------------------------------------------------ sharding
 
@@ -134,6 +144,10 @@ class JaxDataLoader(object):
         self._stop_event = threading.Event()
         self._queue = queue.Queue(self._prefetch)
         self._sharding = self._resolve_sharding()
+        # Stale pending entries from an abandoned previous iteration reference a dead
+        # stream; dropping them leaves their items undelivered, so a resume re-serves
+        # those rows instead of losing them.
+        self._delivery_fifo.clear()
         self._producer = threading.Thread(target=self._produce,
                                           args=(self._queue, self._stop_event),
                                           daemon=True,
@@ -148,13 +162,16 @@ class JaxDataLoader(object):
                 if item is _END:
                     if self._error is not None:
                         raise self._error
+                    self._mark_delivered(None)  # drop_last / buffer-drain leftovers
                     return
+                batch, local_rows = item
                 self.stats.wait_time_s += now - wait_start
                 self.stats.total_time_s += now - last_emit
                 last_emit = now
                 self.stats.batches += 1
-                self.stats.rows += self._batch_rows(item)
-                yield item
+                self.stats.rows += local_rows
+                self._mark_delivered(local_rows)
+                yield batch
         finally:
             self._stop_event.set()
             self._in_iter = False
@@ -168,12 +185,6 @@ class JaxDataLoader(object):
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-
-    @staticmethod
-    def _batch_rows(batch):
-        for value in batch.values():
-            return int(value.shape[0])
-        return 0
 
     # ------------------------------------------------------------------ producer
 
@@ -225,12 +236,21 @@ class JaxDataLoader(object):
         namedtuple round-trip); other iterables fall back to row accumulation."""
         iter_columnar = getattr(self.reader, 'iter_columnar', None)
         if iter_columnar is not None and getattr(self.reader, 'ngram', None) is None:
-            for batch in iter_columnar():
-                yield self._sanitize(dict(batch.columns))
+            self._delivery_supported = True
+            for batch in iter_columnar(include_empty=True):
+                if batch.item_id is None:
+                    self._delivery_supported = False
+                else:
+                    with self._fifo_lock:
+                        self._delivery_fifo.append([batch.item_id, batch.num_rows])
+                if batch.num_rows:
+                    yield self._sanitize(dict(batch.columns))
         elif getattr(self.reader, 'is_batched_reader', False):
+            self._delivery_supported = False
             for batch in self.reader:
                 yield self._sanitize(batch._asdict())
         else:
+            self._delivery_supported = False
             pending = []
             for row in self.reader:
                 pending.append(row._asdict())
@@ -270,6 +290,7 @@ class JaxDataLoader(object):
         return out
 
     def _emit(self, columns, out_queue, stop_event):
+        local_rows = self._batch_cols_rows(columns)
         if self._device_put:
             import jax
             sharding = self._sharding
@@ -280,7 +301,10 @@ class JaxDataLoader(object):
                 batch = jax.device_put(columns, sharding)
         else:
             batch = columns
-        self._put(batch, out_queue, stop_event)
+        # Host-local row count travels alongside: with a multi-process mesh the device
+        # array's leading dim is the GLOBAL batch, but stats and delivery accounting are
+        # per-host.
+        self._put((batch, local_rows), out_queue, stop_event)
 
     def _put(self, item, out_queue, stop_event):
         while not stop_event.is_set():
@@ -294,6 +318,76 @@ class JaxDataLoader(object):
                 out_queue.put_nowait(_END)
             except queue.Full:
                 pass
+
+    # ------------------------------------------------------------------ checkpoint
+
+    def _mark_delivered(self, n_rows):
+        """Consumer-thread half of delivery accounting: retire ``n_rows`` from the FIFO
+        (``None`` = end of stream: everything still pending was dropped by ``drop_last``
+        or drained out of the buffer and will never be served in this run)."""
+        fifo = self._delivery_fifo
+        remaining = n_rows
+        while True:
+            with self._fifo_lock:
+                if not fifo:
+                    break
+                head = fifo[0]
+                if n_rows is None:
+                    take = head[1]
+                else:
+                    if head[1] > 0 and remaining <= 0:
+                        break
+                    take = min(head[1], remaining)
+                head[1] -= take
+                if n_rows is not None:
+                    remaining -= take
+                if head[1] > 0:
+                    break
+                fifo.popleft()
+            self._note_delivered(head[0])
+
+    def _note_delivered(self, item_id):
+        epoch, piece, drop = item_id
+        self._delivered_by_epoch.setdefault(epoch, set()).add((piece, drop))
+        items_per_epoch = getattr(self.reader, 'items_per_epoch', None)
+        if not items_per_epoch:
+            return
+        while (len(self._delivered_by_epoch.get(self._epochs_delivered, ()))
+               >= items_per_epoch):
+            del self._delivered_by_epoch[self._epochs_delivered]
+            self._epochs_delivered += 1
+
+    def state_dict(self):
+        """Delivery-exact resumable read position: an item (rowgroup x drop-partition)
+        counts as consumed only once every one of its rows was handed to the training
+        loop — rows still inside the prefetch queue, the producer, or a drained buffer
+        are NOT counted and will be re-served on resume (at-least-once; a partially
+        delivered item is re-read whole). Rebuild the reader with the same arguments
+        plus ``resume_state=state`` and wrap it in a fresh loader to continue.
+
+        With a shuffling buffer, emission order differs from ingest order, so per-item
+        attribution is only trustworthy when nothing is pending — checkpoint at a stream
+        boundary (after the iterator is exhausted) in that case."""
+        if self._delivery_supported is False:
+            raise ValueError('state_dict requires a Reader with the columnar fast path '
+                             '(iter_columnar, non-NGram)')
+        with self._fifo_lock:
+            pending = any(entry[1] > 0 for entry in self._delivery_fifo)
+        if pending and self._shuffling_queue_capacity:
+            raise ValueError('With a shuffling buffer the loader cannot attribute '
+                             'in-flight rows to work items; checkpoint after the '
+                             'iterator is exhausted (epoch boundary) instead')
+        items_per_epoch = getattr(self.reader, 'items_per_epoch', None)
+        if items_per_epoch is None:
+            raise ValueError('Reader does not support checkpointing')
+        return {
+            'version': 1,
+            'items_per_epoch': items_per_epoch,
+            'epochs_consumed': self._epochs_delivered,
+            'consumed_by_epoch': {
+                epoch - self._epochs_delivered: sorted(ids)
+                for epoch, ids in self._delivered_by_epoch.items()},
+        }
 
     # ------------------------------------------------------------------ lifecycle
 

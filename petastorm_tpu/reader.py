@@ -7,6 +7,7 @@ schedule with bounded in-flight work.
 """
 
 import logging
+import threading
 import warnings
 
 import numpy as np
@@ -57,7 +58,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='threa
                 shard_seed=None, cache_type='null', cache_location=None,
                 cache_size_limit=None, cache_row_size_estimate=None,
                 cache_extra_settings=None, transform_spec=None, storage_options=None,
-                filesystem=None):
+                filesystem=None, resume_state=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -84,7 +85,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='threa
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   shard_seed=shard_seed, cache=cache, transform_spec=transform_spec,
                   is_batched_reader=False, decode=True,
-                  storage_options=storage_options, filesystem=filesystem)
+                  storage_options=storage_options, filesystem=filesystem,
+                  resume_state=resume_state)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -94,7 +96,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cur_shard=None, shard_count=None, shard_seed=None, cache_type='null',
                       cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, cache_extra_settings=None,
-                      transform_spec=None, storage_options=None, filesystem=None):
+                      transform_spec=None, storage_options=None, filesystem=None,
+                      resume_state=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     """
@@ -119,7 +122,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   predicate=predicate, rowgroup_selector=None, num_epochs=num_epochs,
                   cur_shard=cur_shard, shard_count=shard_count, shard_seed=shard_seed,
                   cache=cache, transform_spec=transform_spec, is_batched_reader=True,
-                  decode=False, storage_options=storage_options, filesystem=filesystem)
+                  decode=False, storage_options=storage_options, filesystem=filesystem,
+                  resume_state=resume_state)
 
 
 class Reader(object):
@@ -131,7 +135,7 @@ class Reader(object):
                  shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None, shard_seed=None,
                  cache=None, transform_spec=None, is_batched_reader=False, decode=True,
-                 storage_options=None, filesystem=None):
+                 storage_options=None, filesystem=None, resume_state=None):
         self.num_epochs = num_epochs
         self.is_batched_reader = is_batched_reader
         self.last_row_consumed = False
@@ -244,23 +248,56 @@ class Reader(object):
                     'shuffle_row_drop_partition': (drop_part, shuffle_row_drop_partitions),
                 })
 
+        # ---------------------------------------------- checkpoint / resume
+        # Consumption is tracked at work-item (rowgroup x drop-partition) granularity:
+        # every item yields exactly one ColumnarBatch, tagged with its absolute epoch and
+        # counted when popped off the results queue. Deterministic epoch order (sorted
+        # fragments + seeded shuffles) makes the position replayable — the extension
+        # SURVEY.md §5.4 prescribes over the reference's epoch-only restart granularity.
+        self._items_per_epoch = len(items)
+        self._accounting_lock = threading.Lock()
+        self._epochs_consumed = 0
+        self._consumed_by_epoch = {}  # absolute epoch -> set of (piece, drop)
+        iterations = num_epochs
+        skip_by_iteration = None
+        pre_shuffles = 0
+        if resume_state is not None:
+            if ngram is not None:
+                raise ValueError('resume_state is not supported with NGram windows')
+            self._load_resume_state(resume_state)
+            pre_shuffles = self._epochs_consumed
+            skip_by_iteration = {epoch - self._epochs_consumed: set(ids)
+                                 for epoch, ids in self._consumed_by_epoch.items()}
+            if num_epochs is not None:
+                iterations = num_epochs - self._epochs_consumed
+                if iterations <= 0:
+                    raise ValueError(
+                        'resume_state shows all {} epochs already consumed'.format(num_epochs))
+
         max_in_flight = getattr(reader_pool, 'workers_count', 1) + _VENTILATE_EXTRA_ROWGROUPS
         self._ventilator = ConcurrentVentilator(
             ventilate_fn=reader_pool.ventilate,
             items_to_ventilate=items,
-            iterations=num_epochs,
+            iterations=iterations,
             max_ventilation_queue_size=max_in_flight,
             randomize_item_order=shuffle_row_groups,
-            random_seed=seed)
+            random_seed=seed,
+            pre_shuffle_count=pre_shuffles,
+            skip_ids_by_iteration=skip_by_iteration,
+            item_id_fn=_item_id,
+            reset_iterations=num_epochs,
+            tag_epoch=True)
         self._pool = reader_pool
         self._pool.start(RowGroupWorker, worker_setup, self._ventilator)
 
         if ngram is not None:
             self._results_reader = _NGramResultsReader(self.result_schema, ngram)
         elif is_batched_reader:
-            self._results_reader = _BatchResultsReader(self.result_schema)
+            self._results_reader = _BatchResultsReader(self.result_schema,
+                                                       on_batch=self._note_item_consumed)
         else:
-            self._results_reader = _RowResultsReader(self.result_schema)
+            self._results_reader = _RowResultsReader(self.result_schema,
+                                                     on_batch=self._note_item_consumed)
 
     # --------------------------------------------------------------- sharding
 
@@ -297,21 +334,26 @@ class Reader(object):
         """Total rows in this shard per epoch (reference: reader.py:492-494)."""
         return sum(rg.row_group_num_rows for rg in self._shard_row_groups)
 
-    def iter_columnar(self):
+    def iter_columnar(self, include_empty=False):
         """Iterate raw :class:`ColumnarBatch` results straight off the worker pool —
         the zero-copy fast path for columnar consumers (JaxDataLoader), skipping the
         per-row namedtuple conversion of ``__next__``. Do not interleave with ``next()``;
-        not available for NGram readers."""
+        not available for NGram readers. ``include_empty`` also yields zero-row batches
+        (published so every work item is observable — delivery-exact checkpointing
+        needs them)."""
         if self.ngram is not None:
             raise ValueError('iter_columnar is not supported with NGram windows')
         while True:
             if self._stopped:
                 raise RuntimeError('Trying to read from a stopped reader')
             try:
-                yield self._pool.get_results()
+                batch = self._pool.get_results()
             except EmptyResultError:
                 self.last_row_consumed = True
                 return
+            self._note_item_consumed(batch)
+            if batch.num_rows or include_empty:
+                yield batch
 
     def reset(self):
         """Re-ventilate for another ``num_epochs`` pass; only valid after full consumption
@@ -322,6 +364,67 @@ class Reader(object):
         self._results_reader.reset()
         self._ventilator.reset()
         self.last_row_consumed = False
+
+    # ----------------------------------------------------------- checkpoint / resume
+
+    def _note_item_consumed(self, batch):
+        item_id = getattr(batch, 'item_id', None)
+        if item_id is None:
+            return
+        epoch, piece, drop = item_id
+        with self._accounting_lock:
+            self._consumed_by_epoch.setdefault(epoch, set()).add((piece, drop))
+            # Epochs complete strictly in order; results of later epochs accumulate in
+            # their own sets until the earlier epoch's straggler items are popped.
+            while (len(self._consumed_by_epoch.get(self._epochs_consumed, ()))
+                   >= self._items_per_epoch):
+                del self._consumed_by_epoch[self._epochs_consumed]
+                self._epochs_consumed += 1
+
+    def _load_resume_state(self, state):
+        if not isinstance(state, dict) or state.get('version') != 1:
+            raise ValueError('Unrecognized resume_state {!r}'.format(state))
+        if state['items_per_epoch'] != self._items_per_epoch:
+            raise ValueError(
+                'resume_state was captured from a reader with {} work items per epoch, '
+                'but this reader has {} — dataset contents, sharding, predicate, selector '
+                'or shuffle_row_drop_partitions differ'
+                .format(state['items_per_epoch'], self._items_per_epoch))
+        self._epochs_consumed = int(state['epochs_consumed'])
+        self._consumed_by_epoch = {
+            self._epochs_consumed + int(offset): {tuple(item) for item in ids}
+            for offset, ids in state['consumed_by_epoch'].items()}
+
+    def state_dict(self):
+        """Snapshot of the read position, resumable via ``make_reader(...,
+        resume_state=state)`` with identical construction arguments.
+
+        Granularity is the work item (rowgroup x drop-partition): an item counts as
+        consumed once its batch is popped off the results queue (``consumed_by_epoch``
+        maps epoch offsets to consumed items — several epochs can be partially consumed
+        at once since completions interleave across epoch boundaries). On resume, the
+        seeded epoch order is replayed deterministically and consumed items are skipped
+        in their respective epochs. Results published by workers but not yet popped are
+        re-read (at-least-once); rows of a popped batch not yet emitted row-wise are
+        skipped (at-most-once) — for delivery-exact accounting over a loader use
+        ``JaxDataLoader.state_dict`` instead. The reference has no analog (restart
+        granularity is the epoch, SURVEY.md §5.4).
+        """
+        if self.ngram is not None:
+            raise ValueError('state_dict is not supported with NGram windows')
+        with self._accounting_lock:
+            return {
+                'version': 1,
+                'items_per_epoch': self._items_per_epoch,
+                'epochs_consumed': self._epochs_consumed,
+                'consumed_by_epoch': {
+                    epoch - self._epochs_consumed: sorted(ids)
+                    for epoch, ids in self._consumed_by_epoch.items()},
+            }
+
+    @property
+    def items_per_epoch(self):
+        return self._items_per_epoch
 
     # ------------------------------------------------------------- lifecycle
 
@@ -347,6 +450,11 @@ class Reader(object):
         self.join()
 
 
+def _item_id(item):
+    """Stable identity of a ventilated work item for consumption accounting."""
+    return (item['piece_index'], item['shuffle_row_drop_partition'][0])
+
+
 def _is_ngram(schema_fields):
     from petastorm_tpu.ngram import NGram
     return isinstance(schema_fields, NGram)
@@ -365,14 +473,17 @@ def _eval_partition_predicate(predicate, row_group):
 class _RowResultsReader(object):
     """Buffers a ColumnarBatch and pops one namedtuple per read (row-at-a-time API)."""
 
-    def __init__(self, result_schema):
+    def __init__(self, result_schema, on_batch=None):
         self._schema = result_schema
+        self._on_batch = on_batch
         self._batch = None
         self._next_row = 0
 
     def read_next(self, pool):
         while self._batch is None or self._next_row >= self._batch.num_rows:
             self._batch = pool.get_results()
+            if self._on_batch is not None:
+                self._on_batch(self._batch)
             self._next_row = 0
         row = self._batch.row(self._next_row)
         self._next_row += 1
@@ -386,12 +497,17 @@ class _RowResultsReader(object):
 class _BatchResultsReader(object):
     """Emits one namedtuple-of-arrays per rowgroup batch."""
 
-    def __init__(self, result_schema):
+    def __init__(self, result_schema, on_batch=None):
         self._schema = result_schema
+        self._on_batch = on_batch
 
     def read_next(self, pool):
-        batch = pool.get_results()
-        return self._schema.make_namedtuple(**batch.columns)
+        while True:
+            batch = pool.get_results()
+            if self._on_batch is not None:
+                self._on_batch(batch)
+            if batch.num_rows:
+                return self._schema.make_namedtuple(**batch.columns)
 
     def reset(self):
         pass

@@ -30,13 +30,16 @@ logger = logging.getLogger(__name__)
 class ColumnarBatch(object):
     """Decoded columns of (a partition of) one rowgroup: ``{field_name: ndarray | list}``.
     Arrays are ``(n,) + field.shape`` when shapes are uniform; ragged fields stay as lists
-    of per-row arrays."""
+    of per-row arrays. ``item_id`` identifies the ventilated work item
+    ``(piece_index, drop_partition)`` that produced this batch — the unit of the reader's
+    checkpoint/resume accounting (empty batches are published solely to carry it)."""
 
-    __slots__ = ('columns', 'num_rows')
+    __slots__ = ('columns', 'num_rows', 'item_id')
 
-    def __init__(self, columns, num_rows):
+    def __init__(self, columns, num_rows, item_id=None):
         self.columns = columns
         self.num_rows = num_rows
+        self.item_id = item_id
 
     def row(self, i):
         return {name: col[i] for name, col in self.columns.items()}
@@ -95,7 +98,7 @@ class RowGroupWorker(WorkerBase):
         return self._filesystem
 
     def process(self, piece_index, fragment_path, row_group_id, partition_keys=None,
-                worker_predicate=None, shuffle_row_drop_partition=(0, 1)):
+                worker_predicate=None, shuffle_row_drop_partition=(0, 1), epoch_index=0):
         setup = self._setup
         if setup.ngram is not None:
             batch = self._process_ngram(piece_index, fragment_path, row_group_id,
@@ -117,12 +120,19 @@ class RowGroupWorker(WorkerBase):
                 setup.dataset_token, fragment_path, row_group_id,
                 shuffle_row_drop_partition, predicate_token)
             columns = setup.cache.get(cache_key, load)
+        # (absolute_epoch, piece, drop_partition): the epoch tag lets the reader attribute
+        # this result to the right epoch even when completions interleave across an epoch
+        # boundary (parallel pools keep up to workers+2 items in flight).
+        item_id = (epoch_index, piece_index, shuffle_row_drop_partition[0])
         num_rows = _columns_num_rows(columns)
         if num_rows == 0:
+            # Publish an empty batch anyway: every item must yield exactly one result so
+            # the reader's consumption accounting (state_dict/resume) stays exact.
+            self.publish_func(ColumnarBatch({}, 0, item_id=item_id))
             return
         columns = self._shuffle(columns, num_rows, piece_index)
         columns, num_rows = self._apply_transform(columns, num_rows)
-        self.publish_func(ColumnarBatch(columns, num_rows))
+        self.publish_func(ColumnarBatch(columns, num_rows, item_id=item_id))
 
     # ------------------------------------------------------------------ load
 

@@ -40,7 +40,21 @@ class ConcurrentVentilator(Ventilator):
     (reference: ventilator.py:63-168)."""
 
     def __init__(self, ventilate_fn, items_to_ventilate, iterations=1,
-                 max_ventilation_queue_size=None, randomize_item_order=False, random_seed=None):
+                 max_ventilation_queue_size=None, randomize_item_order=False,
+                 random_seed=None, pre_shuffle_count=0, skip_ids_by_iteration=None,
+                 item_id_fn=None, reset_iterations=None, tag_epoch=False):
+        """Resume-from-checkpoint support: the RNG stream is advanced by
+        ``pre_shuffle_count`` epoch-shuffles (reproducing the item order of the epoch
+        being resumed); items whose ``item_id_fn(item)`` appears in
+        ``skip_ids_by_iteration[k]`` are skipped during the k-th pass after construction
+        (they were consumed before the checkpoint; results can straddle several epochs,
+        hence a per-iteration map, not a single set). With ``tag_epoch`` every ventilated
+        call gets an ``epoch_index`` kwarg carrying the absolute epoch
+        (``pre_shuffle_count`` + completed passes) so consumers can attribute results to
+        epochs even when completions interleave across an epoch boundary.
+        ``reset_iterations`` is what :meth:`reset` restores (defaults to ``iterations``;
+        a resumed reader passes its full ``num_epochs`` so reset keeps its documented
+        meaning)."""
         super().__init__(ventilate_fn)
         if iterations is not None and (not isinstance(iterations, int) or iterations < 1):
             raise ValueError('iterations must be a positive integer or None, got {!r}'
@@ -48,10 +62,20 @@ class ConcurrentVentilator(Ventilator):
         self._items_to_ventilate = list(items_to_ventilate)
         self._iterations = iterations
         self._iterations_remaining = iterations
+        self._reset_iterations = reset_iterations if reset_iterations is not None else iterations
         self._max_ventilation_queue_size = (max_ventilation_queue_size
                                             or len(self._items_to_ventilate) or 1)
         self._randomize_item_order = randomize_item_order
         self._random_state = np.random.RandomState(random_seed)
+        if randomize_item_order:
+            for _ in range(pre_shuffle_count):
+                self._random_state.shuffle(self._items_to_ventilate)
+        self._skip_ids_by_iteration = {int(k): set(v)
+                                       for k, v in (skip_ids_by_iteration or {}).items()}
+        self._item_id_fn = item_id_fn or (lambda item: None)
+        self._tag_epoch = tag_epoch
+        self._pass_offset = 0
+        self._absolute_epoch = pre_shuffle_count
 
         self._in_flight = 0
         self._current_item_to_ventilate = 0
@@ -80,23 +104,33 @@ class ConcurrentVentilator(Ventilator):
         while not self._stop_requested.is_set():
             if self._completed.is_set():
                 return
-            with self._item_processed:
-                while (self._in_flight >= self._max_ventilation_queue_size
-                       and not self._stop_requested.is_set()):
-                    self._item_processed.wait(timeout=0.1)
-                if self._stop_requested.is_set():
-                    return
-                self._in_flight += 1
             item = self._items_to_ventilate[self._current_item_to_ventilate]
+            skip_ids = self._skip_ids_by_iteration.get(self._pass_offset)
+            skip = bool(skip_ids) and self._item_id_fn(item) in skip_ids
+            if not skip:
+                with self._item_processed:
+                    while (self._in_flight >= self._max_ventilation_queue_size
+                           and not self._stop_requested.is_set()):
+                        self._item_processed.wait(timeout=0.1)
+                    if self._stop_requested.is_set():
+                        return
+                    self._in_flight += 1
             self._current_item_to_ventilate += 1
             try:
-                self._ventilate_fn(**item)
+                if not skip:
+                    if self._tag_epoch:
+                        self._ventilate_fn(epoch_index=self._absolute_epoch, **item)
+                    else:
+                        self._ventilate_fn(**item)
             except Exception as exc:  # noqa: BLE001 - surface to consumer, never hang
                 self.error = exc
                 self._completed.set()
                 return
             if self._current_item_to_ventilate >= len(self._items_to_ventilate):
                 self._current_item_to_ventilate = 0
+                self._skip_ids_by_iteration.pop(self._pass_offset, None)
+                self._pass_offset += 1
+                self._absolute_epoch += 1
                 if self._iterations_remaining is not None:
                     self._iterations_remaining -= 1
                     if self._iterations_remaining <= 0:
@@ -128,7 +162,9 @@ class ConcurrentVentilator(Ventilator):
         self._completed.clear()
         self._stop_requested.clear()
         self._current_item_to_ventilate = 0
-        self._iterations_remaining = self._iterations
+        # Full reset_iterations, not the (possibly resume-reduced) first-run iterations;
+        # the RNG stream and absolute epoch counter continue uninterrupted.
+        self._iterations_remaining = self._reset_iterations
         self._thread = None
         self.start()
 
