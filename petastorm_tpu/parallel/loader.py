@@ -17,6 +17,7 @@ torch), designed per SURVEY.md §7.1 item 5:
 """
 
 import collections
+import contextlib
 import queue
 import threading
 import time
@@ -27,6 +28,17 @@ from petastorm_tpu.parallel.shuffling_buffer import (NoopShufflingBuffer,
                                                      RandomShufflingBuffer)
 
 _END = object()
+
+
+def _trace_span(name):
+    """jax.profiler annotation so loader stages show up in device traces next to the
+    XLA ops they feed (SURVEY.md §5.1: the TPU-native replacement for the reference's
+    per-thread cProfile); a no-op nullcontext when jax is absent."""
+    try:
+        from jax.profiler import TraceAnnotation
+    except ImportError:
+        return contextlib.nullcontext()
+    return TraceAnnotation(name)
 
 
 class LoaderStats(object):
@@ -157,7 +169,8 @@ class JaxDataLoader(object):
             last_emit = time.monotonic()
             while True:
                 wait_start = time.monotonic()
-                item = self._queue.get()
+                with _trace_span('petastorm_tpu.loader.wait_input'):
+                    item = self._queue.get()
                 now = time.monotonic()
                 if item is _END:
                     if self._error is not None:
@@ -294,11 +307,12 @@ class JaxDataLoader(object):
         if self._device_put:
             import jax
             sharding = self._sharding
-            if self._mesh is not None:
-                batch = {name: jax.make_array_from_process_local_data(sharding, col)
-                         for name, col in columns.items()}
-            else:
-                batch = jax.device_put(columns, sharding)
+            with _trace_span('petastorm_tpu.loader.h2d'):
+                if self._mesh is not None:
+                    batch = {name: jax.make_array_from_process_local_data(sharding, col)
+                             for name, col in columns.items()}
+                else:
+                    batch = jax.device_put(columns, sharding)
         else:
             batch = columns
         # Host-local row count travels alongside: with a multi-process mesh the device

@@ -115,3 +115,63 @@ class TestNgramEndToEnd:
                          num_epochs=2, shuffle_row_groups=False) as reader:
             windows = list(reader)
         assert len(windows) == 38
+
+    def test_ngram_delta_threshold_end_to_end(self, tmp_path):
+        """Gapped timestamps through the full reader (model: reference's
+        test_ngram_delta_threshold over dataset 0,3,8,10,11,20,23)."""
+        url = str(tmp_path / 'gaps')
+        write_rows(url, SeqSchema, _seq_rows([0, 3, 8, 10, 11, 20, 23]),
+                   rows_per_file=7, rowgroup_size_mb=64)
+        ngram = NGram({0: ['ts', 'value'], 1: ['ts', 'label']}, delta_threshold=4,
+                      timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False) as reader:
+            pairs = sorted((w[0].ts, w[1].ts) for w in reader)
+        assert pairs == [(0, 3), (8, 10), (10, 11), (20, 23)]
+
+    def test_ngram_delta_small_threshold_no_windows(self, tmp_path):
+        """Timestamps spaced wider than the threshold yield no windows at all (model:
+        reference's test_ngram_delta_small_threshold)."""
+        url = str(tmp_path / 'sparse')
+        write_rows(url, SeqSchema, _seq_rows(range(0, 100, 5)), rows_per_file=20,
+                   rowgroup_size_mb=64)
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, workers_count=1) as reader:
+            assert list(reader) == []
+
+    def test_ngram_length_1(self, seq_dataset):
+        """A one-timestep NGram degenerates to per-row reads wrapped in {0: row}
+        (model: reference's test_ngram_length_1)."""
+        ngram = NGram({0: ['ts', 'value']}, delta_threshold=10, timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False) as reader:
+            windows = list(reader)
+        assert len(windows) == 20
+        assert sorted(w[0].ts for w in windows) == list(range(20))
+
+    def test_ngram_regex_fields_end_to_end(self, seq_dataset):
+        """Regex patterns resolve per timestep against the schema (model: reference's
+        test_ngram_with_regex_fields)."""
+        ngram = NGram({0: ['^ts$', 'val.*'], 1: ['^(ts|label)$']}, delta_threshold=1,
+                      timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False) as reader:
+            w = next(reader)
+        assert set(w[0]._fields) == {'ts', 'value'}
+        assert set(w[1]._fields) == {'ts', 'label'}
+
+    def test_ngram_no_overlap_end_to_end(self, seq_dataset):
+        """timestamp_overlap=False tiles the sequence into disjoint windows through the
+        full reader path."""
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts',
+                      timestamp_overlap=False)
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False) as reader:
+            starts = sorted(w[0].ts for w in reader)
+        assert starts == list(range(0, 20, 2))
+
+    def test_ngram_resume_rejected(self, seq_dataset):
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1) as reader:
+            with pytest.raises(ValueError, match='NGram'):
+                reader.state_dict()
