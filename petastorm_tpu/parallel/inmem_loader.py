@@ -1,0 +1,204 @@
+"""InMemJaxLoader: load a dataset once, then serve seeded epoch batches with no further
+host IO — the TPU-native counterpart of the reference's ``InMemBatchedDataLoader``
+(petastorm/pytorch.py:368-496: fill ≤ rows_capacity rows once, stop the reader, then
+epochs of seeded ``torch.randperm`` batch sampling).
+
+TPU-first design: on a single device (``mesh=None``) the whole dataset lives in HBM and
+every batch is produced by one jitted gather — per-epoch permutations are computed with
+``jax.random`` on device, so after the fill phase the input pipeline touches the host
+zero times (input stall is structurally 0). With a ``mesh`` the dataset stays in host
+RAM and each sampled batch is assembled into a mesh-sharded ``jax.Array`` like
+:class:`JaxDataLoader` does (HBM-resident sharded sampling would force cross-shard
+gathers; host assembly is the faster layout there).
+"""
+
+import warnings
+
+import numpy as np
+
+from petastorm_tpu.parallel.loader import resolve_sharding, sanitize_columns
+
+_FILL_SAFETY_CAP = 100_000_000
+
+
+class InMemJaxLoader(object):
+    """Fill once from ``reader``, then iterate seeded shuffled batches for
+    ``num_epochs`` (None = infinite).
+
+    :param reader: petastorm_tpu Reader (row or batched; non-NGram).
+    :param batch_size: rows per batch on this host.
+    :param num_epochs: epochs to serve from memory (None = infinite). Independent of the
+        reader's own ``num_epochs``, which only governs the fill (use reader
+        num_epochs=1).
+    :param rows_capacity: stop filling after this many rows (required if the reader is
+        infinite). The reader is stopped after the fill, mirroring the reference's
+        deadlock avoidance (pytorch.py:420-424).
+    :param shuffle: seeded reshuffle every epoch (default True).
+    :param seed: base seed; epoch ``e`` uses fold-in of ``e``.
+    :param mesh/partition_spec: as in :class:`JaxDataLoader`.
+    :param pad_ragged: as in :class:`JaxDataLoader`.
+    :param drop_last: drop the final partial batch (static shapes under jit).
+    :param device_put: False keeps batches as host numpy (debugging).
+    """
+
+    def __init__(self, reader, batch_size, num_epochs=1, rows_capacity=None,
+                 shuffle=True, seed=0, mesh=None, partition_spec=None, pad_ragged=None,
+                 drop_last=True, device_put=True):
+        if batch_size < 1:
+            raise ValueError('batch_size must be >= 1')
+        if num_epochs is not None and num_epochs < 1:
+            raise ValueError('num_epochs must be >= 1 or None')
+        if partition_spec is not None and mesh is None:
+            raise ValueError('partition_spec requires a mesh')
+        self.batch_size = batch_size
+        self.num_epochs = num_epochs
+        self._shuffle = shuffle
+        self._seed = seed
+        self._mesh = mesh
+        self._partition_spec = partition_spec
+        self._pad_ragged = dict(pad_ragged or {})
+        self._drop_last = drop_last
+        self._device_put = device_put
+        self._columns = self._fill(reader, rows_capacity)
+        self._num_rows = next(iter(self._columns.values())).shape[0] if self._columns else 0
+        if self._num_rows < batch_size and drop_last:
+            raise ValueError('Loaded {} rows < batch_size {} with drop_last=True — '
+                             'every epoch would be empty'.format(self._num_rows, batch_size))
+        self._data = None  # device-resident dataset (single-device path), built lazily
+        self._take = None
+
+    # ------------------------------------------------------------------ fill
+
+    def _fill(self, reader, rows_capacity):
+        if getattr(reader, 'ngram', None) is not None:
+            raise ValueError('InMemJaxLoader does not support NGram readers')
+        if rows_capacity is None and reader.num_epochs is None:
+            raise ValueError('rows_capacity is required with an infinite reader '
+                             '(num_epochs=None), otherwise the fill never ends')
+        cap = rows_capacity if rows_capacity is not None else _FILL_SAFETY_CAP
+        chunks = []
+        rows = 0
+        try:
+            for batch in reader.iter_columnar():
+                chunks.append(sanitize_columns(dict(batch.columns), self._pad_ragged,
+                                               self._device_put))
+                rows += batch.num_rows
+                if rows >= cap:
+                    if rows_capacity is None:
+                        warnings.warn(
+                            'InMemJaxLoader fill hit the {}-row safety cap without an '
+                            'explicit rows_capacity; the dataset is TRUNCATED. Pass '
+                            'rows_capacity to make the limit intentional.'
+                            .format(_FILL_SAFETY_CAP))
+                    break
+        finally:
+            # Stop regardless: an infinite reader would otherwise keep workers running
+            # (reference: pytorch.py:420-424).
+            reader.stop()
+            reader.join()
+        if not chunks:
+            return {}
+        columns = {name: _concat([c[name] for c in chunks])
+                   for name in chunks[0]}
+        if rows_capacity is not None:
+            columns = {name: col[:rows_capacity] for name, col in columns.items()}
+        return columns
+
+    # ------------------------------------------------------------------ iteration
+
+    def __len__(self):
+        """Batches per epoch."""
+        if self._drop_last:
+            return self._num_rows // self.batch_size
+        return -(-self._num_rows // self.batch_size)
+
+    @property
+    def num_rows(self):
+        return self._num_rows
+
+    def __iter__(self):
+        if self._num_rows == 0:
+            return
+        epoch = 0
+        while self.num_epochs is None or epoch < self.num_epochs:
+            if self._device_put and self._mesh is None:
+                yield from self._iter_epoch_on_device(epoch)
+            else:
+                yield from self._iter_epoch_host(epoch)
+            epoch += 1
+
+    # -- single-device: dataset in HBM, jitted gather, device-side permutation --------
+
+    def _ensure_device_data(self):
+        import jax
+        if self._data is None:
+            self._data = jax.device_put(self._columns)
+            # The on-device path never reads the host copy again; holding it would
+            # double the dataset's memory footprint.
+            self._columns = None
+
+            @jax.jit
+            def take(data, idx):
+                return {name: col[idx] for name, col in data.items()}
+
+            self._take = take
+        return self._data
+
+    def _iter_epoch_on_device(self, epoch):
+        import jax
+        import jax.numpy as jnp
+        data = self._ensure_device_data()
+        n = self._num_rows
+        if self._shuffle:
+            key = jax.random.fold_in(jax.random.PRNGKey(self._seed), epoch)
+            perm = jax.random.permutation(key, n)
+        else:
+            perm = jnp.arange(n)
+        limit = n - self.batch_size + 1 if self._drop_last else n
+        for start in range(0, limit, self.batch_size):
+            idx = jax.lax.dynamic_slice_in_dim(
+                perm, start, min(self.batch_size, n - start))
+            yield self._take(data, idx)
+
+    # -- mesh / host path: numpy sampling + per-batch sharded assembly ----------------
+
+    def _iter_epoch_host(self, epoch):
+        if self._shuffle:
+            perm = np.random.RandomState((self._seed + epoch) % (2 ** 31)).permutation(
+                self._num_rows)
+        else:
+            perm = np.arange(self._num_rows)
+        sharding = resolve_sharding(self._mesh, self._partition_spec, self._device_put)
+        limit = (self._num_rows - self.batch_size + 1 if self._drop_last
+                 else self._num_rows)
+        for start in range(0, limit, self.batch_size):
+            idx = perm[start:start + self.batch_size]
+            batch = {name: np.ascontiguousarray(col[idx])
+                     for name, col in self._columns.items()}
+            if self._device_put:
+                # __iter__ routes here with device_put only when a mesh is present
+                # (single-device device_put takes the HBM-resident path).
+                import jax
+                batch = {name: jax.make_array_from_process_local_data(sharding, col)
+                         for name, col in batch.items()}
+            yield batch
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def stop(self):
+        pass
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        pass
+
+
+def _concat(parts):
+    if len(parts) == 1:
+        return np.ascontiguousarray(parts[0])
+    return np.concatenate(parts, axis=0)

@@ -30,15 +30,19 @@ from petastorm_tpu.parallel.shuffling_buffer import (NoopShufflingBuffer,
 _END = object()
 
 
+try:
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except ImportError:  # pragma: no cover - jax is a hard dep in practice
+    _TraceAnnotation = None
+
+
 def _trace_span(name):
     """jax.profiler annotation so loader stages show up in device traces next to the
     XLA ops they feed (SURVEY.md §5.1: the TPU-native replacement for the reference's
     per-thread cProfile); a no-op nullcontext when jax is absent."""
-    try:
-        from jax.profiler import TraceAnnotation
-    except ImportError:
+    if _TraceAnnotation is None:
         return contextlib.nullcontext()
-    return TraceAnnotation(name)
+    return _TraceAnnotation(name)
 
 
 class LoaderStats(object):
@@ -117,18 +121,7 @@ class JaxDataLoader(object):
     # ------------------------------------------------------------------ sharding
 
     def _resolve_sharding(self):
-        if not self._device_put:
-            return None
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
-        if self._mesh is None:
-            if self._partition_spec is not None:
-                raise ValueError('partition_spec requires a mesh')
-            return SingleDeviceSharding(jax.devices()[0])
-        spec = self._partition_spec
-        if spec is None:
-            spec = PartitionSpec(self._mesh.axis_names[0])
-        return NamedSharding(self._mesh, spec)
+        return resolve_sharding(self._mesh, self._partition_spec, self._device_put)
 
     # ------------------------------------------------------------------ iteration
 
@@ -274,33 +267,7 @@ class JaxDataLoader(object):
                 yield self._sanitize(_rows_to_columns(pending))
 
     def _sanitize(self, columns):
-        """Dtype sanitization for the device (the analog of the torch/tf sanitizers,
-        pytorch.py:40-65 / tf_utils.py:57-96): datetimes -> int64 ns, ragged fields padded
-        per ``pad_ragged``, strings/objects rejected with the field named."""
-        out = {}
-        for name, col in columns.items():
-            if name in self._pad_ragged:
-                padded, lengths = _pad_column(col, self._pad_ragged[name], name)
-                out[name] = padded
-                out[name + '_len'] = lengths
-                continue
-            if isinstance(col, list):
-                raise ValueError(
-                    'Field {!r} is ragged (variable shape); pass pad_ragged={{{!r}: '
-                    '(max_shape...)}} to pad it, or drop it via schema_fields'
-                    .format(name, name))
-            if col.dtype.kind == 'M':
-                out[name] = col.astype('datetime64[ns]').astype(np.int64)
-            elif col.dtype.kind in ('U', 'S', 'O'):
-                if self._device_put:
-                    raise ValueError(
-                        'Field {!r} has dtype {} which has no device representation; '
-                        'drop it via schema_fields or use device_put=False'
-                        .format(name, col.dtype))
-                out[name] = col
-            else:
-                out[name] = np.ascontiguousarray(col)
-        return out
+        return sanitize_columns(columns, self._pad_ragged, self._device_put)
 
     def _emit(self, columns, out_queue, stop_event):
         local_rows = self._batch_cols_rows(columns)
@@ -418,6 +385,57 @@ class JaxDataLoader(object):
     def __exit__(self, exc_type, exc_val, exc_tb):
         self.stop()
         self.join()
+
+
+def resolve_sharding(mesh, partition_spec, device_put):
+    """Sharding for emitted batch arrays: single default device without a mesh, else a
+    ``NamedSharding`` over ``partition_spec`` (default: batch axis over the mesh's first
+    axis)."""
+    if not device_put:
+        if partition_spec is not None and mesh is None:
+            raise ValueError('partition_spec requires a mesh')
+        return None
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
+    if mesh is None:
+        if partition_spec is not None:
+            raise ValueError('partition_spec requires a mesh')
+        return SingleDeviceSharding(jax.devices()[0])
+    spec = partition_spec
+    if spec is None:
+        spec = PartitionSpec(mesh.axis_names[0])
+    return NamedSharding(mesh, spec)
+
+
+def sanitize_columns(columns, pad_ragged, device_put):
+    """Dtype sanitization for the device (the analog of the torch/tf sanitizers,
+    pytorch.py:40-65 / tf_utils.py:57-96): datetimes -> int64 ns, ragged fields padded
+    per ``pad_ragged`` (emitting a ``<field>_len`` mask column), strings/objects rejected
+    with the field named when a device representation is required."""
+    out = {}
+    for name, col in columns.items():
+        if name in pad_ragged:
+            padded, lengths = _pad_column(col, pad_ragged[name], name)
+            out[name] = padded
+            out[name + '_len'] = lengths
+            continue
+        if isinstance(col, list):
+            raise ValueError(
+                'Field {!r} is ragged (variable shape); pass pad_ragged={{{!r}: '
+                '(max_shape...)}} to pad it, or drop it via schema_fields'
+                .format(name, name))
+        if col.dtype.kind == 'M':
+            out[name] = col.astype('datetime64[ns]').astype(np.int64)
+        elif col.dtype.kind in ('U', 'S', 'O'):
+            if device_put:
+                raise ValueError(
+                    'Field {!r} has dtype {} which has no device representation; '
+                    'drop it via schema_fields or use device_put=False'
+                    .format(name, col.dtype))
+            out[name] = col
+        else:
+            out[name] = np.ascontiguousarray(col)
+    return out
 
 
 def _iter_column_slices(columns, slice_rows):
