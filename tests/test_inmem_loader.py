@@ -79,6 +79,21 @@ def test_drop_last_false_serves_tail(synthetic_dataset):
     assert sizes == [30, 30, 30, 10]
 
 
+def test_fill_from_weighted_sampling_reader(synthetic_dataset):
+    """Readers without the columnar fast path (WeightedSamplingReader) fill through the
+    row-accumulation fallback."""
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    r1 = make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                     schema_fields=['id'])
+    r2 = make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                     schema_fields=['id'])
+    mixed = WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=0)
+    loader = InMemJaxLoader(mixed, batch_size=10, num_epochs=1, device_put=False,
+                            drop_last=False)
+    assert loader.num_rows > 0
+    assert sum(len(b['id']) for b in loader) == loader.num_rows
+
+
 def test_host_only_mode(synthetic_dataset):
     reader = make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
                          schema_fields=['id'])

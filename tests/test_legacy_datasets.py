@@ -1,0 +1,73 @@
+"""Golden back-compat: read the REAL datasets petastorm 0.4.0-0.7.6 shipped in its test
+tree (pickled Unischemas incl. pyspark-namedtuple-hijack pickles and pre-numpy-2 scalar
+names), end to end through make_reader (model: petastorm/tests/
+test_reading_legacy_datasets.py). Skipped when the reference checkout is absent."""
+
+import os
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+
+LEGACY_BASE = '/root/reference/petastorm/tests/data/legacy'
+VERSIONS = ['0.4.0', '0.4.3', '0.5.1', '0.6.0', '0.7.0', '0.7.6']
+
+pytestmark = pytest.mark.skipif(not os.path.isdir(LEGACY_BASE),
+                                reason='reference legacy datasets not available')
+
+
+def _url(version):
+    return 'file://' + os.path.join(LEGACY_BASE, version)
+
+
+@pytest.mark.parametrize('version', VERSIONS)
+def test_legacy_dataset_reads_and_decodes(version):
+    with make_reader(_url(version), workers_count=1, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        rows = {row.id: row for row in reader}
+    assert len(rows) == 100
+    row = rows[0]
+    assert row.image_png.shape == (32, 16, 3) and row.image_png.dtype == np.uint8
+    assert row.matrix.dtype == np.float32 or row.matrix.dtype == np.float64
+    from decimal import Decimal
+    assert isinstance(row.decimal, Decimal)
+
+
+def test_legacy_versions_core_schema_stable():
+    """Each version's pickled Unischema depickles through a different pickle vintage
+    (copyreg protocol-0, NEWOBJ, pyspark's namedtuple-hijack ``_restore``); petastorm
+    grew fields over time, but the core fields must resolve to identical dtype/shape in
+    every vintage."""
+    # matrix was float32 before 0.4.3 -> dtype left unchecked, shape pinned
+    core = {'id': ('<i8', ()), 'id2': ('<i4', ()), 'image_png': ('|u1', (32, 16, 3)),
+            'matrix': (None, (32, 16, 3)), 'decimal': (None, ()),
+            'partition_key': (None, ())}
+
+    def fields(version):
+        from petastorm_tpu.etl.dataset_metadata import get_schema, open_dataset
+        schema = get_schema(open_dataset(_url(version)))
+        return {name: (np.dtype(f.numpy_dtype).str if f.numpy_dtype is not None
+                       and np.dtype(f.numpy_dtype).kind not in ('U', 'S', 'O') else None,
+                       tuple(f.shape))
+                for name, f in schema.fields.items()}
+
+    for version in VERSIONS:
+        got = fields(version)
+        for name, (expected_dtype, expected_shape) in core.items():
+            assert name in got, (version, name)
+            got_dtype, got_shape = got[name]
+            assert got_shape == expected_shape, (version, name, got_shape)
+            if expected_dtype is not None:
+                assert got_dtype == expected_dtype, (version, name, got_dtype)
+
+
+def test_legacy_partition_predicate_prunes(tmp_path):
+    """Partition-key predicates prune legacy stores' rowgroups in the main process."""
+    from petastorm_tpu.predicates import in_lambda
+    pred = in_lambda(['partition_key'], lambda pk: pk == 'p_2')
+    with make_reader(_url('0.7.6'), workers_count=1, num_epochs=1,
+                     predicate=pred) as reader:
+        rows = list(reader)
+    assert rows
+    assert all(r.partition_key == 'p_2' for r in rows)
