@@ -25,6 +25,9 @@ def main(argv=None):
                         help='measure in a fresh interpreter for a clean RSS reading')
     parser.add_argument('--jax-batch-size', type=int, default=256)
     parser.add_argument('--no-shuffle-row-groups', action='store_true')
+    parser.add_argument('--profile-threads', action='store_true',
+                        help='cProfile each thread-pool worker; aggregate logged on '
+                             'shutdown')
     parser.add_argument('-v', '--verbose', action='store_true')
     args = parser.parse_args(argv)
 
@@ -35,7 +38,8 @@ def main(argv=None):
         measure_cycles_count=args.measure_cycles, pool_type=args.pool_type,
         loaders_count=args.workers_count, read_method=args.read_method,
         shuffle_row_groups=not args.no_shuffle_row_groups,
-        jax_batch_size=args.jax_batch_size, spawn_new_process=args.spawn_new_process)
+        jax_batch_size=args.jax_batch_size, spawn_new_process=args.spawn_new_process,
+        profile_threads=args.profile_threads)
     print('Throughput: {:.2f} samples/sec; RSS: {:.2f} MB; CPU: {:.2f}%{}'.format(
         result.samples_per_second, result.memory_info.rss / (1 << 20), result.cpu,
         '; input-stall: {:.1%}'.format(result.input_stall_fraction)

@@ -22,27 +22,37 @@ READ_JAX = 'jax'
 def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
                       measure_cycles_count=1000, pool_type='thread', loaders_count=3,
                       read_method=READ_PYTHON, shuffle_row_groups=True,
-                      jax_batch_size=256, spawn_new_process=False):
+                      jax_batch_size=256, spawn_new_process=False,
+                      profile_threads=False):
     """Measure read throughput of a dataset (reference: throughput.py:112-172).
 
     ``read_method='python'`` iterates raw reader rows; ``'jax'`` drives a JaxDataLoader
     (cycle = one batch) and also reports the loader's input-stall fraction.
     ``spawn_new_process`` re-runs the measurement in a fresh interpreter for a clean
-    RSS reading (reference: throughput.py:144-149)."""
+    RSS reading (reference: throughput.py:144-149). ``profile_threads`` wraps each
+    thread-pool worker in cProfile; the aggregate is logged on shutdown (reference:
+    thread_pool.py:41-49 + benchmark/cli.py:56-57)."""
     if spawn_new_process:
         from petastorm_tpu.utils import run_in_subprocess
         return run_in_subprocess(reader_throughput, dataset_url, field_regex,
                                  warmup_cycles_count, measure_cycles_count, pool_type,
                                  loaders_count, read_method, shuffle_row_groups,
-                                 jax_batch_size, False)
+                                 jax_batch_size, False, profile_threads)
 
     import psutil
     from petastorm_tpu.reader import make_reader
 
     process = psutil.Process()
+    reader_pool = None
+    if profile_threads:
+        if pool_type != 'thread':
+            raise ValueError('--profile-threads requires the thread pool')
+        from petastorm_tpu.workers.thread_pool import ThreadPool
+        reader_pool = ThreadPool(loaders_count, profiling_enabled=True)
     reader = make_reader(dataset_url, schema_fields=field_regex,
                          reader_pool_type=pool_type, workers_count=loaders_count,
-                         shuffle_row_groups=shuffle_row_groups, num_epochs=None)
+                         shuffle_row_groups=shuffle_row_groups, num_epochs=None,
+                         reader_pool=reader_pool)
     stall = 0.0
     try:
         if read_method == READ_PYTHON:

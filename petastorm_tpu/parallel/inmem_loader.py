@@ -16,7 +16,8 @@ import warnings
 
 import numpy as np
 
-from petastorm_tpu.parallel.loader import resolve_sharding, sanitize_columns
+from petastorm_tpu.parallel.loader import (iter_reader_chunks, reader_may_be_infinite,
+                                           resolve_sharding, sanitize_columns)
 
 _FILL_SAFETY_CAP = 100_000_000
 
@@ -72,14 +73,15 @@ class InMemJaxLoader(object):
     def _fill(self, reader, rows_capacity):
         if getattr(reader, 'ngram', None) is not None:
             raise ValueError('InMemJaxLoader does not support NGram readers')
-        if rows_capacity is None and getattr(reader, 'num_epochs', 1) is None:
-            raise ValueError('rows_capacity is required with an infinite reader '
-                             '(num_epochs=None), otherwise the fill never ends')
+        if rows_capacity is None and reader_may_be_infinite(reader):
+            raise ValueError('rows_capacity is required with a (possibly) infinite '
+                             'reader (num_epochs=None, or a wrapper over one), '
+                             'otherwise the fill never ends')
         cap = rows_capacity if rows_capacity is not None else _FILL_SAFETY_CAP
         chunks = []
         rows = 0
         try:
-            for columns, n in self._fill_chunks(reader):
+            for columns, n, _ in iter_reader_chunks(reader):
                 chunks.append(sanitize_columns(columns, self._pad_ragged,
                                                self._device_put))
                 rows += n
@@ -103,31 +105,6 @@ class InMemJaxLoader(object):
         if rows_capacity is not None:
             columns = {name: col[:rows_capacity] for name, col in columns.items()}
         return columns
-
-    @staticmethod
-    def _fill_chunks(reader):
-        """Yield (columns_dict, num_rows) from any reader: the columnar fast path when
-        available, else per-row accumulation (WeightedSamplingReader and other plain
-        iterables — mirroring JaxDataLoader._reader_chunks)."""
-        iter_columnar = getattr(reader, 'iter_columnar', None)
-        if iter_columnar is not None:
-            for batch in iter_columnar():
-                yield dict(batch.columns), batch.num_rows
-        elif getattr(reader, 'is_batched_reader', False):
-            for batch in reader:
-                columns = batch._asdict()
-                n = len(next(iter(columns.values()))) if columns else 0
-                yield columns, n
-        else:
-            from petastorm_tpu.parallel.loader import _rows_to_columns
-            pending = []
-            for row in reader:
-                pending.append(row._asdict())
-                if len(pending) >= 4096:
-                    yield _rows_to_columns(pending), len(pending)
-                    pending = []
-            if pending:
-                yield _rows_to_columns(pending), len(pending)
 
     # ------------------------------------------------------------------ iteration
 

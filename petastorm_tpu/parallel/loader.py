@@ -237,34 +237,18 @@ class JaxDataLoader(object):
         return 0
 
     def _reader_chunks(self):
-        """Yield sanitized columnar chunks from the reader. Readers exposing the
-        ``iter_columnar`` fast path feed worker batches straight through (no per-row
-        namedtuple round-trip); other iterables fall back to row accumulation."""
-        iter_columnar = getattr(self.reader, 'iter_columnar', None)
-        if iter_columnar is not None and getattr(self.reader, 'ngram', None) is None:
-            self._delivery_supported = True
-            for batch in iter_columnar(include_empty=True):
-                if batch.item_id is None:
-                    self._delivery_supported = False
-                else:
-                    with self._fifo_lock:
-                        self._delivery_fifo.append([batch.item_id, batch.num_rows])
-                if batch.num_rows:
-                    yield self._sanitize(dict(batch.columns))
-        elif getattr(self.reader, 'is_batched_reader', False):
-            self._delivery_supported = False
-            for batch in self.reader:
-                yield self._sanitize(batch._asdict())
-        else:
-            self._delivery_supported = False
-            pending = []
-            for row in self.reader:
-                pending.append(row._asdict())
-                if len(pending) >= self.batch_size:
-                    yield self._sanitize(_rows_to_columns(pending))
-                    pending = []
-            if pending:
-                yield self._sanitize(_rows_to_columns(pending))
+        """Yield sanitized columnar chunks from the reader, tracking delivery when the
+        columnar fast path provides item identity."""
+        for columns, num_rows, item_id in iter_reader_chunks(
+                self.reader, accum_rows=self.batch_size, include_empty=True):
+            if item_id is None:
+                self._delivery_supported = False
+            else:
+                self._delivery_supported = self._delivery_supported is not False
+                with self._fifo_lock:
+                    self._delivery_fifo.append([item_id, num_rows])
+            if num_rows:
+                yield self._sanitize(columns)
 
     def _sanitize(self, columns):
         return sanitize_columns(columns, self._pad_ragged, self._device_put)
@@ -385,6 +369,43 @@ class JaxDataLoader(object):
     def __exit__(self, exc_type, exc_val, exc_tb):
         self.stop()
         self.join()
+
+
+def iter_reader_chunks(reader, accum_rows=4096, include_empty=False):
+    """Yield ``(columns_dict, num_rows, item_id_or_None)`` from any reader: the columnar
+    fast path when available (item identity preserved for delivery accounting), else
+    batched-namedtuple or per-row accumulation (``accum_rows`` per chunk). The single
+    reader-dispatch used by both JaxDataLoader and InMemJaxLoader."""
+    iter_columnar = getattr(reader, 'iter_columnar', None)
+    if iter_columnar is not None and getattr(reader, 'ngram', None) is None:
+        for batch in iter_columnar(include_empty=include_empty):
+            yield dict(batch.columns), batch.num_rows, batch.item_id
+    elif getattr(reader, 'is_batched_reader', False):
+        for batch in reader:
+            columns = batch._asdict()
+            num_rows = len(next(iter(columns.values()))) if columns else 0
+            yield columns, num_rows, None
+    else:
+        pending = []
+        for row in reader:
+            pending.append(row._asdict())
+            if len(pending) >= accum_rows:
+                yield _rows_to_columns(pending), len(pending), None
+                pending = []
+        if pending:
+            yield _rows_to_columns(pending), len(pending), None
+
+
+def reader_may_be_infinite(reader):
+    """Conservative infinite-stream detection: ``num_epochs is None`` on the reader or,
+    for wrapper readers exposing ``_readers``/``readers``, on any wrapped reader;
+    unknown shapes count as infinite (callers should then demand an explicit cap)."""
+    if hasattr(reader, 'num_epochs'):
+        return reader.num_epochs is None
+    inner = getattr(reader, 'readers', None) or getattr(reader, '_readers', None)
+    if inner:
+        return any(reader_may_be_infinite(r) for r in inner)
+    return True
 
 
 def resolve_sharding(mesh, partition_spec, device_put):

@@ -58,11 +58,13 @@ def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='threa
                 shard_seed=None, cache_type='null', cache_location=None,
                 cache_size_limit=None, cache_row_size_estimate=None,
                 cache_extra_settings=None, transform_spec=None, storage_options=None,
-                filesystem=None, resume_state=None):
+                filesystem=None, resume_state=None, reader_pool=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
-    or an :class:`~petastorm_tpu.ngram.NGram` for sequence windows."""
+    or an :class:`~petastorm_tpu.ngram.NGram` for sequence windows. ``reader_pool``
+    overrides ``reader_pool_type`` with a pre-built pool instance (e.g. a ThreadPool with
+    profiling_enabled)."""
     dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
     handle = dataset_metadata.open_dataset(dataset_url_or_urls,
                                            storage_options=storage_options,
@@ -75,7 +77,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='threa
             'Parquet stores.'.format(dataset_url_or_urls))
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
-    pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+    pool = reader_pool if reader_pool is not None else _make_pool(
+        reader_pool_type, workers_count, results_queue_size)
     return Reader(dataset_url_or_urls, handle=handle, schema=schema,
                   schema_fields=schema_fields,
                   reader_pool=pool, seed=seed, shuffle_rows=shuffle_rows,

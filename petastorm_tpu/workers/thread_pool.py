@@ -32,16 +32,41 @@ class WorkerThread(threading.Thread):
         self._worker = worker
 
     def run(self):
+        profiler = None
+        if self._pool._profiling_enabled:
+            import cProfile
+            profiler = cProfile.Profile()
         while True:
             item = self._pool._ventilator_queue.get()
             if item is _STOP_SENTINEL:
                 break
+            # CPython 3.12's cProfile registers a process-global sys.monitoring tool, so
+            # only one profiler may be active at a time: workers contend for the lock
+            # per item and whoever holds it profiles that item (a sample of all
+            # workers' work rather than the reference's true per-thread profiles,
+            # thread_pool.py:41-49 — py3.12 removed that option).
+            profiling_this = profiler is not None and \
+                self._pool._profiler_slot.acquire(blocking=False)
+            if profiling_this:
+                try:
+                    profiler.enable()
+                except ValueError:
+                    # another tool (e.g. coverage) owns the global monitoring slot
+                    self._pool._profiler_slot.release()
+                    profiling_this = False
             try:
-                self._worker.process(**item)
+                try:
+                    self._worker.process(**item)
+                finally:
+                    if profiling_this:
+                        profiler.disable()
+                        self._pool._profiler_slot.release()
                 self._pool._put_result(VentilatedItemProcessedMessage())
             except Exception as exc:  # noqa: BLE001 - propagate to consumer
                 import traceback
                 self._pool._put_result(_WorkerError(exc, traceback.format_exc()))
+        if profiler is not None:
+            self._pool._collect_profile(profiler)
         self._worker.shutdown()
 
 
@@ -49,7 +74,8 @@ class ThreadPool(object):
     """N worker threads, each owning a worker instance; bounded results queue provides
     backpressure (reference: thread_pool.py)."""
 
-    def __init__(self, workers_count, results_queue_size=DEFAULT_RESULTS_QUEUE_SIZE):
+    def __init__(self, workers_count, results_queue_size=DEFAULT_RESULTS_QUEUE_SIZE,
+                 profiling_enabled=False):
         self._workers_count = workers_count
         self._results_queue = queue.Queue(results_queue_size)
         self._ventilator_queue = queue.Queue()
@@ -57,6 +83,12 @@ class ThreadPool(object):
         self._ventilator = None
         self._stopped = threading.Event()
         self.workers_count = workers_count
+        #: per-worker cProfile, aggregated and logged on join() (reference:
+        #: thread_pool.py:41-49,190-198)
+        self._profiling_enabled = profiling_enabled
+        self._profiles = []
+        self._profiles_lock = threading.Lock()
+        self._profiler_slot = threading.Lock()
 
     def start(self, worker_class, worker_args=None, ventilator=None):
         if self._threads:
@@ -123,12 +155,43 @@ class ThreadPool(object):
         for _ in self._threads:
             self._ventilator_queue.put(_STOP_SENTINEL)
 
+    def _collect_profile(self, profiler):
+        with self._profiles_lock:
+            self._profiles.append(profiler)
+
     def join(self):
         if not self._stopped.is_set():
             raise RuntimeError('join() must be preceded by stop()')
+        stragglers = []
         for thread in self._threads:
             thread.join(timeout=30)
+            if thread.is_alive():
+                stragglers.append(thread.name)
         self._threads = []
+        if stragglers and self._profiling_enabled:
+            logger.warning('Worker thread(s) %s still alive after join timeout; their '
+                           'profile data is not included in the aggregate', stragglers)
+        if self._profiling_enabled and self._profiles:
+            import io
+            import pstats
+            stream = io.StringIO()
+            stats = None
+            with self._profiles_lock:
+                for profiler in self._profiles:
+                    try:
+                        profiler.create_stats()
+                    except Exception:  # noqa: BLE001 - never profiled anything
+                        continue
+                    if not getattr(profiler, 'stats', None):
+                        continue  # worker never won the (py3.12-global) profiler slot
+                    if stats is None:
+                        stats = pstats.Stats(profiler, stream=stream)
+                    else:
+                        stats.add(profiler)
+                self._profiles = []
+            if stats is not None:
+                stats.sort_stats('cumulative').print_stats(30)
+                logger.info('Aggregated worker-thread profile:\n%s', stream.getvalue())
 
     @property
     def diagnostics(self):

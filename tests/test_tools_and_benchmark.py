@@ -87,6 +87,24 @@ class TestThroughput:
         assert result.samples_per_second > 0
         assert result.memory_info.rss > 0
 
+    def test_profile_threads(self, synthetic_dataset, caplog):
+        import logging
+        from petastorm_tpu.benchmark.throughput import reader_throughput
+        with caplog.at_level(logging.INFO, logger='petastorm_tpu.workers.thread_pool'):
+            result = reader_throughput(synthetic_dataset.url, field_regex=['id'],
+                                       warmup_cycles_count=5, measure_cycles_count=10,
+                                       loaders_count=2, profile_threads=True)
+        assert result.samples_per_second > 0
+        profile_logs = [r for r in caplog.records if 'profile' in r.message.lower()]
+        assert profile_logs, 'aggregated worker profile must be logged on join'
+        assert 'cumulative' in profile_logs[0].getMessage()
+
+    def test_profile_threads_requires_thread_pool(self, synthetic_dataset):
+        from petastorm_tpu.benchmark.throughput import reader_throughput
+        with pytest.raises(ValueError, match='thread pool'):
+            reader_throughput(synthetic_dataset.url, pool_type='dummy',
+                              profile_threads=True)
+
     def test_jax_read_method(self, synthetic_dataset):
         from petastorm_tpu.benchmark.throughput import READ_JAX, reader_throughput
         result = reader_throughput(synthetic_dataset.url, field_regex=['id', 'matrix'],
