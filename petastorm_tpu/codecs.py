@@ -111,7 +111,10 @@ def arrow_type_for_numpy(numpy_dtype):
     dtype = np.dtype(numpy_dtype) if not isinstance(numpy_dtype, np.dtype) else numpy_dtype
     if dtype in _NUMPY_TO_ARROW:
         return _NUMPY_TO_ARROW[dtype]
-    if dtype.kind in ('U', 'S') or dtype == np.dtype(object):
+    if dtype.kind == 'S':
+        # bytes dtype must store as Arrow binary, or decode hands back str
+        return pa.binary()
+    if dtype.kind == 'U' or dtype == np.dtype(object):
         return pa.string()
     if dtype.kind == 'M':
         return pa.timestamp('ns')

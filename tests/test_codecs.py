@@ -29,6 +29,14 @@ class TestScalarCodec:
         field = UnischemaField('s', np.str_, (), ScalarCodec(), False)
         assert _roundtrip(field.codec, field, 'hello') == 'hello'
 
+    def test_bytes_roundtrip_stays_bytes(self):
+        """np.bytes_ fields must map to Arrow binary, not string — otherwise decode
+        hands back str and binary payloads get UTF-8 mangled."""
+        field = UnischemaField('b', np.bytes_, (), ScalarCodec(), False)
+        assert field.codec.arrow_type(field) == pa.binary()
+        out = _roundtrip(field.codec, field, b'\x00\xffraw')
+        assert isinstance(out, bytes) and out == b'\x00\xffraw'
+
     def test_rejects_array(self):
         field = UnischemaField('x', np.int32, (), ScalarCodec(), False)
         with pytest.raises(TypeError):
