@@ -1,0 +1,162 @@
+"""Long-context training from Parquet: sequence-parallel attention fed by
+sequence-sharded loader batches.
+
+The end-to-end long-context story (SURVEY.md §5.7's idiomatic extension point — the
+reference only *constructs* sequences via NGram; it has no compute-side sequence
+parallelism):
+
+1. tokenized documents live in a petastorm_tpu store (one ``(seq_len,)`` int32
+   NdarrayCodec field per row);
+2. ``JaxDataLoader`` emits batches sharded over a 2-D ``(data, seq)`` mesh with
+   ``PartitionSpec('data', 'seq')`` — each device holds a [B/data, T/seq] token shard,
+   assembled straight from the host pipeline (no resharding step);
+3. a causal transformer block computes exact attention over the sequence axis with
+   ``ops.ring_attention`` (K/V shards rotate around the ``seq`` ring via ``ppermute``
+   on ICI), so sequences longer than one chip's HBM are trained without gathering the
+   full sequence anywhere.
+
+Run: ``python -m examples.long_context.jax_example --seq-len 512``
+"""
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+VOCAB = 256
+EMBED = 64
+HEADS = 4
+
+
+def build_dataset(url, num_docs=256, seq_len=512, seed=0):
+    """Materialize synthetic tokenized documents (stand-in for a tokenized corpus)."""
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_rows
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('Docs', [
+        UnischemaField('doc_id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('tokens', np.int32, (seq_len,), NdarrayCodec(), False),
+    ])
+    rng = np.random.RandomState(seed)
+    # a learnable synthetic language: each doc repeats a per-doc token bigram pattern
+    rows = []
+    for i in range(num_docs):
+        base = rng.randint(0, VOCAB, size=8, dtype=np.int32)
+        tokens = np.tile(base, seq_len // 8 + 1)[:seq_len].astype(np.int32)
+        rows.append({'doc_id': i, 'tokens': tokens})
+    write_rows(url, schema, rows, n_files=4)
+    return schema
+
+
+def init_params(key, vocab=VOCAB, embed=EMBED):
+    import jax
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale = embed ** -0.5
+    return {
+        'embed': jax.random.normal(k1, (vocab, embed)) * scale,
+        'qkv': jax.random.normal(k2, (embed, 3 * embed)) * scale,
+        'out': jax.random.normal(k3, (embed, vocab)) * scale,
+    }
+
+
+def make_train_step(mesh, learning_rate=2.0):
+    """Jitted train step over the (data, seq) mesh: embeddings/matmuls are GSPMD-sharded
+    by the batch's PartitionSpec; attention runs as ring attention over the seq axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from petastorm_tpu.ops.ring_attention import ring_attention
+    from petastorm_tpu.parallel.mesh import shard_map_compat
+
+    attn_spec = P('data', 'seq', None, None)
+    ring = shard_map_compat(
+        lambda q, k, v: ring_attention(q, k, v, axis_name='seq', causal=True),
+        mesh, (attn_spec, attn_spec, attn_spec), attn_spec)
+
+    def loss_fn(params, tokens):
+        b, t = tokens.shape
+        x = params['embed'][tokens]                                  # [B,T,D]
+        qkv = x @ params['qkv']                                      # [B,T,3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        head_dim = EMBED // HEADS
+        q = q.reshape(b, t, HEADS, head_dim)
+        k = k.reshape(b, t, HEADS, head_dim)
+        v = v.reshape(b, t, HEADS, head_dim)
+        attn = ring(q, k, v).reshape(b, t, EMBED)
+        logits = (x + attn) @ params['out']                          # [B,T,V] (residual)
+        # next-token prediction; mask the final position (no target)
+        targets = jnp.roll(tokens, -1, axis=1)
+        per_tok = -jax.nn.log_softmax(logits)[
+            jnp.arange(b)[:, None], jnp.arange(t)[None, :], targets]
+        mask = jnp.broadcast_to(jnp.arange(t)[None, :] < t - 1, per_tok.shape)
+        return (per_tok * mask).sum() / mask.sum()
+
+    batch_sharding = NamedSharding(mesh, P('data', 'seq'))
+
+    @jax.jit
+    def train_step(params, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        params = jax.tree_util.tree_map(lambda p, g: p - learning_rate * g,
+                                        params, grads)
+        return params, loss
+
+    return train_step, batch_sharding
+
+
+def train(dataset_url, batch_size=8, epochs=2, data_axis=None):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.parallel import JaxDataLoader, make_mesh
+
+    n_dev = len(jax.devices())
+    if data_axis is None:
+        data_axis = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    if n_dev % data_axis:
+        raise ValueError('data_axis {} does not divide device count {}'
+                         .format(data_axis, n_dev))
+    mesh = make_mesh(('data', 'seq'), axis_sizes=(data_axis, n_dev // data_axis))
+    train_step, _ = make_train_step(mesh)
+
+    params = init_params(jax.random.PRNGKey(0))
+    loss = None
+    reader = make_reader(dataset_url, schema_fields=['tokens'], num_epochs=epochs,
+                         shuffle_row_groups=True, seed=7)
+    with JaxDataLoader(reader, batch_size=batch_size, mesh=mesh,
+                       partition_spec=P('data', 'seq')) as loader:
+        for step, batch in enumerate(loader):
+            params, loss = train_step(params, batch['tokens'])
+            if step % 20 == 0:
+                print('step {} loss {:.4f}'.format(step, float(loss)))
+        print('input pipeline stats:', loader.stats.as_dict())
+    return params, float(loss)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default=None)
+    parser.add_argument('--num-docs', type=int, default=256)
+    parser.add_argument('--seq-len', type=int, default=512)
+    parser.add_argument('--batch-size', type=int, default=8)
+    parser.add_argument('--epochs', type=int, default=2)
+    parser.add_argument('--data-axis', type=int, default=None,
+                        help='mesh data-axis size (default: 2 if the device count is '
+                             'even, else 1; seq axis gets the rest)')
+    args = parser.parse_args()
+
+    url = args.dataset_url or os.path.join(tempfile.gettempdir(), 'long_context_demo')
+    if not os.path.exists(os.path.join(url.replace('file://', ''), '_common_metadata')):
+        print('materializing {} docs x {} tokens to {}'.format(
+            args.num_docs, args.seq_len, url))
+        build_dataset(url, args.num_docs, args.seq_len)
+    _, final_loss = train(url, batch_size=args.batch_size, epochs=args.epochs,
+                          data_axis=args.data_axis)
+    print('final loss: {:.4f}'.format(final_loss))
+
+
+if __name__ == '__main__':
+    main()

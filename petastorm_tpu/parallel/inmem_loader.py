@@ -16,8 +16,9 @@ import warnings
 
 import numpy as np
 
-from petastorm_tpu.parallel.loader import (iter_reader_chunks, reader_may_be_infinite,
-                                           resolve_sharding, sanitize_columns)
+from petastorm_tpu.parallel.loader import (FieldShardings, iter_reader_chunks,
+                                           reader_may_be_infinite, resolve_sharding,
+                                           sanitize_columns, sharding_for_field)
 
 _FILL_SAFETY_CAP = 100_000_000
 
@@ -173,6 +174,8 @@ class InMemJaxLoader(object):
         else:
             perm = np.arange(self._num_rows)
         sharding = resolve_sharding(self._mesh, self._partition_spec, self._device_put)
+        if isinstance(sharding, FieldShardings):
+            sharding.check_unused(self._columns.keys())
         limit = (self._num_rows - self.batch_size + 1 if self._drop_last
                  else self._num_rows)
         for start in range(0, limit, self.batch_size):
@@ -183,7 +186,8 @@ class InMemJaxLoader(object):
                 # __iter__ routes here with device_put only when a mesh is present
                 # (single-device device_put takes the HBM-resident path).
                 import jax
-                batch = {name: jax.make_array_from_process_local_data(sharding, col)
+                batch = {name: jax.make_array_from_process_local_data(
+                             sharding_for_field(sharding, name), col)
                          for name, col in batch.items()}
             yield batch
 

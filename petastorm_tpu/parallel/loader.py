@@ -73,7 +73,9 @@ class JaxDataLoader(object):
         global batch is ``batch_size * jax.process_count()``.
     :param mesh: optional ``jax.sharding.Mesh``; None = single default device.
     :param partition_spec: ``PartitionSpec`` for every batch array (default: batch axis
-        over the mesh's first axis). Accepts any layout for TP/SP consumers.
+        over the mesh's first axis), or a dict ``{field: PartitionSpec}`` — named fields
+        get their spec, the rest the batch-axis default. Accepts any layout for TP/SP
+        consumers (e.g. ``{'tokens': P('data', 'seq')}`` for sequence-sharded batches).
     :param shuffling_queue_capacity: >0 enables a RandomShufflingBuffer of that capacity.
     :param min_after_retrieve: decorrelation floor (default capacity//2).
     :param pad_ragged: {field: padded_shape_tuple} — ragged fields are zero-padded to the
@@ -117,6 +119,7 @@ class JaxDataLoader(object):
         self._delivery_supported = None
         self._epochs_delivered = 0
         self._delivered_by_epoch = {}
+        self._spec_keys_checked = False
 
     # ------------------------------------------------------------------ sharding
 
@@ -258,9 +261,13 @@ class JaxDataLoader(object):
         if self._device_put:
             import jax
             sharding = self._sharding
+            if isinstance(sharding, FieldShardings) and not self._spec_keys_checked:
+                self._spec_keys_checked = True
+                sharding.check_unused(columns.keys())
             with _trace_span('petastorm_tpu.loader.h2d'):
                 if self._mesh is not None:
-                    batch = {name: jax.make_array_from_process_local_data(sharding, col)
+                    batch = {name: jax.make_array_from_process_local_data(
+                                 sharding_for_field(sharding, name), col)
                              for name, col in columns.items()}
                 else:
                     batch = jax.device_put(columns, sharding)
@@ -408,10 +415,39 @@ def reader_may_be_infinite(reader):
     return True
 
 
+class FieldShardings(object):
+    """Per-field sharding table: fields named in the ``partition_spec`` dict get their
+    spec, everything else the batch-axis default (rank-1 label columns can ride along
+    with a rank-2 sequence-sharded tokens column)."""
+
+    def __init__(self, per_field, default):
+        self._per_field = per_field
+        self._default = default
+
+    def for_field(self, name):
+        return self._per_field.get(name, self._default)
+
+    def check_unused(self, field_names):
+        """Warn once about spec keys matching no batch field (a typoed key would
+        otherwise silently leave its field on the batch-axis default)."""
+        unused = set(self._per_field) - set(field_names)
+        if unused:
+            import warnings
+            warnings.warn('partition_spec keys {} match no batch field (fields: {}); '
+                          'those fields fall back to the default batch-axis sharding'
+                          .format(sorted(unused), sorted(field_names)))
+
+
 def resolve_sharding(mesh, partition_spec, device_put):
     """Sharding for emitted batch arrays: single default device without a mesh, else a
     ``NamedSharding`` over ``partition_spec`` (default: batch axis over the mesh's first
-    axis)."""
+    axis). A dict ``partition_spec`` ({field: PartitionSpec}) returns a
+    :class:`FieldShardings` table."""
+    if isinstance(partition_spec, dict):
+        per_field = {name: resolve_sharding(mesh, spec, device_put)
+                     for name, spec in partition_spec.items()}
+        default = resolve_sharding(mesh, None, device_put)
+        return FieldShardings(per_field, default)
     if not device_put:
         if partition_spec is not None and mesh is None:
             raise ValueError('partition_spec requires a mesh')
@@ -426,6 +462,10 @@ def resolve_sharding(mesh, partition_spec, device_put):
     if spec is None:
         spec = PartitionSpec(mesh.axis_names[0])
     return NamedSharding(mesh, spec)
+
+
+def sharding_for_field(sharding, name):
+    return sharding.for_field(name) if isinstance(sharding, FieldShardings) else sharding
 
 
 def sanitize_columns(columns, pad_ragged, device_put):

@@ -44,6 +44,21 @@ class TestMesh:
 
 
 class TestLoader:
+    def test_per_field_partition_spec(self, synthetic_dataset):
+        """Dict partition_spec: named fields get their spec (e.g. sequence sharding),
+        the rest the batch-axis default — rank-1 labels ride along with rank-2 data."""
+        from petastorm_tpu import make_reader
+        mesh = make_mesh(('data', 'seq'), (2, 4))
+        with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                         workers_count=1) as reader:
+            loader = JaxDataLoader(
+                reader, batch_size=16, mesh=mesh,
+                partition_spec={'matrix': PartitionSpec('data', 'seq')})
+            batch = next(iter(loader))
+            loader.stop()
+        assert batch['matrix'].sharding.spec == PartitionSpec('data', 'seq')
+        assert batch['id'].sharding.spec == PartitionSpec('data')
+
     def test_batched_reader_to_device(self, scalar_dataset):
         mesh = make_mesh(('data',))
         with make_batch_reader(scalar_dataset.url, schema_fields=['id', 'float64'],
