@@ -5,15 +5,32 @@ reference's examples/mnist/schema.py shape), then measures steady-state rows/sec
 ``make_reader -> JaxDataLoader -> jitted MnistCNN train step`` on the default JAX device,
 with input-stall%% from the loader's own instrumentation.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``vs_baseline`` is the ratio to the reference's published hello_world reader throughput
 (709.84 samples/sec — docs/benchmarks_tutorial.rst:20-21; BASELINE.md).
+
+Robustness (round-2 hardening): the accelerator tunnel on this host is known to be
+flaky — ``jax.devices()`` can raise UNAVAILABLE transiently or hang outright. A single
+failed backend init must not zero the benchmark. Structure:
+
+- parent process: builds the dataset (host-only), then probes the TPU backend in a
+  *subprocess* with a hard timeout (an in-process probe can hang the whole bench),
+  retrying with backoff; runs the measured bench in a child process with a timeout and
+  retries that too; if the TPU never comes up, falls back to ``JAX_PLATFORMS=cpu`` so a
+  number (tagged ``"platform": "cpu"``) is still produced.
+- child process (``BENCH_CHILD=1``): the actual measurement loop.
+
+Estimator note: ``value`` is the MEDIAN of per-epoch rates (robust to shared-host CPU
+contention transients); the baseline constant 709.84 is a mean-style published number.
+The JSON line carries both ``value`` (median) and ``value_mean`` plus an ``estimator``
+tag so historical ``vs_baseline`` ratios stay interpretable (ADVICE.md round 1).
 
 Extra diagnostics go to stderr only.
 """
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
@@ -25,10 +42,20 @@ NUM_ROWS = int(os.environ.get('BENCH_ROWS', 50000))
 BATCH_SIZE = int(os.environ.get('BENCH_BATCH', 2048))
 WORKERS = int(os.environ.get('BENCH_WORKERS', 4))
 EPOCHS = int(os.environ.get('BENCH_EPOCHS', 7))
+PROBE_TIMEOUT_S = int(os.environ.get('BENCH_PROBE_TIMEOUT', 120))
+PROBE_ATTEMPTS = int(os.environ.get('BENCH_PROBE_ATTEMPTS', 5))
+PROBE_BACKOFF_S = (15, 30, 60, 120)
+CHILD_TIMEOUT_S = int(os.environ.get('BENCH_CHILD_TIMEOUT', 1800))
+CHILD_ATTEMPTS = int(os.environ.get('BENCH_CHILD_ATTEMPTS', 2))
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def dataset_url():
+    return os.path.join(tempfile.gettempdir(),
+                        'petastorm_tpu_bench_mnist_{}'.format(NUM_ROWS))
 
 
 def build_dataset(url):
@@ -49,8 +76,111 @@ def build_dataset(url):
     return schema
 
 
-def main():
+def probe_tpu():
+    """Check the TPU backend from a throwaway subprocess with a hard timeout.
+
+    Returns True iff ``jax.devices()`` succeeds and reports a non-CPU device.
+    Runs out-of-process because the tunnel can *hang* (not just fail) inside
+    backend init, which would otherwise wedge the whole benchmark.
+    """
+    code = ("import jax; ds = jax.devices(); "
+            "print('PROBE_OK' if ds and ds[0].platform != 'cpu' else 'PROBE_CPU')")
+    try:
+        out = subprocess.run([sys.executable, '-c', code], capture_output=True,
+                             text=True, timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        log('probe: timed out after {}s'.format(PROBE_TIMEOUT_S))
+        return False
+    if 'PROBE_OK' in out.stdout:
+        return True
+    log('probe: rc={} stdout={!r} stderr tail={!r}'.format(
+        out.returncode, out.stdout.strip(), out.stderr.strip()[-500:]))
+    return False
+
+
+def run_child(platform_env):
+    """Run the measured bench in a child; return the parsed JSON dict or None."""
+    env = dict(os.environ)
+    env['BENCH_CHILD'] = '1'
+    if platform_env is not None:
+        env['JAX_PLATFORMS'] = platform_env
+    try:
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+                             env=env)
+    except subprocess.TimeoutExpired as exc:
+        stderr = exc.stderr or b''
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode('utf-8', 'replace')
+        log('child: timed out after {}s; stderr tail: {!r}'
+            .format(CHILD_TIMEOUT_S, stderr[-2000:]))
+        return None
+    sys.stderr.write(out.stderr)
+    if out.returncode != 0:
+        log('child: rc={}'.format(out.returncode))
+        return None
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    log('child: no JSON line on stdout')
+    return None
+
+
+def orchestrate():
+    url = dataset_url()
+    if not os.path.exists(os.path.join(url, '_common_metadata')):
+        log('materializing {} rows to {}'.format(NUM_ROWS, url))
+        build_dataset(url)
+
+    tpu_up = False
+    for attempt in range(PROBE_ATTEMPTS):
+        if probe_tpu():
+            tpu_up = True
+            log('probe: TPU backend OK (attempt {})'.format(attempt + 1))
+            break
+        if attempt < PROBE_ATTEMPTS - 1:
+            delay = PROBE_BACKOFF_S[min(attempt, len(PROBE_BACKOFF_S) - 1)]
+            log('probe: retrying in {}s'.format(delay))
+            time.sleep(delay)
+
+    result = None
+    if tpu_up:
+        for attempt in range(CHILD_ATTEMPTS):
+            result = run_child(platform_env=None)
+            if result is not None:
+                break
+            log('bench child failed (attempt {})'.format(attempt + 1))
+            if attempt < CHILD_ATTEMPTS - 1:
+                time.sleep(30)
+                if not probe_tpu():
+                    log('TPU gone after child failure')
+                    break
+
+    if result is None:
+        log('FALLBACK: TPU unavailable — measuring on CPU so the round still has a '
+            'number. vs_baseline from a CPU run is NOT the headline TPU metric.')
+        result = run_child(platform_env='cpu')
+        if result is not None:
+            result['platform'] = 'cpu'
+
+    if result is None:
+        log('bench failed on all platforms')
+        sys.exit(1)
+    if 'platform' not in result:
+        log('WARNING: child JSON carries no platform field')
+    print(json.dumps(result))
+
+
+def child_main():
     import jax
+    if os.environ.get('JAX_PLATFORMS') == 'cpu':
+        # The accelerator plugin on this image pins the platform at import; the env var
+        # alone does not reach it — the config update is load-bearing for CPU fallback.
+        jax.config.update('jax_platforms', 'cpu')
     import jax.numpy as jnp
     import optax
 
@@ -62,7 +192,7 @@ def main():
     device = jax.devices()[0]
     log('bench device: {}'.format(device))
 
-    url = os.path.join(tempfile.gettempdir(), 'petastorm_tpu_bench_mnist_{}'.format(NUM_ROWS))
+    url = dataset_url()
     if not os.path.exists(os.path.join(url, '_common_metadata')):
         log('materializing {} rows to {}'.format(NUM_ROWS, url))
         build_dataset(url)
@@ -116,6 +246,7 @@ def main():
     # median: per-epoch rates on a shared host are noisy (transient CPU contention can
     # halve a single epoch); the median is the robust steady-state estimate
     value = float(np.median(rates))
+    mean = float(np.mean(rates))
     stall = float(np.median(stalls))
     log('input_stall_fraction: {:.3f}'.format(stall))
     print(json.dumps({
@@ -123,7 +254,18 @@ def main():
         'value': round(value, 2),
         'unit': 'rows/s/chip',
         'vs_baseline': round(value / REFERENCE_BASELINE_ROWS_PER_SEC, 3),
+        'input_stall_fraction': round(stall, 4),
+        'value_mean': round(mean, 2),
+        'estimator': 'median_of_{}_epochs'.format(EPOCHS),
+        'platform': jax.devices()[0].platform,
     }))
+
+
+def main():
+    if os.environ.get('BENCH_CHILD') == '1':
+        child_main()
+    else:
+        orchestrate()
 
 
 if __name__ == '__main__':

@@ -264,6 +264,7 @@ class Reader(object):
         iterations = num_epochs
         skip_by_iteration = None
         pre_shuffles = 0
+        self._resume_fast_forward = {}
         if resume_state is not None:
             if ngram is not None:
                 raise ValueError('resume_state is not supported with NGram windows')
@@ -297,10 +298,12 @@ class Reader(object):
             self._results_reader = _NGramResultsReader(self.result_schema, ngram)
         elif is_batched_reader:
             self._results_reader = _BatchResultsReader(self.result_schema,
-                                                       on_batch=self._note_item_consumed)
+                                                       on_batch=self._note_item_consumed,
+                                                       fast_forward=self._resume_fast_forward)
         else:
             self._results_reader = _RowResultsReader(self.result_schema,
-                                                     on_batch=self._note_item_consumed)
+                                                     on_batch=self._note_item_consumed,
+                                                     fast_forward=self._resume_fast_forward)
 
     # --------------------------------------------------------------- sharding
 
@@ -355,6 +358,12 @@ class Reader(object):
                 self.last_row_consumed = True
                 return
             self._note_item_consumed(batch)
+            if self._resume_fast_forward and batch.item_id is not None:
+                # Honor a row_cursor from a row-path checkpoint: skip the rows that
+                # were already emitted before the checkpoint (exact-once everywhere).
+                start = self._resume_fast_forward.pop(batch.item_id, 0)
+                if start:
+                    batch = _slice_batch(batch, start)
             if batch.num_rows or include_empty:
                 yield batch
 
@@ -397,26 +406,40 @@ class Reader(object):
         self._consumed_by_epoch = {
             self._epochs_consumed + int(offset): {tuple(item) for item in ids}
             for offset, ids in state['consumed_by_epoch'].items()}
+        cursor = state.get('row_cursor')
+        if cursor is not None:
+            # Replay the mid-batch position: the item is NOT in the consumed sets (its
+            # batch was only partially emitted), so it re-ventilates in its epoch; the
+            # row reader fast-forwards past the rows already emitted before checkpoint.
+            key = (self._epochs_consumed + int(cursor['epoch_offset']),
+                   int(cursor['piece']), int(cursor['drop']))
+            self._resume_fast_forward[key] = int(cursor['next_row'])
 
     def state_dict(self):
         """Snapshot of the read position, resumable via ``make_reader(...,
         resume_state=state)`` with identical construction arguments.
 
         Granularity is the work item (rowgroup x drop-partition): an item counts as
-        consumed once its batch is popped off the results queue (``consumed_by_epoch``
-        maps epoch offsets to consumed items — several epochs can be partially consumed
-        at once since completions interleave across epoch boundaries). On resume, the
-        seeded epoch order is replayed deterministically and consumed items are skipped
-        in their respective epochs. Results published by workers but not yet popped are
-        re-read (at-least-once); rows of a popped batch not yet emitted row-wise are
-        skipped (at-most-once) — for delivery-exact accounting over a loader use
-        ``JaxDataLoader.state_dict`` instead. The reference has no analog (restart
-        granularity is the epoch, SURVEY.md §5.4).
+        consumed once ALL of its rows have been emitted (``consumed_by_epoch`` maps
+        epoch offsets to consumed items — several epochs can be partially consumed at
+        once since completions interleave across epoch boundaries). A checkpoint taken
+        mid-batch on the row path additionally records a ``row_cursor`` (item + next
+        row index), and resume fast-forwards that item to the exact row — no rows are
+        lost or duplicated (row-exact, provided in-batch row order is reproducible:
+        either ``shuffle_rows=False`` or a fixed ``seed``; with ``shuffle_rows=True``
+        and ``seed=None`` the partial batch is replayed in a new random order and
+        resume is only item-exact). Results published by workers but not yet popped
+        are re-read (at-least-once). Call from the consuming thread, between ``next()``
+        calls. The reference has no analog (restart granularity is the epoch,
+        SURVEY.md §5.4).
         """
         if self.ngram is not None:
             raise ValueError('state_dict is not supported with NGram windows')
+        cursor = None
+        if isinstance(self._results_reader, _RowResultsReader):
+            cursor = self._results_reader.cursor()
         with self._accounting_lock:
-            return {
+            state = {
                 'version': 1,
                 'items_per_epoch': self._items_per_epoch,
                 'epochs_consumed': self._epochs_consumed,
@@ -424,6 +447,14 @@ class Reader(object):
                     epoch - self._epochs_consumed: sorted(ids)
                     for epoch, ids in self._consumed_by_epoch.items()},
             }
+            if cursor is not None:
+                (epoch, piece, drop), next_row = cursor
+                # Deferred acknowledgment guarantees epoch >= _epochs_consumed: the
+                # partially-emitted item is unconsumed, so its epoch cannot be closed.
+                state['row_cursor'] = {'epoch_offset': epoch - self._epochs_consumed,
+                                       'piece': piece, 'drop': drop,
+                                       'next_row': next_row}
+            return state
 
     @property
     def items_per_epoch(self):
@@ -458,6 +489,14 @@ def _item_id(item):
     return (item['piece_index'], item['shuffle_row_drop_partition'][0])
 
 
+def _slice_batch(batch, start):
+    """Drop the first ``start`` rows of a ColumnarBatch (row-cursor fast-forward)."""
+    from petastorm_tpu.reader_worker import ColumnarBatch
+    n = max(batch.num_rows - start, 0)
+    return ColumnarBatch({name: col[start:] for name, col in batch.columns.items()},
+                         n, item_id=batch.item_id)
+
+
 def _is_ngram(schema_fields):
     from petastorm_tpu.ngram import NGram
     return isinstance(schema_fields, NGram)
@@ -478,47 +517,81 @@ class _RowResultsReader(object):
 
     Hot loop: rows are emitted positionally (``namedtuple._make`` over columns
     pre-ordered once per batch) — profiling shows dict-based per-row assembly costs
-    ~4x the actual decode at small row sizes."""
+    ~4x the actual decode at small row sizes.
 
-    def __init__(self, result_schema, on_batch=None):
+    Consumption accounting is row-exact: ``on_batch`` is invoked only once the LAST row
+    of a batch has been emitted (not when the batch is popped off the queue), so a
+    checkpoint taken mid-batch leaves the item unconsumed and :meth:`cursor` pinpoints
+    the resume row. ``fast_forward`` maps ``item_id -> start_row`` for replaying such a
+    cursor: the matching batch starts emitting at ``start_row`` instead of 0."""
+
+    def __init__(self, result_schema, on_batch=None, fast_forward=None):
         self._namedtuple = result_schema.namedtuple
         self._field_names = list(result_schema.fields)
         self._on_batch = on_batch
+        self._fast_forward = dict(fast_forward or {})
         self._columns = None
         self._num_rows = 0
         self._next_row = 0
+        self._current_batch = None
 
     def read_next(self, pool):
         while self._columns is None or self._next_row >= self._num_rows:
             batch = pool.get_results()
-            if self._on_batch is not None:
-                self._on_batch(batch)
-            self._columns = [batch.columns[name] for name in self._field_names] \
-                if batch.num_rows else None
+            item_id = getattr(batch, 'item_id', None)
+            start_row = self._fast_forward.pop(item_id, 0) if item_id is not None else 0
+            if batch.num_rows == 0 or start_row >= batch.num_rows:
+                # Nothing (left) to emit: consumed the moment it is popped.
+                if self._on_batch is not None:
+                    self._on_batch(batch)
+                self._columns = None
+                continue
+            self._columns = [batch.columns[name] for name in self._field_names]
             self._num_rows = batch.num_rows
-            self._next_row = 0
+            self._next_row = start_row
+            self._current_batch = batch
         i = self._next_row
         self._next_row = i + 1
+        if self._next_row >= self._num_rows and self._on_batch is not None:
+            # Acknowledge consumption only now that every row has been emitted
+            # (at-least-once semantics; ADVICE.md round 1).
+            self._on_batch(self._current_batch)
         return self._namedtuple._make([col[i] for col in self._columns])
+
+    def cursor(self):
+        """``(item_id, next_row)`` of the partially-emitted buffered batch, or None."""
+        if self._columns is not None and self._next_row < self._num_rows:
+            item_id = getattr(self._current_batch, 'item_id', None)
+            if item_id is not None:
+                return item_id, self._next_row
+        return None
 
     def reset(self):
         self._columns = None
         self._num_rows = 0
         self._next_row = 0
+        self._current_batch = None
 
 
 class _BatchResultsReader(object):
-    """Emits one namedtuple-of-arrays per rowgroup batch."""
+    """Emits one namedtuple-of-arrays per rowgroup batch. A ``fast_forward`` map (from a
+    row-path checkpoint's ``row_cursor``) slices the matching batch so already-emitted
+    rows are not re-delivered."""
 
-    def __init__(self, result_schema, on_batch=None):
+    def __init__(self, result_schema, on_batch=None, fast_forward=None):
         self._schema = result_schema
         self._on_batch = on_batch
+        self._fast_forward = fast_forward if fast_forward is not None else {}
 
     def read_next(self, pool):
         while True:
             batch = pool.get_results()
             if self._on_batch is not None:
                 self._on_batch(batch)
+            if self._fast_forward and batch.item_id is not None:
+                start = self._fast_forward.pop(batch.item_id, 0)
+                if start:
+                    batch = _slice_batch(batch, start)
             if batch.num_rows:
                 return self._schema.make_namedtuple(**batch.columns)
 
