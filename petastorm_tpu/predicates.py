@@ -39,7 +39,12 @@ class in_set(PredicateBase):
 
 class in_intersection(PredicateBase):
     """True when any element of a list-valued field intersects the given values
-    (reference: predicates.py:64-80)."""
+    (reference: predicates.py:64-80).
+
+    Row mode gets one row's sequence and returns a scalar; batch mode
+    (``make_batch_reader``) gets the whole column — an object array of per-row
+    sequences, or a 2-D array when row lengths are uniform — and returns an ``(n,)``
+    mask."""
 
     def __init__(self, inclusion_values, predicate_field):
         self._inclusion_values = set(inclusion_values)
@@ -50,6 +55,10 @@ class in_intersection(PredicateBase):
 
     def do_include(self, values):
         value = values[self._predicate_field]
+        if isinstance(value, np.ndarray) and (value.ndim >= 2 or value.dtype == object):
+            intersects = self._inclusion_values.intersection
+            return np.fromiter((bool(intersects(np.ravel(row))) for row in value),
+                               dtype=bool, count=len(value))
         return bool(self._inclusion_values.intersection(value))
 
 

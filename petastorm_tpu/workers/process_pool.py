@@ -35,11 +35,21 @@ class WorkerTerminationError(Exception):
 
 
 class ProcessPool(object):
-    def __init__(self, workers_count, results_queue_size=50, zmq_copy_buffers=True):
+    def __init__(self, workers_count, results_queue_size=50, zmq_copy_buffers=False,
+                 payload_serializer=None):
+        """``payload_serializer`` picks the wire format for worker results (reference:
+        process_pool.py:251-270 pluggable serializers): default
+        :class:`~petastorm_tpu.workers.serializers.ArrowIpcSerializer` (columnar
+        zero-copy receive); pass :class:`PickleSerializer` to force plain pickle.
+        ``zmq_copy_buffers=False`` (default) receives result frames without copying —
+        deserialized arrays then alias ZMQ frame memory."""
+        from petastorm_tpu.workers.serializers import ArrowIpcSerializer
         self._workers_count = workers_count
         self.workers_count = workers_count
         self._results_queue_size = results_queue_size
         self._zmq_copy = zmq_copy_buffers
+        self._serializer = (payload_serializer if payload_serializer is not None
+                            else ArrowIpcSerializer())
         self._context = None
         self._ventilator = None
         self._processes = []
@@ -69,6 +79,7 @@ class ProcessPool(object):
         bootstrap = {
             'worker_class': dill.dumps(worker_class),
             'worker_args': dill.dumps(worker_args),
+            'serializer': dill.dumps(self._serializer),
             'vent_addr': 'tcp://127.0.0.1:{}'.format(vent_port),
             'control_addr': 'tcp://127.0.0.1:{}'.format(control_port),
             'results_addr': 'tcp://127.0.0.1:{}'.format(results_port),
@@ -106,8 +117,10 @@ class ProcessPool(object):
 
     def _recv(self):
         parts = self._results_socket.recv_multipart(copy=self._zmq_copy)
+        if not self._zmq_copy:
+            parts = [p.buffer for p in parts]  # memoryviews over frame memory, no copy
         kind = bytes(memoryview(parts[0]))
-        payload = parts[1] if len(parts) > 1 else None
+        payload = parts[1:] if len(parts) > 1 else None
         return kind, payload
 
     def ventilate(self, **kwargs):
@@ -154,12 +167,12 @@ class ProcessPool(object):
                     self._ventilator.processed_item()
                 continue
             if kind == MSG_ERROR:
-                exc, tb = pickle.loads(bytes(memoryview(payload)))
+                exc, tb = pickle.loads(bytes(memoryview(payload[0])))
                 logger.error('Worker failure re-raised in consumer:\n%s', tb)
                 self.stop()
                 raise exc
             if kind == MSG_RESULT:
-                return pickle.loads(bytes(memoryview(payload)))
+                return self._serializer.deserialize(payload)
             if kind == MSG_STARTED:  # late joiner after restart — ignore
                 continue
 

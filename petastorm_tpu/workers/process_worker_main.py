@@ -33,6 +33,7 @@ def main(bootstrap_path):
 
     worker_class = dill.loads(bootstrap['worker_class'])
     worker_args = dill.loads(bootstrap['worker_args'])
+    serializer = dill.loads(bootstrap['serializer'])
     worker_id = bootstrap['worker_id']
 
     threading.Thread(target=_watch_parent, args=(bootstrap['parent_pid'],),
@@ -48,7 +49,7 @@ def main(bootstrap_path):
     results_socket.connect(bootstrap['results_addr'])
 
     def publish(result):
-        results_socket.send_multipart([b'result', pickle.dumps(result, protocol=5)])
+        results_socket.send_multipart([b'result'] + serializer.serialize(result))
 
     worker = worker_class(worker_id, publish, worker_args)
     results_socket.send_multipart([b'started'])

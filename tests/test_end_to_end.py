@@ -6,11 +6,11 @@ import pytest
 
 from petastorm_tpu import make_batch_reader, make_reader
 from petastorm_tpu.errors import NoDataAvailableError
-from petastorm_tpu.predicates import in_lambda, in_pseudorandom_split, in_reduce, in_set
+from petastorm_tpu.predicates import (in_intersection, in_lambda, in_pseudorandom_split,
+                                      in_reduce, in_set)
 from petastorm_tpu.transform import TransformSpec
 
-# 'process' is added once the process pool lands
-POOLS = ['dummy', 'thread']
+POOLS = ['dummy', 'thread', 'process']
 
 
 def _reader(url, **kwargs):
@@ -211,6 +211,31 @@ def test_pseudorandom_split_partitions(synthetic_dataset):
         with _reader(synthetic_dataset.url, predicate=pred) as reader:
             all_ids.extend(row.id for row in reader)
     assert sorted(all_ids) == sorted(r['id'] for r in synthetic_dataset.rows)
+
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_predicate_in_intersection_row_reader(synthetic_dataset, pool):
+    """List-valued predicate over the row path (scalar do_include per row)."""
+    wanted = {float(synthetic_dataset.rows[2]['string_list'][0]),
+              float(synthetic_dataset.rows[7]['string_list'][1])}
+    with _reader(synthetic_dataset.url, reader_pool_type=pool,
+                 predicate=in_intersection(wanted, 'string_list')) as reader:
+        rows = list(reader)
+    expected = [r['id'] for r in synthetic_dataset.rows
+                if wanted & set(float(v) for v in r['string_list'])]
+    assert sorted(row.id for row in rows) == sorted(expected)
+
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_predicate_in_intersection_batch_reader(scalar_dataset, pool):
+    """in_intersection must return a per-row mask under make_batch_reader (round-1
+    VERDICT: previously returned one scalar bool -> ValueError)."""
+    wanted = {10, 30}
+    with make_batch_reader(scalar_dataset.url, reader_pool_type=pool, workers_count=2,
+                           predicate=in_intersection(wanted, 'int_list')) as reader:
+        ids = [i for b in reader for i in b.id.tolist()]
+    expected = [r['id'] for r in scalar_dataset.rows if wanted & set(r['int_list'])]
+    assert sorted(ids) == sorted(expected)
 
 
 def test_predicate_no_match_yields_nothing(synthetic_dataset):

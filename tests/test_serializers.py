@@ -1,0 +1,117 @@
+"""Wire serializers for the process pool (model: petastorm/tests/
+test_arrow_table_serializer.py + test_pickle_serializer.py)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader_worker import ColumnarBatch
+from petastorm_tpu.workers.serializers import ArrowIpcSerializer, PickleSerializer
+
+SERIALIZERS = [PickleSerializer, ArrowIpcSerializer]
+
+
+def _roundtrip(serializer, obj):
+    frames = serializer.serialize(obj)
+    # the wire delivers plain buffers; simulate by materializing to bytes
+    wire = [bytes(memoryview(f)) for f in frames]
+    return serializer.deserialize(wire)
+
+
+def _make_batch():
+    return ColumnarBatch({
+        'scalar_i64': np.arange(10, dtype=np.int64),
+        'scalar_f32': np.linspace(0, 1, 10, dtype=np.float32),
+        'image': np.arange(10 * 4 * 3, dtype=np.uint8).reshape(10, 4, 3),
+        'matrix': np.random.RandomState(0).rand(10, 2, 5),
+        'strings': np.array(['s_{}'.format(i) for i in range(10)], dtype=object),
+        'ragged': [np.arange(i + 1, dtype=np.int32) for i in range(10)],
+    }, 10, item_id=(3, 7, 0))
+
+
+@pytest.mark.parametrize('serializer_cls', SERIALIZERS)
+def test_columnar_batch_roundtrip(serializer_cls):
+    serializer = serializer_cls()
+    batch = _make_batch()
+    out = _roundtrip(serializer, batch)
+    assert isinstance(out, ColumnarBatch)
+    assert out.num_rows == 10
+    assert out.item_id == (3, 7, 0)
+    assert set(out.columns) == set(batch.columns)
+    for name in ('scalar_i64', 'scalar_f32', 'image', 'matrix'):
+        assert out.columns[name].dtype == batch.columns[name].dtype, name
+        np.testing.assert_array_equal(out.columns[name], batch.columns[name], err_msg=name)
+    np.testing.assert_array_equal(out.columns['strings'], batch.columns['strings'])
+    for got, want in zip(out.columns['ragged'], batch.columns['ragged']):
+        np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize('serializer_cls', SERIALIZERS)
+def test_empty_batch_roundtrip(serializer_cls):
+    serializer = serializer_cls()
+    batch = ColumnarBatch({'a': np.array([], dtype=np.float64),
+                           'b': np.zeros((0, 3, 2), dtype=np.int16)}, 0, item_id=(0, 1, 0))
+    out = _roundtrip(serializer, batch)
+    assert out.num_rows == 0
+    assert out.item_id == (0, 1, 0)
+    assert out.columns['a'].shape == (0,)
+    assert out.columns['b'].shape == (0, 3, 2)
+    assert out.columns['b'].dtype == np.int16
+
+
+@pytest.mark.parametrize('serializer_cls', SERIALIZERS)
+def test_non_batch_payload_falls_back_to_pickle(serializer_cls):
+    serializer = serializer_cls()
+    payload = [{'offset': {0: 'x'}, 'vals': [1, 2, 3]}]
+    assert _roundtrip(serializer, payload) == payload
+
+
+def test_arrow_ipc_zero_copy_receive():
+    """writable=False: deserialized numeric columns alias the incoming frame memory."""
+    serializer = ArrowIpcSerializer(writable=False)
+    batch = ColumnarBatch({'image': np.arange(6 * 28 * 28, dtype=np.uint8)
+                           .reshape(6, 28, 28)}, 6, item_id=(0, 0, 0))
+    out = _roundtrip(serializer, batch)
+    # zero-copy: the numpy array's memory lives inside the wire frame's buffer range
+    col = out.columns['image']
+    assert not col.flags.owndata
+    np.testing.assert_array_equal(col, batch.columns['image'])
+
+
+def test_arrow_ipc_default_yields_writable_columns():
+    """Default mode must behave like the thread/dummy pools: in-place ops work."""
+    out = _roundtrip(ArrowIpcSerializer(), _make_batch())
+    for name in ('scalar_i64', 'image', 'matrix'):
+        assert out.columns[name].flags.writeable, name
+    out.columns['image'][0, 0, 0] = 255  # must not raise
+
+
+def test_arrow_ipc_numpy_ints_in_item_id():
+    serializer = ArrowIpcSerializer()
+    batch = ColumnarBatch({'a': np.arange(3, dtype=np.float32)}, np.int64(3),
+                          item_id=(np.int64(1), np.int32(2), 0))
+    out = _roundtrip(serializer, batch)
+    assert out.item_id == (1, 2, 0)
+    assert out.num_rows == 3
+
+
+def test_process_pool_with_pickle_serializer(synthetic_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.workers.process_pool import ProcessPool
+    pool = ProcessPool(2, payload_serializer=PickleSerializer())
+    with make_reader(synthetic_dataset.url, reader_pool=pool,
+                     schema_fields=['id', 'matrix']) as reader:
+        ids = sorted(row.id for row in reader)
+    assert ids == sorted(r['id'] for r in synthetic_dataset.rows)
+
+
+def test_bool_and_datetime_columns_roundtrip():
+    """Non-'iuf' dtypes must ride the sidecar, not break the Arrow path."""
+    serializer = ArrowIpcSerializer()
+    batch = ColumnarBatch({
+        'flag': np.array([True, False, True]),
+        'when': np.array(['2024-01-01', '2024-01-02', '2024-01-03'], dtype='datetime64[D]'),
+    }, 3, item_id=None)
+    out = _roundtrip(serializer, batch)
+    assert out.item_id is None
+    np.testing.assert_array_equal(out.columns['flag'], batch.columns['flag'])
+    np.testing.assert_array_equal(out.columns['when'], batch.columns['when'])
