@@ -26,8 +26,16 @@ def main(argv=None):
     parser.add_argument('--jax-batch-size', type=int, default=256)
     parser.add_argument('--no-shuffle-row-groups', action='store_true')
     parser.add_argument('--profile-threads', action='store_true',
-                        help='cProfile each thread-pool worker; aggregate logged on '
-                             'shutdown')
+                        help='sampled cProfile across thread-pool workers (one shared '
+                             'profiler slot on py3.12+); aggregate logged on shutdown')
+    parser.add_argument('--ngram-length', type=int,
+                        help='measure NGram windows/sec with windows of this many '
+                             'timesteps instead of plain rows')
+    parser.add_argument('--ngram-ts-field',
+                        help='timestamp field ordering the NGram windows')
+    parser.add_argument('--ngram-delta-threshold', type=int,
+                        help='max timestamp gap between consecutive window timesteps '
+                             '(default: unbounded)')
     parser.add_argument('-v', '--verbose', action='store_true')
     args = parser.parse_args(argv)
 
@@ -39,7 +47,9 @@ def main(argv=None):
         loaders_count=args.workers_count, read_method=args.read_method,
         shuffle_row_groups=not args.no_shuffle_row_groups,
         jax_batch_size=args.jax_batch_size, spawn_new_process=args.spawn_new_process,
-        profile_threads=args.profile_threads)
+        profile_threads=args.profile_threads, ngram_length=args.ngram_length,
+        ngram_ts_field=args.ngram_ts_field,
+        ngram_delta_threshold=args.ngram_delta_threshold)
     print('Throughput: {:.2f} samples/sec; RSS: {:.2f} MB; CPU: {:.2f}%{}'.format(
         result.samples_per_second, result.memory_info.rss / (1 << 20), result.cpu,
         '; input-stall: {:.1%}'.format(result.input_stall_fraction)

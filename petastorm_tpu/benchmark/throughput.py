@@ -23,7 +23,8 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
                       measure_cycles_count=1000, pool_type='thread', loaders_count=3,
                       read_method=READ_PYTHON, shuffle_row_groups=True,
                       jax_batch_size=256, spawn_new_process=False,
-                      profile_threads=False):
+                      profile_threads=False, ngram_length=None, ngram_ts_field=None,
+                      ngram_delta_threshold=None):
     """Measure read throughput of a dataset (reference: throughput.py:112-172).
 
     ``read_method='python'`` iterates raw reader rows; ``'jax'`` drives a JaxDataLoader
@@ -31,13 +32,18 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
     ``spawn_new_process`` re-runs the measurement in a fresh interpreter for a clean
     RSS reading (reference: throughput.py:144-149). ``profile_threads`` wraps each
     thread-pool worker in cProfile; the aggregate is logged on shutdown (reference:
-    thread_pool.py:41-49 + benchmark/cli.py:56-57)."""
+    thread_pool.py:41-49 + benchmark/cli.py:56-57).
+
+    ``ngram_length`` + ``ngram_ts_field`` switch the measurement to NGram window
+    formation (cycle = one window of ``ngram_length`` timesteps, every field at every
+    offset): the windows/sec figure benchmarks the columnar gather path."""
     if spawn_new_process:
         from petastorm_tpu.utils import run_in_subprocess
         return run_in_subprocess(reader_throughput, dataset_url, field_regex,
                                  warmup_cycles_count, measure_cycles_count, pool_type,
                                  loaders_count, read_method, shuffle_row_groups,
-                                 jax_batch_size, False, profile_threads)
+                                 jax_batch_size, False, profile_threads, ngram_length,
+                                 ngram_ts_field, ngram_delta_threshold)
 
     import psutil
     from petastorm_tpu.reader import make_reader
@@ -49,7 +55,22 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
             raise ValueError('--profile-threads requires the thread pool')
         from petastorm_tpu.workers.thread_pool import ThreadPool
         reader_pool = ThreadPool(loaders_count, profiling_enabled=True)
-    reader = make_reader(dataset_url, schema_fields=field_regex,
+    schema_fields = field_regex
+    if ngram_length is None and (ngram_ts_field or ngram_delta_threshold is not None):
+        raise ValueError('ngram_ts_field / ngram_delta_threshold require ngram_length')
+    if ngram_length is not None:
+        if not ngram_ts_field:
+            raise ValueError('ngram_ts_field is required with ngram_length')
+        if read_method != READ_PYTHON:
+            raise ValueError('NGram benchmarking uses the python read method')
+        from petastorm_tpu.ngram import NGram
+        fields = field_regex if field_regex else ['.*']
+        schema_fields = NGram({offset: list(fields) for offset in range(ngram_length)},
+                              delta_threshold=(ngram_delta_threshold
+                                               if ngram_delta_threshold is not None
+                                               else (1 << 62)),
+                              timestamp_field=ngram_ts_field)
+    reader = make_reader(dataset_url, schema_fields=schema_fields,
                          reader_pool_type=pool_type, workers_count=loaders_count,
                          shuffle_row_groups=shuffle_row_groups, num_epochs=None,
                          reader_pool=reader_pool)

@@ -77,7 +77,12 @@ class NGram(object):
                 else:
                     raise ValueError('NGram fields must be UnischemaFields or regex '
                                      'strings, got {!r}'.format(item))
-            self._fields[key] = resolved
+            # overlapping patterns may match the same field twice: dedup by name,
+            # preserving first-match order
+            seen = {}
+            for field in resolved:
+                seen.setdefault(field.name, field)
+            self._fields[key] = list(seen.values())
         self._resolved = True
 
     def get_field_names_at_timestep(self, key):
@@ -105,7 +110,13 @@ class NGram(object):
         """Compute window start indices over a timestamp vector (rows of ONE rowgroup,
         sorted ascending). Returns an array of starts; window i spans
         ``starts[i] : starts[i] + length``. Columnar analog of reference form_ngram
-        (ngram.py:225-270)."""
+        (ngram.py:225-270).
+
+        Vectorized: the delta-threshold scan is a cumulative count of oversized gaps
+        (a window is valid iff no bad gap falls inside it) — O(n) numpy, no Python loop
+        over rows. Only the ``timestamp_overlap=False`` greedy selection walks the
+        (already-filtered) candidate list sequentially, as the emitted-window dependency
+        chain requires."""
         timestamps = np.asarray(timestamps)
         n = len(timestamps)
         length = self.length
@@ -115,25 +126,24 @@ class NGram(object):
             raise NotImplementedError(
                 'NGram assumes data sorted by {!r}, which is not the case'
                 .format(self.timestamp_field_name))
+        if length == 1:
+            candidates = np.arange(n, dtype=np.int64)
+        else:
+            bad = np.diff(timestamps) > self._delta_threshold
+            bad_before = np.concatenate([[0], np.cumsum(bad)])
+            # window at start s covers deltas s .. s+length-2
+            window_bad = bad_before[length - 1:] - bad_before[:n - length + 1]
+            candidates = np.nonzero(window_bad == 0)[0].astype(np.int64)
+        if self.timestamp_overlap:
+            return candidates
         starts = []
         prev_end_ts = None
-        for start in range(n - length + 1):
-            window_ts = timestamps[start:start + length]
-            if not self.timestamp_overlap and prev_end_ts is not None \
-                    and window_ts[0] <= prev_end_ts:
+        for start in candidates:
+            if prev_end_ts is not None and timestamps[start] <= prev_end_ts:
                 continue
-            if self._pass_threshold(window_ts):
-                starts.append(start)
-                if not self.timestamp_overlap:
-                    prev_end_ts = window_ts[-1]
+            starts.append(start)
+            prev_end_ts = timestamps[start + length - 1]
         return np.asarray(starts, dtype=np.int64)
-
-    def _pass_threshold(self, window_ts):
-        """Every consecutive delta must be <= delta_threshold (reference: ngram.py:205-213;
-        its worked example skips a delta of 5 against threshold 4)."""
-        if len(window_ts) <= 1:
-            return True
-        return bool(np.all(np.diff(window_ts) <= self._delta_threshold))
 
     def form_ngram(self, rows):
         """Row-dict formation: list of {offset: row_dict-subset} (reference semantics)."""
@@ -157,14 +167,42 @@ class NGram(object):
         return result
 
     def make_namedtuples(self, window, schema=None):
-        """Convert {offset: row_dict} into {offset: namedtuple} (reference:
-        ngram.py:272-297)."""
+        """Convert {offset: row_dict} into {offset: namedtuple} — companion to the
+        row-dict :meth:`form_ngram` API (reference: ngram.py:272-297). The reader hot
+        path uses :meth:`window_plan` + :meth:`window_from_plan` instead."""
         result = {}
         for key, row in window.items():
             names = sorted(row.keys())
             cls = _timestep_namedtuple(tuple(names))
             result[key] = cls(**row)
         return result
+
+    def window_plan(self, column_names):
+        """Precompute the per-timestep emission plan for a given set of available
+        columns: ``[(offset, row_position, field_names, namedtuple_cls), ...]``. The
+        plan is identical for every window of every batch with the same columns —
+        compute it once, then emit windows with :meth:`window_from_plan` (hoists the
+        sort/filter/namedtuple-cache work off the per-window hot path)."""
+        column_names = set(column_names)
+        base_key = min(self._fields.keys())
+        plan = []
+        for key, field_list in self._fields.items():
+            names = tuple(sorted({f.name for f in field_list if f.name in column_names}))
+            plan.append((key, key - base_key, names, _timestep_namedtuple(names)))
+        return plan
+
+    @staticmethod
+    def window_from_plan(columns, start, plan):
+        """Emit one ``{offset: namedtuple}`` window straight from columnar data using a
+        precomputed :meth:`window_plan` — the hot-path consumer of
+        :meth:`form_ngram_columnar` gather indices (no intermediate per-row dicts;
+        columns are shared across all windows of a rowgroup)."""
+        return {key: cls._make(columns[name][start + position] for name in names)
+                for key, position, names, cls in plan}
+
+    def window_from_columns(self, columns, start):
+        """One-shot convenience: :meth:`window_plan` + :meth:`window_from_plan`."""
+        return self.window_from_plan(columns, start, self.window_plan(columns))
 
 
 _timestep_cache = {}

@@ -5,9 +5,29 @@ One ventilated piece = one rowgroup; windows are formed within it (ngram.py:85-9
 rowgroup size bounds max window length). Shuffle-row-drop partitions receive ``length-1``
 carry-over rows from the next partition so windows at the partition boundary survive
 (reference: py_dict_reader_worker.py:299-304).
-"""
+
+The published payload is columnar end-to-end: one :class:`NGramWindows` per piece holding
+the decoded columns ONCE plus the window start indices from
+``NGram.form_ngram_columnar`` — windows are views (gather indices), not materialized
+per-row dicts, so N overlapping windows cost O(rows) not O(N x length) to ship, cache,
+and shuffle. The per-window namedtuple view is built lazily at consumption
+(``NGram.window_from_columns``)."""
 
 import numpy as np
+
+
+class NGramWindows(object):
+    """Columnar window set of one rowgroup piece: ``starts[i]`` is the first row of
+    window i; every window spans ``length`` consecutive rows of ``columns``."""
+
+    __slots__ = ('columns', 'starts')
+
+    def __init__(self, columns, starts):
+        self.columns = columns
+        self.starts = starts
+
+    def __len__(self):
+        return len(self.starts)
 
 
 def process_ngram_piece(worker, piece_index, fragment_path, row_group_id, partition_keys,
@@ -38,15 +58,18 @@ def process_ngram_piece(worker, piece_index, fragment_path, row_group_id, partit
             columns = {name: _take(col, selected) for name, col in columns.items()}
             num_rows = len(selected)
 
-        rows = [{name: col[i] for name, col in columns.items()} for i in range(num_rows)]
-        return ngram.form_ngram(rows)
+        timestamps = np.asarray(columns[ngram.timestamp_field_name][:num_rows])
+        starts = ngram.form_ngram_columnar(timestamps)
+        return {'columns': columns, 'starts': starts}
 
     cache_key = 'ngram:{}:{}:{}:{}'.format(setup.dataset_token, fragment_path,
                                            row_group_id, shuffle_row_drop_partition)
-    windows = setup.cache.get(cache_key, load_windows)
+    payload = setup.cache.get(cache_key, load_windows)
+    starts = payload['starts']
 
-    if setup.shuffle_rows and windows:
+    if setup.shuffle_rows and len(starts):
         seed = None if setup.seed is None else (setup.seed + piece_index) % (2 ** 31)
-        order = np.random.RandomState(seed).permutation(len(windows))
-        windows = [windows[i] for i in order]
-    return windows
+        starts = starts[np.random.RandomState(seed).permutation(len(starts))]
+    if not len(starts):
+        return None
+    return NGramWindows(payload['columns'], starts)

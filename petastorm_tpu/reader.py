@@ -600,21 +600,30 @@ class _BatchResultsReader(object):
 
 
 class _NGramResultsReader(object):
-    """Buffers formed ngram windows ({offset: row_dict}) and emits {offset: namedtuple}."""
+    """Buffers a columnar NGramWindows payload and emits one {offset: namedtuple} per
+    read, gathering rows lazily from the shared columns (no per-row dict
+    materialization on the hot path)."""
 
     def __init__(self, result_schema, ngram):
         self._ngram = ngram
-        self._windows = []
+        self._payload = None
+        self._plan = None
+        self._plan_columns = None
         self._next = 0
 
     def read_next(self, pool):
-        while self._next >= len(self._windows):
-            self._windows = pool.get_results()
+        while self._payload is None or self._next >= len(self._payload.starts):
+            self._payload = pool.get_results()
             self._next = 0
-        window = self._windows[self._next]
+            columns_key = frozenset(self._payload.columns)
+            if columns_key != self._plan_columns:
+                # one plan per column set (constant per reader) — not per window
+                self._plan = self._ngram.window_plan(columns_key)
+                self._plan_columns = columns_key
+        start = self._payload.starts[self._next]
         self._next += 1
-        return self._ngram.make_namedtuples(window)
+        return self._ngram.window_from_plan(self._payload.columns, start, self._plan)
 
     def reset(self):
-        self._windows = []
+        self._payload = None
         self._next = 0

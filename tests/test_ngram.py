@@ -170,6 +170,100 @@ class TestNgramEndToEnd:
             starts = sorted(w[0].ts for w in reader)
         assert starts == list(range(0, 20, 2))
 
+    @pytest.mark.parametrize('pool', ['dummy', 'thread', 'process'])
+    def test_ngram_gapped_over_shuffled_pools(self, tmp_path_factory, pool):
+        """Delta-threshold violations must be respected identically across every pool
+        flavor with rowgroup+row shuffling on (model: reference
+        test_ngram_end_to_end.py's reader-factory matrix)."""
+        url = str(tmp_path_factory.mktemp('gapshuf') / 'ds')
+        write_rows(url, SeqSchema, _seq_rows([0, 3, 8, 10, 11, 20, 23]),
+                   rows_per_file=7, rowgroup_size_mb=64)
+        ngram = NGram({0: ['ts', 'value'], 1: ['ts', 'label']}, delta_threshold=4,
+                      timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, reader_pool_type=pool,
+                         workers_count=2, shuffle_row_groups=True, shuffle_rows=True,
+                         seed=11) as reader:
+            pairs = sorted((w[0].ts, w[1].ts) for w in reader)
+        assert pairs == [(0, 3), (8, 10), (10, 11), (20, 23)]
+
+    def test_ngram_windows_do_not_cross_rowgroups(self, tmp_path):
+        """Rowgroup boundaries bound windows (reference caveat ngram.py:85-91): 20
+        consecutive rows in 2 files -> the (9,10) pair must NOT be emitted."""
+        url = str(tmp_path / 'split')
+        write_rows(url, SeqSchema, _seq_rows(range(20)), rows_per_file=10,
+                   rowgroup_size_mb=64)
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False) as reader:
+            starts = sorted(w[0].ts for w in reader)
+        assert starts == [t for t in range(19) if t != 9]
+
+    def test_ngram_no_overlap_with_drop_partitions_rejected(self, seq_dataset):
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts',
+                      timestamp_overlap=False)
+        with pytest.raises(NotImplementedError, match='timestamp_overlap'):
+            make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                        shuffle_row_drop_partitions=2)
+
+    def test_ngram_with_predicate_rejected(self, seq_dataset):
+        from petastorm_tpu.predicates import in_set
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+        with pytest.raises(ValueError, match='NGram'):
+            make_reader(seq_dataset, schema_fields=ngram,
+                        predicate=in_set({1}, 'label'))
+
+    def test_ngram_negative_offsets_end_to_end(self, seq_dataset):
+        """Offsets {-1, 0, 1}: emitted keys keep their user-facing offsets and order
+        rows correctly (model: reference test_ngram with negative shifts)."""
+        ngram = NGram({-1: ['ts'], 0: ['ts', 'value'], 1: ['ts']}, delta_threshold=1,
+                      timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False) as reader:
+            windows = list(reader)
+        assert len(windows) == 18
+        for w in windows:
+            assert w[0].ts == w[-1].ts + 1
+            assert w[1].ts == w[0].ts + 1
+
+    def test_ngram_sparse_offsets_skip_middle_timestep(self, seq_dataset):
+        """{0, 2} spans 3 rows but emits only the named offsets; the middle row still
+        participates in the delta check."""
+        ngram = NGram({0: ['ts'], 2: ['ts']}, delta_threshold=1, timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False) as reader:
+            windows = list(reader)
+        assert len(windows) == 18
+        for w in windows:
+            assert set(w.keys()) == {0, 2}
+            assert w[2].ts == w[0].ts + 2
+
+    def test_ngram_shuffle_rows_permutes_but_preserves_set(self, seq_dataset):
+        ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+
+        def read(shuffle, seed=None):
+            with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                             shuffle_row_groups=False, shuffle_rows=shuffle,
+                             seed=seed, reader_pool_type='dummy') as reader:
+                return [w[0].ts for w in reader]
+
+        ordered = read(False)
+        shuffled = read(True, seed=3)
+        assert ordered == sorted(ordered)
+        assert shuffled != ordered
+        assert sorted(shuffled) == ordered
+        assert read(True, seed=3) == shuffled  # seeded => reproducible
+
+    def test_ngram_overlapping_regexes_dedup(self, seq_dataset):
+        """Patterns matching the same field twice must not produce duplicate namedtuple
+        fields (regression: duplicate name ValueError on first window read)."""
+        ngram = NGram({0: ['ts', 't.*'], 1: ['.*', 'label']}, delta_threshold=1,
+                      timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False) as reader:
+            w = next(reader)
+        assert set(w[0]._fields) == {'ts'}
+        assert set(w[1]._fields) == {'ts', 'value', 'label'}
+
     def test_ngram_resume_rejected(self, seq_dataset):
         ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
         with make_reader(seq_dataset, schema_fields=ngram, workers_count=1) as reader:

@@ -105,6 +105,20 @@ class TestThroughput:
             reader_throughput(synthetic_dataset.url, pool_type='dummy',
                               profile_threads=True)
 
+    def test_ngram_windows_throughput(self, synthetic_dataset):
+        """NGram benchmarking mode: cycle = one window over every field (VERDICT round 1
+        item 8 — benchmarks the columnar gather hot path)."""
+        from petastorm_tpu.benchmark.throughput import reader_throughput
+        result = reader_throughput(synthetic_dataset.url, field_regex=['id', 'id2'],
+                                   warmup_cycles_count=5, measure_cycles_count=20,
+                                   loaders_count=1, ngram_length=3, ngram_ts_field='id')
+        assert result.samples_per_second > 0
+
+    def test_ngram_throughput_requires_ts_field(self, synthetic_dataset):
+        from petastorm_tpu.benchmark.throughput import reader_throughput
+        with pytest.raises(ValueError, match='ngram_ts_field'):
+            reader_throughput(synthetic_dataset.url, ngram_length=3)
+
     def test_jax_read_method(self, synthetic_dataset):
         from petastorm_tpu.benchmark.throughput import READ_JAX, reader_throughput
         result = reader_throughput(synthetic_dataset.url, field_regex=['id', 'matrix'],
