@@ -50,8 +50,9 @@ def main(argv=None):
         profile_threads=args.profile_threads, ngram_length=args.ngram_length,
         ngram_ts_field=args.ngram_ts_field,
         ngram_delta_threshold=args.ngram_delta_threshold)
-    print('Throughput: {:.2f} samples/sec; RSS: {:.2f} MB; CPU: {:.2f}%{}'.format(
-        result.samples_per_second, result.memory_info.rss / (1 << 20), result.cpu,
+    unit = 'windows/sec' if args.ngram_length else 'samples/sec'
+    print('Throughput: {:.2f} {}; RSS: {:.2f} MB; CPU: {:.2f}%{}'.format(
+        result.samples_per_second, unit, result.memory_info.rss / (1 << 20), result.cpu,
         '; input-stall: {:.1%}'.format(result.input_stall_fraction)
         if result.input_stall_fraction else ''))
     return 0

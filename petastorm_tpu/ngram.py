@@ -200,9 +200,6 @@ class NGram(object):
         return {key: cls._make(columns[name][start + position] for name in names)
                 for key, position, names, cls in plan}
 
-    def window_from_columns(self, columns, start):
-        """One-shot convenience: :meth:`window_plan` + :meth:`window_from_plan`."""
-        return self.window_from_plan(columns, start, self.window_plan(columns))
 
 
 _timestep_cache = {}

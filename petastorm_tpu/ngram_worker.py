@@ -11,7 +11,7 @@ the decoded columns ONCE plus the window start indices from
 ``NGram.form_ngram_columnar`` — windows are views (gather indices), not materialized
 per-row dicts, so N overlapping windows cost O(rows) not O(N x length) to ship, cache,
 and shuffle. The per-window namedtuple view is built lazily at consumption
-(``NGram.window_from_columns``)."""
+(``NGram.window_plan`` + ``NGram.window_from_plan`` in the reader's results reader)."""
 
 import numpy as np
 

@@ -116,29 +116,73 @@ def make_petastorm_dataset(reader):
 def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
     """Legacy graph-mode tensors (reference: tf_utils.py:269-318): a ``py_func`` wrapping
     ``next(reader)``, optionally through a ``RandomShuffleQueue``. Returns a namedtuple
-    of tensors (or {offset: namedtuple} for NGram)."""
-    import tensorflow as tf
-
+    of tensors, or ``{offset: namedtuple}`` for NGram readers (the window is flattened
+    to one tuple through the graph boundary and unflattened after — reference:
+    tf_utils.py:107-120,254-266,408-438)."""
     if getattr(reader, 'is_batched_reader', False) and shuffling_queue_capacity > 0:
         raise ValueError('Shuffling queue is not supported with batched readers '
                          '(reference: tf_utils.py:307-311)')
     if getattr(reader, 'ngram', None) is not None:
-        raise NotImplementedError('tf_tensors NGram support: use make_petastorm_dataset')
+        return _tf_tensors_ngram(reader, shuffling_queue_capacity, min_after_dequeue)
 
     schema = reader.result_schema
     field_names = list(schema.fields)
-    dtypes = [_tf_dtype_for_field(schema.fields[n]) for n in field_names]
+    fields = [schema.fields[n] for n in field_names]
 
     def _next_sample():
         row = next(reader)
         return [np.asarray(_sanitize_field_value(v)) for v in row]
 
-    values = tf.compat.v1.py_func(_next_sample, [], dtypes,
-                                  name='petastorm_tpu_next_sample')
-    for value, name in zip(values, field_names):
-        field = schema.fields[name]
-        if not any(d is None for d in field.shape):
-            value.set_shape(field.shape)
+    values = _flat_graph_values(_next_sample, fields, shuffling_queue_capacity,
+                                min_after_dequeue, op_name='petastorm_tpu_next_sample')
+    return schema.namedtuple(**dict(zip(field_names, values)))
+
+
+def _tf_tensors_ngram(reader, shuffling_queue_capacity, min_after_dequeue):
+    """NGram variant: flatten ``{offset: namedtuple}`` into one flat tensor tuple across
+    the py_func/queue boundary, rebuild the per-offset namedtuples after (reference:
+    tf_utils.py:107-120,140-182,408-438)."""
+    ngram = reader.ngram
+    schema = reader.result_schema
+    # The emission plan IS the flattening order: (offset, row_position, names, cls) per
+    # timestep, exactly matching what the reader's window reader emits.
+    plan = ngram.window_plan(schema.fields)
+    flat_fields = [schema.fields[name] for _, _, names, _ in plan for name in names]
+
+    def _next_window():
+        window = next(reader)
+        out = []
+        for key, _, names, _ in plan:
+            step = window[key]
+            for name in names:
+                out.append(np.asarray(_sanitize_field_value(getattr(step, name))))
+        return out
+
+    values = _flat_graph_values(_next_window, flat_fields, shuffling_queue_capacity,
+                                min_after_dequeue, op_name='petastorm_tpu_next_window')
+    result = {}
+    index = 0
+    for key, _, names, cls in plan:
+        result[key] = cls._make(values[index:index + len(names)])
+        index += len(names)
+    return result
+
+
+def _flat_graph_values(next_fn, fields, shuffling_queue_capacity, min_after_dequeue,
+                       op_name):
+    """py_func over ``next_fn`` -> optional RandomShuffleQueue -> list of tensors with
+    static shapes assigned from ``fields`` (reference: tf_utils.py:185-219)."""
+    import tensorflow as tf
+
+    dtypes = [_tf_dtype_for_field(field) for field in fields]
+
+    def _set_shapes(values):
+        for value, field in zip(values, fields):
+            if not any(d is None for d in field.shape):
+                value.set_shape(field.shape)
+
+    values = tf.compat.v1.py_func(next_fn, [], dtypes, name=op_name)
+    _set_shapes(values)
 
     if shuffling_queue_capacity > 0:
         queue = tf.queue.RandomShuffleQueue(shuffling_queue_capacity, min_after_dequeue,
@@ -150,12 +194,9 @@ def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
         # Well-known op name so queue depth is observable (reference: tf_utils.py:45-47).
         tf.identity(queue.size(), name='random_shuffling_queue_size')
         values = queue.dequeue()
-        if len(field_names) == 1:
+        if len(fields) == 1:
             # dequeue() returns a lone Tensor (not a list) for single-component queues.
             values = [values]
-        for value, name in zip(values, field_names):
-            field = schema.fields[name]
-            if not any(d is None for d in field.shape):
-                value.set_shape(field.shape)
+        _set_shapes(values)
 
-    return schema.namedtuple(**dict(zip(field_names, values)))
+    return list(values)

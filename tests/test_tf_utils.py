@@ -82,3 +82,54 @@ def test_tf_tensors_graph_mode(synthetic_dataset):
                 value = session.run(row_tensors)
     source = synthetic_dataset.rows_by_id[int(value.id)]
     np.testing.assert_array_almost_equal(value.matrix, source['matrix'])
+
+
+def _write_seq_dataset(tmp_path, n=10):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_rows
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('S', [
+        UnischemaField('ts', np.int64, (), ScalarCodec(), False),
+        UnischemaField('v', np.float32, (2,), NdarrayCodec(), False)])
+    url = str(tmp_path / 'seq')
+    write_rows(url, schema,
+               [{'ts': t, 'v': np.array([t, -t], np.float32)} for t in range(n)],
+               rows_per_file=n, rowgroup_size_mb=64)
+    return url
+
+
+def test_tf_tensors_ngram_graph_mode(tmp_path):
+    """NGram window through tf_tensors: flatten/unflatten across the py_func boundary
+    (reference parity: tf_utils.py:254-266,408-438 + its ngram tf tests)."""
+    ngram = NGram({0: ['ts', 'v'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+    url = _write_seq_dataset(tmp_path)
+    with make_reader(url, schema_fields=ngram, workers_count=1,
+                     shuffle_row_groups=False) as reader:
+        with tf.Graph().as_default():
+            window = tf_tensors(reader)
+            assert set(window.keys()) == {0, 1}
+            assert window[0].v.shape.as_list() == [2]
+            with tf.compat.v1.Session() as session:
+                values = [session.run(window) for _ in range(9)]
+    for value in values:
+        assert int(value[1].ts) == int(value[0].ts) + 1
+        np.testing.assert_array_almost_equal(value[0].v,
+                                             [value[0].ts, -float(value[0].ts)])
+    assert sorted(int(v[0].ts) for v in values) == list(range(9))
+
+
+def test_tf_tensors_ngram_with_shuffling_queue(tmp_path):
+    ngram = NGram({0: ['ts', 'v'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+    url = _write_seq_dataset(tmp_path, n=12)
+    with make_reader(url, schema_fields=ngram, workers_count=1, num_epochs=None,
+                     shuffle_row_groups=False) as reader:
+        with tf.Graph().as_default():
+            window = tf_tensors(reader, shuffling_queue_capacity=8, min_after_dequeue=2)
+            with tf.compat.v1.Session() as session:
+                coord = tf.train.Coordinator()
+                threads = tf.compat.v1.train.start_queue_runners(session, coord)
+                values = [session.run(window) for _ in range(20)]
+                coord.request_stop()
+                coord.join(threads, stop_grace_period_secs=5)
+    for value in values:
+        assert int(value[1].ts) == int(value[0].ts) + 1
