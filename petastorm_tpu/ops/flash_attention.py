@@ -1,9 +1,11 @@
 """Pallas TPU flash attention: O(T)-memory blockwise attention on the MXU.
 
 Forward pass is a Pallas kernel (grid over [batch*heads, q-blocks, kv-blocks], online
-log-sum-exp softmax accumulated in VMEM scratch, matmuls in fp32 on the MXU). Backward
-is a ``jax.custom_vjp`` that recomputes attention blockwise with XLA ops — correct and
-memory-bounded, with the forward savings where they matter most for inference/serving.
+log-sum-exp softmax accumulated in VMEM scratch, matmuls in fp32 on the MXU) that also
+emits the per-row log-sum-exp. Backward is the flash backward: two Pallas kernels (dQ,
+and dK/dV) that REMATERIALIZE the score blocks from Q/K and the saved LSE — the
+[T, T] attention matrix never exists in any pass, so training memory is O(T * block),
+sub-quadratic in sequence length.
 
 Falls back to the XLA path (:func:`petastorm_tpu.ops.ring_attention.dense_attention`)
 when shapes don't tile (T % block != 0, head_dim not lane-aligned) and runs in Pallas
@@ -22,7 +24,7 @@ _NEG_INF = -1e30
 _LANE = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, causal,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, causal,
                   block_q, block_k, scale):
     """One (bh, qi, ki) grid step: fold K/V block ``ki`` into the online softmax
     accumulator for Q block ``qi``."""
@@ -73,10 +75,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, causal,
     @pl.when(ki == nk - 1)
     def _finalize():
         o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        # log-sum-exp per query row: the backward's softmax replay key
+        lse_ref[0] = (m_scr[:, :1] + jnp.log(l_scr[:, :1]))[:, 0]
 
 
 def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
-    """q/k/v: [BH, T, D] -> o: [BH, T, D]."""
+    """q/k/v: [BH, T, D] -> (o: [BH, T, D], lse: [BH, T] float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -89,14 +93,16 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     grid = (bh, nq, nk)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, t), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max (lane-replicated)
             pltpu.VMEM((block_q, _LANE), jnp.float32),   # running denominator
@@ -108,6 +114,151 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     )(q, k, v)
 
 
+def _rematerialized_p_ds(q, k, v, do, lse, delta, qi, ki, causal, block_q, block_k,
+                         scale):
+    """Shared backward-block math: replay P from (Q, K, LSE), form dS.
+
+    Returns (p, ds), both [Bq, Bk] fp32. ``delta = rowsum(dO * O)`` is the softmax
+    jacobian's diagonal correction (flash-attention backward identity)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    p = jnp.exp(s - lse[:, None])                               # [Bq, Bk]
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        p = jnp.where(q_pos >= k_pos, p, 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [Bq, Bk]
+    ds = p * (dp - delta[:, None])
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                         dq_scr, *, causal, block_q, block_k, scale):
+    """Grid (bh, qi, ki): accumulate dQ for q-block qi over all k-blocks."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _fold():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _rematerialized_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
+                                     causal, block_q, block_k, scale)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            _fold()
+    else:
+        _fold()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                          dv_ref, dk_scr, dv_scr, *, causal, block_q, block_k, scale):
+    """Grid (bh, ki, qi): accumulate dK/dV for k-block ki over all q-blocks."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _fold():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _rematerialized_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
+                                     causal, block_q, block_k, scale)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        # q-blocks entirely above the diagonal (every q_pos < k_pos) contribute nothing
+        @pl.when(qi * block_q + (block_q - 1) >= ki * block_k)
+        def _():
+            _fold()
+    else:
+        _fold()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    """q/k/v/o/do: [BH, T, D], lse: [BH, T] -> (dq, dk, dv), blockwise (no [T, T])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    nq, nk = t // block_q, t // block_k
+    scale = d ** -0.5
+    # Softmax jacobian diagonal: delta_i = sum_d dO_id * O_id (O(T*D), no score matrix).
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, T]
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, block_q=block_q,
+                          block_k=block_k, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, qrow, qrow],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dK/dV iterate the OTHER way: outer over k-blocks, inner over q-blocks.
+    kspec_o = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    qspec_i = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    qrow_i = pl.BlockSpec((1, block_q), lambda b, i, j: (b, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, block_q=block_q,
+                          block_k=block_k, scale=scale),
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        grid=(bh, nk, nq),
+        in_specs=[qspec_i, kspec_o, kspec_o, qspec_i, qrow_i, qrow_i],
+        out_specs=[kspec_o, kspec_o],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 def _tiles(t, d, block_q, block_k):
     return t % block_q == 0 and t % block_k == 0 and d % _LANE == 0
 
@@ -115,34 +266,57 @@ def _tiles(t, d, block_q, block_k):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q, k, v, causal=False, block_q=256, block_k=256):
     """Flash attention over ``[B, T, H, D]`` inputs (same layout as
-    :func:`~petastorm_tpu.ops.ring_attention.dense_attention`). Exact; forward runs as a
-    Pallas TPU kernel when shapes tile, XLA blockwise otherwise."""
+    :func:`~petastorm_tpu.ops.ring_attention.dense_attention`). Exact; both passes run
+    as Pallas TPU kernels when shapes tile (XLA dense fallback otherwise), with
+    O(T * block) memory in forward AND backward."""
     return _attention_impl(q, k, v, causal, block_q, block_k)
 
 
-def _attention_impl(q, k, v, causal, block_q, block_k):
-    from petastorm_tpu.ops.ring_attention import dense_attention
+def _use_pallas(q, k, block_q, block_k):
     b, t, h, d = q.shape
-    if not _tiles(t, d, block_q, block_k) or t != k.shape[1]:
-        return dense_attention(q, k, v, causal=causal)
-    interpret = jax.default_backend() != 'tpu'
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    o = _flash_forward(to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k, interpret)
-    return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return _tiles(t, d, block_q, block_k) and t == k.shape[1]
+
+
+def _to_bh(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bh(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _attention_impl(q, k, v, causal, block_q, block_k):
+    return _fwd(q, k, v, causal, block_q, block_k)[0]
 
 
 def _fwd(q, k, v, causal, block_q, block_k):
-    return _attention_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+    from petastorm_tpu.ops.ring_attention import dense_attention
+    if not _use_pallas(q, k, block_q, block_k):
+        return dense_attention(q, k, v, causal=causal), (q, k, v, None, None, None)
+    b, t, h, d = q.shape
+    interpret = jax.default_backend() != 'tpu'
+    # Residuals stay in the kernels' [BH, T, D] layout so the backward re-uses the
+    # forward's transposes instead of redoing them.
+    q_bh, k_bh, v_bh = _to_bh(q), _to_bh(k), _to_bh(v)
+    o_bh, lse = _flash_forward(q_bh, k_bh, v_bh, causal, block_q, block_k, interpret)
+    return _from_bh(o_bh, b, h), (q_bh, k_bh, v_bh, o_bh, lse, (b, h))
 
 
 def _bwd(causal, block_q, block_k, residuals, g):
-    """Recompute-backward in XLA: correct gradients at O(T^2) flops, O(T^2) attention
-    matrix rematerialized under XLA fusion (not stored from forward)."""
-    from petastorm_tpu.ops.ring_attention import dense_attention
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda a, b_, c: dense_attention(a, b_, c, causal=causal), q, k, v)
-    return vjp(g)
+    q_bh, k_bh, v_bh, o_bh, lse, bh_dims = residuals
+    if o_bh is None:
+        # Fallback shapes: recompute through the dense path (O(T^2) memory there too).
+        from petastorm_tpu.ops.ring_attention import dense_attention
+        _, vjp = jax.vjp(lambda a, b_, c: dense_attention(a, b_, c, causal=causal),
+                         q_bh, k_bh, v_bh)
+        return vjp(g)
+    b, h = bh_dims
+    interpret = jax.default_backend() != 'tpu'
+    dq, dk, dv = _flash_backward(q_bh, k_bh, v_bh, o_bh, lse, _to_bh(g), causal,
+                                 block_q, block_k, interpret)
+    return _from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h)
 
 
 flash_attention.defvjp(_fwd, _bwd)
