@@ -48,11 +48,31 @@ def directory_imagenet_rows(imagenet_dir, noun_id_to_text=None):
     return rows
 
 
+def _center_resize(image, hw):
+    """Center-crop to square + nearest-neighbor resize to (hw, hw) — host numpy."""
+    h, w = image.shape[:2]
+    side = min(h, w)
+    top, left = (h - side) // 2, (w - side) // 2
+    square = image[top:top + side, left:left + side]
+    idx = np.arange(hw) * side // hw
+    return np.ascontiguousarray(square[idx][:, idx])
+
+
 def generate_petastorm_imagenet(output_url, imagenet_dir=None, synthetic=False,
-                                rowgroup_size_mb=8):
+                                rowgroup_size_mb=8, dct_hw=None, dct_quality=90):
+    """``dct_hw`` switches to the fixed-size DCT-domain store (schema.py
+    dct_imagenet_schema): images are resized at write time and stored as quantized DCT
+    coefficient blocks so readers can decode on-chip."""
     rows = (synthetic_imagenet_rows() if synthetic
             else directory_imagenet_rows(imagenet_dir))
-    write_rows(output_url, ImagenetSchema, rows, rowgroup_size_mb=rowgroup_size_mb)
+    if dct_hw is not None:
+        from examples.imagenet.schema import dct_imagenet_schema
+        for row in rows:
+            row['image'] = _center_resize(row['image'], dct_hw)
+        schema = dct_imagenet_schema(dct_hw, quality=dct_quality)
+    else:
+        schema = ImagenetSchema
+    write_rows(output_url, schema, rows, rowgroup_size_mb=rowgroup_size_mb)
     print('wrote {} rows to {}'.format(len(rows), output_url))
 
 
@@ -63,9 +83,14 @@ def main():
                         help='directory of <noun_id>/*.jpg class folders')
     parser.add_argument('--synthetic', action='store_true',
                         help='generate random images instead of scanning a directory')
+    parser.add_argument('--dct-hw', type=int, default=None,
+                        help='write the DCT-domain store with images resized to this '
+                             'size (multiple of 8) for on-chip decode')
+    parser.add_argument('--dct-quality', type=int, default=90)
     args = parser.parse_args()
     generate_petastorm_imagenet(args.output_url, imagenet_dir=args.imagenet_dir,
-                                synthetic=args.synthetic or args.imagenet_dir is None)
+                                synthetic=args.synthetic or args.imagenet_dir is None,
+                                dct_hw=args.dct_hw, dct_quality=args.dct_quality)
 
 
 if __name__ == '__main__':

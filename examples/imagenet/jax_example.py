@@ -26,15 +26,10 @@ IMAGE_HW = 64
 
 
 def make_transform(class_to_label, image_hw=IMAGE_HW):
+    from examples.imagenet.generate_petastorm_imagenet import _center_resize
+
     def _transform(row):
-        image = row['image']
-        h, w = image.shape[:2]
-        side = min(h, w)
-        top, left = (h - side) // 2, (w - side) // 2
-        square = image[top:top + side, left:left + side]
-        # Nearest-neighbor host resize (index gather) — cheap and codec-agnostic.
-        idx = (np.arange(image_hw) * side // image_hw)
-        row['image'] = square[idx][:, idx]
+        row['image'] = _center_resize(row['image'], image_hw)
         row['label'] = np.int32(class_to_label[row['noun_id']])
         return row
 
@@ -44,8 +39,26 @@ def make_transform(class_to_label, image_hw=IMAGE_HW):
                          selected_fields=['image', 'label'])
 
 
+def make_label_transform(class_to_label, image_field_spec):
+    """Label mapping for a fixed-size store (DCT or raw): keeps the image field as-is
+    (host decode already yields a static shape — or raw coefficient blocks under a
+    field override) and adds the integer label."""
+    def _transform(row):
+        row['label'] = np.int32(class_to_label[row['noun_id']])
+        return row
+
+    return TransformSpec(_transform,
+                         edit_fields=[image_field_spec, ('label', np.int32, (), False)],
+                         selected_fields=['image', 'label'])
+
+
 def train(dataset_url, batch_size=8, epochs=1, learning_rate=1e-3,
-          stage_sizes=(1, 1, 1, 1), num_filters=16):
+          stage_sizes=(1, 1, 1, 1), num_filters=16, on_chip_decode=False,
+          image_hw=IMAGE_HW, dct_quality=90):
+    """``on_chip_decode=True`` reads a DCT-domain store (generate with ``--dct-hw``)
+    through a field override so workers ship raw int16 coefficient blocks; dequant +
+    IDCT + color conversion then run inside the jitted train step on the device
+    (SURVEY.md §7.3 — the decode FLOPs land on the MXU, the host never runs an IDCT)."""
     with make_reader(dataset_url, schema_fields=['noun_id'], num_epochs=1,
                      shuffle_row_groups=False) as scan_reader:
         nouns = sorted({row.noun_id for row in scan_reader})
@@ -54,15 +67,18 @@ def train(dataset_url, batch_size=8, epochs=1, learning_rate=1e-3,
     model = ResNet(stage_sizes=list(stage_sizes), num_classes=len(nouns),
                    num_filters=num_filters)
     variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, IMAGE_HW, IMAGE_HW, 3)))
+                           jnp.zeros((1, image_hw, image_hw, 3)))
     params, batch_stats = variables['params'], variables['batch_stats']
     optimizer = optax.adam(learning_rate)
     opt_state = optimizer.init(params)
 
     @jax.jit
     def train_step(params, batch_stats, opt_state, rng, images, labels):
+        if on_chip_decode:
+            from petastorm_tpu.ops.image_decode import dct_decode_images_jax
+            images = dct_decode_images_jax(images, quality=dct_quality)
         # On-chip preprocessing: crop/flip augment + bf16 normalize (ops/image.py).
-        images = random_crop_flip(rng, images, (IMAGE_HW - 8, IMAGE_HW - 8))
+        images = random_crop_flip(rng, images, (image_hw - 8, image_hw - 8))
         images = normalize_image(images, mean=127.5, std=127.5)
 
         def loss_fn(p):
@@ -77,9 +93,18 @@ def train(dataset_url, batch_size=8, epochs=1, learning_rate=1e-3,
 
     rng = jax.random.PRNGKey(1)
     loss = None
-    transform = make_transform(class_to_label)
-    with make_reader(dataset_url, num_epochs=epochs, transform_spec=transform,
-                     shuffle_rows=True, seed=0) as reader:
+    if on_chip_decode:
+        from examples.imagenet.schema import dct_coefficients_field
+        override = dct_coefficients_field(image_hw, quality=dct_quality)
+        transform = make_label_transform(
+            class_to_label, ('image', np.int16,
+                             (image_hw // 8, image_hw // 8, 8, 8, 3), False))
+        reader_kwargs = dict(field_overrides=[override], transform_spec=transform)
+    else:
+        reader_kwargs = dict(transform_spec=make_transform(class_to_label,
+                                                           image_hw=image_hw))
+    with make_reader(dataset_url, num_epochs=epochs, shuffle_rows=True, seed=0,
+                     **reader_kwargs) as reader:
         loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
         for step, batch in enumerate(loader):
             rng, step_rng = jax.random.split(rng)
@@ -95,8 +120,12 @@ def main():
     parser.add_argument('--dataset-url', default='file:///tmp/imagenet')
     parser.add_argument('--batch-size', type=int, default=8)
     parser.add_argument('--epochs', type=int, default=1)
+    parser.add_argument('--on-chip-decode', action='store_true',
+                        help='read a --dct-hw store and decode on the device')
+    parser.add_argument('--image-hw', type=int, default=IMAGE_HW)
     args = parser.parse_args()
-    train(args.dataset_url, batch_size=args.batch_size, epochs=args.epochs)
+    train(args.dataset_url, batch_size=args.batch_size, epochs=args.epochs,
+          on_chip_decode=args.on_chip_decode, image_hw=args.image_hw)
 
 
 if __name__ == '__main__':

@@ -11,3 +11,28 @@ ImagenetSchema = Unischema('ImagenetSchema', [
     UnischemaField('text', np.str_, (), ScalarCodec(np.str_), False),
     UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('png'), False),
 ])
+
+
+def dct_imagenet_schema(image_hw, quality=90):
+    """Fixed-size DCT-domain variant (SURVEY.md §7.3 on-chip decode): images resized to
+    ``image_hw`` at write time and stored as quantized DCT coefficients, so readers can
+    either decode on the host (default) or ship int16 coefficients straight to the chip
+    (``make_reader(..., field_overrides=[dct_coefficients_field(image_hw)])``)."""
+    from petastorm_tpu.codecs import DctImageCodec
+    if image_hw % 8:
+        raise ValueError('image_hw must be a multiple of 8, got {}'.format(image_hw))
+    return Unischema('DctImagenetSchema', [
+        UnischemaField('noun_id', np.str_, (), ScalarCodec(np.str_), False),
+        UnischemaField('text', np.str_, (), ScalarCodec(np.str_), False),
+        UnischemaField('image', np.uint8, (image_hw, image_hw, 3),
+                       DctImageCodec(quality=quality), False),
+    ])
+
+
+def dct_coefficients_field(image_hw, quality=90):
+    """The read-time override that makes workers emit raw coefficient blocks."""
+    from petastorm_tpu.codecs import DctCoefficientsCodec
+    if image_hw % 8:
+        raise ValueError('image_hw must be a multiple of 8, got {}'.format(image_hw))
+    return UnischemaField('image', np.int16, (image_hw // 8, image_hw // 8, 8, 8, 3),
+                          DctCoefficientsCodec(quality=quality), False)

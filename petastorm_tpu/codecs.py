@@ -211,6 +211,16 @@ def _parse_arrow_type(type_str):
     raise ValueError('Cannot parse Arrow type {!r}'.format(type_str))
 
 
+def _ndarray_to_npy_bytes(value):
+    memfile = BytesIO()
+    np.save(memfile, value)
+    return memfile.getvalue()
+
+
+def _npy_bytes_to_ndarray(blob):
+    return np.ascontiguousarray(np.load(BytesIO(blob), allow_pickle=False))
+
+
 class NdarrayCodec(FieldCodec):
     """Stores a numpy tensor as an uncompressed ``.npy`` byte blob (reference:
     petastorm/codecs.py:133-171)."""
@@ -225,13 +235,10 @@ class NdarrayCodec(FieldCodec):
         if not _is_compliant_shape(value.shape, unischema_field.shape):
             raise ValueError('Unexpected shape {} for field {} (expected {})'
                              .format(value.shape, unischema_field.name, unischema_field.shape))
-        memfile = BytesIO()
-        np.save(memfile, value)
-        return memfile.getvalue()
+        return _ndarray_to_npy_bytes(value)
 
     def decode(self, unischema_field, value):
-        memfile = BytesIO(value)
-        return np.ascontiguousarray(np.load(memfile, allow_pickle=False))
+        return _npy_bytes_to_ndarray(value)
 
     def decode_arrow_column(self, unischema_field, arrow_col):
         """Whole-column decode straight from Arrow buffers: when every ``.npy`` blob in a
@@ -441,11 +448,94 @@ class CompressedImageCodec(FieldCodec):
         return 'CompressedImageCodec({!r}, quality={})'.format(self.image_codec, self._quality)
 
 
+class DctImageCodec(FieldCodec):
+    """JPEG-style DCT-domain image storage with an on-chip decode option (SURVEY.md
+    §7.3's decode-as-jax-op variant; no reference analog).
+
+    Images are stored as quantized 8x8 DCT coefficient blocks (int16) with a tiny
+    header carrying the pre-padding height/width; Parquet page compression over the
+    mostly-zero coefficients replaces JPEG's entropy coder, so the stored size is
+    JPEG-like. ``decode`` runs the exact host mirror (numpy IDCT) — full parity with
+    every reader path. For on-chip decode, read the SAME stored field through
+    :class:`DctCoefficientsCodec` (``make_reader(..., field_overrides=...)``): workers
+    then ship raw int16 coefficients and ``ops.image_decode.dct_decode_images_jax``
+    does dequant + IDCT + color conversion on the MXU inside your jitted step."""
+
+    codec_name = 'dct_image'
+    _MAGIC = b'DCT1'
+
+    def __init__(self, quality=75):
+        self._quality = int(quality)
+
+    @property
+    def quality(self):
+        return self._quality
+
+    def encode(self, unischema_field, value):
+        import struct
+        from petastorm_tpu.ops.image_decode import dct_encode_image
+        expected = np.dtype(unischema_field.numpy_dtype)
+        if value.dtype != expected or expected != np.uint8:
+            raise ValueError('DctImageCodec requires uint8 images (field {}, got {})'
+                             .format(unischema_field.name, value.dtype))
+        if not _is_compliant_shape(value.shape, unischema_field.shape):
+            raise ValueError('Unexpected shape {} for field {} (expected {})'
+                             .format(value.shape, unischema_field.name,
+                                     unischema_field.shape))
+        coeffs = dct_encode_image(value, quality=self._quality)
+        header = self._MAGIC + struct.pack('<HH', value.shape[0], value.shape[1])
+        return header + _ndarray_to_npy_bytes(coeffs)
+
+    def _split(self, unischema_field, value):
+        import struct
+        value = bytes(value)
+        if value[:4] != self._MAGIC:
+            raise ValueError('Field {} is not DCT-coded data'.format(unischema_field.name))
+        h, w = struct.unpack('<HH', value[4:8])
+        return (h, w), value[8:]
+
+    def decode(self, unischema_field, value):
+        from petastorm_tpu.ops.image_decode import dct_decode_image
+        (h, w), npy = self._split(unischema_field, value)
+        coeffs = _npy_bytes_to_ndarray(npy)
+        return dct_decode_image(coeffs, quality=self._quality, orig_hw=(h, w))
+
+    def arrow_type(self, unischema_field):
+        return pa.binary()
+
+    def to_config(self):
+        return {'codec': self.codec_name, 'quality': self._quality}
+
+    @classmethod
+    def from_config(cls, config):
+        return cls(quality=config['quality'])
+
+    def __str__(self):
+        return 'DctImageCodec(quality={})'.format(self._quality)
+
+
+class DctCoefficientsCodec(DctImageCodec):
+    """Read-side reinterpretation of a :class:`DctImageCodec` field: decodes only to the
+    raw int16 coefficient blocks ``[H/8, W/8, 8, 8, C]`` (no host IDCT) so the device
+    does the transform. Use via ``make_reader(..., field_overrides=[UnischemaField(name,
+    np.int16, (None, None, 8, 8, C), DctCoefficientsCodec(quality), False)])``.
+    Images whose dimensions are multiples of 8 reconstruct exactly like the host path;
+    otherwise the on-chip image keeps the edge padding (crop with the stored sizes)."""
+
+    codec_name = 'dct_coefficients'
+
+    def decode(self, unischema_field, value):
+        _, npy = self._split(unischema_field, value)
+        return _npy_bytes_to_ndarray(npy)
+
+
 _CODEC_REGISTRY = {
     ScalarCodec.codec_name: ScalarCodec,
     NdarrayCodec.codec_name: NdarrayCodec,
     CompressedNdarrayCodec.codec_name: CompressedNdarrayCodec,
     CompressedImageCodec.codec_name: CompressedImageCodec,
+    DctImageCodec.codec_name: DctImageCodec,
+    DctCoefficientsCodec.codec_name: DctCoefficientsCodec,
 }
 
 

@@ -58,13 +58,17 @@ def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='threa
                 shard_seed=None, cache_type='null', cache_location=None,
                 cache_size_limit=None, cache_row_size_estimate=None,
                 cache_extra_settings=None, transform_spec=None, storage_options=None,
-                filesystem=None, resume_state=None, reader_pool=None):
+                filesystem=None, resume_state=None, reader_pool=None,
+                field_overrides=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
     or an :class:`~petastorm_tpu.ngram.NGram` for sequence windows. ``reader_pool``
     overrides ``reader_pool_type`` with a pre-built pool instance (e.g. a ThreadPool with
-    profiling_enabled)."""
+    profiling_enabled). ``field_overrides`` — list of :class:`UnischemaField`s replacing
+    same-named stored fields for THIS read (read-time reinterpretation: e.g. swap a
+    ``DctImageCodec`` field to ``DctCoefficientsCodec`` so raw coefficients flow to an
+    on-device decode)."""
     dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
     handle = dataset_metadata.open_dataset(dataset_url_or_urls,
                                            storage_options=storage_options,
@@ -75,6 +79,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='threa
         raise RuntimeError(
             'Dataset at {!r} has no Unischema metadata. Use make_batch_reader for plain '
             'Parquet stores.'.format(dataset_url_or_urls))
+    if field_overrides:
+        schema = _apply_field_overrides(schema, field_overrides)
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
     pool = reader_pool if reader_pool is not None else _make_pool(
@@ -495,6 +501,16 @@ def _slice_batch(batch, start):
     n = max(batch.num_rows - start, 0)
     return ColumnarBatch({name: col[start:] for name, col in batch.columns.items()},
                          n, item_id=batch.item_id)
+
+
+def _apply_field_overrides(schema, field_overrides):
+    by_name = {f.name: f for f in field_overrides}
+    unknown = sorted(set(by_name) - set(schema.fields))
+    if unknown:
+        raise ValueError('field_overrides name fields not in the schema: {}'
+                         .format(unknown))
+    return Unischema(schema.name,
+                     [by_name.get(name, field) for name, field in schema.fields.items()])
 
 
 def _is_ngram(schema_fields):

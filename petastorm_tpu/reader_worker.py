@@ -65,10 +65,18 @@ class WorkerSetup(object):
         self.seed = seed
         self.partition_field_names = set(partition_field_names)
         # Cache key token covers the dataset identity AND the read configuration: two
-        # readers with different column sets / decode modes sharing one cache_location
-        # must never serve each other's entries.
-        token_src = '{}|{}|{}|{}'.format(dataset_path_or_paths, sorted(self.fields_to_read),
-                                         decode, transform_spec is not None).encode('utf-8')
+        # readers with different column sets / decode modes / per-field codec
+        # interpretations (field_overrides) sharing one cache_location must never serve
+        # each other's entries. Codec configs are part of the identity because the
+        # cached value is the POST-decode output.
+        field_specs = sorted(
+            (name, str(field.numpy_dtype), str(field.shape),
+             str(field.codec.to_config()) if field.codec is not None else 'none')
+            for name, field in schema.fields.items() if name in self.fields_to_read)
+        token_src = '{}|{}|{}|{}|{}'.format(dataset_path_or_paths,
+                                            sorted(self.fields_to_read), decode,
+                                            transform_spec is not None,
+                                            field_specs).encode('utf-8')
         self.dataset_token = hashlib.md5(token_src).hexdigest()[:16]
         read_view = schema.create_schema_view(
             [re.escape(name) for name in self.fields_to_read]) \

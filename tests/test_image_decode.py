@@ -1,0 +1,166 @@
+"""DCT-domain image codec + on-chip decode (SURVEY.md §7.3 decode-as-jax-op variant)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import DctCoefficientsCodec, DctImageCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import write_rows
+from petastorm_tpu.ops.image_decode import (dct_decode_image, dct_decode_images_jax,
+                                            dct_encode_image)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def _psnr(a, b):
+    mse = np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2)
+    return 10 * np.log10(255.0 ** 2 / mse) if mse else np.inf
+
+
+def _assert_images_equal_mod_ties(a, b):
+    """Equal up to +-1 on a vanishing fraction of pixels (cross-backend 0.5-rounding)."""
+    diff = np.abs(a.astype(np.int32) - b.astype(np.int32))
+    assert diff.max() <= 1, 'difference beyond a rounding tie'
+    assert np.count_nonzero(diff) <= max(1, a.size // 1000)
+
+
+def _test_image(h, w, c=3, seed=0):
+    """Smooth structured image (random noise is the DCT's worst case and not
+    representative of photos)."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    chans = []
+    for i in range(c):
+        base = (128 + 100 * np.sin(xx / (8.0 + 3 * i)) * np.cos(yy / (11.0 + 2 * i))
+                + rng.randn(h, w) * 6)
+        chans.append(base)
+    img = np.stack(chans, axis=-1) if c > 1 else chans[0][..., None]
+    return np.clip(img, 0, 255).astype(np.uint8) if c > 1 else \
+        np.clip(img[..., 0], 0, 255).astype(np.uint8)
+
+
+class TestDctTransform:
+    @pytest.mark.parametrize('hw', [(64, 64), (60, 50), (17, 33)])
+    def test_roundtrip_rgb_psnr(self, hw):
+        img = _test_image(*hw)
+        coeffs = dct_encode_image(img, quality=90)
+        out = dct_decode_image(coeffs, quality=90, orig_hw=hw)
+        assert out.shape == img.shape and out.dtype == np.uint8
+        assert _psnr(img, out) > 30, 'quality-90 DCT round trip must stay high-fidelity'
+
+    def test_roundtrip_grayscale(self):
+        img = _test_image(40, 48, c=1)
+        assert img.ndim == 2
+        coeffs = dct_encode_image(img, quality=85)
+        out = dct_decode_image(coeffs, quality=85, orig_hw=(40, 48))
+        assert out.shape == img.shape
+        assert _psnr(img, out) > 33
+
+    def test_quality_tradeoff(self):
+        img = _test_image(64, 64)
+        high = dct_decode_image(dct_encode_image(img, 95), 95, (64, 64))
+        low = dct_decode_image(dct_encode_image(img, 20), 20, (64, 64))
+        assert _psnr(img, high) > _psnr(img, low)
+        # low quality quantizes harder -> more zeros -> compresses smaller
+        assert (np.count_nonzero(dct_encode_image(img, 20))
+                < np.count_nonzero(dct_encode_image(img, 95)))
+
+    def test_device_decode_matches_host(self):
+        """The jitted decode must reproduce the host mirror to within rounding ties
+        (float associativity differs between numpy and XLA; a 0.5-boundary pixel may
+        round the other way) for /8 shapes."""
+        imgs = np.stack([_test_image(64, 64, seed=s) for s in range(3)])
+        coeffs = np.stack([dct_encode_image(im, 80) for im in imgs])
+        on_host = np.stack([dct_decode_image(c, 80) for c in coeffs])
+        on_device = np.asarray(dct_decode_images_jax(jnp.asarray(coeffs), quality=80))
+        _assert_images_equal_mod_ties(on_host, on_device)
+
+    def test_encode_rejects_bad_input(self):
+        with pytest.raises(ValueError, match='uint8'):
+            dct_encode_image(np.zeros((8, 8), np.float32))
+        with pytest.raises(ValueError, match='channels'):
+            dct_encode_image(np.zeros((8, 8, 4), np.uint8))
+
+
+SCHEMA = Unischema('DctStore', [
+    UnischemaField('id', np.int64, (), ScalarCodec(), False),
+    UnischemaField('image', np.uint8, (64, 64, 3), DctImageCodec(quality=90), False),
+])
+
+
+@pytest.fixture(scope='module')
+def dct_dataset(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp('dct') / 'ds')
+    rows = [{'id': i, 'image': _test_image(64, 64, seed=i)} for i in range(12)]
+    write_rows(url, SCHEMA, rows, rows_per_file=6, rowgroup_size_mb=64)
+    return url, rows
+
+
+class TestDctCodecEndToEnd:
+    def test_host_decode_path(self, dct_dataset):
+        url, rows = dct_dataset
+        with make_reader(url, workers_count=1, shuffle_row_groups=False) as reader:
+            decoded = {row.id: row.image for row in reader}
+        assert len(decoded) == 12
+        for row in rows:
+            assert decoded[row['id']].shape == (64, 64, 3)
+            assert _psnr(row['image'], decoded[row['id']]) > 30
+
+    def test_field_override_ships_coefficients_and_decodes_on_device(self, dct_dataset):
+        url, rows = dct_dataset
+        override = UnischemaField('image', np.int16, (8, 8, 8, 8, 3),
+                                  DctCoefficientsCodec(quality=90), False)
+        with make_reader(url, workers_count=1, shuffle_row_groups=False,
+                         field_overrides=[override]) as reader:
+            got = {row.id: row.image for row in reader}
+        assert got[0].dtype == np.int16 and got[0].shape == (8, 8, 8, 8, 3)
+        # device decode of shipped coefficients == host codec decode
+        ids = sorted(got)
+        coeffs = jnp.asarray(np.stack([got[i] for i in ids]))
+        on_device = np.asarray(dct_decode_images_jax(coeffs, quality=90))
+        with make_reader(url, workers_count=1, shuffle_row_groups=False) as reader:
+            on_host = {row.id: row.image for row in reader}
+        for pos, i in enumerate(ids):
+            _assert_images_equal_mod_ties(on_device[pos], on_host[i])
+
+    def test_schema_json_roundtrip(self, dct_dataset):
+        url, _ = dct_dataset
+        from petastorm_tpu.etl.dataset_metadata import get_schema, open_dataset
+        schema = get_schema(open_dataset(url))
+        codec = schema.fields['image'].codec
+        assert isinstance(codec, DctImageCodec)
+        assert codec.quality == 90
+
+    def test_field_overrides_unknown_name_rejected(self, dct_dataset):
+        url, _ = dct_dataset
+        bad = UnischemaField('nope', np.int16, (), ScalarCodec(), False)
+        with pytest.raises(ValueError, match='nope'):
+            make_reader(url, field_overrides=[bad])
+
+    def test_field_override_has_own_cache_identity(self, dct_dataset, tmp_path):
+        """A host-decode read and a coefficients-override read sharing one disk cache
+        must not serve each other's entries (the cached value is post-decode)."""
+        url, _ = dct_dataset
+        cache_kwargs = dict(cache_type='local-disk', cache_location=str(tmp_path / 'c'),
+                            cache_size_limit=1 << 30, workers_count=1,
+                            shuffle_row_groups=False)
+        with make_reader(url, **cache_kwargs) as reader:
+            host_row = next(reader)
+        assert host_row.image.dtype == np.uint8
+        override = UnischemaField('image', np.int16, (8, 8, 8, 8, 3),
+                                  DctCoefficientsCodec(quality=90), False)
+        with make_reader(url, field_overrides=[override], **cache_kwargs) as reader:
+            coeff_row = next(reader)
+        assert coeff_row.image.dtype == np.int16
+        assert coeff_row.image.shape == (8, 8, 8, 8, 3)
+
+    def test_storage_size_is_compressed(self, dct_dataset):
+        """DCT blob (pre page-compression) stays in the ballpark of the raw image;
+        the many zero coefficients are what parquet's page codec then squeezes."""
+        img = _test_image(64, 64)
+        field = SCHEMA.fields['image']
+        blob = DctImageCodec(quality=50).encode(field, img)
+        assert len(blob) <= img.nbytes * 2 + 256
+        nonzero = np.count_nonzero(dct_encode_image(img, quality=50))
+        assert nonzero < img.size // 3  # sparse: page compression has leverage
