@@ -269,7 +269,7 @@ def make_converter(df, parent_cache_dir_url=None, rowgroup_size_mb=32, compressi
     return converter
 
 
-def _make_converter_spark(df, parent, rowgroup_size_mb):  # pragma: no cover - no pyspark
+def _make_converter_spark(df, parent, rowgroup_size_mb):
     cache_dir = '{}/{}'.format(parent, uuid.uuid4().hex)
     df.write.option('parquet.block.size', rowgroup_size_mb << 20).parquet(cache_dir)
     from petastorm_tpu.etl.dataset_metadata import open_dataset

@@ -83,6 +83,15 @@ def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='threa
         schema = _apply_field_overrides(schema, field_overrides)
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
+    if reader_pool is not None:
+        # Pool-shape kwargs describe a pool this call is NOT building (ADVICE.md r1).
+        ignored = [name for name, value, default in [
+            ('workers_count', workers_count, 10),
+            ('results_queue_size', results_queue_size, 50),
+            ('reader_pool_type', reader_pool_type, 'thread')] if value != default]
+        if ignored:
+            warnings.warn('reader_pool was supplied; ignoring pool-shape arguments {} '
+                          '(the pre-built pool defines its own shape)'.format(ignored))
     pool = reader_pool if reader_pool is not None else _make_pool(
         reader_pool_type, workers_count, results_queue_size)
     return Reader(dataset_url_or_urls, handle=handle, schema=schema,

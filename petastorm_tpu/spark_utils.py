@@ -1,5 +1,31 @@
-"""Spark adapter (reference: petastorm/spark_utils.py:24-52) — gated on pyspark being
-installed; the rest of the framework has no Spark dependency."""
+"""Spark adapters (reference: petastorm/spark_utils.py:24-52 and the write-path helper
+petastorm/unischema.py:348-413) — gated on pyspark being installed; the rest of the
+framework has no Spark dependency.
+
+Write path with Spark: codec-encode rows with :func:`dict_to_spark_row`, write the
+DataFrame as Parquet, then attach metadata with
+``petastorm_tpu.etl.dataset_metadata.materialize_dataset`` — or skip Spark entirely:
+``write_rows`` is the first-class pure-Arrow writer (SURVEY.md §7.1 step 3 makes Spark
+optional by design)."""
+
+
+def dict_to_spark_row(schema, row_dict):
+    """Validate + codec-encode one row dict and build an ordered ``pyspark.sql.Row``
+    (reference: petastorm/unischema.py:348-384). The encode/validation logic is the
+    shared :func:`~petastorm_tpu.unischema.dict_to_encoded_row`; this wrapper only adds
+    the Spark Row rendering, so the pure-Arrow writer and the Spark writer cannot
+    diverge."""
+    try:
+        from pyspark.sql import Row
+    except ImportError:
+        raise ImportError('dict_to_spark_row requires pyspark, which is not installed; '
+                          'use petastorm_tpu.etl.dataset_metadata.write_rows instead')
+    from petastorm_tpu.unischema import dict_to_encoded_row
+    encoded = dict_to_encoded_row(schema, row_dict)
+    # Stable field order: Row(**kwargs) sorts on some pyspark versions; build through
+    # an ordered Row class instead (same approach as the reference).
+    row_cls = Row(*schema.fields.keys())
+    return row_cls(*[encoded[name] for name in schema.fields])
 
 
 def dataset_as_rdd(dataset_url, spark_session, schema_fields=None, storage_options=None):

@@ -167,6 +167,55 @@ class TestLoader:
         assert stats['rows'] == 50
         assert 0.0 <= stats['input_stall_fraction'] <= 1.0
 
+    def test_stall_metric_directional_sanity(self, synthetic_dataset):
+        """The north-star input-stall metric must move the right way (VERDICT r1 item
+        10 — a CI smoke so the metric can't silently rot between TPU runs): a slow
+        PRODUCER (sleeping transform) shows high stall; a slow CONSUMER (sleeping
+        between batches) shows low stall. Margins are wide to stay robust on 1 CPU."""
+        import time as _time
+        from petastorm_tpu.transform import TransformSpec
+
+        def slow_producer_stall():
+            slow = TransformSpec(lambda row: (_time.sleep(0.05), row)[1])
+            with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                             transform_spec=slow, workers_count=1,
+                             shuffle_row_groups=False) as reader:
+                loader = JaxDataLoader(reader, batch_size=25, device_put=False)
+                list(loader)
+            return loader.stats.input_stall_fraction
+
+        def slow_consumer_stall():
+            with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                             workers_count=1, shuffle_row_groups=False) as reader:
+                loader = JaxDataLoader(reader, batch_size=25, device_put=False,
+                                       prefetch=2)
+                for _ in loader:
+                    _time.sleep(0.08)
+            return loader.stats.input_stall_fraction
+
+        producer_bound = slow_producer_stall()
+        consumer_bound = slow_consumer_stall()
+        assert 0.0 <= consumer_bound <= 1.0 and 0.0 <= producer_bound <= 1.0
+        assert producer_bound > consumer_bound + 0.2, \
+            'input-bound run must report much higher stall than compute-bound run'
+
+    def test_reader_pool_with_pool_shape_args_warns(self, scalar_dataset, synthetic_dataset):
+        import warnings as _warnings
+        from petastorm_tpu.workers.thread_pool import ThreadPool
+        pool = ThreadPool(2, 10)
+        with pytest.warns(UserWarning, match='ignoring pool-shape'):
+            reader = make_reader(synthetic_dataset.url, reader_pool=pool,
+                                 workers_count=3)
+        reader.stop()
+        reader.join()
+        # no warning when only reader_pool is given
+        pool2 = ThreadPool(2, 10)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter('error')
+            reader = make_reader(synthetic_dataset.url, reader_pool=pool2)
+        reader.stop()
+        reader.join()
+
     def test_reiteration_after_early_break(self, scalar_dataset):
         """Breaking mid-epoch then re-iterating must not leak the old producer's batches
         into the new iteration."""

