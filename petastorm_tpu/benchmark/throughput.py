@@ -70,10 +70,11 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
                                                if ngram_delta_threshold is not None
                                                else (1 << 62)),
                               timestamp_field=ngram_ts_field)
+    pool_kwargs = ({'reader_pool': reader_pool} if reader_pool is not None
+                   else {'reader_pool_type': pool_type, 'workers_count': loaders_count})
     reader = make_reader(dataset_url, schema_fields=schema_fields,
-                         reader_pool_type=pool_type, workers_count=loaders_count,
                          shuffle_row_groups=shuffle_row_groups, num_epochs=None,
-                         reader_pool=reader_pool)
+                         **pool_kwargs)
     stall = 0.0
     try:
         if read_method == READ_PYTHON:

@@ -54,13 +54,44 @@ def _resolve_filesystem(url, storage_options=None):
     if scheme in ('', 'file'):
         return pafs.LocalFileSystem()
     if scheme == 'hdfs':
-        parsed = urlparse(url)
-        return pafs.HadoopFileSystem(parsed.hostname or 'default', parsed.port or 0)
+        return _resolve_hdfs(url)
     # Everything else goes through fsspec (s3/gs/abfs/...), matching the reference's
     # catch-all branch (fs_utils.py:132-144).
     import fsspec
     fs = fsspec.filesystem(scheme, **(storage_options or {}))
     return pafs.PyFileSystem(pafs.FSSpecHandler(fs))
+
+
+def _resolve_hdfs(url):
+    """Connect an ``hdfs://`` URL, routing hostless and HA-nameservice authorities
+    through the hadoop-config namenode resolver with failover (reference:
+    petastorm/fs_utils.py:82-130; hdfs/namenode.py:84-120).
+
+    - ``hdfs:///path``: resolve ``fs.defaultFS`` from the hadoop config.
+    - ``hdfs://nameservice/path`` where the authority matches a configured
+      ``dfs.nameservices`` entry: resolve to its namenode list.
+    - ``hdfs://host:port/path``: direct connection; a portless host is still checked
+      against the configured nameservices first, as a bare port is what distinguishes a
+      physical namenode from a logical service name.
+    Multi-namenode resolutions connect via ``HdfsConnector.connect_to_either_namenode``.
+    """
+    from petastorm_tpu.hdfs.namenode import (
+        HdfsConfigError, HdfsConnector, HdfsNamenodeResolver)
+    parsed = urlparse(url)
+    if parsed.port:
+        return pafs.HadoopFileSystem(parsed.hostname, parsed.port)
+    resolver = HdfsNamenodeResolver()
+    try:
+        if not parsed.hostname:
+            _, namenodes = resolver.resolve_default_hdfs_service()
+        else:
+            namenodes = resolver.resolve_hdfs_name_service(parsed.hostname)
+    except HdfsConfigError:
+        # No usable hadoop config found by us: hand the authority (or 'default') to
+        # libhdfs with port 0 so it applies its own core-site.xml lookup — the
+        # authority may be a logical HA nameservice only libhdfs can resolve.
+        return pafs.HadoopFileSystem(parsed.hostname or 'default', parsed.port or 0)
+    return HdfsConnector.connect_to_either_namenode(namenodes)
 
 
 def _resolve_single(url, storage_options=None, filesystem=None):

@@ -17,6 +17,15 @@ logger = logging.getLogger(__name__)
 _HADOOP_HOME_VARS = ('HADOOP_HOME', 'HADOOP_PREFIX', 'HADOOP_INSTALL')
 MAX_NAMENODES = 2
 
+# OSError subclasses that describe the *file*, not the connection — a failover retry
+# cannot fix these and must not tear down a healthy namenode connection.
+_NON_FAILOVER_ERRORS = (FileNotFoundError, PermissionError, FileExistsError,
+                        IsADirectoryError, NotADirectoryError)
+
+
+def _is_failover_error(exc):
+    return isinstance(exc, OSError) and not isinstance(exc, _NON_FAILOVER_ERRORS)
+
 
 class HdfsConfigError(RuntimeError):
     pass
@@ -24,20 +33,25 @@ class HdfsConfigError(RuntimeError):
 
 def _load_hadoop_configuration():
     """Locate and parse hdfs-site.xml + core-site.xml into one {name: value} dict
-    (reference: namenode.py:34-65)."""
-    config = {}
+    (reference: namenode.py:34-65). ``HADOOP_CONF_DIR`` (pointing directly at the conf
+    directory, per hadoop convention) wins over the ``HADOOP_HOME``-style roots."""
+    conf_dirs = []
+    conf_dir_env = os.environ.get('HADOOP_CONF_DIR')
+    if conf_dir_env:
+        conf_dirs.append(conf_dir_env)
     for var in _HADOOP_HOME_VARS:
         home = os.environ.get(var)
-        if not home:
-            continue
-        conf_dir = os.path.join(home, 'etc', 'hadoop')
+        if home:
+            conf_dirs.append(os.path.join(home, 'etc', 'hadoop'))
+    for conf_dir in conf_dirs:
+        config = {}
         for file_name in ('core-site.xml', 'hdfs-site.xml'):
             path = os.path.join(conf_dir, file_name)
             if os.path.exists(path):
                 config.update(_parse_hadoop_xml(path))
         if config:
             return config
-    return config
+    return {}
 
 
 def _parse_hadoop_xml(path):
@@ -116,7 +130,8 @@ class HdfsConnector(object):
         """Connect one namenode via pyarrow HadoopFileSystem; override in tests."""
         import pyarrow.fs as pafs
         host, _, port = address.partition(':')
-        return pafs.HadoopFileSystem(host, int(port) if port else 8020, user=user)
+        return pafs.HadoopFileSystem(host or 'default', int(port) if port else 8020,
+                                     user=user)
 
     @classmethod
     def connect_to_either_namenode(cls, namenode_addresses, user=None):
@@ -133,6 +148,89 @@ class HdfsConnector(object):
         raise HdfsConnectError('Could not connect to any namenode of {}:\n{}'
                                .format(list(namenode_addresses), '\n'.join(errors)))
 
+    @classmethod
+    def connect_ha(cls, namenode_addresses, user=None):
+        """Return a picklable :class:`HAHdfsClient` proxy that fails over between the
+        given namenodes on every operation (reference: namenode.py:274-286)."""
+        if not namenode_addresses:
+            raise HdfsConnectError('Must supply at least one namenode address')
+        return HAHdfsClient(cls, list(namenode_addresses), user=user)
+
+    @classmethod
+    def _try_next_namenode(cls, index_of_nn, namenode_addresses, user=None):
+        """Round-robin connect starting after ``index_of_nn``; return
+        ``(new_index, filesystem)`` (reference: namenode.py:288-316)."""
+        count = len(namenode_addresses)
+        for step in range(1, count + 1):
+            idx = (index_of_nn + step) % count
+            address = namenode_addresses[idx]
+            try:
+                return idx, cls.hdfs_connect_namenode(address, user=user)
+            except Exception as exc:  # noqa: BLE001 - expected for standby namenodes
+                logger.debug('Namenode %s connect failed during failover: %s',
+                             address, exc)
+        raise HdfsConnectError('Unable to connect to any namenode of {}'
+                               .format(list(namenode_addresses)))
+
+
+class HAHdfsClient(object):
+    """High-availability proxy over a live ``pyarrow.fs.HadoopFileSystem``.
+
+    The reference subclasses the legacy python ``HadoopFileSystem`` and decorates every
+    public method with ``namenode_failover`` (reference: namenode.py:211-238). Modern
+    ``pyarrow.fs`` filesystems are C++ extension classes that cannot be subclassed that
+    way, so this is a delegating proxy instead: attribute access forwards to the live
+    connection, callables are wrapped so an ``OSError`` triggers a round-robin reconnect
+    to the next namenode and a single retry. Picklable via ``__reduce__`` — workers
+    re-resolve their own connection (reference: namenode.py:231-233).
+
+    Pass :meth:`unwrap` to APIs that require a real pyarrow filesystem instance
+    (e.g. ``pyarrow.dataset``); the proxy itself covers metadata-style calls made
+    through it.
+    """
+
+    def __init__(self, connector_cls, namenode_addresses, user=None):
+        self._connector_cls = connector_cls
+        self._namenode_addresses = list(namenode_addresses)
+        self._user = user
+        self._index_of_nn = -1
+        self._do_connect()
+
+    def __reduce__(self):
+        return self.__class__, (self._connector_cls, self._namenode_addresses, self._user)
+
+    def _do_connect(self):
+        self._index_of_nn, self._filesystem = self._connector_cls._try_next_namenode(
+            self._index_of_nn, self._namenode_addresses, user=self._user)
+
+    def reconnect(self):
+        """Advance to the next namenode; used by :func:`namenode_failover` retries."""
+        self._do_connect()
+
+    def unwrap(self):
+        """The live ``pyarrow.fs.HadoopFileSystem`` (reconnects if never connected)."""
+        return self._filesystem
+
+    def __getattr__(self, name):
+        if name.startswith('_'):
+            raise AttributeError(name)
+        attr = getattr(self._filesystem, name)
+        if not callable(attr):
+            return attr
+
+        def call_with_failover(*args, **kwargs):
+            try:
+                return attr(*args, **kwargs)
+            except OSError as exc:
+                if not _is_failover_error(exc):
+                    raise
+                logger.warning('HDFS %s failed; failing over to next namenode', name)
+                self.reconnect()
+                return getattr(self._filesystem, name)(*args, **kwargs)
+
+        call_with_failover.__name__ = name
+        return call_with_failover
+
 
 def namenode_failover(func):
     """Decorator retrying an HDFS operation once after a connection failure (reference:
@@ -146,7 +244,9 @@ def namenode_failover(func):
     def wrapper(*args, **kwargs):
         try:
             return func(*args, **kwargs)
-        except OSError:
+        except OSError as exc:
+            if not _is_failover_error(exc):
+                raise
             reconnect = getattr(args[0], 'reconnect', None) if args else None
             if callable(reconnect):
                 logger.warning('HDFS operation %s failed; reconnecting and retrying',

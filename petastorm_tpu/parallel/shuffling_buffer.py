@@ -1,18 +1,28 @@
 """Columnar shuffling buffers (reference: petastorm/reader_impl/shuffling_buffer.py:23-180
 and pytorch_shuffling_buffer.py:22-279, unified).
 
-One numpy-columnar implementation serves every adapter (JAX, torch, TF): batches are
-dicts of ``(n, ...)`` arrays (or lists for ragged fields). Both buffers hold added chunks
-as separate *parts* and only materialize the rows a retrieve touches — ``add_many`` never
-re-copies the whole store, so cost is amortized O(rows moved), not O(buffer) per call
-(the reference achieves the same with swap-to-end pops, shuffling_buffer.py:116-140).
-The random buffer keeps a ``min_after_retrieve`` decorrelation floor. Not thread safe
-(same contract as the reference, shuffling_buffer.py:24-26).
+One columnar implementation serves every adapter (JAX, torch, TF): batches are
+dicts of ``(n, ...)`` arrays (or lists for ragged fields). Columns may be numpy arrays
+*or* torch tensors on any device — gather/concat dispatch per column, so the torch
+loaders shuffle device-resident tensors exactly like the reference's batched torch
+buffers (pytorch_shuffling_buffer.py:22-279, CPU or CUDA) without a separate class.
+Both buffers hold added chunks as separate *parts* and only materialize the rows a
+retrieve touches — ``add_many`` never re-copies the whole store, so cost is amortized
+O(rows moved), not O(buffer) per call (the reference achieves the same with swap-to-end
+pops, shuffling_buffer.py:116-140). The random buffer keeps a ``min_after_retrieve``
+decorrelation floor. Not thread safe (same contract as the reference,
+shuffling_buffer.py:24-26).
 """
 
+import sys
 from collections import deque
 
 import numpy as np
+
+
+def _is_torch_tensor(value):
+    torch = sys.modules.get('torch')
+    return torch is not None and isinstance(value, torch.Tensor)
 
 
 class ShufflingBufferBase(object):
@@ -36,9 +46,16 @@ class ShufflingBufferBase(object):
 
 
 def _gather(columns, indices):
-    return {name: (col[indices] if isinstance(col, np.ndarray)
-                   else [col[i] for i in indices])
-            for name, col in columns.items()}
+    out = {}
+    for name, col in columns.items():
+        if isinstance(col, np.ndarray):
+            out[name] = col[indices]
+        elif _is_torch_tensor(col):
+            # Advanced indexing gathers on the tensor's own device (cpu/cuda).
+            out[name] = col[np.asarray(indices)]
+        else:
+            out[name] = [col[i] for i in indices]
+    return out
 
 
 def _concat_parts(parts):
@@ -47,6 +64,9 @@ def _concat_parts(parts):
         values = [p[name] for p in parts]
         if isinstance(values[0], np.ndarray) and values[0].ndim >= 1:
             out[name] = np.concatenate(values) if len(values) > 1 else values[0]
+        elif _is_torch_tensor(values[0]):
+            import torch
+            out[name] = torch.cat(values) if len(values) > 1 else values[0]
         else:
             merged = []
             for v in values:

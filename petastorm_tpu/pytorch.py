@@ -43,6 +43,13 @@ def _sanitize_value(name, value):
     return arr
 
 
+def _writable_contiguous(arr):
+    """Contiguous, writable copy-on-need: Arrow-backed columns are read-only views and
+    torch.as_tensor cannot safely alias them."""
+    arr = np.ascontiguousarray(arr)
+    return arr if arr.flags.writeable else arr.copy()
+
+
 def decimal_friendly_collate(rows):
     """Collate a list of row dicts into a dict of stacked torch tensors (reference:
     pytorch.py:68-90)."""
@@ -141,8 +148,13 @@ class DataLoader(LoaderBase):
 
 
 class BatchedDataLoader(LoaderBase):
-    """Columnar fast path over a batched reader (reference: pytorch.py:254-365):
-    per-column ``transform_fn`` (default torch.as_tensor), columnar shuffling buffers."""
+    """Columnar fast path over a batched reader (reference: pytorch.py:254-365).
+
+    Columns are converted to torch tensors via ``transform_fn`` (default
+    ``torch.as_tensor``) *before* entering the shuffling buffer, so when
+    ``transform_fn`` places tensors on an accelerator the buffer gathers/concats
+    device-resident tensors — the reference's CUDA batched-buffer behavior
+    (pytorch_shuffling_buffer.py:22-279) with one unified buffer implementation."""
 
     def __init__(self, reader, batch_size=1, transform_fn=None,
                  shuffling_queue_capacity=0, seed=None):
@@ -165,18 +177,15 @@ class BatchedDataLoader(LoaderBase):
         else:
             buffer = NoopShufflingBuffer()
         for batch in self.reader:
-            columns = {name: _sanitize_value(name, col)
+            columns = {name: self.transform_fn(_writable_contiguous(
+                           _sanitize_value(name, col)))
                        for name, col in batch._asdict().items()}
             buffer.add_many(columns)
             while buffer.can_retrieve(self.batch_size):
-                yield self._to_torch(buffer.retrieve(self.batch_size))
+                yield buffer.retrieve(self.batch_size)
         buffer.finish()
         while buffer.can_retrieve(1):
-            yield self._to_torch(buffer.retrieve(self.batch_size))
-
-    def _to_torch(self, columns):
-        return {name: self.transform_fn(np.ascontiguousarray(col))
-                for name, col in columns.items()}
+            yield buffer.retrieve(self.batch_size)
 
 
 class InMemBatchedDataLoader(LoaderBase):

@@ -28,6 +28,12 @@ logger = logging.getLogger(__name__)
 #: extra rowgroups kept in flight beyond the worker count (reference: reader.py:45-47)
 _VENTILATE_EXTRA_ROWGROUPS = 2
 
+#: pool-shape defaults shared by the make_reader signature and the reader_pool
+#: conflict warning — one source of truth so they cannot drift apart
+_DEFAULT_POOL_TYPE = 'thread'
+_DEFAULT_WORKERS_COUNT = 10
+_DEFAULT_RESULTS_QUEUE_SIZE = 50
+
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size):
     if reader_pool_type == 'thread':
@@ -51,8 +57,10 @@ def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_est
     raise ValueError('Unknown cache_type {!r} (expected null/local-disk)'.format(cache_type))
 
 
-def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
-                workers_count=10, results_queue_size=50, seed=None, shuffle_rows=False,
+def make_reader(dataset_url_or_urls, schema_fields=None,
+                reader_pool_type=_DEFAULT_POOL_TYPE,
+                workers_count=_DEFAULT_WORKERS_COUNT,
+                results_queue_size=_DEFAULT_RESULTS_QUEUE_SIZE, seed=None, shuffle_rows=False,
                 shuffle_row_groups=True, shuffle_row_drop_partitions=1, predicate=None,
                 rowgroup_selector=None, num_epochs=1, cur_shard=None, shard_count=None,
                 shard_seed=None, cache_type='null', cache_location=None,
@@ -86,9 +94,10 @@ def make_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='threa
     if reader_pool is not None:
         # Pool-shape kwargs describe a pool this call is NOT building (ADVICE.md r1).
         ignored = [name for name, value, default in [
-            ('workers_count', workers_count, 10),
-            ('results_queue_size', results_queue_size, 50),
-            ('reader_pool_type', reader_pool_type, 'thread')] if value != default]
+            ('workers_count', workers_count, _DEFAULT_WORKERS_COUNT),
+            ('results_queue_size', results_queue_size, _DEFAULT_RESULTS_QUEUE_SIZE),
+            ('reader_pool_type', reader_pool_type, _DEFAULT_POOL_TYPE)]
+            if value != default]
         if ignored:
             warnings.warn('reader_pool was supplied; ignoring pool-shape arguments {} '
                           '(the pre-built pool defines its own shape)'.format(ignored))

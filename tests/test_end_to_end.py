@@ -121,6 +121,45 @@ def test_sharding_disjoint_and_complete(synthetic_dataset):
     assert sorted(ids) == sorted(r['id'] for r in synthetic_dataset.rows)
 
 
+@pytest.mark.parametrize('shard_count', [2, 3, 5, 7])
+def test_sharding_property_disjoint_and_complete(synthetic_dataset, shard_count):
+    """For every shard_count: shards pairwise disjoint, union == whole store (model:
+    reference test_end_to_end.py multi-shard coverage assertions)."""
+    shards = []
+    for shard in range(shard_count):
+        try:
+            with _reader(synthetic_dataset.url, cur_shard=shard,
+                         shard_count=shard_count, shuffle_row_groups=False) as reader:
+                shards.append({row.id for row in reader})
+        except NoDataAvailableError:
+            shards.append(set())  # legitimate when rowgroups < shard_count
+    for i in range(shard_count):
+        for j in range(i + 1, shard_count):
+            assert not (shards[i] & shards[j]), \
+                'shards {} and {} overlap'.format(i, j)
+    assert set().union(*shards) == {r['id'] for r in synthetic_dataset.rows}
+
+
+def test_sharding_seed_changes_assignment(synthetic_dataset):
+    def shard0_ids(seed):
+        with _reader(synthetic_dataset.url, cur_shard=0, shard_count=2,
+                     shard_seed=seed, shuffle_row_groups=False) as reader:
+            return sorted(row.id for row in reader)
+    by_seed = {seed: shard0_ids(seed) for seed in (1, 2, 3, 4, 5)}
+    assert len({tuple(v) for v in by_seed.values()}) > 1, \
+        'different shard seeds never changed the shard-0 rowgroup assignment'
+
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_sharding_over_all_pools(synthetic_dataset, pool):
+    ids = []
+    for shard in range(2):
+        with _reader(synthetic_dataset.url, reader_pool_type=pool, cur_shard=shard,
+                     shard_count=2, shuffle_row_groups=False) as reader:
+            ids.extend(row.id for row in reader)
+    assert sorted(ids) == sorted(r['id'] for r in synthetic_dataset.rows)
+
+
 def test_sharding_seeded_shuffle_deterministic(synthetic_dataset):
     def read_shard():
         with _reader(synthetic_dataset.url, cur_shard=0, shard_count=2, shard_seed=123,

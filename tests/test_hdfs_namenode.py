@@ -1,0 +1,480 @@
+"""Dedicated HDFS namenode-resolution + HA-failover tests with programmable mock
+connectors (model: reference petastorm/hdfs/tests/test_hdfs_namenode.py:42,265,309 —
+resolver matrix, env-var conf discovery, connect failover counts, HA client behavior).
+No HDFS cluster is ever touched: connections are mocks with scripted failure counts.
+"""
+import os
+import pickle
+
+import pytest
+
+from petastorm_tpu.fs_utils import _resolve_hdfs
+from petastorm_tpu.hdfs.namenode import (
+    HAHdfsClient, HdfsConfigError, HdfsConnectError, HdfsConnector,
+    HdfsNamenodeResolver, namenode_failover)
+
+HA_CONFIG = {
+    'fs.defaultFS': 'hdfs://nameservice1',
+    'dfs.nameservices': 'nameservice1,ns2',
+    'dfs.ha.namenodes.nameservice1': 'nn1,nn2',
+    'dfs.namenode.rpc-address.nameservice1.nn1': 'nn1.example.com:8020',
+    'dfs.namenode.rpc-address.nameservice1.nn2': 'nn2.example.com:8020',
+    'dfs.ha.namenodes.ns2': 'a,b,c',
+    'dfs.namenode.rpc-address.ns2.a': 'a:8020',
+    'dfs.namenode.rpc-address.ns2.b': 'b:8020',
+    'dfs.namenode.rpc-address.ns2.c': 'c:8020',
+}
+
+
+class MockHdfs(object):
+    """Filesystem stand-in whose operations fail for the first ``n_failovers`` calls
+    (model: reference MockHdfs, test_hdfs_namenode.py:265-306)."""
+
+    def __init__(self, n_failovers=0):
+        self.n_failovers = n_failovers
+        self.calls = 0
+
+    def get_file_info(self, path):
+        self.calls += 1
+        if self.n_failovers > 0:
+            self.n_failovers -= 1
+            raise OSError('scripted failure ({} left)'.format(self.n_failovers))
+        return 'info:{}'.format(path)
+
+    @property
+    def type_name(self):
+        return 'mockhdfs'
+
+
+class MockHdfsConnector(HdfsConnector):
+    """Connector whose namenode connections fail a scripted number of times per
+    address (model: reference MockHdfsConnector, test_hdfs_namenode.py:309-355)."""
+
+    _fail_n_next_connect = {}
+    connect_attempts = []
+
+    @classmethod
+    def reset(cls):
+        cls._fail_n_next_connect = {}
+        cls.connect_attempts = []
+
+    @classmethod
+    def set_fail_n_next_connect(cls, address, count):
+        cls._fail_n_next_connect[address] = count
+
+    @classmethod
+    def hdfs_connect_namenode(cls, address, user=None):
+        cls.connect_attempts.append((address, user))
+        remaining = cls._fail_n_next_connect.get(address, 0)
+        if remaining > 0:
+            cls._fail_n_next_connect[address] = remaining - 1
+            raise IOError('namenode {} down'.format(address))
+        return MockHdfs()
+
+
+@pytest.fixture(autouse=True)
+def _reset_mock_connector():
+    MockHdfsConnector.reset()
+    yield
+    MockHdfsConnector.reset()
+
+
+class TestResolverDefaultService:
+    def test_typical_ha_default(self):
+        service, namenodes = HdfsNamenodeResolver(HA_CONFIG).resolve_default_hdfs_service()
+        assert service == 'nameservice1'
+        assert namenodes == ['nn1.example.com:8020', 'nn2.example.com:8020']
+
+    def test_missing_default_fs(self):
+        with pytest.raises(HdfsConfigError):
+            HdfsNamenodeResolver({}).resolve_default_hdfs_service()
+
+    def test_non_hdfs_default_fs(self):
+        config = {'fs.defaultFS': 'file:///tmp'}
+        with pytest.raises(HdfsConfigError):
+            HdfsNamenodeResolver(config).resolve_default_hdfs_service()
+
+    def test_default_fs_with_path_suffix(self):
+        config = dict(HA_CONFIG, **{'fs.defaultFS': 'hdfs://nameservice1/user/me'})
+        service, namenodes = HdfsNamenodeResolver(config).resolve_default_hdfs_service()
+        assert service == 'nameservice1'
+        assert len(namenodes) == 2
+
+
+class TestResolverNameService:
+    def test_ha_pair(self):
+        resolver = HdfsNamenodeResolver(HA_CONFIG)
+        assert resolver.resolve_hdfs_name_service('nameservice1') == \
+            ['nn1.example.com:8020', 'nn2.example.com:8020']
+
+    def test_more_than_max_namenodes_truncated(self):
+        resolver = HdfsNamenodeResolver(HA_CONFIG)
+        assert resolver.resolve_hdfs_name_service('ns2') == ['a:8020', 'b:8020']
+
+    def test_unknown_service_is_direct_host(self):
+        resolver = HdfsNamenodeResolver(HA_CONFIG)
+        assert resolver.resolve_hdfs_name_service('plainhost:9000') == ['plainhost:9000']
+
+    def test_empty_nameservice_raises(self):
+        with pytest.raises(HdfsConfigError):
+            HdfsNamenodeResolver(HA_CONFIG).resolve_hdfs_name_service('')
+
+    def test_declared_service_without_namenode_list_raises(self):
+        config = dict(HA_CONFIG)
+        del config['dfs.ha.namenodes.nameservice1']
+        with pytest.raises(HdfsConfigError):
+            HdfsNamenodeResolver(config).resolve_hdfs_name_service('nameservice1')
+
+    def test_declared_service_missing_rpc_address_raises(self):
+        config = dict(HA_CONFIG)
+        del config['dfs.namenode.rpc-address.nameservice1.nn2']
+        with pytest.raises(HdfsConfigError):
+            HdfsNamenodeResolver(config).resolve_hdfs_name_service('nameservice1')
+
+
+def _write_hadoop_conf(home, core_site=None, hdfs_site=None):
+    conf_dir = os.path.join(str(home), 'etc', 'hadoop')
+    os.makedirs(conf_dir, exist_ok=True)
+
+    def write(file_name, properties):
+        body = ''.join(
+            '<property><name>{}</name><value>{}</value></property>'.format(k, v)
+            for k, v in properties.items())
+        with open(os.path.join(conf_dir, file_name), 'w') as f:
+            f.write('<configuration>{}</configuration>'.format(body))
+
+    if core_site is not None:
+        write('core-site.xml', core_site)
+    if hdfs_site is not None:
+        write('hdfs-site.xml', hdfs_site)
+
+
+class TestEnvConfigDiscovery:
+    """Hadoop conf located via HADOOP_HOME / HADOOP_PREFIX / HADOOP_INSTALL (model:
+    reference test_hdfs_namenode.py:201-259)."""
+
+    CORE = {'fs.defaultFS': 'hdfs://envservice'}
+    HDFS = {
+        'dfs.nameservices': 'envservice',
+        'dfs.ha.namenodes.envservice': 'nn1,nn2',
+        'dfs.namenode.rpc-address.envservice.nn1': 'env1:8020',
+        'dfs.namenode.rpc-address.envservice.nn2': 'env2:8020',
+    }
+
+    @pytest.fixture(autouse=True)
+    def _clean_env(self, monkeypatch):
+        for var in ('HADOOP_HOME', 'HADOOP_PREFIX', 'HADOOP_INSTALL',
+                    'HADOOP_CONF_DIR'):
+            monkeypatch.delenv(var, raising=False)
+
+    @pytest.mark.parametrize('var', ['HADOOP_HOME', 'HADOOP_PREFIX', 'HADOOP_INSTALL'])
+    def test_each_env_var_is_honored(self, tmp_path, monkeypatch, var):
+        _write_hadoop_conf(tmp_path, core_site=self.CORE, hdfs_site=self.HDFS)
+        monkeypatch.setenv(var, str(tmp_path))
+        service, namenodes = HdfsNamenodeResolver().resolve_default_hdfs_service()
+        assert service == 'envservice'
+        assert namenodes == ['env1:8020', 'env2:8020']
+
+    def test_hadoop_conf_dir_points_at_conf_directly(self, tmp_path, monkeypatch):
+        conf_dir = tmp_path / 'conf-only'
+        _write_hadoop_conf(conf_dir, core_site=self.CORE, hdfs_site=self.HDFS)
+        monkeypatch.setenv('HADOOP_CONF_DIR',
+                           str(conf_dir / 'etc' / 'hadoop'))
+        service, _ = HdfsNamenodeResolver().resolve_default_hdfs_service()
+        assert service == 'envservice'
+
+    def test_hadoop_conf_dir_wins_over_hadoop_home(self, tmp_path, monkeypatch):
+        primary = tmp_path / 'primary'
+        other = tmp_path / 'other'
+        _write_hadoop_conf(primary, core_site=self.CORE, hdfs_site=self.HDFS)
+        _write_hadoop_conf(other, core_site={'fs.defaultFS': 'hdfs://otherservice'})
+        monkeypatch.setenv('HADOOP_CONF_DIR', str(primary / 'etc' / 'hadoop'))
+        monkeypatch.setenv('HADOOP_HOME', str(other))
+        service, _ = HdfsNamenodeResolver().resolve_default_hdfs_service()
+        assert service == 'envservice'
+
+    def test_first_populated_var_wins(self, tmp_path, monkeypatch):
+        good = tmp_path / 'good'
+        other = tmp_path / 'other'
+        _write_hadoop_conf(good, core_site=self.CORE, hdfs_site=self.HDFS)
+        _write_hadoop_conf(other, core_site={'fs.defaultFS': 'hdfs://otherservice'})
+        monkeypatch.setenv('HADOOP_HOME', str(good))
+        monkeypatch.setenv('HADOOP_INSTALL', str(other))
+        service, _ = HdfsNamenodeResolver().resolve_default_hdfs_service()
+        assert service == 'envservice'
+
+    def test_bad_home_falls_through_to_next_var(self, tmp_path, monkeypatch):
+        _write_hadoop_conf(tmp_path, core_site=self.CORE, hdfs_site=self.HDFS)
+        monkeypatch.setenv('HADOOP_HOME', str(tmp_path / 'does-not-exist'))
+        monkeypatch.setenv('HADOOP_INSTALL', str(tmp_path))
+        service, _ = HdfsNamenodeResolver().resolve_default_hdfs_service()
+        assert service == 'envservice'
+
+    def test_no_conf_files_yields_empty_config(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('HADOOP_HOME', str(tmp_path))
+        with pytest.raises(HdfsConfigError):
+            HdfsNamenodeResolver().resolve_default_hdfs_service()
+
+    def test_hdfs_site_only(self, tmp_path, monkeypatch):
+        _write_hadoop_conf(tmp_path, hdfs_site=dict(self.HDFS, **self.CORE))
+        monkeypatch.setenv('HADOOP_HOME', str(tmp_path))
+        service, namenodes = HdfsNamenodeResolver().resolve_default_hdfs_service()
+        assert service == 'envservice'
+        assert len(namenodes) == 2
+
+
+class TestConnectFailover:
+    """connect_to_either_namenode retry/failover accounting (model: reference
+    test_hdfs_namenode.py:370-419)."""
+
+    NODES = ['nn1:8020', 'nn2:8020']
+
+    def test_first_namenode_ok(self):
+        fs = MockHdfsConnector.connect_to_either_namenode(self.NODES)
+        assert isinstance(fs, MockHdfs)
+        assert MockHdfsConnector.connect_attempts == [('nn1:8020', None)]
+
+    def test_user_is_threaded_through(self):
+        MockHdfsConnector.connect_to_either_namenode(self.NODES, user='alice')
+        assert MockHdfsConnector.connect_attempts == [('nn1:8020', 'alice')]
+
+    def test_one_failure_retries_same_namenode(self):
+        MockHdfsConnector.set_fail_n_next_connect('nn1:8020', 1)
+        fs = MockHdfsConnector.connect_to_either_namenode(self.NODES)
+        assert isinstance(fs, MockHdfs)
+        addresses = [a for a, _ in MockHdfsConnector.connect_attempts]
+        assert addresses == ['nn1:8020', 'nn1:8020']
+
+    def test_two_failures_fail_over_to_second(self):
+        MockHdfsConnector.set_fail_n_next_connect('nn1:8020', 2)
+        fs = MockHdfsConnector.connect_to_either_namenode(self.NODES)
+        assert isinstance(fs, MockHdfs)
+        addresses = [a for a, _ in MockHdfsConnector.connect_attempts]
+        assert addresses == ['nn1:8020', 'nn1:8020', 'nn2:8020']
+
+    def test_four_failures_raise(self):
+        MockHdfsConnector.set_fail_n_next_connect('nn1:8020', 2)
+        MockHdfsConnector.set_fail_n_next_connect('nn2:8020', 2)
+        with pytest.raises(HdfsConnectError):
+            MockHdfsConnector.connect_to_either_namenode(self.NODES)
+        assert len(MockHdfsConnector.connect_attempts) == 4
+
+
+class TestTryNextNamenode:
+    def test_round_robin_from_fresh(self):
+        idx, fs = MockHdfsConnector._try_next_namenode(-1, ['a:1', 'b:2'])
+        assert idx == 0 and isinstance(fs, MockHdfs)
+
+    def test_round_robin_advances_past_current(self):
+        MockHdfsConnector.set_fail_n_next_connect('b:2', 1)
+        idx, _ = MockHdfsConnector._try_next_namenode(0, ['a:1', 'b:2'])
+        # b (next after a) fails once, wraps around to a.
+        assert idx == 0
+        addresses = [a for a, _ in MockHdfsConnector.connect_attempts]
+        assert addresses == ['b:2', 'a:1']
+
+    def test_all_down_raises(self):
+        MockHdfsConnector.set_fail_n_next_connect('a:1', 5)
+        MockHdfsConnector.set_fail_n_next_connect('b:2', 5)
+        with pytest.raises(HdfsConnectError):
+            MockHdfsConnector._try_next_namenode(-1, ['a:1', 'b:2'])
+
+
+class TestHAHdfsClient:
+    """HA proxy semantics (model: reference HAHdfsClientTest,
+    test_hdfs_namenode.py:422-539)."""
+
+    NODES = ['nn1:8020', 'nn2:8020']
+
+    def test_connect_ha_returns_proxy(self):
+        client = MockHdfsConnector.connect_ha(self.NODES)
+        assert isinstance(client, HAHdfsClient)
+        assert isinstance(client.unwrap(), MockHdfs)
+
+    def test_empty_namenode_list_raises(self):
+        with pytest.raises(HdfsConnectError):
+            MockHdfsConnector.connect_ha([])
+
+    def test_operation_passthrough(self):
+        client = MockHdfsConnector.connect_ha(self.NODES)
+        assert client.get_file_info('/x') == 'info:/x'
+
+    def test_non_callable_attribute_passthrough(self):
+        client = MockHdfsConnector.connect_ha(self.NODES)
+        assert client.type_name == 'mockhdfs'
+
+    def test_operation_failover_reconnects_to_next_namenode(self):
+        client = MockHdfsConnector.connect_ha(self.NODES)
+        client.unwrap().n_failovers = 1
+        first_fs = client.unwrap()
+        assert client.get_file_info('/x') == 'info:/x'
+        assert client.unwrap() is not first_fs
+        addresses = [a for a, _ in MockHdfsConnector.connect_attempts]
+        assert addresses == ['nn1:8020', 'nn2:8020']
+
+    def test_two_consecutive_failures_propagate(self):
+        client = MockHdfsConnector.connect_ha(self.NODES)
+
+        class AlwaysDown(MockHdfs):
+            def get_file_info(self, path):
+                raise OSError('down forever')
+
+        client._filesystem = AlwaysDown()
+        original_connect = MockHdfsConnector.hdfs_connect_namenode
+        try:
+            MockHdfsConnector.hdfs_connect_namenode = classmethod(
+                lambda cls, address, user=None: AlwaysDown())
+            with pytest.raises(OSError):
+                client.get_file_info('/x')
+        finally:
+            MockHdfsConnector.hdfs_connect_namenode = original_connect
+
+    def test_file_semantic_oserror_is_not_failed_over(self):
+        # FileNotFoundError describes the file, not the connection: no reconnect,
+        # no duplicate attempt.
+        client = MockHdfsConnector.connect_ha(self.NODES)
+
+        class MissingFs(MockHdfs):
+            def get_file_info(self, path):
+                self.calls += 1
+                raise FileNotFoundError(path)
+
+        fs = MissingFs()
+        client._filesystem = fs
+        with pytest.raises(FileNotFoundError):
+            client.get_file_info('/gone')
+        assert fs.calls == 1
+        assert len(MockHdfsConnector.connect_attempts) == 1  # only the initial connect
+
+    def test_unhandled_exception_is_not_retried(self):
+        client = MockHdfsConnector.connect_ha(self.NODES)
+
+        class TypeErrorFs(MockHdfs):
+            def get_file_info(self, path):
+                self.calls += 1
+                raise TypeError('not an OSError')
+
+        broken = TypeErrorFs()
+        client._filesystem = broken
+        with pytest.raises(TypeError):
+            client.get_file_info('/x')
+        assert broken.calls == 1
+
+    def test_client_pickles_correctly(self):
+        client = MockHdfsConnector.connect_ha(self.NODES, user='bob')
+        restored = pickle.loads(pickle.dumps(client))
+        assert isinstance(restored, HAHdfsClient)
+        assert restored._namenode_addresses == self.NODES
+        assert restored._user == 'bob'
+        assert restored.get_file_info('/y') == 'info:/y'
+
+    def test_private_attribute_access_raises(self):
+        client = MockHdfsConnector.connect_ha(self.NODES)
+        with pytest.raises(AttributeError):
+            client._does_not_exist  # noqa: B018
+
+
+class TestNamenodeFailoverDecorator:
+    def test_retries_once_with_reconnect(self):
+        class Client:
+            def __init__(self):
+                self.reconnects = 0
+                self.attempts = 0
+
+            def reconnect(self):
+                self.reconnects += 1
+
+            @namenode_failover
+            def op(self):
+                self.attempts += 1
+                if self.attempts == 1:
+                    raise OSError('transient')
+                return 'ok'
+
+        client = Client()
+        assert client.op() == 'ok'
+        assert client.reconnects == 1
+
+    def test_second_failure_propagates(self):
+        class Client:
+            @namenode_failover
+            def op(self):
+                raise OSError('hard down')
+
+        with pytest.raises(OSError):
+            Client().op()
+
+    def test_file_not_found_is_not_retried(self):
+        class Client:
+            attempts = 0
+
+            @namenode_failover
+            def op(self):
+                Client.attempts += 1
+                raise FileNotFoundError('/gone')
+
+        with pytest.raises(FileNotFoundError):
+            Client().op()
+        assert Client.attempts == 1
+
+
+class TestFsUtilsHdfsRouting:
+    """_resolve_hdfs dispatch: host:port direct, nameservice via failover, hostless via
+    fs.defaultFS (reference: petastorm/fs_utils.py:82-130)."""
+
+    @pytest.fixture(autouse=True)
+    def _conf_env(self, tmp_path, monkeypatch):
+        _write_hadoop_conf(
+            tmp_path,
+            core_site={'fs.defaultFS': 'hdfs://routed'},
+            hdfs_site={
+                'dfs.nameservices': 'routed',
+                'dfs.ha.namenodes.routed': 'nn1,nn2',
+                'dfs.namenode.rpc-address.routed.nn1': 'r1:8020',
+                'dfs.namenode.rpc-address.routed.nn2': 'r2:8020',
+            })
+        for var in ('HADOOP_PREFIX', 'HADOOP_INSTALL', 'HADOOP_CONF_DIR'):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv('HADOOP_HOME', str(tmp_path))
+
+    @pytest.fixture(autouse=True)
+    def _capture_connections(self, monkeypatch):
+        self.direct = []
+        self.failover = []
+
+        import pyarrow.fs as pafs
+
+        def fake_direct(host, port, user=None, **kwargs):
+            self.direct.append((host, port))
+            return 'direct-fs'
+
+        def fake_failover(namenodes, user=None):
+            self.failover.append(list(namenodes))
+            return 'ha-fs'
+
+        monkeypatch.setattr(pafs, 'HadoopFileSystem', fake_direct)
+        monkeypatch.setattr(HdfsConnector, 'connect_to_either_namenode',
+                            classmethod(lambda cls, nodes, user=None: fake_failover(nodes)))
+
+    def test_host_port_connects_directly(self):
+        assert _resolve_hdfs('hdfs://somehost:9000/ds') == 'direct-fs'
+        assert self.direct == [('somehost', 9000)]
+        assert self.failover == []
+
+    def test_nameservice_routes_through_failover(self):
+        assert _resolve_hdfs('hdfs://routed/ds') == 'ha-fs'
+        assert self.failover == [['r1:8020', 'r2:8020']]
+
+    def test_hostless_uses_default_fs(self):
+        assert _resolve_hdfs('hdfs:///ds') == 'ha-fs'
+        assert self.failover == [['r1:8020', 'r2:8020']]
+
+    def test_portless_unknown_host_is_single_namenode(self):
+        assert _resolve_hdfs('hdfs://lonehost/ds') == 'ha-fs'
+        assert self.failover == [['lonehost']]
+
+    def test_no_hadoop_config_falls_back_to_libhdfs_default(self, monkeypatch):
+        # Port 0 lets libhdfs do its own core-site.xml / logical-nameservice lookup.
+        monkeypatch.setenv('HADOOP_HOME', '/nonexistent-hadoop')
+        assert _resolve_hdfs('hdfs:///ds') == 'direct-fs'
+        assert self.direct == [('default', 0)]

@@ -1,0 +1,162 @@
+"""Dedicated WeightedSamplingReader tests (model: reference
+petastorm/tests/test_weighted_sampling_reader.py — mixing ratios, schema/mode
+validation, stop semantics), using stub readers plus one real-reader e2e."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+
+class StubReader(object):
+    """Minimal reader double emitting a tagged stream."""
+
+    def __init__(self, tag, num_rows=None, fields=('id',), batched=False, ngram=None):
+        self.tag = tag
+        self.is_batched_reader = batched
+        self.ngram = ngram
+        self.last_row_consumed = False
+        self.stopped = False
+        self.joined = False
+        self.resets = 0
+        self._emitted = 0
+        self._num_rows = num_rows
+        self.result_schema = type('S', (), {'fields': {f: None for f in fields}})()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._num_rows is not None and self._emitted >= self._num_rows:
+            self.last_row_consumed = True
+            raise StopIteration
+        self._emitted += 1
+        return self.tag
+
+    def reset(self):
+        self.resets += 1
+        self._emitted = 0
+        self.last_row_consumed = False
+
+    def stop(self):
+        self.stopped = True
+
+    def join(self):
+        self.joined = True
+
+
+class TestValidation:
+    def test_empty_readers_rejected(self):
+        with pytest.raises(ValueError):
+            WeightedSamplingReader([], [])
+
+    def test_length_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            WeightedSamplingReader([StubReader('a')], [0.5, 0.5])
+
+    def test_negative_probability_rejected(self):
+        with pytest.raises(ValueError):
+            WeightedSamplingReader([StubReader('a'), StubReader('b')], [0.5, -0.1])
+
+    def test_all_zero_probabilities_rejected(self):
+        with pytest.raises(ValueError):
+            WeightedSamplingReader([StubReader('a'), StubReader('b')], [0, 0])
+
+    def test_mismatched_fields_rejected(self):
+        with pytest.raises(ValueError):
+            WeightedSamplingReader(
+                [StubReader('a', fields=('x',)), StubReader('b', fields=('y',))],
+                [0.5, 0.5])
+
+    def test_mismatched_batched_mode_rejected(self):
+        with pytest.raises(ValueError):
+            WeightedSamplingReader(
+                [StubReader('a', batched=True), StubReader('b', batched=False)],
+                [0.5, 0.5])
+
+    def test_mismatched_ngram_rejected(self):
+        with pytest.raises(ValueError):
+            WeightedSamplingReader(
+                [StubReader('a', ngram='spec1'), StubReader('b', ngram=None)],
+                [0.5, 0.5])
+
+    def test_matching_ngram_accepted(self):
+        mixed = WeightedSamplingReader(
+            [StubReader('a', ngram='spec'), StubReader('b', ngram='spec')], [1, 1])
+        assert mixed.ngram == 'spec'
+
+
+class TestMixing:
+    def test_ratios_approximate_probabilities(self):
+        readers = [StubReader('a'), StubReader('b')]
+        mixed = WeightedSamplingReader(readers, [0.8, 0.2], seed=0)
+        draws = [next(mixed) for _ in range(4000)]
+        frac_a = draws.count('a') / len(draws)
+        assert 0.75 < frac_a < 0.85
+
+    def test_probabilities_are_normalized(self):
+        readers = [StubReader('a'), StubReader('b')]
+        mixed = WeightedSamplingReader(readers, [8, 2], seed=0)
+        draws = [next(mixed) for _ in range(4000)]
+        assert 0.75 < draws.count('a') / len(draws) < 0.85
+
+    def test_zero_probability_reader_never_drawn(self):
+        readers = [StubReader('a'), StubReader('b')]
+        mixed = WeightedSamplingReader(readers, [1.0, 0.0], seed=3)
+        assert all(next(mixed) == 'a' for _ in range(500))
+
+    def test_seeded_draw_sequence_reproducible(self):
+        def run():
+            mixed = WeightedSamplingReader(
+                [StubReader('a'), StubReader('b')], [0.5, 0.5], seed=123)
+            return [next(mixed) for _ in range(100)]
+        assert run() == run()
+
+    def test_stops_when_any_reader_exhausts(self):
+        readers = [StubReader('a', num_rows=5), StubReader('b')]
+        mixed = WeightedSamplingReader(readers, [0.9, 0.1], seed=0)
+        drawn = list(mixed)
+        assert drawn.count('a') == 5
+
+    def test_single_reader_passthrough(self):
+        mixed = WeightedSamplingReader([StubReader('a', num_rows=3)], [1.0], seed=0)
+        assert list(mixed) == ['a', 'a', 'a']
+
+
+class TestLifecycle:
+    def test_stop_join_propagate_to_all(self):
+        readers = [StubReader('a'), StubReader('b')]
+        with WeightedSamplingReader(readers, [0.5, 0.5]) as mixed:
+            next(mixed)
+        assert all(r.stopped and r.joined for r in readers)
+
+    def test_partial_reset_only_restarts_exhausted(self):
+        exhausted = StubReader('a', num_rows=2)
+        ongoing = StubReader('b')
+        mixed = WeightedSamplingReader([exhausted, ongoing], [0.9, 0.1], seed=0)
+        list(mixed)
+        assert exhausted.last_row_consumed
+        mixed.reset()
+        assert exhausted.resets == 1
+        assert ongoing.resets == 0
+
+    def test_properties_delegate_to_first_reader(self):
+        readers = [StubReader('a', batched=False), StubReader('b', batched=False)]
+        mixed = WeightedSamplingReader(readers, [1, 1])
+        assert mixed.is_batched_reader is False
+        assert mixed.result_schema is readers[0].result_schema
+        assert mixed.ngram is None
+        assert mixed.last_row_consumed is False
+
+
+def test_real_readers_mixed_row_set(synthetic_dataset):
+    """e2e: two shards of the same store mixed 50/50 never invent or lose row ids."""
+    from petastorm_tpu.reader import make_reader
+    r1 = make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     cur_shard=0, shard_count=2, shuffle_row_groups=False)
+    r2 = make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     cur_shard=1, shard_count=2, shuffle_row_groups=False)
+    all_ids = {r['id'] for r in synthetic_dataset.rows}
+    with WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=0) as mixed:
+        seen = {row.id for row in mixed}
+    assert seen <= all_ids
+    assert len(seen) > 0
