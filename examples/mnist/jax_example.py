@@ -68,6 +68,36 @@ def train(dataset_url, batch_size=128, epochs=1, learning_rate=1e-3,
     return params, float(loss), float(accuracy)
 
 
+def train_inmem(dataset_url, batch_size=128, epochs=1, learning_rate=1e-3):
+    """The recommended configuration for fits-in-HBM datasets: fill once, then run
+    each epoch — shuffle, gather, and every train step — as ONE compiled program via
+    ``InMemJaxLoader.scan_epochs`` (zero host involvement after the fill)."""
+    from petastorm_tpu.parallel import InMemJaxLoader
+
+    model = MnistCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))['params']
+    optimizer = optax.adam(learning_rate)
+    opt_state = optimizer.init(params)
+    train_step = make_train_step(model, optimizer)
+
+    reader = make_reader('{}/train'.format(dataset_url.rstrip('/')), num_epochs=1,
+                         transform_spec=TRANSFORM)
+    loader = InMemJaxLoader(reader, batch_size=batch_size, num_epochs=None, seed=42)
+
+    def step(carry, batch):
+        params, opt_state = carry
+        params, opt_state, loss, accuracy = train_step(
+            params, opt_state, batch['image'], batch['digit'])
+        return (params, opt_state), (loss, accuracy)
+
+    (params, opt_state), per_epoch = loader.scan_epochs(
+        step, (params, opt_state), num_epochs=epochs)
+    for epoch, (losses, accs) in enumerate(per_epoch):
+        print('epoch {}: loss {:.4f} acc {:.3f}'.format(
+            epoch, float(losses[-1]), float(accs[-1])))
+    return params, float(per_epoch[-1][0][-1]), float(per_epoch[-1][1][-1])
+
+
 def evaluate(params, dataset_url, batch_size=128):
     model = MnistCNN()
 
@@ -94,9 +124,13 @@ def main():
     parser.add_argument('--batch-size', type=int, default=128)
     parser.add_argument('--epochs', type=int, default=1)
     parser.add_argument('--learning-rate', type=float, default=1e-3)
+    parser.add_argument('--inmem', action='store_true',
+                        help='HBM-resident epochs via InMemJaxLoader.scan_epochs '
+                             '(recommended when the dataset fits in HBM)')
     args = parser.parse_args()
-    params, _, _ = train(args.dataset_url, batch_size=args.batch_size,
-                         epochs=args.epochs, learning_rate=args.learning_rate)
+    train_fn = train_inmem if args.inmem else train
+    params, _, _ = train_fn(args.dataset_url, batch_size=args.batch_size,
+                            epochs=args.epochs, learning_rate=args.learning_rate)
     evaluate(params, args.dataset_url, batch_size=args.batch_size)
 
 

@@ -23,7 +23,8 @@ import pyarrow.dataset as pads
 import pyarrow.parquet as pq
 
 from petastorm_tpu.errors import MetadataError
-from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths, path_exists
+from petastorm_tpu.fs_utils import (as_arrow_filesystem,
+                                    get_filesystem_and_path_or_paths, path_exists)
 from petastorm_tpu.unischema import Unischema, dict_to_encoded_row
 
 logger = logging.getLogger(__name__)
@@ -107,8 +108,8 @@ def open_dataset(dataset_url_or_urls, storage_options=None, filesystem=None):
     default ``ignore_prefixes``."""
     fs, path_or_paths = get_filesystem_and_path_or_paths(
         dataset_url_or_urls, storage_options=storage_options, filesystem=filesystem)
-    arrow_dataset = pads.dataset(path_or_paths, filesystem=fs, format='parquet',
-                                 partitioning='hive')
+    arrow_dataset = pads.dataset(path_or_paths, filesystem=as_arrow_filesystem(fs),
+                                 format='parquet', partitioning='hive')
     return DatasetHandle(fs, path_or_paths, arrow_dataset)
 
 
@@ -226,7 +227,8 @@ def materialize_dataset(dataset_url, schema, rowgroup_size_mb=DEFAULT_ROW_GROUP_
     yield
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options=storage_options,
                                                 filesystem=filesystem)
-    arrow_dataset = pads.dataset(path, filesystem=fs, format='parquet', partitioning='hive')
+    arrow_dataset = pads.dataset(path, filesystem=as_arrow_filesystem(fs),
+                                 format='parquet', partitioning='hive')
     handle = DatasetHandle(fs, path, arrow_dataset)
     row_groups_map = _scan_row_groups_per_file(handle)
     metadata = {

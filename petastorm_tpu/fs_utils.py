@@ -91,6 +91,11 @@ def _resolve_hdfs(url):
         # libhdfs with port 0 so it applies its own core-site.xml lookup — the
         # authority may be a logical HA nameservice only libhdfs can resolve.
         return pafs.HadoopFileSystem(parsed.hostname or 'default', parsed.port or 0)
+    if len(namenodes) > 1:
+        # HA nameservice: return the failover proxy so metadata operations made
+        # through this object retry on the standby mid-job. Arrow C++ consumers
+        # unwrap it via as_arrow_filesystem().
+        return HdfsConnector.connect_ha(namenodes)
     return HdfsConnector.connect_to_either_namenode(namenodes)
 
 
@@ -116,6 +121,14 @@ def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None, filesyst
     if isinstance(url_or_urls, (list, tuple)):
         return filesystem, paths
     return filesystem, paths[0]
+
+
+def as_arrow_filesystem(filesystem):
+    """The real pyarrow filesystem behind ``filesystem`` — unwraps failover proxies
+    (``HAHdfsClient``) for APIs that require a C++ ``pyarrow.fs.FileSystem`` instance
+    (``pyarrow.dataset`` etc.). Plain filesystems pass through."""
+    unwrap = getattr(filesystem, 'unwrap', None)
+    return unwrap() if callable(unwrap) else filesystem
 
 
 def path_exists(filesystem, path):

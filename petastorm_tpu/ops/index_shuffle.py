@@ -1,0 +1,94 @@
+"""Materialization-free random index permutation (TPU-native epoch shuffling).
+
+``jax.random.permutation(key, n)`` compiles to a sort — ~50 ms for n=50k on a v5e,
+which can rival the *training compute* of an entire small-model epoch. TPUs are
+systolic-array machines; sorting is their weakest op. This module provides the
+standard alternative (the trick behind tf.random_index_shuffle): a **cycle-walking
+Feistel cipher** over ``[0, n)`` — a keyed bijection evaluated *pointwise*, so a batch
+of B positions costs O(B) elementwise uint32 ops, nothing is materialized, and the
+permutation for any batch of any epoch is computed on demand inside the same compiled
+program that consumes it.
+
+Construction: round up the domain to ``2^k`` with ``k = ceil(log2 n)`` exactly, split
+indices into a high ``k//2``-bit half and a low ``k - k//2``-bit half, and run a fixed
+number of *alternating* Feistel rounds (odd/even rounds mix opposite halves — the
+alternating form keeps the bijection for unequal half widths, so ``k`` never needs
+rounding up to even and the domain stays ``< 2n``). The round function is murmur-style
+keyed mixing in uint32 wraparound arithmetic. Values landing in ``[n, 2^k)``
+cycle-walk by re-encrypting until they fall below ``n`` — expected < 2 walks since
+``2^k < 2n``. Each round key derives from a ``jax.random`` key, so the permutation is
+seeded and reproducible like the sort it replaces.
+
+No reference analog: petastorm shuffles with numpy/torch permutations on the host
+(reference: reader_impl/shuffling_buffer.py:116-140, pytorch.py:464-489).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_DEFAULT_ROUNDS = 4
+
+
+def _round_fn(value, round_key, mask):
+    """Murmur3-style mixing of one Feistel half under a round key (uint32 wrap)."""
+    h = (value ^ round_key) * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h & mask
+
+
+def _encrypt(x, round_keys, right_bits, left_mask, right_mask):
+    # Alternating Feistel over unequal halves: XOR-ing one half with a keyed hash of
+    # the other is invertible regardless of widths, so any k works (no even-k padding
+    # of the domain).
+    left = (x >> right_bits) & left_mask
+    right = x & right_mask
+    for i, round_key in enumerate(round_keys):
+        if i % 2 == 0:
+            left = left ^ _round_fn(right, round_key, left_mask)
+        else:
+            right = right ^ _round_fn(left, round_key, right_mask)
+    return (left << right_bits) | right
+
+
+def random_index_shuffle(positions, key, n, rounds=_DEFAULT_ROUNDS):
+    """Map ``positions`` in ``[0, n)`` through a seeded pseudorandom permutation of
+    ``[0, n)``, elementwise — the TPU-friendly replacement for indexing into
+    ``jax.random.permutation(key, n)``.
+
+    :param positions: int array of indices in ``[0, n)`` (any shape).
+    :param key: ``jax.random`` PRNG key selecting the permutation.
+    :param n: domain size (python int; static under jit).
+    :param rounds: Feistel rounds (4 is plenty for decorrelation; not crypto).
+    :return: int32 array, same shape: ``perm[positions]`` of a full permutation.
+    """
+    n = int(n)
+    if n < 1:
+        raise ValueError('n must be >= 1')
+    if n == 1:
+        return jnp.zeros_like(jnp.asarray(positions, jnp.int32))
+    k = max(1, int(np.ceil(np.log2(n))))
+    left_bits = k // 2
+    right_bits = jnp.uint32(k - left_bits)       # >= left_bits; k never padded
+    left_mask = jnp.uint32((1 << left_bits) - 1)
+    right_mask = jnp.uint32((1 << (k - left_bits)) - 1)
+    round_keys = list(jax.random.randint(
+        key, (rounds,), 0, np.iinfo(np.int32).max, dtype=jnp.int32).astype(jnp.uint32))
+    x = jnp.asarray(positions).astype(jnp.uint32)
+    limit = jnp.uint32(n)
+
+    x = _encrypt(x, round_keys, right_bits, left_mask, right_mask)
+
+    def any_out_of_range(x):
+        return jnp.any(x >= limit)
+
+    def walk(x):
+        # Re-encrypt only the out-of-range lanes; in-range lanes stay put. The cipher
+        # is a bijection on [0, 2^k), so walking always terminates (expected < 2
+        # iterations because 2^k < 2n).
+        walked = _encrypt(x, round_keys, right_bits, left_mask, right_mask)
+        return jnp.where(x >= limit, walked, x)
+
+    return jax.lax.while_loop(any_out_of_range, walk, x).astype(jnp.int32)

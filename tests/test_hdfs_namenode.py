@@ -374,6 +374,18 @@ class TestHAHdfsClient:
             client._does_not_exist  # noqa: B018
 
 
+class TestArrowUnwrap:
+    def test_plain_filesystem_passthrough(self):
+        from petastorm_tpu.fs_utils import as_arrow_filesystem
+        sentinel = object()
+        assert as_arrow_filesystem(sentinel) is sentinel
+
+    def test_ha_proxy_unwraps_to_live_connection(self):
+        from petastorm_tpu.fs_utils import as_arrow_filesystem
+        client = MockHdfsConnector.connect_ha(['nn1:8020', 'nn2:8020'])
+        assert as_arrow_filesystem(client) is client.unwrap()
+
+
 class TestNamenodeFailoverDecorator:
     def test_retries_once_with_reconnect(self):
         class Client:
@@ -440,7 +452,8 @@ class TestFsUtilsHdfsRouting:
     @pytest.fixture(autouse=True)
     def _capture_connections(self, monkeypatch):
         self.direct = []
-        self.failover = []
+        self.single = []
+        self.ha = []
 
         import pyarrow.fs as pafs
 
@@ -448,30 +461,35 @@ class TestFsUtilsHdfsRouting:
             self.direct.append((host, port))
             return 'direct-fs'
 
-        def fake_failover(namenodes, user=None):
-            self.failover.append(list(namenodes))
-            return 'ha-fs'
-
         monkeypatch.setattr(pafs, 'HadoopFileSystem', fake_direct)
-        monkeypatch.setattr(HdfsConnector, 'connect_to_either_namenode',
-                            classmethod(lambda cls, nodes, user=None: fake_failover(nodes)))
+        monkeypatch.setattr(
+            HdfsConnector, 'connect_to_either_namenode',
+            classmethod(lambda cls, nodes, user=None:
+                        self.single.append(list(nodes)) or 'single-fs'))
+        monkeypatch.setattr(
+            HdfsConnector, 'connect_ha',
+            classmethod(lambda cls, nodes, user=None:
+                        self.ha.append(list(nodes)) or 'ha-proxy'))
 
     def test_host_port_connects_directly(self):
         assert _resolve_hdfs('hdfs://somehost:9000/ds') == 'direct-fs'
         assert self.direct == [('somehost', 9000)]
-        assert self.failover == []
+        assert self.single == [] and self.ha == []
 
-    def test_nameservice_routes_through_failover(self):
-        assert _resolve_hdfs('hdfs://routed/ds') == 'ha-fs'
-        assert self.failover == [['r1:8020', 'r2:8020']]
+    def test_nameservice_routes_through_ha_proxy(self):
+        # Multi-namenode resolutions get the operation-level failover proxy.
+        assert _resolve_hdfs('hdfs://routed/ds') == 'ha-proxy'
+        assert self.ha == [['r1:8020', 'r2:8020']]
+        assert self.single == []
 
     def test_hostless_uses_default_fs(self):
-        assert _resolve_hdfs('hdfs:///ds') == 'ha-fs'
-        assert self.failover == [['r1:8020', 'r2:8020']]
+        assert _resolve_hdfs('hdfs:///ds') == 'ha-proxy'
+        assert self.ha == [['r1:8020', 'r2:8020']]
 
     def test_portless_unknown_host_is_single_namenode(self):
-        assert _resolve_hdfs('hdfs://lonehost/ds') == 'ha-fs'
-        assert self.failover == [['lonehost']]
+        assert _resolve_hdfs('hdfs://lonehost/ds') == 'single-fs'
+        assert self.single == [['lonehost']]
+        assert self.ha == []
 
     def test_no_hadoop_config_falls_back_to_libhdfs_default(self, monkeypatch):
         # Port 0 lets libhdfs do its own core-site.xml / logical-nameservice lookup.

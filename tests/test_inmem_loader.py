@@ -100,3 +100,104 @@ def test_host_only_mode(synthetic_dataset):
     loader = InMemJaxLoader(reader, batch_size=25, num_epochs=1, device_put=False)
     batch = next(iter(loader))
     assert isinstance(batch['id'], np.ndarray)
+
+
+class TestScanEpochs:
+    """scan_epochs compiles sampling + training into one program per epoch."""
+
+    def _loader(self, synthetic_dataset, batch_size=20, shuffle=True):
+        # Deterministic fill order: HBM row order is the fill order, so permutation
+        # reproducibility across runs needs a reproducible fill.
+        reader = make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                             schema_fields=['id'], shuffle_row_groups=False)
+        return InMemJaxLoader(reader, batch_size=batch_size, num_epochs=None,
+                              shuffle=shuffle, seed=3)
+
+    def test_each_epoch_covers_dataset_once(self, synthetic_dataset):
+        loader = self._loader(synthetic_dataset)
+
+        def step(carry, batch):
+            return carry + 1, batch['id']
+
+        steps, aux = loader.scan_epochs(step, 0, num_epochs=2)
+        assert int(steps) == 2 * len(loader)
+        all_ids = sorted(r['id'] for r in synthetic_dataset.rows)
+        epoch0 = sorted(int(i) for i in np.asarray(aux[0]).ravel())
+        epoch1 = sorted(int(i) for i in np.asarray(aux[1]).ravel())
+        assert epoch0 == all_ids
+        assert epoch1 == all_ids
+        assert np.asarray(aux[0]).ravel().tolist() != \
+            np.asarray(aux[1]).ravel().tolist()  # different permutations
+
+    def test_seeded_order_reproducible(self, synthetic_dataset):
+        def run():
+            loader = self._loader(synthetic_dataset)
+            _, aux = loader.scan_epochs(lambda c, b: (c, b['id']), None, num_epochs=1)
+            return np.asarray(aux[0]).ravel().tolist()
+        assert run() == run()
+
+    def test_no_shuffle_is_sequential(self, synthetic_dataset):
+        loader = self._loader(synthetic_dataset, shuffle=False)
+        _, aux = loader.scan_epochs(lambda c, b: (c, b['id']), None, num_epochs=1)
+        ids = np.asarray(aux[0]).ravel().tolist()
+        assert ids == sorted(ids)
+
+    def test_carry_threads_like_training(self, synthetic_dataset):
+        import jax.numpy as jnp
+        loader = self._loader(synthetic_dataset)
+
+        def step(carry, batch):
+            return carry + jnp.sum(batch['id']), None
+
+        total, _ = loader.scan_epochs(step, 0, num_epochs=1)
+        assert int(total) == sum(r['id'] for r in synthetic_dataset.rows)
+
+    def test_shuffle_override_per_call(self, synthetic_dataset):
+        # A shuffling loader can still run deterministic sequential epochs (e.g. eval
+        # or a compute-floor measurement) via the per-call override.
+        loader = self._loader(synthetic_dataset, shuffle=True)
+        step = lambda c, b: (c, b['id'])  # noqa: E731
+        _, aux_seq = loader.scan_epochs(step, None, num_epochs=1, shuffle=False)
+        seq = np.asarray(aux_seq[0]).ravel().tolist()
+        assert seq == sorted(seq)
+        _, aux_shuf = loader.scan_epochs(step, None, num_epochs=1)
+        shuf = np.asarray(aux_shuf[0]).ravel().tolist()
+        assert shuf != sorted(shuf)
+
+    def test_consecutive_calls_advance_permutation(self, synthetic_dataset):
+        loader = self._loader(synthetic_dataset)
+        step = lambda c, b: (c, b['id'])  # noqa: E731
+        _, aux_a = loader.scan_epochs(step, None, num_epochs=1)
+        _, aux_b = loader.scan_epochs(step, None, num_epochs=1)
+        first = np.asarray(aux_a[0]).ravel().tolist()
+        second = np.asarray(aux_b[0]).ravel().tolist()
+        assert first != second  # continued, not replayed
+        _, aux_c = loader.scan_epochs(step, None, num_epochs=1, epoch_offset=0)
+        assert np.asarray(aux_c[0]).ravel().tolist() == first  # explicit replay
+        # The pinned-offset replay must not clobber the cursor: the next default call
+        # serves epoch 2, not a repeat of epoch 1.
+        _, aux_d = loader.scan_epochs(step, None, num_epochs=1)
+        third = np.asarray(aux_d[0]).ravel().tolist()
+        assert third not in (first, second)
+
+    def test_partial_tail_with_drop_last_false_rejected(self, synthetic_dataset):
+        reader = make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                             schema_fields=['id'], shuffle_row_groups=False)
+        loader = InMemJaxLoader(reader, batch_size=30, drop_last=False)  # 100 % 30 != 0
+        with pytest.raises(ValueError, match='partial batch'):
+            loader.scan_epochs(lambda c, b: (c, None), 0)
+
+    def test_mesh_mode_rejected(self, synthetic_dataset):
+        reader = make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                             schema_fields=['id'])
+        mesh = make_mesh(('data',))
+        loader = InMemJaxLoader(reader, batch_size=8, mesh=mesh)
+        with pytest.raises(ValueError, match='single-device'):
+            loader.scan_epochs(lambda c, b: (c, None), 0)
+
+    def test_host_mode_rejected(self, synthetic_dataset):
+        reader = make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                             schema_fields=['id'])
+        loader = InMemJaxLoader(reader, batch_size=8, device_put=False)
+        with pytest.raises(ValueError, match='single-device'):
+            loader.scan_epochs(lambda c, b: (c, None), 0)
