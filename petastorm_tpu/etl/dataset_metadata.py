@@ -108,7 +108,11 @@ def open_dataset(dataset_url_or_urls, storage_options=None, filesystem=None):
     default ``ignore_prefixes``."""
     fs, path_or_paths = get_filesystem_and_path_or_paths(
         dataset_url_or_urls, storage_options=storage_options, filesystem=filesystem)
-    arrow_dataset = pads.dataset(path_or_paths, filesystem=as_arrow_filesystem(fs),
+    # The handle's filesystem flows into Arrow C++ (make_fragment in the workers and
+    # rowgroup indexing), which requires a real pyarrow filesystem — unwrap any HA
+    # failover proxy once here.
+    fs = as_arrow_filesystem(fs)
+    arrow_dataset = pads.dataset(path_or_paths, filesystem=fs,
                                  format='parquet', partitioning='hive')
     return DatasetHandle(fs, path_or_paths, arrow_dataset)
 
@@ -227,7 +231,8 @@ def materialize_dataset(dataset_url, schema, rowgroup_size_mb=DEFAULT_ROW_GROUP_
     yield
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options=storage_options,
                                                 filesystem=filesystem)
-    arrow_dataset = pads.dataset(path, filesystem=as_arrow_filesystem(fs),
+    fs = as_arrow_filesystem(fs)   # handle.filesystem feeds Arrow C++ (see open_dataset)
+    arrow_dataset = pads.dataset(path, filesystem=fs,
                                  format='parquet', partitioning='hive')
     handle = DatasetHandle(fs, path, arrow_dataset)
     row_groups_map = _scan_row_groups_per_file(handle)

@@ -155,7 +155,10 @@ class FilesystemFactory(object):
         self._storage_options = storage_options
 
     def __call__(self):
-        return _resolve_single(self._url, self._storage_options)[0]
+        # Workers hand this filesystem straight into Arrow C++ (make_fragment) — a
+        # python HA proxy is not accepted there, so unwrap. Connect-time namenode
+        # failover still applies on each worker's fresh connection.
+        return as_arrow_filesystem(_resolve_single(self._url, self._storage_options)[0])
 
 
 def make_filesystem_factory(url, storage_options=None):

@@ -21,6 +21,8 @@ from petastorm_tpu.parallel.loader import (FieldShardings, iter_reader_chunks,
                                            sanitize_columns, sharding_for_field)
 
 _FILL_SAFETY_CAP = 100_000_000
+#: scan_epochs keeps this many compiled (step_fn, shuffle) programs before evicting
+_SCAN_CACHE_MAX = 8
 
 
 class InMemJaxLoader(object):
@@ -226,7 +228,8 @@ class InMemJaxLoader(object):
         shuffle = self._shuffle if shuffle is None else shuffle
         seed = self._seed
 
-        if (step_fn, shuffle) not in self._scan_cache:
+        cache_key = (step_fn, shuffle)
+        if cache_key not in self._scan_cache:
             from petastorm_tpu.ops.index_shuffle import random_index_shuffle
 
             @jax.jit
@@ -249,8 +252,17 @@ class InMemJaxLoader(object):
 
                 return jax.lax.scan(body, carry, jnp.arange(batches_per_epoch))
 
-            self._scan_cache[(step_fn, shuffle)] = one_epoch
-        one_epoch = self._scan_cache[(step_fn, shuffle)]
+            if len(self._scan_cache) >= _SCAN_CACHE_MAX:
+                # A fresh lambda per call defeats reuse (closures cannot be safely
+                # deduplicated) — warn once and evict oldest so the compiled
+                # executables and their captured environments cannot accumulate.
+                warnings.warn(
+                    'scan_epochs compiled {} distinct (step_fn, shuffle) programs; '
+                    'pass a stable step_fn object to reuse compilations'
+                    .format(len(self._scan_cache) + 1))
+                self._scan_cache.pop(next(iter(self._scan_cache)))
+            self._scan_cache[cache_key] = one_epoch
+        one_epoch = self._scan_cache[cache_key]
 
         start = self._scan_epoch if epoch_offset is None else epoch_offset
         aux_per_epoch = []

@@ -15,7 +15,8 @@ import numpy as np
 from petastorm_tpu.cache import LocalDiskCache, NullCache
 from petastorm_tpu.errors import MetadataError, NoDataAvailableError
 from petastorm_tpu.etl import dataset_metadata
-from petastorm_tpu.fs_utils import make_filesystem_factory, normalize_dataset_url_or_urls
+from petastorm_tpu.fs_utils import (as_arrow_filesystem, make_filesystem_factory,
+                                    normalize_dataset_url_or_urls)
 from petastorm_tpu.reader_worker import RowGroupWorker, WorkerSetup
 from petastorm_tpu.unischema import Unischema, match_unischema_fields
 from petastorm_tpu.workers import EmptyResultError
@@ -222,8 +223,11 @@ class Reader(object):
 
         url_for_factory = dataset_url_or_urls if not isinstance(dataset_url_or_urls, list) \
             else dataset_url_or_urls[0]
+        # Workers feed this filesystem into Arrow C++ — unwrap any HA failover proxy
+        # (as_arrow_filesystem) when the caller supplied one explicitly.
         filesystem_factory = (make_filesystem_factory(url_for_factory, storage_options)
-                              if filesystem is None else (lambda: filesystem))
+                              if filesystem is None
+                              else (lambda: as_arrow_filesystem(filesystem)))
         worker_setup = WorkerSetup(
             dataset_path_or_paths=handle.path_or_paths,
             filesystem_factory=filesystem_factory,

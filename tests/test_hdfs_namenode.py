@@ -386,6 +386,36 @@ class TestArrowUnwrap:
         assert as_arrow_filesystem(client) is client.unwrap()
 
 
+class TestProxyThroughReaderStack:
+    def test_make_reader_accepts_ha_proxy_filesystem(self, tmp_path):
+        """A resolver that yields the HA proxy must still read end-to-end: the Arrow
+        C++ hand-offs (pads.dataset, worker make_fragment) unwrap it (regression:
+        the proxy is a plain python object pyarrow rejects)."""
+        import pyarrow.fs as pafs
+
+        import numpy as np
+        from petastorm_tpu.codecs import ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_rows
+        from petastorm_tpu.reader import make_reader
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        schema = Unischema('S', [
+            UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False)])
+        url = 'file://' + str(tmp_path / 'ds')
+        write_rows(url, schema, [{'id': i} for i in range(10)], rows_per_file=5)
+
+        class LocalConnector(HdfsConnector):
+            @classmethod
+            def hdfs_connect_namenode(cls, address, user=None):
+                return pafs.LocalFileSystem()
+
+        proxy = LocalConnector.connect_ha(['nn1:8020', 'nn2:8020'])
+        with make_reader(url, reader_pool_type='dummy', filesystem=proxy,
+                         shuffle_row_groups=False) as reader:
+            ids = [row.id for row in reader]
+        assert sorted(ids) == list(range(10))
+
+
 class TestNamenodeFailoverDecorator:
     def test_retries_once_with_reconnect(self):
         class Client:
