@@ -4,5 +4,12 @@ pytorch/tf adapter layer + Horovod rank sniffing; SURVEY.md §7.1 item 5)."""
 
 from petastorm_tpu.parallel.inmem_loader import InMemJaxLoader  # noqa: F401
 from petastorm_tpu.parallel.loader import JaxDataLoader, make_jax_loader  # noqa: F401
+
+def __getattr__(name):  # lazy: orbax import is heavy and optional at runtime
+    if name == 'TrainingCheckpointer':
+        from petastorm_tpu.parallel.checkpoint import TrainingCheckpointer
+        return TrainingCheckpointer
+    raise AttributeError(name)
+
 from petastorm_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding, distributed_shard_info, make_mesh)

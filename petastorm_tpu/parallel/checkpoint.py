@@ -1,0 +1,112 @@
+"""Orbax-bundled training checkpoints: model state + input-pipeline position in ONE
+atomic checkpoint.
+
+The reference's story is "persistent artifacts only" (SURVEY.md §5.4: restart
+granularity is the epoch; petastorm/reader.py:496-520). This repo's readers/loaders are
+mid-epoch resumable (``Reader.state_dict`` / ``JaxDataLoader.state_dict``), and the
+natural TPU-native home for that state is the same orbax checkpoint that holds the
+model: saving them together means a restored job resumes from the exact rows it had
+not yet trained on, and a torn checkpoint (model saved, loader position lost) cannot
+happen. Orbax handles atomicity, retention, and async-friendly layout.
+
+Usage::
+
+    ckpt = TrainingCheckpointer('/ckpts', max_to_keep=3)
+    for batch in loader:
+        state = train_step(state, batch)
+        if step % 1000 == 0:
+            ckpt.save(step, state, loader=loader)
+
+    # on restart
+    state, loader_state = ckpt.restore(state)      # template for structure
+    reader = make_reader(url, ..., resume_state=loader_state['reader'])
+    loader = JaxDataLoader(reader, ...)
+"""
+
+import orbax.checkpoint as ocp
+
+_MODEL_KEY = 'train_state'
+_LOADER_KEY = 'input_pipeline'
+
+
+class TrainingCheckpointer(object):
+    """Atomic (model pytree, input-pipeline position) checkpoints via an orbax
+    ``CheckpointManager``.
+
+    :param directory: checkpoint root (local path or any orbax-supported store).
+    :param max_to_keep: retention count (orbax deletes older steps).
+    :param save_interval_steps: if set, :meth:`save` becomes a no-op except every
+        N-th step — lets the training loop call it unconditionally.
+    """
+
+    def __init__(self, directory, max_to_keep=3, save_interval_steps=None):
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps or 1,
+            create=True)
+        self._manager = ocp.CheckpointManager(directory, options=options)
+
+    def save(self, step, train_state, loader=None, loader_state=None, force=False):
+        """Save ``train_state`` (any pytree of arrays) plus the input position.
+
+        Pass either ``loader`` (its ``state_dict()`` is taken — raising where the
+        loader cannot attribute in-flight rows, exactly like a direct call) or an
+        explicit ``loader_state`` dict; with neither, only the model state is saved.
+        Returns True when orbax actually wrote a step."""
+        if loader is not None and loader_state is not None:
+            raise ValueError('Pass loader or loader_state, not both')
+        if loader is not None:
+            loader_state = {'reader': loader.state_dict()}
+        elif loader_state is not None and 'reader' not in loader_state:
+            loader_state = {'reader': loader_state}
+        composite = {_MODEL_KEY: ocp.args.StandardSave(train_state)}
+        if loader_state is not None:
+            composite[_LOADER_KEY] = ocp.args.JsonSave(loader_state)
+        return self._manager.save(step, args=ocp.args.Composite(**composite),
+                                  force=force)
+
+    def restore(self, train_state_template, step=None):
+        """Restore ``(train_state, loader_state)`` from ``step`` (default: latest).
+
+        ``train_state_template`` supplies the pytree structure/shapes (pass the
+        freshly initialized state). ``loader_state`` is the dict whose ``['reader']``
+        entry feeds ``make_reader(..., resume_state=...)``; it is None when the
+        checkpoint carried no input position."""
+        # Settle any in-flight async save FIRST: the step-directory probe below would
+        # otherwise miss the not-yet-finalized input_pipeline item and silently drop
+        # the read position (manager.restore waits internally, but too late for the
+        # probe).
+        self._manager.wait_until_finished()
+        if step is None:
+            step = self._manager.latest_step()
+        if step is None:
+            raise ValueError('No checkpoint found under {!r}'
+                             .format(str(self._manager.directory)))
+        composite = {_MODEL_KEY: ocp.args.StandardRestore(train_state_template)}
+        # Directory probe instead of manager.item_metadata(step): the latter logs a
+        # scary "could not be restored" warning per item on a fresh manager that has
+        # no handler registry yet.
+        step_dir = self._manager.directory / str(step)
+        if (step_dir / _LOADER_KEY).exists():
+            composite[_LOADER_KEY] = ocp.args.JsonRestore()
+        restored = self._manager.restore(step, args=ocp.args.Composite(**composite))
+        return restored[_MODEL_KEY], restored.get(_LOADER_KEY)
+
+    @property
+    def latest_step(self):
+        return self._manager.latest_step()
+
+    def all_steps(self):
+        return list(self._manager.all_steps())
+
+    def wait_until_finished(self):
+        self._manager.wait_until_finished()
+
+    def close(self):
+        self._manager.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()

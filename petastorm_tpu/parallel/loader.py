@@ -186,13 +186,17 @@ class JaxDataLoader(object):
             self._in_iter = False
             self._drain_queue()
 
-    def _drain_queue(self):
+    def _drain_queue(self, _empty=queue.Empty):
+        # _empty bound at definition time: this runs from generator finalizers, which
+        # at interpreter shutdown may fire after module globals (the `queue` module)
+        # are cleared — a global lookup then raises "catching classes that do not
+        # inherit from BaseException".
         if self._queue is None:
             return
         try:
             while True:
                 self._queue.get_nowait()
-        except queue.Empty:
+        except _empty:
             pass
 
     # ------------------------------------------------------------------ producer
