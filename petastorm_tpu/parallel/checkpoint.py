@@ -55,6 +55,11 @@ class TrainingCheckpointer(object):
         Returns True when orbax actually wrote a step."""
         if loader is not None and loader_state is not None:
             raise ValueError('Pass loader or loader_state, not both')
+        if not force and not self._manager.should_save(step):
+            # The no-op contract must hold BEFORE deriving loader state: state_dict()
+            # can legitimately raise mid-stream (shuffling buffer) on steps orbax
+            # would skip anyway.
+            return False
         if loader is not None:
             loader_state = {'reader': loader.state_dict()}
         elif loader_state is not None and 'reader' not in loader_state:

@@ -133,3 +133,85 @@ def test_tf_tensors_ngram_with_shuffling_queue(tmp_path):
                 coord.join(threads, stop_grace_period_secs=5)
     for value in values:
         assert int(value[1].ts) == int(value[0].ts) + 1
+
+
+# ------------------------------------------------------- dtype sanitization edges
+
+class TestDtypeSanitization:
+    """numpy -> TF dtype mapping edges (model: reference tf_utils.py:27-96 matrix in
+    test_tf_utils.py): decimals become strings, datetimes ns-int64, unsigned types
+    promote, strings pass through."""
+
+    def test_decimal_scalar_to_string(self):
+        from decimal import Decimal
+        from petastorm_tpu.tf_utils import _sanitize_field_value
+        assert _sanitize_field_value(Decimal('1.25')) == '1.25'
+
+    def test_datetime_to_ns_int64(self):
+        import datetime
+        from petastorm_tpu.tf_utils import _sanitize_field_value
+        out = _sanitize_field_value(datetime.date(1970, 1, 2))
+        assert out == 24 * 3600 * 10**9
+
+    def test_uint16_and_uint32_promote(self):
+        from petastorm_tpu.tf_utils import _sanitize_field_value
+        assert _sanitize_field_value(np.uint16(7)).dtype == np.int32
+        assert _sanitize_field_value(np.uint32(7)).dtype == np.int64
+        arr16 = _sanitize_field_value(np.array([1, 2], np.uint16))
+        arr32 = _sanitize_field_value(np.array([1, 2], np.uint32))
+        assert arr16.dtype == np.int32 and arr32.dtype == np.int64
+
+    def test_tf_dtype_for_string_and_datetime_fields(self):
+        from decimal import Decimal
+        from petastorm_tpu.codecs import ScalarCodec
+        from petastorm_tpu.tf_utils import _tf_dtype_for_field
+        from petastorm_tpu.unischema import UnischemaField
+        assert _tf_dtype_for_field(
+            UnischemaField('s', np.str_, (), ScalarCodec(), False)) == tf.string
+        assert _tf_dtype_for_field(
+            UnischemaField('d', Decimal, (), ScalarCodec(), False)) == tf.string
+        assert _tf_dtype_for_field(
+            UnischemaField('u', np.uint16, (), ScalarCodec(), False)) == tf.int32
+
+    def test_string_field_round_trips_through_dataset(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         schema_fields=['id', 'sensor_name'],
+                         shuffle_row_groups=False) as reader:
+            dataset = make_petastorm_dataset(reader)
+            names = {int(t.id.numpy()): t.sensor_name.numpy().decode()
+                     for t in dataset}
+        for row in synthetic_dataset.rows:
+            assert names[row['id']] == row['sensor_name']
+
+
+# ------------------------------------------------------- tf.function / training
+
+class TestTfFunctionIntegration:
+    """tf.data pipelines must survive tf.function tracing (model: reference
+    test_tf_autograph.py)."""
+
+    def test_map_inside_tf_function(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy') as reader:
+            dataset = make_petastorm_dataset(reader).unbatch().batch(8)
+
+            @tf.function
+            def total_ids(ds):
+                total = tf.constant(0, tf.int64)
+                for batch in ds:
+                    total += tf.reduce_sum(batch.id)
+                return total
+
+            total = int(total_ids(dataset).numpy())
+        assert total == sum(r['id'] for r in scalar_dataset.rows)
+
+    def test_keras_fit_one_epoch(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               schema_fields=['id', 'float64']) as reader:
+            dataset = (make_petastorm_dataset(reader).unbatch().batch(16)
+                       .map(lambda t: (tf.cast(tf.reshape(t.float64, (-1, 1)),
+                                               tf.float32),
+                                       tf.cast(t.id % 2, tf.float32))))
+            model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+            model.compile(optimizer='sgd', loss='mse')
+            history = model.fit(dataset, epochs=1, verbose=0)
+        assert np.isfinite(history.history['loss'][0])

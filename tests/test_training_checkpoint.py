@@ -112,3 +112,20 @@ class TestWithInputPipeline:
         # JSON round-trip: int keys become strings — exactly what
         # Reader._load_resume_state normalizes back
         assert list(loader_state['reader']['consumed_by_epoch'].keys()) == ['0']
+
+
+def test_save_interval_gates_before_loader_state(tmp_path):
+    """The every-N no-op contract must hold even when deriving loader state would
+    raise: skipped steps never touch the loader (regression: state_dict() ran first)."""
+
+    class ExplodingLoader:
+        def state_dict(self):
+            raise ValueError('cannot attribute in-flight rows')
+
+    with TrainingCheckpointer(str(tmp_path / 'ck'), save_interval_steps=10) as ckpt:
+        assert ckpt.save(10, _state(1))  # eligible step, saved without loader
+        # step 11 is gated out BEFORE the loader is consulted: no raise, no save
+        assert ckpt.save(11, _state(2), loader=ExplodingLoader()) is False
+        # an eligible step genuinely consults the loader (and surfaces its error)
+        with pytest.raises(ValueError, match='in-flight'):
+            ckpt.save(20, _state(3), loader=ExplodingLoader())
