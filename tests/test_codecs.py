@@ -144,3 +144,48 @@ def test_compliant_shape():
     assert _is_compliant_shape((3, 4), (None, 4))
     assert not _is_compliant_shape((3, 4), (3, 5))
     assert not _is_compliant_shape((3, 4), (3, 4, 1))
+
+
+class TestDtypeMatrix:
+    """Round-trip property across the supported dtype x codec matrix (model:
+    reference test_codec_scalar/ndarray/image trio breadth)."""
+
+    SCALAR_DTYPES = [np.int8, np.int16, np.int32, np.int64, np.uint8, np.uint16,
+                     np.uint32, np.uint64, np.float16, np.float32, np.float64,
+                     np.bool_]
+
+    @pytest.mark.parametrize('dtype', SCALAR_DTYPES)
+    def test_scalar_codec_every_dtype(self, dtype):
+        field = UnischemaField('x', dtype, (), ScalarCodec(), False)
+        value = dtype(1) if dtype != np.bool_ else np.bool_(True)
+        decoded = _roundtrip(field.codec, field, value)
+        assert decoded == value
+        assert np.asarray(decoded).dtype == np.dtype(dtype)
+
+    @pytest.mark.parametrize('dtype', [np.int8, np.int16, np.int32, np.int64,
+                                       np.uint8, np.uint16, np.uint32, np.uint64,
+                                       np.float16, np.float32, np.float64, np.bool_])
+    @pytest.mark.parametrize('codec_cls', [NdarrayCodec, CompressedNdarrayCodec])
+    def test_ndarray_codec_every_dtype(self, dtype, codec_cls):
+        rng = np.random.RandomState(0)
+        if dtype == np.bool_:
+            value = rng.rand(3, 4) > 0.5
+        elif np.dtype(dtype).kind == 'f':
+            value = rng.randn(3, 4).astype(dtype)
+        else:
+            value = rng.randint(0, 100, (3, 4)).astype(dtype)
+        field = UnischemaField('x', dtype, (3, 4), codec_cls(), False)
+        out = _roundtrip(field.codec, field, value)
+        np.testing.assert_array_equal(out, value)
+        assert out.dtype == np.dtype(dtype)
+
+    @pytest.mark.parametrize('shape', [(0,), (1,), (5, 0, 2), (2, 3, 4, 5)])
+    def test_ndarray_codec_edge_shapes(self, shape):
+        value = np.zeros(shape, np.float32)
+        field = UnischemaField('x', np.float32, shape, NdarrayCodec(), False)
+        assert _roundtrip(field.codec, field, value).shape == shape
+
+    def test_fortran_order_array_roundtrips(self):
+        value = np.asfortranarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+        field = UnischemaField('x', np.float32, (3, 4), NdarrayCodec(), False)
+        np.testing.assert_array_equal(_roundtrip(field.codec, field, value), value)
