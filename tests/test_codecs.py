@@ -146,15 +146,15 @@ def test_compliant_shape():
     assert not _is_compliant_shape((3, 4), (3, 4, 1))
 
 
+ALL_DTYPES = [np.int8, np.int16, np.int32, np.int64, np.uint8, np.uint16,
+              np.uint32, np.uint64, np.float16, np.float32, np.float64, np.bool_]
+
+
 class TestDtypeMatrix:
     """Round-trip property across the supported dtype x codec matrix (model:
     reference test_codec_scalar/ndarray/image trio breadth)."""
 
-    SCALAR_DTYPES = [np.int8, np.int16, np.int32, np.int64, np.uint8, np.uint16,
-                     np.uint32, np.uint64, np.float16, np.float32, np.float64,
-                     np.bool_]
-
-    @pytest.mark.parametrize('dtype', SCALAR_DTYPES)
+    @pytest.mark.parametrize('dtype', ALL_DTYPES)
     def test_scalar_codec_every_dtype(self, dtype):
         field = UnischemaField('x', dtype, (), ScalarCodec(), False)
         value = dtype(1) if dtype != np.bool_ else np.bool_(True)
@@ -162,9 +162,7 @@ class TestDtypeMatrix:
         assert decoded == value
         assert np.asarray(decoded).dtype == np.dtype(dtype)
 
-    @pytest.mark.parametrize('dtype', [np.int8, np.int16, np.int32, np.int64,
-                                       np.uint8, np.uint16, np.uint32, np.uint64,
-                                       np.float16, np.float32, np.float64, np.bool_])
+    @pytest.mark.parametrize('dtype', ALL_DTYPES)
     @pytest.mark.parametrize('codec_cls', [NdarrayCodec, CompressedNdarrayCodec])
     def test_ndarray_codec_every_dtype(self, dtype, codec_cls):
         rng = np.random.RandomState(0)

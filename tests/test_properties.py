@@ -1,0 +1,124 @@
+"""Property-based tests (hypothesis) over the pure-function core: window formation,
+index shuffling, shuffling buffers, and split predicates. These state the invariants
+the example-based suites sample — for any input, not just the curated cases."""
+import numpy as np
+import pytest
+
+pytest.importorskip('hypothesis')
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.parallel.shuffling_buffer import (NoopShufflingBuffer,
+                                                     RandomShufflingBuffer)
+
+SETTINGS = dict(max_examples=50, deadline=None)
+
+
+def _brute_force_starts(timestamps, length, threshold):
+    """O(n*L) reference for form_ngram_columnar's vectorized scan."""
+    out = []
+    for start in range(len(timestamps) - length + 1):
+        deltas = np.diff(timestamps[start:start + length])
+        if np.all(deltas <= threshold):
+            out.append(start)
+    return out
+
+
+class TestNgramWindowProperties:
+    @given(st.lists(st.integers(0, 1000), min_size=1, max_size=60),
+           st.integers(1, 6), st.integers(0, 50))
+    @settings(**SETTINGS)
+    def test_vectorized_scan_matches_brute_force(self, deltas, length, threshold):
+        timestamps = np.cumsum(np.asarray(deltas))  # sorted by construction
+        ngram = NGram({i: ['x'] for i in range(length)}, delta_threshold=threshold,
+                      timestamp_field='x')
+        starts = ngram.form_ngram_columnar(timestamps).tolist()
+        assert starts == _brute_force_starts(timestamps, length, threshold)
+
+    @given(st.lists(st.integers(0, 100), min_size=2, max_size=60),
+           st.integers(2, 5), st.integers(0, 30))
+    @settings(**SETTINGS)
+    def test_no_overlap_mode_windows_disjoint_in_time(self, deltas, length, threshold):
+        timestamps = np.cumsum(np.asarray(deltas))
+        ngram = NGram({i: ['x'] for i in range(length)}, delta_threshold=threshold,
+                      timestamp_field='x', timestamp_overlap=False)
+        starts = ngram.form_ngram_columnar(timestamps)
+        overlap_all = NGram({i: ['x'] for i in range(length)},
+                            delta_threshold=threshold, timestamp_field='x')
+        all_starts = set(overlap_all.form_ngram_columnar(timestamps).tolist())
+        for i in range(1, len(starts)):
+            prev_end = timestamps[starts[i - 1] + length - 1]
+            assert timestamps[starts[i]] > prev_end
+        assert set(starts.tolist()) <= all_starts  # selection, never invention
+
+
+class TestIndexShuffleProperties:
+    @given(st.integers(1, 5000), st.integers(0, 2**31 - 1))
+    @settings(**SETTINGS)
+    def test_bijection_for_any_n_and_key(self, n, seed):
+        import jax
+        import jax.numpy as jnp
+
+        from petastorm_tpu.ops.index_shuffle import random_index_shuffle
+        out = np.asarray(random_index_shuffle(
+            jnp.arange(n), jax.random.PRNGKey(seed), n))
+        assert sorted(out.tolist()) == list(range(n))
+
+
+class TestShufflingBufferProperties:
+    @given(st.lists(st.integers(1, 20), min_size=1, max_size=12),
+           st.integers(1, 10), st.integers(0, 2**31 - 1))
+    @settings(**SETTINGS)
+    def test_random_buffer_preserves_multiset(self, chunk_sizes, batch, seed):
+        buf = RandomShufflingBuffer(10_000, min_after_retrieve=0, seed=seed)
+        expected = []
+        next_id = 0
+        for size in chunk_sizes:
+            ids = np.arange(next_id, next_id + size)
+            buf.add_many({'id': ids, 'twice': ids * 2})
+            expected.extend(ids.tolist())
+            next_id += size
+        buf.finish()
+        got = []
+        while buf.can_retrieve(1):
+            out = buf.retrieve(batch)
+            np.testing.assert_array_equal(out['twice'], 2 * out['id'])  # row alignment
+            got.extend(out['id'].tolist())
+        assert sorted(got) == sorted(expected)
+
+    @given(st.lists(st.integers(1, 20), min_size=1, max_size=12),
+           st.integers(1, 10))
+    @settings(**SETTINGS)
+    def test_noop_buffer_is_fifo(self, chunk_sizes, batch):
+        buf = NoopShufflingBuffer()
+        expected = []
+        next_id = 0
+        for size in chunk_sizes:
+            ids = np.arange(next_id, next_id + size)
+            buf.add_many({'id': ids})
+            expected.extend(ids.tolist())
+            next_id += size
+        buf.finish()
+        got = []
+        while buf.can_retrieve(1):
+            got.extend(buf.retrieve(batch)['id'].tolist())
+        assert got == expected
+
+
+class TestSplitPredicateProperties:
+    @given(st.lists(st.floats(0.05, 1.0), min_size=2, max_size=5),
+           st.integers(0, 1000))
+    @settings(**SETTINGS)
+    def test_pseudorandom_split_partitions_disjoint_and_complete(self, weights, base):
+        from petastorm_tpu.predicates import in_pseudorandom_split
+        total = sum(weights)
+        ratios = [w / total for w in weights]
+        keys = ['k_{}'.format(base + i) for i in range(200)]
+        membership = []
+        for subset in range(len(ratios)):
+            pred = in_pseudorandom_split(ratios, subset, 'f')
+            membership.append({k for k in keys if pred.do_include({'f': k})})
+        for i in range(len(ratios)):
+            for j in range(i + 1, len(ratios)):
+                assert not (membership[i] & membership[j])
+        assert set().union(*membership) == set(keys)
