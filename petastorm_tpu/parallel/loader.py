@@ -19,6 +19,7 @@ torch), designed per SURVEY.md §7.1 item 5:
 import collections
 import contextlib
 import queue
+import sys
 import threading
 import time
 
@@ -186,12 +187,12 @@ class JaxDataLoader(object):
             self._in_iter = False
             self._drain_queue()
 
-    def _drain_queue(self, _empty=queue.Empty):
-        # _empty bound at definition time: this runs from generator finalizers, which
-        # at interpreter shutdown may fire after module globals (the `queue` module)
-        # are cleared — a global lookup then raises "catching classes that do not
-        # inherit from BaseException".
-        if self._queue is None:
+    def _drain_queue(self, _empty=queue.Empty, _is_finalizing=sys.is_finalizing):
+        # Bound at definition time and guarded: this runs from generator finalizers,
+        # which can fire during interpreter shutdown after module globals (ours AND
+        # the stdlib queue module's Empty) are cleared — `raise Empty` inside
+        # queue.get then raises TypeError. Draining is pointless at shutdown anyway.
+        if self._queue is None or _is_finalizing():
             return
         try:
             while True:
