@@ -3,3 +3,4 @@ the reference's examples/mnist and examples/imagenet model code, re-done in flax
 
 from petastorm_tpu.models.mnist import MnistCNN  # noqa: F401
 from petastorm_tpu.models.resnet import ResNet50  # noqa: F401
+from petastorm_tpu.models.transformer import TransformerLM, next_token_loss  # noqa: F401

@@ -1,0 +1,86 @@
+"""TransformerLM: the decoder-only consumer model family (MXU-first flax).
+
+The reference feeds torch/TF models; this repo's flagship consumers are JAX-native
+(models/mnist.py, models/resnet.py for vision). TransformerLM completes the family for
+the long-context story (SURVEY.md §5.7): bf16 compute with float32 logits, pre-norm
+blocks, and a pluggable ``attention_fn`` so the SAME module runs
+
+- dense attention on one chip (default),
+- ``ops.flash_attention`` (Pallas MXU kernel) via ``attention_fn=flash_attention``,
+- ``ops.ring_attention`` sequence-parallel over a mesh axis by injecting a
+  ``shard_map``-wrapped callable (see examples/long_context) — the model stays free of
+  mesh concerns; sharding is the caller's injection.
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def dense_causal_attention(q, k, v):
+    """[B, T, H, D] -> [B, T, H, D] exact causal attention — delegates to the ops
+    reference implementation so every backend (dense default, flash fallback, ring)
+    shares ONE numerical definition (fp32 scores)."""
+    from petastorm_tpu.ops.ring_attention import dense_attention
+    return dense_attention(q, k, v, causal=True)
+
+
+class Block(nn.Module):
+    heads: int
+    attention_fn: Callable
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        embed = x.shape[-1]
+        head_dim = embed // self.heads
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        qkv = nn.Dense(3 * embed, use_bias=False, dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (x.shape[0], x.shape[1], self.heads, head_dim)
+        attn = self.attention_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+        attn = attn.reshape(x.shape[0], x.shape[1], embed)
+        x = x + nn.Dense(embed, use_bias=False, dtype=self.dtype)(attn)
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        h = nn.Dense(4 * embed, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(embed, dtype=self.dtype)(h)
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: tokens [B, T] int -> logits [B, T, vocab] float32."""
+
+    vocab: int = 256
+    embed: int = 64
+    heads: int = 4
+    layers: int = 2
+    max_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        if tokens.shape[1] > self.max_len:
+            # jit-time (shapes are static): gather would silently clamp positions
+            # past the table instead of failing.
+            raise ValueError('sequence length {} exceeds max_len={}; raise max_len'
+                             .format(tokens.shape[1], self.max_len))
+        attention_fn = self.attention_fn or dense_causal_attention
+        x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
+        positions = jnp.arange(tokens.shape[1])
+        x = x + nn.Embed(self.max_len, self.embed, dtype=self.dtype)(positions)[None]
+        for _ in range(self.layers):
+            x = Block(heads=self.heads, attention_fn=attention_fn,
+                      dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.vocab, dtype=jnp.float32)(x)
+
+
+def next_token_loss(logits, tokens):
+    """Causal LM loss: predict token t+1 from positions <= t."""
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    targets = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
