@@ -216,6 +216,10 @@ def orchestrate():
             'BENCH_IMG_ROWS': '128', 'BENCH_IMG_EPOCHS': '1', 'BENCH_WORKERS': '2'})
         if result is not None:
             result['platform'] = 'cpu'
+            result['tpu_reference'] = (
+                'bench_results/r02_tpu_runs.jsonl — committed real-TPU runs of this '
+                'same bench (last line = final config); this CPU line exists only '
+                'because the accelerator tunnel was down at bench time')
 
     if result is None:
         log('bench failed on all platforms')

@@ -62,6 +62,9 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens):
+        if self.embed % self.heads != 0:
+            raise ValueError('embed={} must be divisible by heads={}'
+                             .format(self.embed, self.heads))
         if tokens.shape[1] > self.max_len:
             # jit-time (shapes are static): gather would silently clamp positions
             # past the table instead of failing.
@@ -79,7 +82,11 @@ class TransformerLM(nn.Module):
 
 
 def next_token_loss(logits, tokens):
-    """Causal LM loss: predict token t+1 from positions <= t."""
+    """Causal LM loss: predict token t+1 from positions <= t. Requires T >= 2."""
+    if tokens.shape[1] < 2:
+        raise ValueError('next_token_loss needs sequences of length >= 2 (got {}): '
+                         'the mean over zero predicted positions would be NaN'
+                         .format(tokens.shape[1]))
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     targets = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)

@@ -190,3 +190,14 @@ class TestTransformerLM:
         model = TransformerLM(vocab=8, embed=16, heads=2, layers=1, max_len=16)
         with pytest.raises(ValueError, match='max_len'):
             model.init(jax.random.PRNGKey(0), jnp.zeros((1, 17), jnp.int32))
+
+    def test_bad_head_divisibility_rejected(self):
+        from petastorm_tpu.models import TransformerLM
+        model = TransformerLM(vocab=8, embed=60, heads=8, layers=1)
+        with pytest.raises(ValueError, match='divisible'):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+    def test_next_token_loss_rejects_length_one(self):
+        from petastorm_tpu.models import next_token_loss
+        with pytest.raises(ValueError, match='length >= 2'):
+            next_token_loss(jnp.zeros((2, 1, 8)), jnp.zeros((2, 1), jnp.int32))
