@@ -10,10 +10,11 @@ parallelism):
 2. ``JaxDataLoader`` emits batches sharded over a 2-D ``(data, seq)`` mesh with
    ``PartitionSpec('data', 'seq')`` — each device holds a [B/data, T/seq] token shard,
    assembled straight from the host pipeline (no resharding step);
-3. a causal transformer block computes exact attention over the sequence axis with
-   ``ops.ring_attention`` (K/V shards rotate around the ``seq`` ring via ``ppermute``
-   on ICI), so sequences longer than one chip's HBM are trained without gathering the
-   full sequence anywhere.
+3. the shared :class:`petastorm_tpu.models.TransformerLM` trains with
+   ``ops.ring_attention`` injected as its attention backend (K/V shards rotate around
+   the ``seq`` ring via ``ppermute`` on ICI), so sequences longer than one chip's HBM
+   are trained without gathering the full sequence anywhere — and the model code is
+   identical to the single-chip dense/flash configurations.
 
 Run: ``python -m examples.long_context.jax_example --seq-len 512``
 """
@@ -50,24 +51,14 @@ def build_dataset(url, num_docs=256, seq_len=512, seed=0):
     return schema
 
 
-def init_params(key, vocab=VOCAB, embed=EMBED):
-    import jax
-    k1, k2, k3 = jax.random.split(key, 3)
-    scale = embed ** -0.5
-    return {
-        'embed': jax.random.normal(k1, (vocab, embed)) * scale,
-        'qkv': jax.random.normal(k2, (embed, 3 * embed)) * scale,
-        'out': jax.random.normal(k3, (embed, vocab)) * scale,
-    }
-
-
-def make_train_step(mesh, learning_rate=2.0):
-    """Jitted train step over the (data, seq) mesh: embeddings/matmuls are GSPMD-sharded
-    by the batch's PartitionSpec; attention runs as ring attention over the seq axis."""
-    import jax
+def make_model(mesh):
+    """The shared TransformerLM with ring attention injected over the mesh's ``seq``
+    axis — the model family's documented sequence-parallel injection point
+    (petastorm_tpu/models/transformer.py); the model itself stays mesh-agnostic."""
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
+    from petastorm_tpu.models import TransformerLM
     from petastorm_tpu.ops.ring_attention import ring_attention
     from petastorm_tpu.parallel.mesh import shard_map_compat
 
@@ -75,35 +66,28 @@ def make_train_step(mesh, learning_rate=2.0):
     ring = shard_map_compat(
         lambda q, k, v: ring_attention(q, k, v, axis_name='seq', causal=True),
         mesh, (attn_spec, attn_spec, attn_spec), attn_spec)
+    return TransformerLM(vocab=VOCAB, embed=EMBED, heads=HEADS, layers=1,
+                         dtype=jnp.float32, attention_fn=ring)
 
-    def loss_fn(params, tokens):
-        b, t = tokens.shape
-        x = params['embed'][tokens]                                  # [B,T,D]
-        qkv = x @ params['qkv']                                      # [B,T,3D]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        head_dim = EMBED // HEADS
-        q = q.reshape(b, t, HEADS, head_dim)
-        k = k.reshape(b, t, HEADS, head_dim)
-        v = v.reshape(b, t, HEADS, head_dim)
-        attn = ring(q, k, v).reshape(b, t, EMBED)
-        logits = (x + attn) @ params['out']                          # [B,T,V] (residual)
-        # next-token prediction; mask the final position (no target)
-        targets = jnp.roll(tokens, -1, axis=1)
-        per_tok = -jax.nn.log_softmax(logits)[
-            jnp.arange(b)[:, None], jnp.arange(t)[None, :], targets]
-        mask = jnp.broadcast_to(jnp.arange(t)[None, :] < t - 1, per_tok.shape)
-        return (per_tok * mask).sum() / mask.sum()
 
-    batch_sharding = NamedSharding(mesh, P('data', 'seq'))
+def make_train_step(mesh, model, learning_rate=1e-2):
+    """Jitted train step over the (data, seq) mesh: embeddings/matmuls are GSPMD-sharded
+    by the batch's PartitionSpec; attention runs as ring attention over the seq axis."""
+    import jax
+    import optax
+
+    from petastorm_tpu.models import next_token_loss
+
+    optimizer = optax.adam(learning_rate)
 
     @jax.jit
-    def train_step(params, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        params = jax.tree_util.tree_map(lambda p, g: p - learning_rate * g,
-                                        params, grads)
-        return params, loss
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: next_token_loss(model.apply(p, tokens), tokens))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
 
-    return train_step, batch_sharding
+    return train_step, optimizer
 
 
 def train(dataset_url, batch_size=8, epochs=2, data_axis=None):
@@ -120,19 +104,25 @@ def train(dataset_url, batch_size=8, epochs=2, data_axis=None):
         raise ValueError('data_axis {} does not divide device count {}'
                          .format(data_axis, n_dev))
     mesh = make_mesh(('data', 'seq'), axis_sizes=(data_axis, n_dev // data_axis))
-    train_step, _ = make_train_step(mesh)
+    model = make_model(mesh)
+    train_step, optimizer = make_train_step(mesh, model)
 
-    params = init_params(jax.random.PRNGKey(0))
     loss = None
+    params = opt_state = None
     reader = make_reader(dataset_url, schema_fields=['tokens'], num_epochs=epochs,
                          shuffle_row_groups=True, seed=7)
-    with JaxDataLoader(reader, batch_size=batch_size, mesh=mesh,
-                       partition_spec=P('data', 'seq')) as loader:
-        for step, batch in enumerate(loader):
-            params, loss = train_step(params, batch['tokens'])
-            if step % 20 == 0:
-                print('step {} loss {:.4f}'.format(step, float(loss)))
-        print('input pipeline stats:', loader.stats.as_dict())
+    with mesh:
+        with JaxDataLoader(reader, batch_size=batch_size, mesh=mesh,
+                           partition_spec=P('data', 'seq')) as loader:
+            for step, batch in enumerate(loader):
+                if params is None:
+                    params = model.init(jax.random.PRNGKey(0), batch['tokens'])
+                    opt_state = optimizer.init(params)
+                params, opt_state, loss = train_step(params, opt_state,
+                                                     batch['tokens'])
+                if step % 20 == 0:
+                    print('step {} loss {:.4f}'.format(step, float(loss)))
+            print('input pipeline stats:', loader.stats.as_dict())
     return params, float(loss)
 
 
