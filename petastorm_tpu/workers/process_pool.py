@@ -147,19 +147,34 @@ class ProcessPool(object):
         poller = zmq.Poller()
         poller.register(self._results_socket, zmq.POLLIN)
         deadline = None if timeout is None else time.time() + timeout
+        next_liveness_check = 0.0
         while True:
+            # Liveness on the hot path too — not only when results stop: with several
+            # workers, survivors keep producing after one dies, but the dead worker's
+            # in-flight items are gone, so continuing would silently drop rowgroups.
+            # A dead worker while more results are expected is a loud failure
+            # (reference failure-detection contract, SURVEY.md §5.3). Throttled to
+            # ~10Hz (detection latency is bounded by the 100ms poller timeout anyway)
+            # and skipped once the ventilator reports completion — a worker dying
+            # AFTER all work finished must not turn a successful read into an error.
+            all_work_done = (self._ventilator is not None
+                             and self._ventilator.completed())
+            now = time.time()
+            if (not all_work_done and not self._stopped
+                    and now >= next_liveness_check):
+                next_liveness_check = now + 0.1
+                if any(p.poll() is not None for p in self._processes):
+                    self.stop()
+                    raise WorkerTerminationError('A worker process exited while '
+                                                 'results were still expected')
             if not poller.poll(100):
                 if self._ventilator is not None and getattr(self._ventilator, 'error', None):
                     self.stop()
                     raise self._ventilator.error
-                if self._ventilator is not None and self._ventilator.completed():
+                if all_work_done:
                     raise EmptyResultError()
                 if deadline is not None and time.time() > deadline:
                     raise TimeoutWaitingForResultError()
-                if any(p.poll() is not None for p in self._processes):
-                    self.stop()
-                    raise WorkerTerminationError('A worker process exited while results '
-                                                 'were still expected')
                 continue
             kind, payload = self._recv()
             if kind == MSG_DONE:

@@ -28,3 +28,29 @@ def test_process_pool_worker_exception_propagates(synthetic_dataset):
         with make_reader(synthetic_dataset.url, reader_pool_type='process',
                          workers_count=2, transform_spec=TransformSpec(bad)) as reader:
             list(reader)
+
+
+@pytest.mark.slow
+def test_worker_hard_kill_raises_instead_of_hanging(synthetic_dataset):
+    """SIGKILL-ing a worker mid-read must surface WorkerTerminationError promptly
+    (reference failure-detection contract, SURVEY.md §5.3) — never hang the consumer,
+    never keep silently serving from the survivors."""
+    import os
+    import signal
+    import time
+
+    from petastorm_tpu.workers.process_pool import (ProcessPool,
+                                                    WorkerTerminationError)
+
+    pool = ProcessPool(2)
+    with pytest.raises(WorkerTerminationError):
+        with make_reader(synthetic_dataset.url, reader_pool=pool,
+                         schema_fields=['id'], num_epochs=None,
+                         shuffle_row_groups=False) as reader:
+            next(reader)  # pool is up and serving
+            for process in pool._processes[:1]:
+                os.kill(process.pid, signal.SIGKILL)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                next(reader)
+            pytest.fail('reader kept serving for 30s with a killed worker')
