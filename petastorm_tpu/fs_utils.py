@@ -81,15 +81,19 @@ def _resolve_hdfs(url):
     if parsed.port:
         return pafs.HadoopFileSystem(parsed.hostname, parsed.port)
     resolver = HdfsNamenodeResolver()
+    if parsed.hostname and not resolver.configured:
+        # No hadoop config found by us at all: the authority may be a logical HA
+        # nameservice only libhdfs's own core-site.xml can resolve, so hand it over
+        # with port 0 rather than direct-connecting to <authority>:8020.
+        return pafs.HadoopFileSystem(parsed.hostname, 0)
     try:
         if not parsed.hostname:
             _, namenodes = resolver.resolve_default_hdfs_service()
         else:
             namenodes = resolver.resolve_hdfs_name_service(parsed.hostname)
     except HdfsConfigError:
-        # No usable hadoop config found by us: hand the authority (or 'default') to
-        # libhdfs with port 0 so it applies its own core-site.xml lookup — the
-        # authority may be a logical HA nameservice only libhdfs can resolve.
+        # Config exists but cannot resolve this URL (e.g. fs.defaultFS missing or
+        # non-HDFS): defer to libhdfs's own lookup as the last resort.
         return pafs.HadoopFileSystem(parsed.hostname or 'default', parsed.port or 0)
     if len(namenodes) > 1:
         # HA nameservice: return the failover proxy so metadata operations made

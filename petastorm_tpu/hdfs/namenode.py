@@ -74,6 +74,13 @@ class HdfsNamenodeResolver(object):
         self._config = configuration if configuration is not None \
             else _load_hadoop_configuration()
 
+    @property
+    def configured(self):
+        """True when any hadoop configuration was found/injected. When False, the
+        resolver cannot distinguish a logical HA nameservice from a physical host —
+        callers should defer to libhdfs's own config instead of guessing."""
+        return bool(self._config)
+
     def resolve_default_hdfs_service(self):
         """Return (nameservice, [namenode urls]) for fs.defaultFS (reference:
         namenode.py:110-120)."""

@@ -75,6 +75,8 @@ class InMemJaxLoader(object):
         # epoch cursor so repeated calls keep advancing the permutation sequence
         # instead of replaying epoch 0.
         self._scan_cache = {}
+        self._scan_compile_count = 0
+        self._scan_cache_warned = False
         self._scan_epoch = 0
 
     # ------------------------------------------------------------------ fill
@@ -252,14 +254,17 @@ class InMemJaxLoader(object):
 
                 return jax.lax.scan(body, carry, jnp.arange(batches_per_epoch))
 
+            self._scan_compile_count += 1
             if len(self._scan_cache) >= _SCAN_CACHE_MAX:
                 # A fresh lambda per call defeats reuse (closures cannot be safely
                 # deduplicated) — warn once and evict oldest so the compiled
                 # executables and their captured environments cannot accumulate.
-                warnings.warn(
-                    'scan_epochs compiled {} distinct (step_fn, shuffle) programs; '
-                    'pass a stable step_fn object to reuse compilations'
-                    .format(len(self._scan_cache) + 1))
+                if not self._scan_cache_warned:
+                    self._scan_cache_warned = True
+                    warnings.warn(
+                        'scan_epochs compiled {} distinct (step_fn, shuffle) programs; '
+                        'pass a stable step_fn object to reuse compilations'
+                        .format(self._scan_compile_count))
                 self._scan_cache.pop(next(iter(self._scan_cache)))
             self._scan_cache[cache_key] = one_epoch
         one_epoch = self._scan_cache[cache_key]

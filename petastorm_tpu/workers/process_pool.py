@@ -55,6 +55,10 @@ class ProcessPool(object):
         self._processes = []
         self._stopped = False
         self._in_flight_done = 0
+        # Instance state, not a get_results local: a typical call returns after one
+        # result, so a per-call throttle would still run the liveness probe (ventilator
+        # lock + per-worker poll) once per result.
+        self._next_liveness_check = 0.0
 
     def start(self, worker_class, worker_args=None, ventilator=None):
         import zmq
@@ -147,7 +151,6 @@ class ProcessPool(object):
         poller = zmq.Poller()
         poller.register(self._results_socket, zmq.POLLIN)
         deadline = None if timeout is None else time.time() + timeout
-        next_liveness_check = 0.0
         while True:
             # Liveness on the hot path too — not only when results stop: with several
             # workers, survivors keep producing after one dies, but the dead worker's
@@ -157,13 +160,16 @@ class ProcessPool(object):
             # ~10Hz (detection latency is bounded by the 100ms poller timeout anyway)
             # and skipped once the ventilator reports completion — a worker dying
             # AFTER all work finished must not turn a successful read into an error.
-            all_work_done = (self._ventilator is not None
-                             and self._ventilator.completed())
+            # ventilator.completed() acquires the ventilator lock (shared with the
+            # backpressure condition), so it is only evaluated inside this throttled
+            # window and on poll timeout — never per-result on the hot path.
             now = time.time()
-            if (not all_work_done and not self._stopped
-                    and now >= next_liveness_check):
-                next_liveness_check = now + 0.1
-                if any(p.poll() is not None for p in self._processes):
+            if not self._stopped and now >= self._next_liveness_check:
+                self._next_liveness_check = now + 0.1
+                all_work_done = (self._ventilator is not None
+                                 and self._ventilator.completed())
+                if (not all_work_done
+                        and any(p.poll() is not None for p in self._processes)):
                     self.stop()
                     raise WorkerTerminationError('A worker process exited while '
                                                  'results were still expected')
@@ -171,7 +177,7 @@ class ProcessPool(object):
                 if self._ventilator is not None and getattr(self._ventilator, 'error', None):
                     self.stop()
                     raise self._ventilator.error
-                if all_work_done:
+                if self._ventilator is not None and self._ventilator.completed():
                     raise EmptyResultError()
                 if deadline is not None and time.time() > deadline:
                     raise TimeoutWaitingForResultError()

@@ -526,3 +526,12 @@ class TestFsUtilsHdfsRouting:
         monkeypatch.setenv('HADOOP_HOME', '/nonexistent-hadoop')
         assert _resolve_hdfs('hdfs:///ds') == 'direct-fs'
         assert self.direct == [('default', 0)]
+
+    def test_no_hadoop_config_hands_portless_authority_to_libhdfs(self, monkeypatch):
+        # With NO local hadoop config, a portless authority may be a logical HA
+        # nameservice only libhdfs's own config can resolve — it must go to libhdfs
+        # with port 0, not direct-connect to <authority>:8020 (ADVICE round 2).
+        monkeypatch.setenv('HADOOP_HOME', '/nonexistent-hadoop')
+        assert _resolve_hdfs('hdfs://logicalns/ds') == 'direct-fs'
+        assert self.direct == [('logicalns', 0)]
+        assert self.single == [] and self.ha == []
