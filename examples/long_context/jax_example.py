@@ -16,7 +16,17 @@ parallelism):
    are trained without gathering the full sequence anywhere — and the model code is
    identical to the single-chip dense/flash configurations.
 
+Two dataset modes:
+
+- default: pre-tokenized fixed-length documents (one ``(seq_len,)`` row per doc);
+- ``--ngram-frames N``: the store holds short token *frames* of a stream and the
+  training sequence is assembled by :class:`petastorm_tpu.ngram.NGram` — N consecutive
+  frames per window, gap-checked on ``frame_id`` — flowing straight into the device
+  layer as ``(batch, N, frame_len)`` sequence-sharded arrays (the reference can only
+  emit NGram windows as python dicts; here they feed the mesh, SURVEY.md §5.7).
+
 Run: ``python -m examples.long_context.jax_example --seq-len 512``
+     ``python -m examples.long_context.jax_example --ngram-frames 8``
 """
 
 import argparse
@@ -51,6 +61,29 @@ def build_dataset(url, num_docs=256, seq_len=512, seed=0):
     return schema
 
 
+def build_frame_dataset(url, num_frames=512, frame_len=64, seed=0):
+    """Materialize a token STREAM as consecutive frames: ``frame_id`` orders them and is
+    the NGram timestamp; windows of N frames become N*frame_len-token sequences. Frames
+    of one stream segment live in one rowgroup (windows never cross rowgroups —
+    reference caveat ngram.py:85-91), so rows_per_file bounds the window range."""
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_rows
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('Frames', [
+        UnischemaField('frame_id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('tokens', np.int32, (frame_len,), NdarrayCodec(), False),
+    ])
+    rng = np.random.RandomState(seed)
+    base = rng.randint(0, VOCAB, size=8, dtype=np.int32)
+    stream = np.tile(base, num_frames * frame_len // 8 + 1)[:num_frames * frame_len]
+    rows = [{'frame_id': i, 'tokens': stream[i * frame_len:(i + 1) * frame_len]
+             .astype(np.int32)} for i in range(num_frames)]
+    write_rows(url, schema, rows, rows_per_file=max(64, num_frames // 4),
+               rowgroup_size_mb=64)
+    return schema
+
+
 def make_model(mesh):
     """The shared TransformerLM with ring attention injected over the mesh's ``seq``
     axis — the model family's documented sequence-parallel injection point
@@ -82,6 +115,11 @@ def make_train_step(mesh, model, learning_rate=1e-2):
 
     @jax.jit
     def train_step(params, opt_state, tokens):
+        if tokens.ndim == 3:
+            # NGram window batch (batch, frames, frame_len): frames are consecutive
+            # stream chunks, so flattening yields the contiguous training sequence.
+            # With the frame axis sharded over 'seq' this reshape is shard-local.
+            tokens = tokens.reshape(tokens.shape[0], -1)
         loss, grads = jax.value_and_grad(
             lambda p: next_token_loss(model.apply(p, tokens), tokens))(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -90,7 +128,7 @@ def make_train_step(mesh, model, learning_rate=1e-2):
     return train_step, optimizer
 
 
-def train(dataset_url, batch_size=8, epochs=2, data_axis=None):
+def train(dataset_url, batch_size=8, epochs=2, data_axis=None, ngram_frames=0):
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -107,16 +145,31 @@ def train(dataset_url, batch_size=8, epochs=2, data_axis=None):
     model = make_model(mesh)
     train_step, optimizer = make_train_step(mesh, model)
 
+    if ngram_frames:
+        from petastorm_tpu.ngram import NGram
+        ngram = NGram({i: ['tokens'] for i in range(ngram_frames)},
+                      delta_threshold=1, timestamp_field='frame_id')
+        reader = make_reader(dataset_url, schema_fields=ngram, num_epochs=epochs,
+                             shuffle_row_groups=True, seed=7)
+        # windows arrive (batch, frames, frame_len): shard the frame axis over 'seq'
+        spec = {'tokens': P('data', 'seq'), 'frame_id': P('data', 'seq')}
+    else:
+        reader = make_reader(dataset_url, schema_fields=['tokens'], num_epochs=epochs,
+                             shuffle_row_groups=True, seed=7)
+        spec = P('data', 'seq')
+
     loss = None
     params = opt_state = None
-    reader = make_reader(dataset_url, schema_fields=['tokens'], num_epochs=epochs,
-                         shuffle_row_groups=True, seed=7)
     with mesh:
         with JaxDataLoader(reader, batch_size=batch_size, mesh=mesh,
-                           partition_spec=P('data', 'seq')) as loader:
+                           partition_spec=spec) as loader:
             for step, batch in enumerate(loader):
                 if params is None:
-                    params = model.init(jax.random.PRNGKey(0), batch['tokens'])
+                    # leading dim is the GLOBAL batch (batch_size x process_count)
+                    tokens = batch['tokens']
+                    params = model.init(jax.random.PRNGKey(0),
+                                        jax.numpy.reshape(tokens,
+                                                          (tokens.shape[0], -1)))
                     opt_state = optimizer.init(params)
                 params, opt_state, loss = train_step(params, opt_state,
                                                      batch['tokens'])
@@ -136,15 +189,35 @@ def main():
     parser.add_argument('--data-axis', type=int, default=None,
                         help='mesh data-axis size (default: 2 if the device count is '
                              'even, else 1; seq axis gets the rest)')
+    parser.add_argument('--ngram-frames', type=int, default=0,
+                        help='assemble training sequences as NGram windows of this many '
+                             'consecutive token frames (0 = pre-tokenized docs mode)')
     args = parser.parse_args()
 
-    url = args.dataset_url or os.path.join(tempfile.gettempdir(), 'long_context_demo')
+    if args.ngram_frames:
+        if args.seq_len % args.ngram_frames or args.seq_len < args.ngram_frames:
+            parser.error('--ngram-frames ({}) must divide --seq-len ({})'
+                         .format(args.ngram_frames, args.seq_len))
+        # cache path keyed by the geometry: changing the flags must not silently
+        # reuse a store with a different frame length
+        suffix = '_frames_{}x{}'.format(args.num_docs,
+                                        args.seq_len // args.ngram_frames)
+    else:
+        suffix = ''
+    url = args.dataset_url or os.path.join(tempfile.gettempdir(),
+                                           'long_context_demo' + suffix)
     if not os.path.exists(os.path.join(url.replace('file://', ''), '_common_metadata')):
-        print('materializing {} docs x {} tokens to {}'.format(
-            args.num_docs, args.seq_len, url))
-        build_dataset(url, args.num_docs, args.seq_len)
+        if args.ngram_frames:
+            frame_len = args.seq_len // args.ngram_frames
+            print('materializing {} frames x {} tokens to {}'.format(
+                args.num_docs, frame_len, url))
+            build_frame_dataset(url, num_frames=args.num_docs, frame_len=frame_len)
+        else:
+            print('materializing {} docs x {} tokens to {}'.format(
+                args.num_docs, args.seq_len, url))
+            build_dataset(url, args.num_docs, args.seq_len)
     _, final_loss = train(url, batch_size=args.batch_size, epochs=args.epochs,
-                          data_axis=args.data_axis)
+                          data_axis=args.data_axis, ngram_frames=args.ngram_frames)
     print('final loss: {:.4f}'.format(final_loss))
 
 

@@ -145,6 +145,31 @@ class NGram(object):
             prev_end_ts = timestamps[start + length - 1]
         return np.asarray(starts, dtype=np.int64)
 
+    def windows_as_arrays(self, columns, starts):
+        """Materialize windows as window-major arrays: ``{field: (num_windows, length,
+        *field_shape)}`` via one vectorized gather per column — the device-layer
+        representation (SURVEY.md §5.7: sequence batches for the mesh, the idiomatic
+        TPU extension the reference's row-dict windows cannot feed).
+
+        Every column is emitted over the FULL window length; the reference's per-offset
+        field subsets (ngram.py:215-223) are a row-path view — on device, slicing the
+        length axis is free (XLA fuses it), so consumers take ``batch[field][:, off]``
+        where needed. Overlapping windows are materialized (O(windows x length) host
+        memory, vs the shared-column row path's O(rows)); that copy is the price of a
+        dense device array and is what ``jax.Array`` needs anyway."""
+        starts = np.asarray(starts, dtype=np.int64)
+        length = self.length
+        idx = starts[:, None] + np.arange(length, dtype=np.int64)
+        out = {}
+        for name, col in columns.items():
+            if isinstance(col, list):
+                raise ValueError(
+                    'NGram field {!r} is ragged (variable shape); give it a fixed '
+                    'shape via a TransformSpec before forming device windows'
+                    .format(name))
+            out[name] = np.asarray(col)[idx]
+        return out
+
     def form_ngram(self, rows):
         """Row-dict formation: list of {offset: row_dict-subset} (reference semantics)."""
         if not rows:

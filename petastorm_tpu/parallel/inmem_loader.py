@@ -29,7 +29,11 @@ class InMemJaxLoader(object):
     """Fill once from ``reader``, then iterate seeded shuffled batches for
     ``num_epochs`` (None = infinite).
 
-    :param reader: petastorm_tpu Reader (row or batched; non-NGram).
+    :param reader: petastorm_tpu Reader (row, batched, or NGram). NGram readers fill
+        window-major: every "row" in memory is one window, each field
+        ``(length, *field_shape)``, so batches are ``(batch, length, ...)`` sequence
+        arrays (note overlapping windows are materialized — budget
+        ``rows_capacity x length`` memory).
     :param batch_size: rows per batch on this host.
     :param num_epochs: epochs to serve from memory (None = infinite). Independent of the
         reader's own ``num_epochs``, which only governs the fill (use reader
@@ -82,8 +86,6 @@ class InMemJaxLoader(object):
     # ------------------------------------------------------------------ fill
 
     def _fill(self, reader, rows_capacity):
-        if getattr(reader, 'ngram', None) is not None:
-            raise ValueError('InMemJaxLoader does not support NGram readers')
         if rows_capacity is None and reader_may_be_infinite(reader):
             raise ValueError(
                 'rows_capacity is required with a (possibly) infinite reader: '

@@ -69,7 +69,12 @@ class LoaderStats(object):
 class JaxDataLoader(object):
     """Iterates pytrees (dicts) of device-sharded arrays assembled from a Reader.
 
-    :param reader: a petastorm_tpu Reader (row or batched).
+    :param reader: a petastorm_tpu Reader (row, batched, or NGram). An NGram reader
+        yields sequence batches: each window field arrives as
+        ``(batch, ngram.length, *field_shape)`` (windows are the batch axis — shuffle
+        buffer, padding and sharding all operate on windows), ready for
+        ``partition_spec={'field': PartitionSpec('data', 'seq')}`` sequence sharding.
+        Checkpointing (``state_dict``) is unsupported for NGram readers.
     :param batch_size: rows per emitted batch **on this host**. With a multi-host mesh the
         global batch is ``batch_size * jax.process_count()``.
     :param mesh: optional ``jax.sharding.Mesh``; None = single default device.
@@ -345,7 +350,10 @@ class JaxDataLoader(object):
         With a shuffling buffer, emission order differs from ingest order, so per-item
         attribution is only trustworthy when nothing is pending — checkpoint at a stream
         boundary (after the iterator is exhausted) in that case."""
-        if self._delivery_supported is False:
+        if self._delivery_supported is False or getattr(self.reader, 'ngram', None) is not None:
+            # The explicit ngram check matters before the first chunk is observed
+            # (_delivery_supported still None): an NGram state_dict would look valid
+            # here but resume_state is rejected at reader construction.
             raise ValueError('state_dict requires a Reader with the columnar fast path '
                              '(iter_columnar, non-NGram)')
         with self._fifo_lock:
@@ -389,7 +397,10 @@ def iter_reader_chunks(reader, accum_rows=4096, include_empty=False):
     batched-namedtuple or per-row accumulation (``accum_rows`` per chunk). The single
     reader-dispatch used by both JaxDataLoader and InMemJaxLoader."""
     iter_columnar = getattr(reader, 'iter_columnar', None)
-    if iter_columnar is not None and getattr(reader, 'ngram', None) is None:
+    if iter_columnar is not None:
+        # NGram readers ride the same path: iter_columnar yields window-major batches
+        # ({field: (num_windows, length, ...)}) whose item_id is None, so delivery
+        # accounting degrades gracefully to unsupported.
         for batch in iter_columnar(include_empty=include_empty):
             yield dict(batch.columns), batch.num_rows, batch.item_id
     elif getattr(reader, 'is_batched_reader', False):

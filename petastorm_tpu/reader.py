@@ -17,7 +17,7 @@ from petastorm_tpu.errors import MetadataError, NoDataAvailableError
 from petastorm_tpu.etl import dataset_metadata
 from petastorm_tpu.fs_utils import (as_arrow_filesystem, make_filesystem_factory,
                                     normalize_dataset_url_or_urls)
-from petastorm_tpu.reader_worker import RowGroupWorker, WorkerSetup
+from petastorm_tpu.reader_worker import ColumnarBatch, RowGroupWorker, WorkerSetup
 from petastorm_tpu.unischema import Unischema, match_unischema_fields
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.dummy_pool import DummyPool
@@ -371,12 +371,14 @@ class Reader(object):
     def iter_columnar(self, include_empty=False):
         """Iterate raw :class:`ColumnarBatch` results straight off the worker pool —
         the zero-copy fast path for columnar consumers (JaxDataLoader), skipping the
-        per-row namedtuple conversion of ``__next__``. Do not interleave with ``next()``;
-        not available for NGram readers. ``include_empty`` also yields zero-row batches
-        (published so every work item is observable — delivery-exact checkpointing
-        needs them)."""
-        if self.ngram is not None:
-            raise ValueError('iter_columnar is not supported with NGram windows')
+        per-row namedtuple conversion of ``__next__``. Do not interleave with ``next()``.
+        ``include_empty`` also yields zero-row batches (published so every work item is
+        observable — delivery-exact checkpointing needs them).
+
+        NGram readers yield WINDOW-major batches: each column is
+        ``(num_windows, ngram.length, *field_shape)`` (``NGram.windows_as_arrays``) and
+        ``num_rows`` counts windows. Window batches carry no ``item_id`` (pieces with
+        zero windows publish nothing), so checkpoint/resume stays unsupported for NGram."""
         while True:
             if self._stopped:
                 raise RuntimeError('Trying to read from a stopped reader')
@@ -385,6 +387,12 @@ class Reader(object):
             except EmptyResultError:
                 self.last_row_consumed = True
                 return
+            if self.ngram is not None:
+                # NGramWindows payload (shared columns + gather starts) -> dense
+                # window-major arrays, one vectorized gather per column.
+                batch = ColumnarBatch(
+                    self.ngram.windows_as_arrays(batch.columns, batch.starts),
+                    len(batch.starts))
             self._note_item_consumed(batch)
             if self._resume_fast_forward and batch.item_id is not None:
                 # Honor a row_cursor from a row-path checkpoint: skip the rows that

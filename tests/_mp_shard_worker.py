@@ -1,0 +1,71 @@
+"""Subprocess body for the multi-process loader proof (tests/test_multiprocess.py).
+
+Runs as one of N real processes coordinated by ``jax.distributed.initialize`` on the
+CPU backend: discovers its shard from the JAX runtime (NOT from explicit kwargs),
+reads its shard through JaxDataLoader over a global mesh, and reports everything the
+parent needs to prove the sharding contract (served row ids, global batch shapes,
+process/device counts) as one JSON file.
+
+Not a test module — invoked by path with:
+    python _mp_shard_worker.py <process_id> <num_processes> <coordinator> <url> <out>
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    coordinator = sys.argv[3]
+    dataset_url = sys.argv[4]
+    out_path = sys.argv[5]
+
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes, process_id=process_id)
+
+    import numpy as np
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.parallel import JaxDataLoader, make_mesh
+    from petastorm_tpu.parallel.mesh import distributed_shard_info
+
+    # The flagship discovery path: shard comes from the initialized JAX runtime.
+    cur_shard, shard_count = distributed_shard_info()
+
+    reader = make_reader(dataset_url, cur_shard=cur_shard, shard_count=shard_count,
+                         workers_count=1, num_epochs=1, shuffle_row_groups=False)
+    mesh = make_mesh(('data',))  # global mesh: every device of every process
+    loader = JaxDataLoader(reader, batch_size=4, mesh=mesh, drop_last=False)
+
+    served = []
+    global_batch_rows = []
+    for batch in loader:
+        arr = batch['id']
+        global_batch_rows.append(int(arr.shape[0]))
+        # This process's slice of the global array: exactly the rows it fed in.
+        local = np.concatenate(
+            [np.asarray(shard.data) for shard in arr.addressable_shards])
+        served.extend(int(v) for v in local)
+    reader.stop()
+    reader.join()
+
+    with open(out_path, 'w') as f:
+        json.dump({
+            'process_id': process_id,
+            'discovered_shard': [cur_shard, shard_count],
+            'process_count': jax.process_count(),
+            'global_device_count': len(jax.devices()),
+            'local_device_count': len(jax.local_devices()),
+            'served': served,
+            'global_batch_rows': global_batch_rows,
+        }, f)
+    jax.distributed.shutdown()
+
+
+if __name__ == '__main__':
+    main()
