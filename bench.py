@@ -59,10 +59,26 @@ IMG_ROWS = int(os.environ.get('BENCH_IMG_ROWS', 768))
 IMG_HW = int(os.environ.get('BENCH_IMG_HW', 128))
 IMG_BATCH = int(os.environ.get('BENCH_IMG_BATCH', 64))
 IMG_EPOCHS = int(os.environ.get('BENCH_IMG_EPOCHS', 3))
-PROBE_TIMEOUT_S = int(os.environ.get('BENCH_PROBE_TIMEOUT', 120))
-PROBE_ATTEMPTS = int(os.environ.get('BENCH_PROBE_ATTEMPTS', 5))
-PROBE_BACKOFF_S = (15, 30, 60, 120)
-CHILD_TIMEOUT_S = int(os.environ.get('BENCH_CHILD_TIMEOUT', 1800))
+# larger-than-HBM streaming config (VERDICT r2 item 2): process pool + on-chip DCT
+# decode feeding a real-depth ResNet
+STREAM_EPOCHS = int(os.environ.get('BENCH_STREAM_EPOCHS', 3))
+STREAM_POOL = os.environ.get('BENCH_STREAM_POOL', 'process')
+STREAM_STAGES = tuple(int(s) for s in
+                      os.environ.get('BENCH_STREAM_STAGES', '3,8,36,3').split(','))
+# flash-attention long-context section (VERDICT r2 item 6)
+FLASH_T = int(os.environ.get('BENCH_FLASH_T', 8192))
+FLASH_BATCH = int(os.environ.get('BENCH_FLASH_BATCH', 2))
+FLASH_EMBED = int(os.environ.get('BENCH_FLASH_EMBED', 512))
+FLASH_HEADS = int(os.environ.get('BENCH_FLASH_HEADS', 4))  # head_dim 128 = TPU lane
+FLASH_LAYERS = int(os.environ.get('BENCH_FLASH_LAYERS', 4))
+FLASH_STEPS = int(os.environ.get('BENCH_FLASH_STEPS', 8))
+FLASH_ROWS = int(os.environ.get('BENCH_FLASH_ROWS', 64))
+# probe/backoff shrunk (VERDICT r2 item 1) so >= two child attempts fit the driver
+# window even when every probe times out
+PROBE_TIMEOUT_S = int(os.environ.get('BENCH_PROBE_TIMEOUT', 90))
+PROBE_ATTEMPTS = int(os.environ.get('BENCH_PROBE_ATTEMPTS', 3))
+PROBE_BACKOFF_S = (10, 20)
+CHILD_TIMEOUT_S = int(os.environ.get('BENCH_CHILD_TIMEOUT', 1500))
 CHILD_ATTEMPTS = int(os.environ.get('BENCH_CHILD_ATTEMPTS', 2))
 
 
@@ -94,8 +110,23 @@ def build_dataset(url):
 
 
 def imagenet_dataset_url():
+    # 'dct2': v2 content (photograph-like images) — must not collide with the round-2
+    # uniform-noise stores cached in this tempdir under the old key
     return os.path.join(tempfile.gettempdir(),
-                        'petastorm_tpu_bench_dct_{}_{}'.format(IMG_ROWS, IMG_HW))
+                        'petastorm_tpu_bench_dct2_{}_{}'.format(IMG_ROWS, IMG_HW))
+
+
+def _synthetic_photo(rng, hw):
+    """Photograph-like synthetic image: low-frequency structure + mild texture.
+    Uniform noise is the pathological case for a DCT store (quantization keeps every
+    high-frequency coefficient, so parquet compression cannot do its job); real
+    photographs are low-frequency dominated, which is exactly what the DCT
+    representation and the storage compressor exploit. Built as upsampled coarse
+    noise (smooth fields) plus low-amplitude texture."""
+    coarse = rng.randint(0, 255, (hw // 16, hw // 16, 3)).astype(np.float32)
+    img = np.kron(coarse, np.ones((16, 16, 1), dtype=np.float32))
+    texture = rng.randn(hw, hw, 3).astype(np.float32) * 4.0
+    return np.clip(img + texture, 0, 255).astype(np.uint8)
 
 
 def build_imagenet_dataset(url):
@@ -114,7 +145,7 @@ def build_imagenet_dataset(url):
     ])
     rng = np.random.RandomState(0)
     rows = [{'idx': i, 'label': int(rng.randint(1000)),
-             'image': rng.randint(0, 255, (IMG_HW, IMG_HW, 3), dtype=np.uint8)}
+             'image': _synthetic_photo(rng, IMG_HW)}
             for i in range(IMG_ROWS)]
     write_rows(url, schema, rows, rowgroup_size_mb=16, n_files=4)
 
@@ -141,8 +172,25 @@ def probe_tpu():
     return False
 
 
+def _salvage_partial(stdout):
+    """Newest PARTIAL_JSON line from a dead child's stdout, or None. Sections emit
+    cumulative partials, so the last line carries everything that completed."""
+    if not stdout:
+        return None
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith('PARTIAL_JSON '):
+            try:
+                return json.loads(line[len('PARTIAL_JSON '):])
+            except ValueError:
+                continue
+    return None
+
+
 def run_child(platform_env, extra_env=None):
-    """Run the measured bench in a child; return the parsed JSON dict or None."""
+    """Run the measured bench in a child; return (final_json_or_None,
+    partial_json_or_None). A child that times out or crashes mid-run still
+    contributes its completed sections through the partial."""
     env = dict(os.environ)
     env['BENCH_CHILD'] = '1'
     if platform_env is not None:
@@ -154,25 +202,27 @@ def run_child(platform_env, extra_env=None):
                              capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
                              env=env)
     except subprocess.TimeoutExpired as exc:
-        stderr = exc.stderr or b''
+        stdout, stderr = exc.stdout or b'', exc.stderr or b''
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode('utf-8', 'replace')
         if isinstance(stderr, bytes):
             stderr = stderr.decode('utf-8', 'replace')
         log('child: timed out after {}s; stderr tail: {!r}'
             .format(CHILD_TIMEOUT_S, stderr[-2000:]))
-        return None
+        return None, _salvage_partial(stdout)
     sys.stderr.write(out.stderr)
     if out.returncode != 0:
         log('child: rc={}'.format(out.returncode))
-        return None
+        return None, _salvage_partial(out.stdout)
     for line in reversed(out.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith('{'):
             try:
-                return json.loads(line)
+                return json.loads(line), None
             except ValueError:
                 continue
     log('child: no JSON line on stdout')
-    return None
+    return None, _salvage_partial(out.stdout)
 
 
 def orchestrate():
@@ -191,17 +241,27 @@ def orchestrate():
             time.sleep(delay)
 
     result = None
+    best_partial = None
     if tpu_up:
         for attempt in range(CHILD_ATTEMPTS):
-            result = run_child(platform_env=None)
+            result, partial = run_child(platform_env=None)
+            if partial is not None and (best_partial is None
+                                        or len(partial) >= len(best_partial)):
+                best_partial = partial
             if result is not None:
                 break
             log('bench child failed (attempt {})'.format(attempt + 1))
             if attempt < CHILD_ATTEMPTS - 1:
-                time.sleep(30)
+                time.sleep(15)
                 if not probe_tpu():
                     log('TPU gone after child failure')
                     break
+
+    if result is None and best_partial is not None and 'value' in best_partial:
+        # The TPU child died mid-run but completed the headline section: a partial
+        # TPU measurement beats a complete CPU fallback.
+        log('using salvaged partial TPU results ({} fields)'.format(len(best_partial)))
+        result = best_partial
 
     if result is None:
         log('FALLBACK: TPU unavailable — measuring on CPU so the round still has a '
@@ -209,23 +269,41 @@ def orchestrate():
         # A single host core cannot push the TPU-sized workload through the child
         # timeout; shrink it (explicit BENCH_* env vars still win) so a number is
         # guaranteed.
-        # values validated to finish in ~15 min on this 1-core host (jit compiles
-        # dominate), safely inside CHILD_TIMEOUT_S
-        result = run_child(platform_env='cpu', extra_env={
+        # values validated to finish well inside CHILD_TIMEOUT_S on this 1-core host
+        # (jit compiles dominate)
+        result, partial = run_child(platform_env='cpu', extra_env={
             'BENCH_ROWS': '4000', 'BENCH_BATCH': '512', 'BENCH_EPOCHS': '1',
-            'BENCH_IMG_ROWS': '128', 'BENCH_IMG_EPOCHS': '1', 'BENCH_WORKERS': '2'})
+            'BENCH_IMG_ROWS': '96', 'BENCH_IMG_HW': '64', 'BENCH_IMG_EPOCHS': '1',
+            'BENCH_IMG_BATCH': '32', 'BENCH_WORKERS': '2',
+            'BENCH_STREAM_EPOCHS': '1', 'BENCH_STREAM_STAGES': '1,1,1,1',
+            'BENCH_FLASH_T': '512', 'BENCH_FLASH_BATCH': '1',
+            'BENCH_FLASH_LAYERS': '1', 'BENCH_FLASH_STEPS': '2',
+            'BENCH_FLASH_ROWS': '8'})
+        if result is None:
+            result = partial  # even a partial CPU run beats exiting empty
         if result is not None:
             result['platform'] = 'cpu'
             result['tpu_reference'] = (
-                'bench_results/r02_tpu_runs.jsonl — committed real-TPU runs of this '
-                'same bench (last line = final config); this CPU line exists only '
-                'because the accelerator tunnel was down at bench time')
+                'bench_results/ — committed real-TPU runs of this bench from earlier '
+                'rounds; this CPU line exists only because the accelerator tunnel '
+                'was down at bench time')
 
     if result is None:
         log('bench failed on all platforms')
         sys.exit(1)
     if 'platform' not in result:
         log('WARNING: child JSON carries no platform field')
+    # Salvaged partials come from PARTIAL_JSON lines emitted BEFORE the child's final
+    # normalization — enforce the one-JSON-line contract ({metric, value, unit,
+    # vs_baseline}) here for every path.
+    result.setdefault('metric', 'mnist_train_rows_per_sec_per_chip')
+    result.setdefault('unit', 'rows/s/chip')
+    if 'value' not in result:
+        result['value'] = result.get('streaming_rows_per_sec', 0.0)
+        result['vs_baseline'] = result.get('streaming_vs_baseline', 0.0)
+        result['config'] = 'streaming_fallback_headline'
+    result.setdefault('vs_baseline',
+                      round(result['value'] / REFERENCE_BASELINE_ROWS_PER_SEC, 3))
     print(json.dumps(result))
 
 
@@ -235,6 +313,17 @@ def child_main():
         # The accelerator plugin on this image pins the platform at import; the env var
         # alone does not reach it — the config update is load-bearing for CPU fallback.
         jax.config.update('jax_platforms', 'cpu')
+    # Persistent compilation cache: a retried child (tunnel flake mid-run) must not
+    # re-pay the big ResNet/flash compiles (VERDICT r2 item 1). TPU-only: cached
+    # XLA:CPU AOT results encode exact host CPU features and can SIGILL when the
+    # feature sets drift (observed on this image), and CPU compiles are cheap anyway.
+    if os.environ.get('JAX_PLATFORMS') != 'cpu':
+        cache_dir = os.path.join(tempfile.gettempdir(), 'petastorm_tpu_jax_cache')
+        try:
+            jax.config.update('jax_compilation_cache_dir', cache_dir)
+            jax.config.update('jax_persistent_cache_min_compile_time_secs', 2)
+        except Exception as exc:  # noqa: BLE001 - cache is an optimization only
+            log('compilation cache unavailable: {!r}'.format(exc))
     import jax.numpy as jnp
     import optax
 
@@ -409,43 +498,247 @@ def child_main():
             .format(host, onchip, onchip / max(host, 1e-9)))
         return host, onchip
 
-    log('warmup epoch (compile + cache)...')
-    run_epoch(measure=False)
-    stream_rates, stream_stalls = [], []
-    for _ in range(EPOCHS):
-        rate, stall = run_epoch(measure=True)
-        stream_rates.append(rate)
-        stream_stalls.append(stall)
-    inmem_results, fill_epoch_s = run_inmem()
-    decode_host, decode_onchip = run_decode_delta()
-    inmem_rates = [r for r, _ in inmem_results]
-    inmem_stalls = [s for _, s in inmem_results]
-    # median: per-epoch rates on a shared host are noisy (transient CPU contention can
-    # halve a single epoch); the median is the robust steady-state estimate
-    value = float(np.median(inmem_rates))
-    stall = float(np.median(inmem_stalls))
-    stream_value = float(np.median(stream_rates))
-    stream_stall = float(np.median(stream_stalls))
-    log('inmem: {:.0f} rows/s stall {:.3f}; streaming: {:.0f} rows/s stall {:.3f}'
-        .format(value, stall, stream_value, stream_stall))
-    print(json.dumps({
-        'metric': 'mnist_train_rows_per_sec_per_chip',
-        'value': round(value, 2),
-        'unit': 'rows/s/chip',
-        'vs_baseline': round(value / REFERENCE_BASELINE_ROWS_PER_SEC, 3),
-        'input_stall_fraction': round(stall, 4),
-        'config': 'inmem_hbm_resident_epochs',
-        'fill_epoch_s': round(fill_epoch_s, 3),
-        'streaming_rows_per_sec': round(stream_value, 2),
-        'streaming_vs_baseline': round(stream_value / REFERENCE_BASELINE_ROWS_PER_SEC, 3),
-        'streaming_input_stall_fraction': round(stream_stall, 4),
-        'imagenet_host_decode_rows_per_sec': round(decode_host, 2),
-        'imagenet_onchip_decode_rows_per_sec': round(decode_onchip, 2),
-        'onchip_decode_speedup': round(decode_onchip / max(decode_host, 1e-9), 3),
-        'value_mean': round(float(np.mean(inmem_rates)), 2),
-        'estimator': 'median_of_{}_epochs'.format(EPOCHS),
-        'platform': jax.devices()[0].platform,
-    }))
+    def run_imagenet_stream():
+        """The larger-than-HBM streaming configuration (VERDICT r2 item 2): DCT store
+        read by the BENCH_STREAM_POOL pool (spawn + Arrow IPC wire for 'process'),
+        raw int16 coefficient blocks to the chip, dequant+IDCT on the MXU inside the
+        jitted real-depth ResNet train step, JaxDataLoader prefetch double-buffering.
+        ONE reader serves warmup+measured epochs so per-epoch numbers measure the
+        steady state, not worker-spawn cost; per-epoch stall comes from loader.stats
+        deltas. This is the config where the streaming machinery itself must carry
+        the north star (stall < 0.10) — the dataset is never HBM-resident."""
+        from petastorm_tpu.codecs import DctCoefficientsCodec
+        from petastorm_tpu.models.resnet import ResNet
+        from petastorm_tpu.ops.image import normalize_image
+        from petastorm_tpu.ops.image_decode import dct_decode_images_jax
+        from petastorm_tpu.unischema import UnischemaField
+        img_url = imagenet_dataset_url()
+        if not os.path.exists(os.path.join(img_url, '_common_metadata')):
+            log('materializing {} DCT images to {}'.format(IMG_ROWS, img_url))
+            build_imagenet_dataset(img_url)
+
+        model = ResNet(stage_sizes=list(STREAM_STAGES), num_classes=1000,
+                       num_filters=64)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((IMG_BATCH, IMG_HW, IMG_HW, 3)))
+        params, batch_stats = variables['params'], variables['batch_stats']
+        optimizer = optax.sgd(0.1, momentum=0.9)
+        opt_state = optimizer.init(params)
+
+        @jax.jit
+        def stream_step(params, batch_stats, opt_state, coeffs, labels):
+            images = dct_decode_images_jax(coeffs, quality=90)
+            images = normalize_image(images, mean=127.5, std=127.5,
+                                     dtype=jnp.bfloat16)
+
+            def loss_fn(p):
+                logits, updates = model.apply(
+                    {'params': p, 'batch_stats': batch_stats}, images, train=True,
+                    mutable=['batch_stats'])
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels).mean()
+                return loss, updates['batch_stats']
+
+            (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state2 = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_stats, opt_state2, loss
+
+        override = UnischemaField('image', np.int16,
+                                  (IMG_HW // 8, IMG_HW // 8, 8, 8, 3),
+                                  DctCoefficientsCodec(quality=90), False)
+        reader = make_reader(img_url, reader_pool_type=STREAM_POOL,
+                             workers_count=WORKERS, num_epochs=STREAM_EPOCHS + 1,
+                             shuffle_row_groups=True, seed=13,
+                             field_overrides=[override])
+        loader = JaxDataLoader(reader, batch_size=IMG_BATCH, prefetch=4,
+                               drop_last=True)
+        rows_per_epoch = (len(reader) // IMG_BATCH) * IMG_BATCH
+        rates, stalls = [], []
+        epoch_rows = 0
+        loss = None
+        prev_stats = dict(loader.stats.as_dict())
+        epoch_start = time.perf_counter()
+        for batch in loader:
+            params, batch_stats, opt_state, loss = stream_step(
+                params, batch_stats, opt_state, batch['image'], batch['label'])
+            epoch_rows += IMG_BATCH
+            if epoch_rows >= rows_per_epoch:
+                float(np.asarray(loss))  # gate timing on a real device readback
+                now = time.perf_counter()
+                stats = loader.stats.as_dict()
+                wait = stats['wait_time_s'] - prev_stats['wait_time_s']
+                total = stats['total_time_s'] - prev_stats['total_time_s']
+                rate = epoch_rows / (now - epoch_start)
+                stall = wait / total if total > 0 else 0.0
+                rates.append(rate)
+                stalls.append(stall)
+                log('imagenet stream epoch: {} rows in {:.2f}s -> {:.1f} rows/s, '
+                    'stall {:.3f}'.format(epoch_rows, now - epoch_start, rate, stall))
+                prev_stats, epoch_rows, epoch_start = stats, 0, now
+        reader.stop()
+        reader.join()
+        # epoch 0 carries every compile: it is warmup, not steady state
+        measured_rates, measured_stalls = rates[1:] or rates, stalls[1:] or stalls
+        results.update({
+            'imagenet_stream_rows_per_sec': round(float(np.median(measured_rates)), 2),
+            'imagenet_stream_input_stall_fraction':
+                round(float(np.median(measured_stalls)), 4),
+            'imagenet_stream_config': '{}_pool+dct_onchip_decode+resnet{}x{}@{}px_b{}'
+                .format(STREAM_POOL, '-'.join(map(str, STREAM_STAGES)), 64,
+                        IMG_HW, IMG_BATCH),
+        })
+
+    def run_flash():
+        """Long-context compute section (VERDICT r2 item 6): train TransformerLM with
+        the Pallas flash-attention kernels at T=BENCH_FLASH_T, feeding token windows
+        through InMemJaxLoader. no_fallback is asserted from the kernel's own dispatch
+        predicate (_use_pallas) — if shapes ever stopped tiling, this flips to False
+        rather than silently benchmarking the dense path."""
+        from types import SimpleNamespace
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_rows
+        from petastorm_tpu.models import TransformerLM, next_token_loss
+        from petastorm_tpu.ops.flash_attention import _use_pallas, flash_attention
+        from petastorm_tpu.parallel import InMemJaxLoader
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        head_dim = FLASH_EMBED // FLASH_HEADS
+        shape_q = SimpleNamespace(shape=(FLASH_BATCH, FLASH_T, FLASH_HEADS, head_dim))
+        no_fallback = bool(_use_pallas(shape_q, shape_q, 256, 256))
+
+        token_url = os.path.join(tempfile.gettempdir(),
+                                 'petastorm_tpu_bench_tokens_{}_{}'
+                                 .format(FLASH_ROWS, FLASH_T))
+        if not os.path.exists(os.path.join(token_url, '_common_metadata')):
+            schema = Unischema('Tokens', [
+                UnischemaField('doc_id', np.int64, (), ScalarCodec(), False),
+                UnischemaField('tokens', np.int32, (FLASH_T,), NdarrayCodec(), False),
+            ])
+            rng = np.random.RandomState(0)
+            base = rng.randint(0, 255, size=16, dtype=np.int32)
+            rows = [{'doc_id': i,
+                     'tokens': np.roll(np.tile(base, FLASH_T // 16 + 1)[:FLASH_T], i)
+                     .astype(np.int32)} for i in range(FLASH_ROWS)]
+            write_rows(token_url, schema, rows, rowgroup_size_mb=32, n_files=2)
+
+        model = TransformerLM(vocab=256, embed=FLASH_EMBED, heads=FLASH_HEADS,
+                              layers=FLASH_LAYERS, max_len=FLASH_T,
+                              attention_fn=lambda q, k, v: flash_attention(
+                                  q, k, v, causal=True))
+        optimizer = optax.adam(3e-4)
+
+        @jax.jit
+        def flash_step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: next_token_loss(model.apply(p, tokens), tokens))(params)
+            updates, opt_state2 = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        reader = make_reader(token_url, workers_count=2, num_epochs=1,
+                             shuffle_row_groups=False)
+        loader = InMemJaxLoader(reader, batch_size=FLASH_BATCH, num_epochs=None,
+                                shuffle=True, seed=3, drop_last=True)
+        it = iter(loader)
+        first = next(it)
+        params = model.init(jax.random.PRNGKey(0), first['tokens'])
+        opt_state = optimizer.init(params)
+        params, opt_state, loss = flash_step(params, opt_state, first['tokens'])
+        float(np.asarray(loss))  # warmup: compile fwd+bwd
+        start = time.perf_counter()
+        for _ in range(FLASH_STEPS):
+            batch = next(it)
+            params, opt_state, loss = flash_step(params, opt_state, batch['tokens'])
+        final_loss = float(np.asarray(loss))
+        elapsed = time.perf_counter() - start
+        tokens_per_sec = FLASH_STEPS * FLASH_BATCH * FLASH_T / elapsed
+        log('flash: {} steps of [{}x{}] in {:.2f}s -> {:.0f} tokens/s '
+            '(no_fallback={}, loss {:.3f})'.format(
+                FLASH_STEPS, FLASH_BATCH, FLASH_T, elapsed, tokens_per_sec,
+                no_fallback, final_loss))
+        results.update({
+            'flash_train_tokens_per_sec': round(tokens_per_sec, 1),
+            'flash_seq_len': FLASH_T,
+            'flash_no_fallback': no_fallback,
+            'flash_model': 'TransformerLM(embed={},heads={},layers={})'.format(
+                FLASH_EMBED, FLASH_HEADS, FLASH_LAYERS),
+        })
+
+    # ---------------------------------------------------------------- orchestration
+    platform = jax.devices()[0].platform
+    results = {'platform': platform}
+
+    def emit_partial():
+        # Incremental results: if a later section (or the tunnel) dies, the parent
+        # salvages the last PARTIAL_JSON line from this child's stdout.
+        print('PARTIAL_JSON ' + json.dumps(dict(results, partial=True)), flush=True)
+
+    def run_section(name, fn):
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001 - a section failure must not zero the rest
+            import traceback
+            log('section {} FAILED: {!r}\n{}'.format(name, exc, traceback.format_exc()))
+            results[name + '_error'] = repr(exc)
+        emit_partial()
+
+    def run_mnist_stream():
+        log('warmup epoch (compile + cache)...')
+        run_epoch(measure=False)
+        stream_rates, stream_stalls = [], []
+        for _ in range(EPOCHS):
+            rate, stall = run_epoch(measure=True)
+            stream_rates.append(rate)
+            stream_stalls.append(stall)
+        stream_value = float(np.median(stream_rates))
+        results.update({
+            'streaming_rows_per_sec': round(stream_value, 2),
+            'streaming_vs_baseline':
+                round(stream_value / REFERENCE_BASELINE_ROWS_PER_SEC, 3),
+            'streaming_input_stall_fraction':
+                round(float(np.median(stream_stalls)), 4),
+        })
+
+    def run_mnist_inmem():
+        inmem_results, fill_epoch_s = run_inmem()
+        inmem_rates = [r for r, _ in inmem_results]
+        # median: per-epoch rates on a shared host are noisy (transient CPU contention
+        # can halve a single epoch); the median is the robust steady-state estimate
+        value = float(np.median(inmem_rates))
+        results.update({
+            'value': round(value, 2),
+            'vs_baseline': round(value / REFERENCE_BASELINE_ROWS_PER_SEC, 3),
+            'input_stall_fraction':
+                round(float(np.median([s for _, s in inmem_results])), 4),
+            'config': 'inmem_hbm_resident_epochs',
+            'fill_epoch_s': round(fill_epoch_s, 3),
+            'value_mean': round(float(np.mean(inmem_rates)), 2),
+            'estimator': 'median_of_{}_epochs'.format(EPOCHS),
+        })
+
+    def run_decode():
+        decode_host, decode_onchip = run_decode_delta()
+        results.update({
+            'imagenet_host_decode_rows_per_sec': round(decode_host, 2),
+            'imagenet_onchip_decode_rows_per_sec': round(decode_onchip, 2),
+            'onchip_decode_speedup':
+                round(decode_onchip / max(decode_host, 1e-9), 3),
+        })
+
+    run_section('mnist_stream', run_mnist_stream)
+    run_section('mnist_inmem', run_mnist_inmem)
+    run_section('imagenet_stream', run_imagenet_stream)
+    run_section('decode_delta', run_decode)
+    run_section('flash', run_flash)
+
+    results.setdefault('metric', 'mnist_train_rows_per_sec_per_chip')
+    results.setdefault('unit', 'rows/s/chip')
+    if 'value' not in results:
+        # headline section failed: fall back to the streaming number so the line is
+        # still a valid {metric, value, unit, vs_baseline} record
+        results['value'] = results.get('streaming_rows_per_sec', 0.0)
+        results['vs_baseline'] = results.get('streaming_vs_baseline', 0.0)
+        results['config'] = 'streaming_fallback_headline'
+    print(json.dumps(results))
 
 
 def main():

@@ -3,7 +3,8 @@
 (parity: reference examples/hello_world/external_dataset/generate_external_dataset.py,
 which used Spark; plain pyarrow here).
 
-Run: ``python -m examples.hello_world.external_dataset.generate_external_dataset -o file:///tmp/external_dataset``
+Run: ``python -m examples.hello_world.external_dataset.generate_external_dataset
+-o file:///tmp/external_dataset``
 """
 
 import argparse

@@ -3,7 +3,8 @@ path demo (parity: reference examples/hello_world/petastorm_dataset/
 generate_petastorm_dataset.py, which needs a Spark session; here the pure-pyarrow
 ``write_rows`` path makes Spark optional per SURVEY.md §7.1 step 3).
 
-Run: ``python -m examples.hello_world.petastorm_dataset.generate_petastorm_dataset -o file:///tmp/hello_world_dataset``
+Run: ``python -m examples.hello_world.petastorm_dataset.generate_petastorm_dataset
+-o file:///tmp/hello_world_dataset``
 """
 
 import argparse

@@ -3,7 +3,8 @@ examples/imagenet/generate_petastorm_imagenet.py, which scans an on-disk ImageNe
 with Spark; here either a directory of ``<noun_id>/*.jpg|png`` images or an offline
 synthetic mode).
 
-Run: ``python -m examples.imagenet.generate_petastorm_imagenet -o file:///tmp/imagenet --synthetic``
+Run: ``python -m examples.imagenet.generate_petastorm_imagenet -o file:///tmp/imagenet
+--synthetic``
 """
 
 import argparse

@@ -54,7 +54,8 @@ def make_label_transform(class_to_label, image_field_spec):
 
 def train(dataset_url, batch_size=8, epochs=1, learning_rate=1e-3,
           stage_sizes=(1, 1, 1, 1), num_filters=16, on_chip_decode=False,
-          image_hw=IMAGE_HW, dct_quality=90):
+          image_hw=IMAGE_HW, dct_quality=90, reader_pool_type='thread',
+          workers_count=4, prefetch=2, verbose=True):
     """``on_chip_decode=True`` reads a DCT-domain store (generate with ``--dct-hw``)
     through a field override so workers ship raw int16 coefficient blocks; dequant +
     IDCT + color conversion then run inside the jitted train step on the device
@@ -104,15 +105,21 @@ def train(dataset_url, batch_size=8, epochs=1, learning_rate=1e-3,
         reader_kwargs = dict(transform_spec=make_transform(class_to_label,
                                                            image_hw=image_hw))
     with make_reader(dataset_url, num_epochs=epochs, shuffle_rows=True, seed=0,
+                     reader_pool_type=reader_pool_type, workers_count=workers_count,
                      **reader_kwargs) as reader:
-        loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
+        loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True,
+                               prefetch=prefetch)
         for step, batch in enumerate(loader):
             rng, step_rng = jax.random.split(rng)
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, step_rng,
                 batch['image'], batch['label'])
-            print('step {} loss {:.4f}'.format(step, loss))
-    return params, batch_stats, (float(loss) if loss is not None else None)
+            if verbose:
+                print('step {} loss {:.4f}'.format(step, loss))
+        stats = loader.stats.as_dict()
+        if verbose:
+            print('input pipeline stats:', stats)
+    return params, batch_stats, (float(loss) if loss is not None else None), stats
 
 
 def main():
@@ -123,9 +130,21 @@ def main():
     parser.add_argument('--on-chip-decode', action='store_true',
                         help='read a --dct-hw store and decode on the device')
     parser.add_argument('--image-hw', type=int, default=IMAGE_HW)
+    parser.add_argument('--stage-sizes', type=int, nargs='+', default=[1, 1, 1, 1],
+                        help='ResNet stage depths, e.g. 3 4 6 3 for ResNet50')
+    parser.add_argument('--num-filters', type=int, default=16)
+    parser.add_argument('--pool', default='thread',
+                        choices=['thread', 'process', 'dummy'],
+                        help='reader worker pool (process = spawned workers + '
+                             'Arrow IPC wire; the larger-than-HBM streaming config)')
+    parser.add_argument('--workers', type=int, default=4)
+    parser.add_argument('--prefetch', type=int, default=2)
     args = parser.parse_args()
     train(args.dataset_url, batch_size=args.batch_size, epochs=args.epochs,
-          on_chip_decode=args.on_chip_decode, image_hw=args.image_hw)
+          on_chip_decode=args.on_chip_decode, image_hw=args.image_hw,
+          stage_sizes=tuple(args.stage_sizes), num_filters=args.num_filters,
+          reader_pool_type=args.pool, workers_count=args.workers,
+          prefetch=args.prefetch)
 
 
 if __name__ == '__main__':

@@ -21,8 +21,12 @@ def main(argv=None):
     parser.add_argument('-n', '--measure-cycles', type=int, default=1000)
     parser.add_argument('-d', '--read-method', choices=[READ_PYTHON, READ_JAX],
                         default=READ_PYTHON)
-    parser.add_argument('-q', '--spawn-new-process', action='store_true',
-                        help='measure in a fresh interpreter for a clean RSS reading')
+    # No short flag: -q used to mean the OPPOSITE (--spawn-new-process, now the
+    # default); recycling it would silently invert existing invocations.
+    parser.add_argument('--in-process', action='store_true',
+                        help='measure in THIS interpreter instead of a spawned one '
+                             '(default spawns for a clean RSS reading, matching the '
+                             'reference)')
     parser.add_argument('--jax-batch-size', type=int, default=256)
     parser.add_argument('--no-shuffle-row-groups', action='store_true')
     parser.add_argument('--profile-threads', action='store_true',
@@ -46,7 +50,7 @@ def main(argv=None):
         measure_cycles_count=args.measure_cycles, pool_type=args.pool_type,
         loaders_count=args.workers_count, read_method=args.read_method,
         shuffle_row_groups=not args.no_shuffle_row_groups,
-        jax_batch_size=args.jax_batch_size, spawn_new_process=args.spawn_new_process,
+        jax_batch_size=args.jax_batch_size, spawn_new_process=not args.in_process,
         profile_threads=args.profile_threads, ngram_length=args.ngram_length,
         ngram_ts_field=args.ngram_ts_field,
         ngram_delta_threshold=args.ngram_delta_threshold)

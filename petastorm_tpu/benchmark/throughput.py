@@ -22,21 +22,34 @@ READ_JAX = 'jax'
 def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
                       measure_cycles_count=1000, pool_type='thread', loaders_count=3,
                       read_method=READ_PYTHON, shuffle_row_groups=True,
-                      jax_batch_size=256, spawn_new_process=False,
+                      jax_batch_size=256, spawn_new_process=True,
                       profile_threads=False, ngram_length=None, ngram_ts_field=None,
                       ngram_delta_threshold=None):
     """Measure read throughput of a dataset (reference: throughput.py:112-172).
 
     ``read_method='python'`` iterates raw reader rows; ``'jax'`` drives a JaxDataLoader
     (cycle = one batch) and also reports the loader's input-stall fraction.
-    ``spawn_new_process`` re-runs the measurement in a fresh interpreter for a clean
-    RSS reading (reference: throughput.py:144-149). ``profile_threads`` wraps each
-    thread-pool worker in cProfile; the aggregate is logged on shutdown (reference:
-    thread_pool.py:41-49 + benchmark/cli.py:56-57).
+    ``spawn_new_process`` (default True, matching the reference's default —
+    throughput.py:115,144-149) re-runs the measurement in a fresh interpreter so the
+    RSS reading reflects the pipeline alone, not the caller's footprint.
+    ``profile_threads`` wraps each thread-pool worker in cProfile; the aggregate is
+    logged on shutdown (reference: thread_pool.py:41-49 + benchmark/cli.py:56-57).
 
     ``ngram_length`` + ``ngram_ts_field`` switch the measurement to NGram window
     formation (cycle = one window of ``ngram_length`` timesteps, every field at every
     offset): the windows/sec figure benchmarks the columnar gather path."""
+    # Argument validation stays ahead of the spawn so bad combinations raise in the
+    # caller, not through a child interpreter.
+    if profile_threads and pool_type != 'thread':
+        raise ValueError('--profile-threads requires the thread pool')
+    if ngram_length is None and (ngram_ts_field or ngram_delta_threshold is not None):
+        raise ValueError('ngram_ts_field / ngram_delta_threshold require ngram_length')
+    if ngram_length is not None:
+        if not ngram_ts_field:
+            raise ValueError('ngram_ts_field is required with ngram_length')
+        if read_method != READ_PYTHON:
+            raise ValueError('NGram benchmarking uses the python read method')
+
     if spawn_new_process:
         from petastorm_tpu.utils import run_in_subprocess
         return run_in_subprocess(reader_throughput, dataset_url, field_regex,
@@ -51,18 +64,10 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
     process = psutil.Process()
     reader_pool = None
     if profile_threads:
-        if pool_type != 'thread':
-            raise ValueError('--profile-threads requires the thread pool')
         from petastorm_tpu.workers.thread_pool import ThreadPool
         reader_pool = ThreadPool(loaders_count, profiling_enabled=True)
     schema_fields = field_regex
-    if ngram_length is None and (ngram_ts_field or ngram_delta_threshold is not None):
-        raise ValueError('ngram_ts_field / ngram_delta_threshold require ngram_length')
     if ngram_length is not None:
-        if not ngram_ts_field:
-            raise ValueError('ngram_ts_field is required with ngram_length')
-        if read_method != READ_PYTHON:
-            raise ValueError('NGram benchmarking uses the python read method')
         from petastorm_tpu.ngram import NGram
         fields = field_regex if field_regex else ['.*']
         schema_fields = NGram({offset: list(fields) for offset in range(ngram_length)},

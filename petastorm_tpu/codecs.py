@@ -145,7 +145,8 @@ class ScalarCodec(FieldCodec):
                 raise ValueError(
                     'ScalarCodec does not support Arrow type {!r}: it would not survive '
                     'schema serialization. Supported: {}'.format(
-                        self._arrow_dtype, sorted(_PARSEABLE_ARROW_TYPES) + ['decimal128(p,s)']))
+                        self._arrow_dtype,
+                        sorted(_PARSEABLE_ARROW_TYPES) + ['decimal128(p,s)']))
 
     def encode(self, unischema_field, value):
         if isinstance(value, np.ndarray) and value.ndim > 0:
@@ -385,7 +386,8 @@ class CompressedImageCodec(FieldCodec):
 
     def __init__(self, image_codec='png', quality=80):
         if image_codec not in ('png', 'jpeg'):
-            raise ValueError('image_codec must be "png" or "jpeg", got {!r}'.format(image_codec))
+            raise ValueError('image_codec must be "png" or "jpeg", got {!r}'
+                             .format(image_codec))
         self._image_codec = '.' + image_codec
         self._quality = int(quality)
 

@@ -12,7 +12,8 @@ class NoDataAvailableError(PetastormTpuError):
 
 
 class DecodeFieldError(PetastormTpuError):
-    """Raised when a codec fails to decode a field value (reference: petastorm/utils.py:50-51)."""
+    """Raised when a codec fails to decode a field value (reference:
+    petastorm/utils.py:50-51)."""
 
 
 class MetadataError(PetastormTpuError):

@@ -364,7 +364,8 @@ def get_schema(handle):
     petastorm/etl/dataset_metadata.py:340-373)."""
     metadata = read_metadata_dict(handle)
     if UNISCHEMA_JSON_KEY in metadata:
-        return Unischema.from_json_dict(json.loads(metadata[UNISCHEMA_JSON_KEY].decode('utf-8')))
+        return Unischema.from_json_dict(
+            json.loads(metadata[UNISCHEMA_JSON_KEY].decode('utf-8')))
     if LEGACY_UNISCHEMA_PICKLE_KEY in metadata:
         from petastorm_tpu.etl.legacy import depickle_legacy_unischema
         return depickle_legacy_unischema(metadata[LEGACY_UNISCHEMA_PICKLE_KEY])

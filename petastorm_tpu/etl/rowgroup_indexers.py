@@ -1,4 +1,5 @@
-"""Field-value -> rowgroup-set indexers (reference: petastorm/etl/rowgroup_indexers.py:21-124)."""
+"""Field-value -> rowgroup-set indexers (reference:
+petastorm/etl/rowgroup_indexers.py:21-124)."""
 
 from collections import defaultdict
 

@@ -114,8 +114,9 @@ class RowGroupWorker(WorkerBase):
             return
 
         predicate_token = _predicate_token(worker_predicate)
-        load = lambda: self._load_and_decode(fragment_path, row_group_id, partition_keys,  # noqa: E731
-                                             worker_predicate, shuffle_row_drop_partition)
+        def load():
+            return self._load_and_decode(fragment_path, row_group_id, partition_keys,
+                                         worker_predicate, shuffle_row_drop_partition)
         if predicate_token is None:
             # Unpicklable predicate: no stable cache identity exists — bypass the cache
             # rather than risk serving rows filtered by a different predicate.

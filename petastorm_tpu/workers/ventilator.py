@@ -62,7 +62,8 @@ class ConcurrentVentilator(Ventilator):
         self._items_to_ventilate = list(items_to_ventilate)
         self._iterations = iterations
         self._iterations_remaining = iterations
-        self._reset_iterations = reset_iterations if reset_iterations is not None else iterations
+        self._reset_iterations = (reset_iterations if reset_iterations is not None
+                                  else iterations)
         self._max_ventilation_queue_size = (max_ventilation_queue_size
                                             or len(self._items_to_ventilate) or 1)
         self._randomize_item_order = randomize_item_order
