@@ -7,6 +7,7 @@ the modern ``pyarrow.fs`` API: local paths map to ``LocalFileSystem``, ``hdfs://
 wrapped with ``PyFileSystem(FSSpecHandler)`` so Arrow's C++ readers can consume it.
 """
 
+import warnings
 from urllib.parse import urlparse
 
 import pyarrow.fs as pafs
@@ -47,6 +48,20 @@ def _extract_path(url):
     if scheme == 'hdfs':
         return parsed.path
     return parsed.netloc + parsed.path
+
+
+def check_hdfs_driver(hdfs_driver):
+    """Validate the reference-parity ``hdfs_driver`` kwarg (reference threads a
+    libhdfs/libhdfs3 choice through every API, petastorm/reader.py:126-127). Modern
+    ``pyarrow.fs`` ships only the JVM libhdfs driver — requesting the retired C++
+    libhdfs3 is accepted for API compatibility but warns and uses libhdfs."""
+    if hdfs_driver not in ('libhdfs', 'libhdfs3'):
+        raise ValueError("hdfs_driver must be 'libhdfs' or 'libhdfs3', got {!r}"
+                         .format(hdfs_driver))
+    if hdfs_driver == 'libhdfs3':
+        warnings.warn("hdfs_driver='libhdfs3' is accepted for petastorm API "
+                      "compatibility, but pyarrow.fs only provides the JVM libhdfs "
+                      "driver — connections will use libhdfs")
 
 
 def _resolve_filesystem(url, storage_options=None):

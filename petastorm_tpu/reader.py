@@ -15,7 +15,8 @@ import numpy as np
 from petastorm_tpu.cache import LocalDiskCache, NullCache
 from petastorm_tpu.errors import MetadataError, NoDataAvailableError
 from petastorm_tpu.etl import dataset_metadata
-from petastorm_tpu.fs_utils import (as_arrow_filesystem, make_filesystem_factory,
+from petastorm_tpu.fs_utils import (as_arrow_filesystem, check_hdfs_driver,
+                                    make_filesystem_factory,
                                     normalize_dataset_url_or_urls)
 from petastorm_tpu.reader_worker import ColumnarBatch, RowGroupWorker, WorkerSetup
 from petastorm_tpu.unischema import Unischema, match_unischema_fields
@@ -68,7 +69,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 cache_size_limit=None, cache_row_size_estimate=None,
                 cache_extra_settings=None, transform_spec=None, storage_options=None,
                 filesystem=None, resume_state=None, reader_pool=None,
-                field_overrides=None):
+                field_overrides=None, hdfs_driver='libhdfs'):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -77,7 +78,9 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     profiling_enabled). ``field_overrides`` — list of :class:`UnischemaField`s replacing
     same-named stored fields for THIS read (read-time reinterpretation: e.g. swap a
     ``DctImageCodec`` field to ``DctCoefficientsCodec`` so raw coefficients flow to an
-    on-device decode)."""
+    on-device decode). ``hdfs_driver`` — petastorm API compatibility (reference:
+    reader.py:126-127); pyarrow.fs provides libhdfs only, 'libhdfs3' warns."""
+    check_hdfs_driver(hdfs_driver)
     dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
     handle = dataset_metadata.open_dataset(dataset_url_or_urls,
                                            storage_options=storage_options,
@@ -125,10 +128,11 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, storage_options=None, filesystem=None,
-                      resume_state=None):
+                      resume_state=None, hdfs_driver='libhdfs'):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     """
+    check_hdfs_driver(hdfs_driver)
     dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
     handle = dataset_metadata.open_dataset(dataset_url_or_urls,
                                            storage_options=storage_options,

@@ -60,3 +60,35 @@ def test_filesystem_factory_picklable(tmp_path):
     factory = make_filesystem_factory(str(tmp_path))
     restored = pickle.loads(pickle.dumps(factory))
     assert isinstance(restored(), pafs.LocalFileSystem)
+
+
+class TestHdfsDriverKwarg:
+    """petastorm API-compat hdfs_driver kwarg (reference: reader.py:126-127)."""
+
+    def test_valid_values(self):
+        from petastorm_tpu.fs_utils import check_hdfs_driver
+        check_hdfs_driver('libhdfs')  # silent
+
+    def test_libhdfs3_warns(self):
+        import pytest
+        from petastorm_tpu.fs_utils import check_hdfs_driver
+        with pytest.warns(UserWarning, match='libhdfs'):
+            check_hdfs_driver('libhdfs3')
+
+    def test_invalid_raises(self):
+        import pytest
+        from petastorm_tpu.fs_utils import check_hdfs_driver
+        with pytest.raises(ValueError, match='hdfs_driver'):
+            check_hdfs_driver('webhdfs')
+
+    def test_reader_accepts_kwarg(self, tmp_path):
+        import numpy as np
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.codecs import ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_rows
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        url = str(tmp_path / 'ds')
+        schema = Unischema('S', [UnischemaField('id', np.int64, (), ScalarCodec(), False)])
+        write_rows(url, schema, [{'id': i} for i in range(4)])
+        with make_reader(url, workers_count=1, hdfs_driver='libhdfs') as reader:
+            assert sorted(r.id for r in reader) == [0, 1, 2, 3]
