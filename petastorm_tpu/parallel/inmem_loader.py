@@ -6,10 +6,12 @@ epochs of seeded ``torch.randperm`` batch sampling).
 TPU-first design: on a single device (``mesh=None``) the whole dataset lives in HBM and
 every batch is produced by one jitted gather — per-epoch permutations are computed with
 ``jax.random`` on device, so after the fill phase the input pipeline touches the host
-zero times (input stall is structurally 0). With a ``mesh`` the dataset stays in host
-RAM and each sampled batch is assembled into a mesh-sharded ``jax.Array`` like
-:class:`JaxDataLoader` does (HBM-resident sharded sampling would force cross-shard
-gathers; host assembly is the faster layout there).
+zero times (input stall is structurally 0). With a ``mesh``, python iteration keeps the
+dataset in host RAM and assembles each sampled batch into a mesh-sharded ``jax.Array``
+like :class:`JaxDataLoader` (a GLOBAL per-batch permutation over HBM-resident shards
+would force cross-shard gathers); ``scan_epochs`` over a mesh instead uploads the
+dataset shard-blocked across device HBM and shuffles SHARD-LOCALLY, which keeps the
+gathers collective-free — whole-epoch compilation composed with data parallelism.
 """
 
 import warnings
@@ -73,6 +75,7 @@ class InMemJaxLoader(object):
             raise ValueError('Loaded {} rows < batch_size {} with drop_last=True — '
                              'every epoch would be empty'.format(self._num_rows, batch_size))
         self._data = None  # device-resident dataset (single-device path), built lazily
+        self._sharded_meta = None  # (usable_rows, num_shards) for the mesh scan path
         self._take = None
         # scan_epochs: compiled-program cache keyed by (step_fn, shuffle) — train and
         # eval variants of the same step stay compiled side by side — plus a persistent
@@ -181,6 +184,105 @@ class InMemJaxLoader(object):
         for start in range(0, limit, self.batch_size):
             yield self._take(data, idx_all[start:min(start + self.batch_size, n)])
 
+    # -- mesh-sharded HBM residency for scan_epochs -----------------------------------
+
+    def _batch_axis_name(self):
+        """The mesh axis sharding the batch dimension. scan_epochs over a mesh
+        supports the default batch-axis layout (first mesh axis) or a single-axis
+        ``PartitionSpec``; per-field dict specs have no single batch layout to scan
+        over and are rejected."""
+        if self._partition_spec is None:
+            return self._mesh.axis_names[0]
+        try:
+            (axis,) = tuple(self._partition_spec)
+        except (TypeError, ValueError):
+            axis = None
+        if isinstance(axis, str) and axis in self._mesh.axis_names:
+            return axis
+        raise ValueError(
+            'scan_epochs over a mesh supports partition_spec=None or a single-axis '
+            'PartitionSpec(axis); got {!r}'.format(self._partition_spec))
+
+    def _ensure_sharded_data(self):
+        """Upload the dataset shard-blocked: each column reshaped to
+        ``(num_shards, rows_per_shard, ...)`` and sharded on dim 0 over the batch
+        axis, so every device holds one contiguous row block in its own HBM. Rows
+        beyond ``num_shards * rows_per_shard`` are dropped (at most num_shards - 1).
+
+        Returns ``(data, usable_rows, num_shards)``."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        if self._data is None:
+            axis = self._batch_axis_name()
+            num_shards = self._mesh.shape[axis]
+            rows_per_shard = self._num_rows // num_shards
+            if rows_per_shard == 0:
+                raise ValueError('{} rows cannot be sharded {} ways'
+                                 .format(self._num_rows, num_shards))
+            usable = num_shards * rows_per_shard
+            if usable < self._num_rows:
+                warnings.warn('scan_epochs drops {} trailing rows so the dataset '
+                              'splits evenly over the {} batch-axis shards'
+                              .format(self._num_rows - usable, num_shards))
+            sharding = NamedSharding(self._mesh, PartitionSpec(axis))
+            self._data = {
+                name: jax.device_put(
+                    col[:usable].reshape((num_shards, rows_per_shard) + col.shape[1:]),
+                    sharding)
+                for name, col in self._columns.items()}
+            self._sharded_meta = (usable, num_shards)
+            self._columns = None  # single copy: the host arrays are no longer read
+        return self._data, self._sharded_meta[0], self._sharded_meta[1]
+
+    def _build_sharded_epoch_program(self, step_fn, shuffle, seed, n, num_shards,
+                                     batch_size, batches_per_epoch, index_shuffle):
+        """One compiled epoch over the mesh with SHARD-LOCAL shuffling: each shard
+        permutes its own rows (Feistel cipher keyed by epoch x shard), each global
+        batch takes ``batch_size / num_shards`` rows from every shard, and the gather
+        is a vmapped per-shard take whose batch dim is aligned-sharded on operand,
+        indices, and output — XLA partitions it with NO collectives in the input
+        path. Rows never migrate between shards (the same contract as sharded
+        multi-host reading, reference reader.py:570-594: a shard only ever serves its
+        own rows); cross-shard mixing comes from the once-at-fill row distribution.
+        ``step_fn`` itself runs under plain GSPMD on the reassembled
+        ``(batch_size, ...)`` batch (sharded over the batch axis), so model-side
+        sharding (TP/FSDP/etc.) composes unchanged."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+        axis = self._batch_axis_name()
+        local_bs = batch_size // num_shards
+        rows_per_shard = n // num_shards
+        idx_sharding = NamedSharding(self._mesh, PartitionSpec(axis))
+
+        @jax.jit
+        def one_epoch(data, carry, epoch_index):
+            epoch_key = jax.random.fold_in(jax.random.PRNGKey(seed), epoch_index)
+            shard_keys = jax.vmap(lambda s: jax.random.fold_in(epoch_key, s))(
+                jnp.arange(num_shards))
+            local = jnp.arange(rows_per_shard)
+            if shuffle:
+                idx_all = jax.vmap(
+                    lambda key: index_shuffle(local, key, rows_per_shard))(shard_keys)
+            else:
+                idx_all = jnp.broadcast_to(local, (num_shards, rows_per_shard))
+            # Pin the per-shard index table to the batch axis so the vmapped gather
+            # below partitions shard-locally instead of replicating via all-gather.
+            idx_all = jax.lax.with_sharding_constraint(idx_all, idx_sharding)
+
+            def body(carry, batch_index):
+                idx = jax.lax.dynamic_slice_in_dim(
+                    idx_all, batch_index * local_bs, local_bs, axis=1)
+                batch = {}
+                for name, col in data.items():
+                    taken = jax.vmap(lambda c, i: c[i])(col, idx)
+                    batch[name] = taken.reshape((batch_size,) + taken.shape[2:])
+                return step_fn(carry, batch)
+
+            return jax.lax.scan(body, carry, jnp.arange(batches_per_epoch))
+
+        return one_epoch
+
     # -- fully-compiled epochs: sampling + training in ONE XLA program ----------------
 
     def scan_epochs(self, step_fn, carry, num_epochs=1, epoch_offset=None,
@@ -199,6 +301,14 @@ class InMemJaxLoader(object):
         and continue the epoch/permutation sequence where the previous call stopped
         (override the start with ``epoch_offset``).
 
+        With a ``mesh``, the dataset is uploaded shard-blocked (each device holds a
+        contiguous row block in its own HBM) and shuffling is SHARD-LOCAL: each
+        shard permutes its own rows per epoch, every global batch takes
+        ``batch_size / num_shards`` rows from each shard, and the gather partitions
+        with no collectives in the input path. ``batch_size`` must be divisible by
+        the batch mesh axis size; a ``partition_spec`` must be None or a single-axis
+        ``PartitionSpec``.
+
         :param step_fn: ``step_fn(carry, batch) -> (carry, aux)`` with ``batch`` a dict
             of ``(batch_size, ...)`` arrays — a standard ``lax.scan`` body over your
             train step.
@@ -213,48 +323,67 @@ class InMemJaxLoader(object):
         """
         import jax
         import jax.numpy as jnp
-        if self._mesh is not None or not self._device_put:
-            raise ValueError('scan_epochs requires the single-device HBM-resident '
-                             'mode (mesh=None, device_put=True)')
+        if not self._device_put:
+            raise ValueError('scan_epochs requires device_put=True')
         if self._num_rows == 0:
             raise ValueError('scan_epochs on an empty dataset')
-        data = self._ensure_device_data()
-        n = self._num_rows
         batch_size = self.batch_size
-        batches_per_epoch = n // batch_size
-        if batches_per_epoch == 0:
-            raise ValueError('batch_size {} > dataset rows {}'.format(batch_size, n))
-        if not self._drop_last and n % batch_size != 0:
+        shuffle = self._shuffle if shuffle is None else shuffle
+        seed = self._seed
+        # Validate BEFORE any upload: _ensure_*_data drops the host copy, so failing
+        # after it would leave the loader unusable (batch_size is fixed at __init__).
+        if self._mesh is not None:
+            num_shards = self._mesh.shape[self._batch_axis_name()]
+            if batch_size % num_shards:
+                raise ValueError(
+                    'scan_epochs over a mesh needs batch_size ({}) divisible by the '
+                    'batch mesh axis size ({})'.format(batch_size, num_shards))
+            n = num_shards * (self._num_rows // num_shards)
+        else:
+            n, num_shards = self._num_rows, 1
+        if n // batch_size == 0:
+            raise ValueError('batch_size {} > usable dataset rows {}'
+                             .format(batch_size, n))
+        if not self._drop_last and self._num_rows % batch_size != 0:
             raise ValueError(
                 'scan_epochs cannot serve the trailing partial batch ({} rows): '
                 'lax.scan needs static batch shapes. Use drop_last=True, a divisible '
-                'batch_size, or the python iterator.'.format(n % batch_size))
-        shuffle = self._shuffle if shuffle is None else shuffle
-        seed = self._seed
+                'batch_size, or the python iterator.'.format(self._num_rows % batch_size))
+        if self._mesh is not None:
+            data, n, num_shards = self._ensure_sharded_data()
+        else:
+            data = self._ensure_device_data()
+        batches_per_epoch = n // batch_size
 
         cache_key = (step_fn, shuffle)
         if cache_key not in self._scan_cache:
             from petastorm_tpu.ops.index_shuffle import random_index_shuffle
 
-            @jax.jit
-            def one_epoch(data, carry, epoch_index):
-                # Shuffling via the Feistel index cipher, not jax.random.permutation:
-                # the sort-based permutation costs ~50ms at n=50k on a v5e while the
-                # cipher evaluates the whole epoch's indices in <1ms
-                # (ops/index_shuffle.py). Evaluated ONCE per epoch here — hoisting the
-                # cipher's cycle-walk while_loop out of the batch scan keeps the loop
-                # body free of data-dependent control flow.
-                key = jax.random.fold_in(jax.random.PRNGKey(seed), epoch_index)
-                idx_all = (random_index_shuffle(jnp.arange(n), key, n) if shuffle
-                           else jnp.arange(n))
+            if self._mesh is not None:
+                one_epoch = self._build_sharded_epoch_program(
+                    step_fn, shuffle, seed, n, num_shards, batch_size,
+                    batches_per_epoch, random_index_shuffle)
+            else:
+                @jax.jit
+                def one_epoch(data, carry, epoch_index):
+                    # Shuffling via the Feistel index cipher, not
+                    # jax.random.permutation: the sort-based permutation costs ~50ms
+                    # at n=50k on a v5e while the cipher evaluates the whole epoch's
+                    # indices in <1ms (ops/index_shuffle.py). Evaluated ONCE per epoch
+                    # here — hoisting the cipher's cycle-walk while_loop out of the
+                    # batch scan keeps the loop body free of data-dependent control
+                    # flow.
+                    key = jax.random.fold_in(jax.random.PRNGKey(seed), epoch_index)
+                    idx_all = (random_index_shuffle(jnp.arange(n), key, n) if shuffle
+                               else jnp.arange(n))
 
-                def body(carry, batch_index):
-                    idx = jax.lax.dynamic_slice_in_dim(
-                        idx_all, batch_index * batch_size, batch_size)
-                    batch = {name: col[idx] for name, col in data.items()}
-                    return step_fn(carry, batch)
+                    def body(carry, batch_index):
+                        idx = jax.lax.dynamic_slice_in_dim(
+                            idx_all, batch_index * batch_size, batch_size)
+                        batch = {name: col[idx] for name, col in data.items()}
+                        return step_fn(carry, batch)
 
-                return jax.lax.scan(body, carry, jnp.arange(batches_per_epoch))
+                    return jax.lax.scan(body, carry, jnp.arange(batches_per_epoch))
 
             self._scan_compile_count += 1
             if len(self._scan_cache) >= _SCAN_CACHE_MAX:
@@ -285,6 +414,11 @@ class InMemJaxLoader(object):
     # -- mesh / host path: numpy sampling + per-batch sharded assembly ----------------
 
     def _iter_epoch_host(self, epoch):
+        if self._columns is None:
+            raise RuntimeError(
+                'Python iteration is unavailable after scan_epochs moved the dataset '
+                'to device HBM (the host copy is dropped to avoid double residency); '
+                'keep using scan_epochs, or build a separate loader for iteration')
         if self._shuffle:
             perm = np.random.RandomState((self._seed + epoch) % (2 ** 31)).permutation(
                 self._num_rows)
