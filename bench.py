@@ -698,6 +698,49 @@ def child_main():
                 round(float(np.median(stream_stalls)), 4),
         })
 
+    def run_scan_stream():
+        """Compiled-chunk streaming (JaxDataLoader.scan_stream): the dispatch-bound
+        larger-than-HBM configuration — per-epoch re-read like streaming_*, but one
+        H2D transfer + one XLA dispatch per chunk of batches instead of per batch.
+        The delta against streaming_rows_per_sec is exactly what per-batch dispatch
+        costs on this host/device link."""
+        nonlocal params, opt_state
+
+        def step(carry, batch):
+            p, o = carry
+            p, o, loss = train_step(p, o, batch['image'], batch['digit'])
+            return (p, o), loss
+
+        # ONE loader across epochs (reader.reset() between passes): the compiled
+        # chunk programs live on the loader instance, so epochs 1..N measure the
+        # steady state while epoch 0 absorbs the compiles.
+        reader = make_reader(url, workers_count=WORKERS, shuffle_row_groups=True,
+                             seed=42, num_epochs=1)
+        loader = JaxDataLoader(reader, batch_size=BATCH_SIZE)
+        rates = []
+        for epoch in range(EPOCHS + 1):  # epoch 0 = compile warmup
+            if epoch > 0:
+                reader.reset()
+            start = time.perf_counter()
+            (params, opt_state), aux = loader.scan_stream(
+                step, (params, opt_state), chunk_batches=8, seed=epoch)
+            rows = sum(int(np.asarray(a).shape[0]) for a in aux) * BATCH_SIZE
+            float(np.asarray(aux[-1])[-1])  # gate on device readback
+            elapsed = time.perf_counter() - start
+            if epoch > 0:
+                rates.append(rows / elapsed)
+                log('scan_stream epoch: {} rows in {:.2f}s -> {:.0f} rows/s'
+                    .format(rows, elapsed, rows / elapsed))
+        reader.stop()
+        reader.join()
+        value = float(np.median(rates))
+        results.update({
+            'streaming_scan_rows_per_sec': round(value, 2),
+            'streaming_scan_vs_baseline':
+                round(value / REFERENCE_BASELINE_ROWS_PER_SEC, 3),
+            'streaming_scan_chunk_batches': 8,
+        })
+
     def run_bare_reader():
         """The apples-to-apples ratio (VERDICT r2 weak #6): the reference's 709.84 is
         a bare make_reader row loop — measure OUR bare row loop (same row-namedtuple
@@ -750,6 +793,7 @@ def child_main():
         })
 
     run_section('mnist_stream', run_mnist_stream)
+    run_section('mnist_scan_stream', run_scan_stream)
     run_section('bare_reader', run_bare_reader)
     run_section('mnist_inmem', run_mnist_inmem)
     run_section('imagenet_stream', run_imagenet_stream)

@@ -126,6 +126,8 @@ class JaxDataLoader(object):
         self._epochs_delivered = 0
         self._delivered_by_epoch = {}
         self._spec_keys_checked = False
+        self._scan_stream_used = False
+        self._scan_stream_programs = {}
 
     # ------------------------------------------------------------------ sharding
 
@@ -301,6 +303,116 @@ class JaxDataLoader(object):
             except queue.Full:
                 pass
 
+    # ------------------------------------------------------------ compiled streaming
+
+    def scan_stream(self, step_fn, carry, chunk_batches=32, seed=None):
+        """Stream the reader through compiled chunk programs: accumulate
+        ``chunk_batches`` batches of host rows, upload them as ONE transfer, and run
+        every train step of the chunk inside ONE ``lax.scan`` dispatch.
+
+        The dispatch-bound streaming configuration for larger-than-HBM datasets: the
+        per-batch Python dispatch + small-transfer overhead of ``__iter__`` (which
+        dominates small-model streaming, docs/performance.md) collapses to one
+        host->device transfer and one XLA program launch per ``chunk_batches``
+        batches, while memory stays bounded at one chunk (vs
+        ``InMemJaxLoader.scan_epochs``, which needs the whole dataset resident).
+        No reference analog (petastorm crosses into Python per batch everywhere).
+
+        Rows are shuffled within each chunk (seeded numpy permutation on the host;
+        combine with ``shuffle_row_groups``/``shuffle_rows`` on the reader for
+        cross-chunk decorrelation). The trailing partial chunk runs through a
+        smaller program of the same structure (one extra compile); the final
+        sub-batch-size remainder is dropped (static shapes).
+
+        :param step_fn: ``step_fn(carry, batch) -> (carry, aux)`` — standard
+            ``lax.scan`` body over dicts of ``(batch_size, ...)`` arrays.
+        :param carry: initial carry pytree.
+        :param chunk_batches: batches per compiled chunk (chunk rows =
+            ``chunk_batches * batch_size``).
+        :param seed: within-chunk shuffle seed; None disables the in-chunk shuffle.
+        :return: ``(carry, aux_chunks)`` — aux stacked per chunk, in stream order.
+        """
+        import jax
+        if self._mesh is not None:
+            raise ValueError('scan_stream currently supports the single-device '
+                             'path (mesh=None); use __iter__ for mesh streaming')
+        if self._shuffling_queue_capacity:
+            raise ValueError('scan_stream has its own in-chunk shuffle; construct '
+                             'the loader with shuffling_queue_capacity=0')
+        if chunk_batches < 1:
+            raise ValueError('chunk_batches must be >= 1')
+        if reader_may_be_infinite(self.reader):
+            raise ValueError('scan_stream runs to stream end and cannot consume an '
+                             'infinite reader (num_epochs=None); give the reader a '
+                             'finite num_epochs and call scan_stream per pass')
+        if self._in_iter:
+            raise RuntimeError('scan_stream cannot run while __iter__ is active: '
+                               'both would consume the same reader')
+        if self._producer is not None and self._producer.is_alive():
+            # An abandoned __iter__ left its producer prefetching from the reader:
+            # stop and join it, exactly like a fresh __iter__ would, so the stream
+            # has one consumer.
+            self._stop_event.set()
+            self._drain_queue()
+            self._producer.join(timeout=30)
+            if self._producer.is_alive():
+                raise RuntimeError('Previous producer thread did not stop')
+        sharding = self._resolve_sharding()
+        self._scan_stream_used = True  # bypasses delivery accounting: see state_dict
+        batch_size = self.batch_size
+        # Program cache on the instance: a fresh per-call dict would re-trace and
+        # re-compile the chunk program every call (one call per epoch is the intended
+        # pattern), silently billing full XLA compiles to every epoch.
+        programs = self._scan_stream_programs
+
+        def run_chunk(carry, columns, n_batches, chunk_index):
+            usable = n_batches * batch_size
+            if seed is not None:
+                perm = np.random.RandomState(
+                    (seed + chunk_index) % (2 ** 31)).permutation(usable)
+                columns = {name: col[:usable][perm] for name, col in columns.items()}
+            else:
+                columns = {name: col[:usable] for name, col in columns.items()}
+            chunk = {name: np.ascontiguousarray(
+                         col.reshape((n_batches, batch_size) + col.shape[1:]))
+                     for name, col in columns.items()}
+            with _trace_span('petastorm_tpu.loader.scan_stream.h2d'):
+                chunk = jax.device_put(chunk, sharding)
+            key = (step_fn, n_batches)
+            if key not in programs:
+                @jax.jit
+                def chunk_program(carry, chunk):
+                    return jax.lax.scan(step_fn, carry, chunk)
+                programs[key] = chunk_program
+            return programs[key](carry, chunk)
+
+        pending = []
+        pending_rows = 0
+        chunk_rows = chunk_batches * batch_size
+        chunk_index = 0
+        aux_chunks = []
+        for columns in map(self._sanitize,
+                           (c for c, n, _ in iter_reader_chunks(
+                                self.reader, accum_rows=batch_size,
+                                include_empty=False) if n)):
+            pending.append(columns)
+            pending_rows += self._batch_cols_rows(columns)
+            while pending_rows >= chunk_rows:
+                merged = _concat_column_chunks(pending)
+                head = {name: col[:chunk_rows] for name, col in merged.items()}
+                tail = {name: col[chunk_rows:] for name, col in merged.items()}
+                carry, aux = run_chunk(carry, head, chunk_batches, chunk_index)
+                aux_chunks.append(aux)
+                chunk_index += 1
+                pending = [tail]
+                pending_rows -= chunk_rows
+        if pending_rows >= batch_size:
+            merged = _concat_column_chunks(pending)
+            carry, aux = run_chunk(carry, merged, pending_rows // batch_size,
+                                   chunk_index)
+            aux_chunks.append(aux)
+        return carry, aux_chunks
+
     # ------------------------------------------------------------------ checkpoint
 
     def _mark_delivered(self, n_rows):
@@ -356,6 +468,10 @@ class JaxDataLoader(object):
             # here but resume_state is rejected at reader construction.
             raise ValueError('state_dict requires a Reader with the columnar fast path '
                              '(iter_columnar, non-NGram)')
+        if self._scan_stream_used:
+            raise ValueError('state_dict is not supported after scan_stream (it '
+                             'consumes the reader outside the delivery accounting); '
+                             'checkpoint with the __iter__ path instead')
         with self._fifo_lock:
             pending = any(entry[1] > 0 for entry in self._delivery_fifo)
         if pending and self._shuffling_queue_capacity:
@@ -525,6 +641,15 @@ def _iter_column_slices(columns, slice_rows):
         return
     for start in range(0, n, slice_rows):
         yield {name: col[start:start + slice_rows] for name, col in columns.items()}
+
+
+def _concat_column_chunks(chunks):
+    """Concatenate a list of sanitized column dicts along the row axis (single-chunk
+    lists pass through without a copy)."""
+    if len(chunks) == 1:
+        return chunks[0]
+    return {name: np.concatenate([c[name] for c in chunks])
+            for name in chunks[0]}
 
 
 def _rows_to_columns(rows):

@@ -160,3 +160,35 @@ def test_real_readers_mixed_row_set(synthetic_dataset):
         seen = {row.id for row in mixed}
     assert seen <= all_ids
     assert len(seen) > 0
+
+
+class TestDeviceLayer:
+    def test_weighted_reader_feeds_jax_loader(self, tmp_path):
+        """Mixed-reader rows flow through JaxDataLoader's row-accumulation path
+        (WeightedSamplingReader has no iter_columnar; the loader falls back)."""
+        import numpy as np
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.codecs import ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_rows
+        from petastorm_tpu.parallel import JaxDataLoader
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+        schema = Unischema('S', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False)])
+        urls = []
+        for tag, base in (('a', 0), ('b', 1000)):
+            url = str(tmp_path / tag)
+            write_rows(url, schema, [{'id': base + i} for i in range(32)])
+            urls.append(url)
+        readers = [make_reader(u, workers_count=1, num_epochs=1) for u in urls]
+        mixed = WeightedSamplingReader(readers, [0.5, 0.5])
+        loader = JaxDataLoader(mixed, batch_size=8, drop_last=False,
+                               device_put=False)
+        ids = np.concatenate([b['id'] for b in loader])
+        # stops when either underlying reader exhausts; both sources must appear
+        assert len(ids) >= 8
+        assert any(i < 1000 for i in ids) and any(i >= 1000 for i in ids)
+        for reader in readers:
+            reader.stop()
+            reader.join()
