@@ -718,9 +718,7 @@ def child_main():
                              seed=42, num_epochs=1)
         loader = JaxDataLoader(reader, batch_size=BATCH_SIZE)
         rates = []
-        for epoch in range(EPOCHS + 1):  # epoch 0 = compile warmup
-            if epoch > 0:
-                reader.reset()
+        for epoch in range(EPOCHS + 1):  # epoch 0 = compile warmup; auto-reset after
             start = time.perf_counter()
             (params, opt_state), aux = loader.scan_stream(
                 step, (params, opt_state), chunk_batches=8, seed=epoch)

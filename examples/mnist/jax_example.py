@@ -98,6 +98,42 @@ def train_inmem(dataset_url, batch_size=128, epochs=1, learning_rate=1e-3):
     return params, float(per_epoch[-1][0][-1]), float(per_epoch[-1][1][-1])
 
 
+def train_scan_stream(dataset_url, batch_size=128, epochs=1, learning_rate=1e-3,
+                      chunk_batches=32):
+    """The dispatch-bound streaming configuration for datasets that do NOT fit in
+    HBM: ``JaxDataLoader.scan_stream`` re-reads the store each epoch but runs every
+    ``chunk_batches`` batches as one compiled program with a single host->device
+    transfer — memory bounded at one chunk, per-batch dispatch overhead gone."""
+    model = MnistCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))['params']
+    optimizer = optax.adam(learning_rate)
+    opt_state = optimizer.init(params)
+    train_step = make_train_step(model, optimizer)
+
+    def step(carry, batch):
+        params, opt_state = carry
+        params, opt_state, loss, accuracy = train_step(
+            params, opt_state, batch['image'], batch['digit'])
+        return (params, opt_state), (loss, accuracy)
+
+    reader = make_reader('{}/train'.format(dataset_url.rstrip('/')), num_epochs=1,
+                         transform_spec=TRANSFORM, shuffle_row_groups=True, seed=42)
+    loader = JaxDataLoader(reader, batch_size=batch_size)
+    loss = accuracy = None
+    try:
+        for epoch in range(epochs):  # consumed readers auto-reset per pass
+            (params, opt_state), chunks = loader.scan_stream(
+                step, (params, opt_state), chunk_batches=chunk_batches, seed=epoch)
+            losses, accs = chunks[-1]
+            loss, accuracy = float(losses[-1]), float(accs[-1])
+            print('epoch {}: loss {:.4f} acc {:.3f} ({} chunks)'.format(
+                epoch, loss, accuracy, len(chunks)))
+    finally:
+        reader.stop()
+        reader.join()
+    return params, loss, accuracy
+
+
 def evaluate(params, dataset_url, batch_size=128):
     model = MnistCNN()
 
@@ -127,8 +163,14 @@ def main():
     parser.add_argument('--inmem', action='store_true',
                         help='HBM-resident epochs via InMemJaxLoader.scan_epochs '
                              '(recommended when the dataset fits in HBM)')
+    parser.add_argument('--scan-stream', action='store_true',
+                        help='compiled-chunk streaming via JaxDataLoader.scan_stream '
+                             '(recommended when it does NOT fit in HBM)')
     args = parser.parse_args()
-    train_fn = train_inmem if args.inmem else train
+    if args.inmem and args.scan_stream:
+        parser.error('--inmem and --scan-stream are mutually exclusive')
+    train_fn = (train_inmem if args.inmem
+                else train_scan_stream if args.scan_stream else train)
     params, _, _ = train_fn(args.dataset_url, batch_size=args.batch_size,
                             epochs=args.epochs, learning_rate=args.learning_rate)
     evaluate(params, args.dataset_url, batch_size=args.batch_size)

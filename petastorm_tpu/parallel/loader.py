@@ -29,6 +29,8 @@ from petastorm_tpu.parallel.shuffling_buffer import (NoopShufflingBuffer,
                                                      RandomShufflingBuffer)
 
 _END = object()
+#: scan_stream keeps this many compiled (step_fn, chunk-shape) programs per loader
+_SCAN_STREAM_CACHE_MAX = 8
 
 
 try:
@@ -128,6 +130,7 @@ class JaxDataLoader(object):
         self._spec_keys_checked = False
         self._scan_stream_used = False
         self._scan_stream_programs = {}
+        self._scan_stream_cache_warned = False
 
     # ------------------------------------------------------------------ sharding
 
@@ -341,6 +344,14 @@ class JaxDataLoader(object):
                              'the loader with shuffling_queue_capacity=0')
         if chunk_batches < 1:
             raise ValueError('chunk_batches must be >= 1')
+        if not self._device_put:
+            raise ValueError('scan_stream compiles device programs; it does not '
+                             'support device_put=False (use __iter__ for host batches)')
+        if not self._drop_last:
+            raise ValueError('scan_stream always drops the sub-batch-size remainder '
+                             '(static shapes); construct the loader with '
+                             'drop_last=True to make that explicit, or use __iter__ '
+                             'to see every row')
         if reader_may_be_infinite(self.reader):
             raise ValueError('scan_stream runs to stream end and cannot consume an '
                              'infinite reader (num_epochs=None); give the reader a '
@@ -357,12 +368,19 @@ class JaxDataLoader(object):
             self._producer.join(timeout=30)
             if self._producer.is_alive():
                 raise RuntimeError('Previous producer thread did not stop')
+        if getattr(self.reader, 'last_row_consumed', False):
+            # Mirror __iter__'s re-iteration contract: a fully consumed reader resets
+            # for the next pass — without this, a second scan_stream call would
+            # silently return (carry, []) with zero training done.
+            self.reader.reset()
         sharding = self._resolve_sharding()
         self._scan_stream_used = True  # bypasses delivery accounting: see state_dict
         batch_size = self.batch_size
         # Program cache on the instance: a fresh per-call dict would re-trace and
         # re-compile the chunk program every call (one call per epoch is the intended
-        # pattern), silently billing full XLA compiles to every epoch.
+        # pattern), silently billing full XLA compiles to every epoch. Keyed on
+        # step_fn IDENTITY — pass a stable function object; fresh closures per call
+        # recompile, and past the cap the oldest program is evicted (warned once).
         programs = self._scan_stream_programs
 
         def run_chunk(carry, columns, n_batches, chunk_index):
@@ -383,6 +401,17 @@ class JaxDataLoader(object):
                 @jax.jit
                 def chunk_program(carry, chunk):
                     return jax.lax.scan(step_fn, carry, chunk)
+                if len(programs) >= _SCAN_STREAM_CACHE_MAX:
+                    # Unbounded growth would pin every evicted closure's captured
+                    # scope + compiled executable for the loader's lifetime.
+                    if not self._scan_stream_cache_warned:
+                        self._scan_stream_cache_warned = True
+                        import warnings
+                        warnings.warn(
+                            'scan_stream compiled more than {} distinct (step_fn, '
+                            'chunk-shape) programs; pass a stable step_fn object to '
+                            'reuse compilations'.format(_SCAN_STREAM_CACHE_MAX))
+                    programs.pop(next(iter(programs)))
                 programs[key] = chunk_program
             return programs[key](carry, chunk)
 

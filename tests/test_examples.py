@@ -161,6 +161,15 @@ def test_mnist_jax_inmem_trains(mnist_dataset):
     assert test_accuracy > 0.3
 
 
+def test_mnist_jax_scan_stream_trains(mnist_dataset):
+    from examples.mnist import jax_example
+    params, loss, _ = jax_example.train_scan_stream(mnist_dataset, batch_size=64,
+                                                    epochs=3, chunk_batches=4)
+    assert np.isfinite(loss)
+    test_accuracy = jax_example.evaluate(params, mnist_dataset, batch_size=32)
+    assert test_accuracy > 0.3
+
+
 def test_mnist_pytorch_trains(mnist_dataset):
     from examples.mnist import pytorch_example
     accuracy = pytorch_example.main(['--dataset-url', mnist_dataset, '--epochs', '6',

@@ -356,16 +356,31 @@ class TestScanStream:
             loader.scan_stream(lambda c, b: (c, None), 0)
         it.close()
 
-    def test_programs_cached_across_passes(self, synthetic_dataset):
-        """One compiled program per (step_fn, chunk_size) across reset-separated
-        passes — the bench's steady-state measurement depends on this."""
+    def test_programs_cached_across_passes_with_auto_reset(self, synthetic_dataset):
+        """Repeated scan_stream calls auto-reset the consumed reader (a second call
+        must NOT silently return (carry, [])) and reuse the compiled programs — the
+        bench's steady-state measurement depends on both."""
         loader = JaxDataLoader(self._reader(synthetic_dataset), batch_size=10)
         step = lambda c, b: (c + 1, None)  # noqa: E731
+        carry = 0
         for _ in range(3):
-            loader.scan_stream(step, 0, chunk_batches=4)
-            loader.reader.reset()
+            carry, aux = loader.scan_stream(step, carry, chunk_batches=4)
+            assert len(aux) == 3  # each pass re-serves the full dataset
+        assert int(carry) == 3 * 10  # 10 batches per pass, 3 passes
         # chunks of 4,4,2 -> exactly two program shapes, compiled once each
         assert len(loader._scan_stream_programs) == 2
+
+    def test_device_put_false_rejected(self, synthetic_dataset):
+        loader = JaxDataLoader(self._reader(synthetic_dataset), batch_size=10,
+                               device_put=False)
+        with pytest.raises(ValueError, match='device_put'):
+            loader.scan_stream(lambda c, b: (c, None), 0)
+
+    def test_drop_last_false_rejected(self, synthetic_dataset):
+        loader = JaxDataLoader(self._reader(synthetic_dataset), batch_size=30,
+                               drop_last=False)
+        with pytest.raises(ValueError, match='drop_last'):
+            loader.scan_stream(lambda c, b: (c, None), 0)
 
     def test_state_dict_rejected_after_scan_stream(self, synthetic_dataset):
         loader = JaxDataLoader(self._reader(synthetic_dataset), batch_size=10)
