@@ -110,10 +110,10 @@ def build_dataset(url):
 
 
 def imagenet_dataset_url():
-    # 'dct2': v2 content (photograph-like images) — must not collide with the round-2
-    # uniform-noise stores cached in this tempdir under the old key
+    # 'dct3': v3 content (photograph-like images, zstd) — must not collide with stores
+    # cached in this tempdir under earlier keys
     return os.path.join(tempfile.gettempdir(),
-                        'petastorm_tpu_bench_dct2_{}_{}'.format(IMG_ROWS, IMG_HW))
+                        'petastorm_tpu_bench_dct3_{}_{}'.format(IMG_ROWS, IMG_HW))
 
 
 def _synthetic_photo(rng, hw):
@@ -147,7 +147,9 @@ def build_imagenet_dataset(url):
     rows = [{'idx': i, 'label': int(rng.randint(1000)),
              'image': _synthetic_photo(rng, IMG_HW)}
             for i in range(IMG_ROWS)]
-    write_rows(url, schema, rows, rowgroup_size_mb=16, n_files=4)
+    # zstd: quantized coefficients of photograph-like images are mostly zeros —
+    # smaller shipped bytes is exactly what the on-chip-decode streaming config needs
+    write_rows(url, schema, rows, rowgroup_size_mb=16, n_files=4, compression='zstd')
 
 
 def probe_tpu():

@@ -201,10 +201,13 @@ def write_table_files(filesystem, path, arrow_schema, batches,
 
 def write_rows(dataset_url, schema, rows, rowgroup_size_mb=DEFAULT_ROW_GROUP_SIZE_MB,
                rows_per_file=None, n_files=None, storage_options=None, filesystem=None,
-               file_prefix='part'):
+               file_prefix='part', compression='snappy'):
     """One-shot materialization: encode ``rows`` (list of dicts) and write a petastorm_tpu
     Parquet store with embedded metadata. The Spark-free equivalent of the reference's
-    materialize-with-Spark flow (petastorm/etl/dataset_metadata.py:68-147)."""
+    materialize-with-Spark flow (petastorm/etl/dataset_metadata.py:68-147).
+    ``compression`` is any pyarrow Parquet codec ('snappy' default; 'zstd' trades write
+    CPU for smaller shipped bytes — the right choice for coefficient-domain image
+    stores feeding on-chip decode)."""
     with materialize_dataset(dataset_url, schema, rowgroup_size_mb=rowgroup_size_mb,
                              storage_options=storage_options, filesystem=filesystem):
         fs, path = get_filesystem_and_path_or_paths(dataset_url,
@@ -218,7 +221,7 @@ def write_rows(dataset_url, schema, rows, rowgroup_size_mb=DEFAULT_ROW_GROUP_SIZ
             rows_per_file = max(1, (table.num_rows + n_files - 1) // max(1, n_files))
         write_table_files(fs, path, table.schema, table.to_batches(),
                           rowgroup_size_mb=rowgroup_size_mb, rows_per_file=rows_per_file,
-                          file_prefix=file_prefix)
+                          file_prefix=file_prefix, compression=compression)
 
 
 @contextmanager

@@ -122,3 +122,53 @@ class TestSplitPredicateProperties:
             for j in range(i + 1, len(ratios)):
                 assert not (membership[i] & membership[j])
         assert set().union(*membership) == set(keys)
+
+
+class TestCodecRoundTrips:
+    @settings(max_examples=40, deadline=None)
+    @given(
+        dtype=st.sampled_from(['uint8', 'int16', 'int32', 'int64', 'float32', 'float64']),
+        shape=st.lists(st.integers(1, 6), min_size=1, max_size=3),
+        seed=st.integers(0, 2 ** 16))
+    def test_ndarray_codec_roundtrip(self, dtype, shape, seed):
+        import numpy as np
+        from petastorm_tpu.codecs import NdarrayCodec
+        from petastorm_tpu.unischema import UnischemaField
+        rng = np.random.RandomState(seed)
+        value = (rng.randint(-100, 100, size=shape) if 'int' in dtype
+                 else rng.randn(*shape) * 100).astype(dtype)
+        field = UnischemaField('x', np.dtype(dtype).type, tuple(shape),
+                               NdarrayCodec(), False)
+        codec = NdarrayCodec()
+        decoded = codec.decode(field, codec.encode(field, value))
+        np.testing.assert_array_equal(decoded, value)
+        assert decoded.dtype == value.dtype
+
+    @settings(max_examples=20, deadline=None)
+    @given(compression=st.sampled_from(['snappy', 'zstd', 'none']),
+           n_rows=st.integers(1, 40), seed=st.integers(0, 2 ** 16))
+    def test_write_rows_compression_roundtrip(self, compression, n_rows, seed):
+        import tempfile
+        import numpy as np
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_rows
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('C', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('v', np.float32, (3,), NdarrayCodec(), False)])
+        rng = np.random.RandomState(seed)
+        rows = [{'id': i, 'v': rng.randn(3).astype(np.float32)} for i in range(n_rows)]
+        root = tempfile.mkdtemp()
+        try:
+            url = root + '/ds'
+            write_rows(url, schema, rows, compression=compression)
+            with make_reader(url, workers_count=1, num_epochs=1,
+                             shuffle_row_groups=False) as reader:
+                back = {int(r.id): np.asarray(r.v) for r in reader}
+        finally:
+            import shutil
+            shutil.rmtree(root, ignore_errors=True)
+        assert sorted(back) == list(range(n_rows))
+        for row in rows:
+            np.testing.assert_array_almost_equal(back[row['id']], row['v'])
