@@ -390,6 +390,28 @@ class TestNgramDeviceLayer:
         carry, aux = loader.scan_epochs(step, jnp.float32(0), num_epochs=1)
         assert np.isfinite(float(carry))
 
+    def test_inmem_mesh_scan_epochs_over_windows(self, seq_dataset):
+        """NGram windows + mesh-sharded whole-epoch compilation compose: windows fill
+        shard-blocked across the virtual mesh and scan_epochs trains from
+        (batch, length, ...) sequence batches."""
+        import jax.numpy as jnp
+        from petastorm_tpu.parallel import InMemJaxLoader, make_mesh
+        ngram = NGram({0: ['ts', 'value'], 1: ['ts', 'value']}, delta_threshold=1,
+                      timestamp_field='ts')
+        reader = make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                             shuffle_row_groups=False, num_epochs=1)
+        loader = InMemJaxLoader(reader, batch_size=16, num_epochs=None, shuffle=True,
+                                seed=4, mesh=make_mesh(('data',)), drop_last=True)
+
+        def step(carry, batch):
+            assert batch['value'].shape == (16, 2, 2)
+            return carry + jnp.sum(batch['value']), jnp.min(batch['ts'])
+
+        with pytest.warns(UserWarning, match='trailing rows'):
+            # 19 windows over 8 shards -> 2/shard, 16 usable, 3 dropped
+            carry, aux = loader.scan_epochs(step, jnp.float32(0), num_epochs=2)
+        assert np.isfinite(float(carry))
+
     def test_loader_state_dict_rejected_for_ngram(self, seq_dataset):
         from petastorm_tpu.parallel import JaxDataLoader
         ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
