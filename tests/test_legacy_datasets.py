@@ -62,6 +62,33 @@ def test_legacy_versions_core_schema_stable():
                 assert got_dtype == expected_dtype, (version, name, got_dtype)
 
 
+def test_legacy_store_feeds_jitted_training(tmp_path):
+    """The full switch-from-petastorm story: a store WRITTEN BY REAL PETASTORM 0.7.6
+    flows through make_reader -> JaxDataLoader -> a jitted step on device arrays,
+    with no re-materialization and no petastorm install."""
+    import jax
+    import jax.numpy as jnp
+    from petastorm_tpu.parallel import JaxDataLoader
+    with make_reader(_url('0.7.6'), workers_count=1, num_epochs=1,
+                     schema_fields=['id', 'image_png'],
+                     shuffle_row_groups=False) as reader:
+        loader = JaxDataLoader(reader, batch_size=16, drop_last=True)
+
+        @jax.jit
+        def step(total, images, ids):
+            x = images.astype(jnp.bfloat16) / 255.0
+            return total + jnp.sum(x) + jnp.sum(ids)
+
+        total = jnp.float32(0)
+        batches = 0
+        for batch in loader:
+            assert batch['image_png'].shape == (16, 32, 16, 3)
+            total = step(total, batch['image_png'], batch['id'])
+            batches += 1
+    assert batches == 100 // 16
+    assert np.isfinite(float(total))
+
+
 def test_legacy_partition_predicate_prunes(tmp_path):
     """Partition-key predicates prune legacy stores' rowgroups in the main process."""
     from petastorm_tpu.predicates import in_lambda
