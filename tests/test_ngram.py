@@ -390,6 +390,25 @@ class TestNgramDeviceLayer:
         carry, aux = loader.scan_epochs(step, jnp.float32(0), num_epochs=1)
         assert np.isfinite(float(carry))
 
+    def test_scan_stream_over_windows(self, seq_dataset):
+        """Compiled-chunk streaming composes with NGram: window-major batches flow
+        through scan_stream's chunk programs."""
+        import jax.numpy as jnp
+        from petastorm_tpu.parallel import JaxDataLoader
+        ngram = NGram({0: ['ts', 'value'], 1: ['ts', 'value']}, delta_threshold=1,
+                      timestamp_field='ts')
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         shuffle_row_groups=False, num_epochs=1) as reader:
+            loader = JaxDataLoader(reader, batch_size=4)
+
+            def step(carry, batch):
+                assert batch['value'].shape == (4, 2, 2)
+                return carry + jnp.sum(batch['value']), jnp.float32(0)
+
+            carry, aux = loader.scan_stream(step, jnp.float32(0), chunk_batches=2)
+        assert sum(int(np.asarray(a).shape[0]) for a in aux) == 4  # 19 windows // 4
+        assert np.isfinite(float(carry))
+
     def test_inmem_mesh_scan_epochs_over_windows(self, seq_dataset):
         """NGram windows + mesh-sharded whole-epoch compilation compose: windows fill
         shard-blocked across the virtual mesh and scan_epochs trains from
