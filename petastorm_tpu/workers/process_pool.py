@@ -136,7 +136,11 @@ class ProcessPool(object):
         deadline = time.time() + 60
         while True:
             try:
-                self._vent_socket.send_pyobj(kwargs, flags=zmq.NOBLOCK)
+                # dill, not pickle: ventilated items carry user callables (lambda
+                # predicates, per-item transform state) that plain pickle rejects —
+                # the same reason the worker bootstrap ships via dill.
+                import dill
+                self._vent_socket.send(dill.dumps(kwargs), flags=zmq.NOBLOCK)
                 return
             except zmq.Again:
                 if self._stopped or time.time() > deadline:

@@ -63,7 +63,7 @@ def main(bootstrap_path):
             if control_socket.recv() == b'stop':
                 break
         if vent_socket in events:
-            kwargs = vent_socket.recv_pyobj()
+            kwargs = dill.loads(vent_socket.recv())
             try:
                 worker.process(**kwargs)
                 results_socket.send_multipart([b'done'])
