@@ -4,3 +4,5 @@ the reference's examples/mnist and examples/imagenet model code, re-done in flax
 from petastorm_tpu.models.mnist import MnistCNN  # noqa: F401
 from petastorm_tpu.models.resnet import ResNet50  # noqa: F401
 from petastorm_tpu.models.transformer import TransformerLM, next_token_loss  # noqa: F401
+from petastorm_tpu.models.moe import (MoEMlp, MoEBlock, MoETransformerLM,  # noqa: F401
+                                      expert_partition_specs, moe_aux_total)

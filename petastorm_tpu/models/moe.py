@@ -1,0 +1,275 @@
+"""Mixture-of-Experts layers — expert parallelism (ep) for the device mesh.
+
+The reference has no MoE; this completes the parallelism families the TPU framework
+serves (dp/sp/tp in ``__graft_entry__``/examples, pp in ``parallel/pipeline.py``, ep
+here). Design is TPU-first, not a torch translation:
+
+- **Static capacity dispatch.** Top-k routing with a fixed per-expert capacity
+  ``C = ceil(capacity_factor * k * tokens / num_experts)`` so every shape is known at
+  trace time — no ragged gathers, no data-dependent shapes that would break XLA tiling.
+  Dispatch and combine are one-hot einsum masks, which land on the MXU.
+- **Sharding by annotation.** Expert weights carry a leading experts axis; shard them
+  ``PartitionSpec('expert', ...)`` (see :func:`expert_partition_specs`) and jit under a
+  mesh with an ``'expert'`` axis — XLA places the all-to-all that moves token slots to
+  their expert's device on ICI (the scaling-book recipe: annotate, let the compiler
+  insert collectives). The module itself stays mesh-free; an optional
+  ``expert_axis`` adds a ``with_sharding_constraint`` hint on the dispatched blocks.
+- **Residual overflow.** Tokens past capacity contribute zero from the MoE branch and
+  ride the block's residual connection (Switch Transformer semantics).
+
+The router runs in float32 (softmax stability); expert FFNs run in ``dtype``
+(bfloat16 by default, MXU-native). The load-balance auxiliary loss is sown into the
+``'losses'`` collection — collect with :func:`moe_aux_total`.
+"""
+
+import math
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _capacity(num_tokens, num_experts, num_selected, capacity_factor):
+    cap = int(math.ceil(capacity_factor * num_selected * num_tokens / num_experts))
+    return max(1, cap)
+
+
+def _ambient_mesh_axes():
+    """Axis names of the mesh context the caller is tracing under, or None when no
+    mesh context is active (plain single-chip execution)."""
+    try:
+        from jax.sharding import get_abstract_mesh
+        mesh = get_abstract_mesh()
+        if mesh is not None and mesh.axis_names:
+            return set(mesh.axis_names)
+    except ImportError:
+        pass
+    try:
+        from jax._src.mesh import thread_resources
+        mesh = thread_resources.env.physical_mesh
+        if mesh.axis_names:
+            return set(mesh.axis_names)
+    except ImportError:
+        pass
+    return None
+
+
+def _sharding_hint(x, spec_axes):
+    """with_sharding_constraint when a mesh context is active; a no-op outside one.
+    A mesh that exists but lacks the named axis raises — silently skipping the
+    constraint would disable expert parallelism with no signal."""
+    from jax.sharding import PartitionSpec
+    axes = _ambient_mesh_axes()
+    if axes is None:
+        return x
+    wanted = {a for a in spec_axes if a is not None}
+    if not wanted <= axes:
+        raise ValueError('expert_axis {} not in ambient mesh axes {}; fix the mesh '
+                         'or the MoE expert_axis argument'
+                         .format(sorted(wanted - axes), sorted(axes)))
+    return lax.with_sharding_constraint(x, PartitionSpec(*spec_axes))
+
+
+class MoEMlp(nn.Module):
+    """Top-k routed expert MLP: ``[B, T, D] -> [B, T, D]``.
+
+    Shard ``w1``/``w2`` over their leading experts axis (``expert_partition_specs``)
+    for expert parallelism. ``expert_axis`` (optional) names the mesh axis for
+    sharding hints on the dispatched activations; leave ``None`` when running
+    unsharded (single chip or replicated).
+    """
+
+    num_experts: int
+    capacity_factor: float = 1.25
+    num_selected: int = 1
+    hidden_mult: int = 4
+    dtype: Any = jnp.bfloat16
+    expert_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        batch, seqlen, d = x.shape
+        n_tokens = batch * seqlen
+        n_exp = self.num_experts
+        k = self.num_selected
+        if k > n_exp:
+            raise ValueError('num_selected={} exceeds num_experts={}'.format(k, n_exp))
+        cap = _capacity(n_tokens, n_exp, k, self.capacity_factor)
+        hidden = self.hidden_mult * d
+
+        tokens = x.reshape(n_tokens, d)
+        # Router in float32: softmax over experts must not run in bf16.
+        logits = nn.Dense(n_exp, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name='router')(
+                              tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)                      # [S, X]
+        gate, expert_idx = lax.top_k(probs, k)                       # [S, k]
+        if k > 1:
+            gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+
+        # Slot-major capacity assignment: all first-choice assignments win capacity
+        # before any second choice (Switch/GShard priority). Positions come from an
+        # int32 cumulative count per expert (float32 cumsum loses exactness past
+        # 2^24 token-slots) — static shapes throughout.
+        onehot_i = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.int32)   # [S, k, X]
+        flat_i = onehot_i.transpose(1, 0, 2).reshape(k * n_tokens, n_exp)  # slot-major
+        flat = flat_i.astype(jnp.float32)
+        onehot = onehot_i.astype(jnp.float32)
+        pos_in_expert = jnp.cumsum(flat_i, axis=0) - flat_i             # [kS, X] int32
+        position = jnp.sum(pos_in_expert * flat_i, axis=-1)             # [kS] int32
+        assigned = jnp.sum(flat, axis=-1)
+        keep = assigned * (position < cap).astype(jnp.float32)          # [kS]
+
+        pos_onehot = jax.nn.one_hot(position, cap, dtype=jnp.float32)   # [kS, C]
+        dispatch_flat = (flat[:, :, None] * pos_onehot[:, None, :]
+                         * keep[:, None, None])                         # [kS, X, C]
+        gate_flat = gate.transpose(1, 0).reshape(k * n_tokens)
+        combine_flat = dispatch_flat * gate_flat[:, None, None]
+        dispatch = dispatch_flat.reshape(k, n_tokens, n_exp, cap).sum(0)  # [S, X, C]
+        combine = combine_flat.reshape(k, n_tokens, n_exp, cap).sum(0)
+
+        w1 = self.param('w1', nn.initializers.lecun_normal(batch_axis=(0,)),
+                        (n_exp, d, hidden), jnp.float32)
+        w2 = self.param('w2', nn.initializers.lecun_normal(batch_axis=(0,)),
+                        (n_exp, hidden, d), jnp.float32)
+
+        compute_dtype = self.dtype
+        expert_in = jnp.einsum('sd,sxc->xcd', tokens.astype(compute_dtype),
+                               dispatch.astype(compute_dtype))          # [X, C, D]
+        if self.expert_axis is not None:
+            expert_in = _sharding_hint(expert_in, (self.expert_axis, None, None))
+        h = jnp.einsum('xcd,xdf->xcf', expert_in, w1.astype(compute_dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum('xcf,xfd->xcd', h, w2.astype(compute_dtype))
+        if self.expert_axis is not None:
+            expert_out = _sharding_hint(expert_out, (self.expert_axis, None, None))
+        y = jnp.einsum('xcd,sxc->sd', expert_out.astype(jnp.float32),
+                       combine.astype(jnp.float32))
+
+        # Switch load-balance loss: X * sum_x f_x * P_x, minimized (=1) when uniform.
+        frac_tokens = jnp.mean(onehot[:, 0, :], axis=0)                 # top-1 share
+        mean_probs = jnp.mean(probs, axis=0)
+        aux = n_exp * jnp.sum(frac_tokens * mean_probs)
+        self.sow('losses', 'moe_aux', aux)
+        # Diagnostics: fraction of (token, slot) assignments dropped by capacity.
+        self.sow('losses', 'moe_drop_fraction',
+                 1.0 - jnp.sum(keep) / float(k * n_tokens))
+
+        return y.reshape(batch, seqlen, d).astype(x.dtype)
+
+
+def expert_partition_specs(params, expert_axis='expert'):
+    """PartitionSpecs for a pytree of params: MoE expert weights (leading experts
+    axis, i.e. param names ``w1``/``w2`` under an ``MoEMlp``) sharded over
+    ``expert_axis``, everything else replicated. Feed to ``NamedSharding``/jit."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [str(getattr(p, 'key', getattr(p, 'name', ''))) for p in path]
+        # Expert weights are the 3-D [experts, in, out] leaves named w1/w2 — either
+        # under a nested MoEMlp_* scope or at the root when MoEMlp is applied alone.
+        is_moe = any('MoEMlp' in n for n in names) or getattr(leaf, 'ndim', 0) == 3
+        if is_moe and names and names[-1] in ('w1', 'w2'):
+            return P(expert_axis, *([None] * (leaf.ndim - 1)))
+        return P(*([None] * leaf.ndim))
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def moe_aux_total(mutables, weight=1.0):
+    """Sum the latest sown ``moe_aux`` scalar of every MoE layer in the ``'losses'``
+    collection (as returned by ``model.apply(..., mutable='losses')``), scaled by
+    ``weight``. ``sow`` appends one value per apply, so only each tuple's LAST entry
+    belongs to the current step — summing the whole tuple would double-count when the
+    collection was threaded through from a previous apply (e.g. from ``init``). Train
+    on ``variables['params']`` only; never feed the init-time ``'losses'`` collection
+    to the optimizer."""
+    losses = mutables.get('losses', mutables)
+    leaves = []
+
+    def visit(tree, under_aux=False):
+        if isinstance(tree, dict):
+            for key, sub in tree.items():
+                visit(sub, under_aux or key == 'moe_aux')
+        elif isinstance(tree, (tuple, list)):
+            if under_aux and tree:
+                visit(tree[-1], under_aux)
+            elif not under_aux:
+                for sub in tree:
+                    visit(sub, under_aux)
+        elif under_aux:
+            leaves.append(tree)
+
+    visit(losses)
+    if not leaves:
+        return jnp.float32(0)
+    return weight * sum(leaves)
+
+
+class MoEBlock(nn.Module):
+    """Pre-norm transformer block whose MLP is a routed expert MLP."""
+
+    heads: int
+    num_experts: int
+    attention_fn: Callable
+    capacity_factor: float = 1.25
+    num_selected: int = 1
+    dtype: Any = jnp.bfloat16
+    expert_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        from petastorm_tpu.models.transformer import attention_sublayer
+        x = attention_sublayer(x, self.heads, self.attention_fn, self.dtype)
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        return x + MoEMlp(num_experts=self.num_experts,
+                          capacity_factor=self.capacity_factor,
+                          num_selected=self.num_selected,
+                          dtype=self.dtype,
+                          expert_axis=self.expert_axis)(h)
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with routed-expert MLP blocks: tokens ``[B, T]`` -> logits
+    ``[B, T, vocab]`` float32. Every ``moe_every``-th block is MoE (1 = all)."""
+
+    vocab: int = 256
+    embed: int = 64
+    heads: int = 4
+    layers: int = 2
+    num_experts: int = 4
+    capacity_factor: float = 1.25
+    num_selected: int = 1
+    moe_every: int = 1
+    max_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+    expert_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        from petastorm_tpu.models.transformer import Block, dense_causal_attention
+        if self.embed % self.heads != 0:
+            raise ValueError('embed={} must be divisible by heads={}'
+                             .format(self.embed, self.heads))
+        if tokens.shape[1] > self.max_len:
+            raise ValueError('sequence length {} exceeds max_len={}'
+                             .format(tokens.shape[1], self.max_len))
+        attention_fn = self.attention_fn or dense_causal_attention
+        x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
+        positions = jnp.arange(tokens.shape[1])
+        x = x + nn.Embed(self.max_len, self.embed, dtype=self.dtype)(positions)[None]
+        for i in range(self.layers):
+            if (i + 1) % self.moe_every == 0:
+                x = MoEBlock(heads=self.heads, num_experts=self.num_experts,
+                             capacity_factor=self.capacity_factor,
+                             num_selected=self.num_selected,
+                             attention_fn=attention_fn, dtype=self.dtype,
+                             expert_axis=self.expert_axis)(x)
+            else:
+                x = Block(heads=self.heads, attention_fn=attention_fn,
+                          dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.vocab, dtype=jnp.float32)(x)

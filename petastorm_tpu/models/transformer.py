@@ -27,6 +27,21 @@ def dense_causal_attention(q, k, v):
     return dense_attention(q, k, v, causal=True)
 
 
+def attention_sublayer(x, heads, attention_fn, dtype):
+    """Pre-norm attention sublayer with residual: shared by the dense :class:`Block`
+    and the MoE block (models/moe.py) so the attention path has ONE definition. Must
+    be called from inside a parent module's ``@nn.compact`` ``__call__``."""
+    embed = x.shape[-1]
+    head_dim = embed // heads
+    h = nn.LayerNorm(dtype=jnp.float32)(x).astype(dtype)
+    qkv = nn.Dense(3 * embed, use_bias=False, dtype=dtype)(h)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (x.shape[0], x.shape[1], heads, head_dim)
+    attn = attention_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+    attn = attn.reshape(x.shape[0], x.shape[1], embed)
+    return x + nn.Dense(embed, use_bias=False, dtype=dtype)(attn)
+
+
 class Block(nn.Module):
     heads: int
     attention_fn: Callable
@@ -35,14 +50,7 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x):
         embed = x.shape[-1]
-        head_dim = embed // self.heads
-        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
-        qkv = nn.Dense(3 * embed, use_bias=False, dtype=self.dtype)(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (x.shape[0], x.shape[1], self.heads, head_dim)
-        attn = self.attention_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
-        attn = attn.reshape(x.shape[0], x.shape[1], embed)
-        x = x + nn.Dense(embed, use_bias=False, dtype=self.dtype)(attn)
+        x = attention_sublayer(x, self.heads, self.attention_fn, self.dtype)
         h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
         h = nn.Dense(4 * embed, dtype=self.dtype)(h)
         h = nn.gelu(h)
