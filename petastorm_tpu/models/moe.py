@@ -56,13 +56,28 @@ def _ambient_mesh_axes():
     return None
 
 
+_no_mesh_warned = False
+
+
 def _sharding_hint(x, spec_axes):
-    """with_sharding_constraint when a mesh context is active; a no-op outside one.
-    A mesh that exists but lacks the named axis raises — silently skipping the
-    constraint would disable expert parallelism with no signal."""
+    """with_sharding_constraint when a mesh context is active. A mesh that exists but
+    lacks the named axis raises — silently skipping the constraint would disable
+    expert parallelism with no signal. With no ambient mesh at all (single-chip runs,
+    or jit driven purely by in_shardings without a mesh context) the hint cannot be
+    applied as a bare PartitionSpec; that case warns once instead of raising so a
+    model configured with ``expert_axis`` still runs unsharded."""
+    import warnings
+
     from jax.sharding import PartitionSpec
     axes = _ambient_mesh_axes()
     if axes is None:
+        global _no_mesh_warned
+        if not _no_mesh_warned:
+            _no_mesh_warned = True
+            warnings.warn(
+                'MoE expert_axis={!r} set but no mesh context is active; the expert '
+                'sharding hint was skipped. Trace under `with mesh:` (or jax.set_mesh)'
+                ' for expert parallelism.'.format(spec_axes[0]), stacklevel=2)
         return x
     wanted = {a for a in spec_axes if a is not None}
     if not wanted <= axes:
@@ -136,14 +151,17 @@ class MoEMlp(nn.Module):
                         (n_exp, hidden, d), jnp.float32)
 
         compute_dtype = self.dtype
+        # init() traces outside any mesh; the hint (and its no-mesh warning) only
+        # matters on real forward/backward traces.
+        want_hint = self.expert_axis is not None and not self.is_initializing()
         expert_in = jnp.einsum('sd,sxc->xcd', tokens.astype(compute_dtype),
                                dispatch.astype(compute_dtype))          # [X, C, D]
-        if self.expert_axis is not None:
+        if want_hint:
             expert_in = _sharding_hint(expert_in, (self.expert_axis, None, None))
         h = jnp.einsum('xcd,xdf->xcf', expert_in, w1.astype(compute_dtype))
         h = nn.gelu(h)
         expert_out = jnp.einsum('xcf,xfd->xcd', h, w2.astype(compute_dtype))
-        if self.expert_axis is not None:
+        if want_hint:
             expert_out = _sharding_hint(expert_out, (self.expert_axis, None, None))
         y = jnp.einsum('xcd,sxc->sd', expert_out.astype(jnp.float32),
                        combine.astype(jnp.float32))
@@ -168,11 +186,20 @@ def expert_partition_specs(params, expert_axis='expert'):
 
     def spec(path, leaf):
         names = [str(getattr(p, 'key', getattr(p, 'name', ''))) for p in path]
-        # Expert weights are the 3-D [experts, in, out] leaves named w1/w2 — either
-        # under a nested MoEMlp_* scope or at the root when MoEMlp is applied alone.
-        is_moe = any('MoEMlp' in n for n in names) or getattr(leaf, 'ndim', 0) == 3
-        if is_moe and names and names[-1] in ('w1', 'w2'):
-            return P(expert_axis, *([None] * (leaf.ndim - 1)))
+        # Expert weights are the 3-D [experts, in, out] leaves named w1/w2 — under a
+        # nested MoEMlp_* scope, or directly under 'params' when MoEMlp is the root
+        # module. Both conditions are required: name alone must not capture unrelated
+        # 3-D params, and an MoE leaf with extra leading axes (nn.scan / stacked
+        # pipeline stages) must fail loudly, not shard the wrong axis.
+        in_moe_scope = any('MoEMlp' in n for n in names)
+        if names and names[-1] in ('w1', 'w2') and (in_moe_scope or len(names) <= 2):
+            if leaf.ndim == 3:
+                return P(expert_axis, *([None] * (leaf.ndim - 1)))
+            if in_moe_scope:
+                raise ValueError(
+                    'MoE expert weight {} has ndim {} (expected 3): scanned/stacked '
+                    'MoE params need hand-written specs'.format(
+                        '/'.join(names), leaf.ndim))
         return P(*([None] * leaf.ndim))
 
     return jax.tree_util.tree_map_with_path(spec, params)

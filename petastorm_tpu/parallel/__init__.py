@@ -13,3 +13,6 @@ def __getattr__(name):  # lazy: orbax import is heavy and optional at runtime
 
 from petastorm_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding, distributed_shard_info, make_mesh)
+from petastorm_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipeline, microbatch, stack_stage_params, stage_partition_specs,
+    unstack_stage_params)

@@ -1,0 +1,161 @@
+"""Pipeline parallelism tests (parallel/pipeline.py): the shard_map/ppermute GPipe
+schedule must agree exactly with sequentially applying the stages, including grads."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from petastorm_tpu.parallel.pipeline import (make_pipeline, microbatch,
+                                             stack_stage_params,
+                                             stage_partition_specs,
+                                             unstack_stage_params)
+
+N_STAGES = 4
+DIM = 8
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params['w'] + params['b'])
+
+
+def make_stages(seed):
+    rng = np.random.RandomState(seed)
+    return [{'w': jnp.asarray(rng.randn(DIM, DIM) * 0.5, jnp.float32),
+             'b': jnp.asarray(rng.randn(DIM) * 0.1, jnp.float32)}
+            for _ in range(N_STAGES)]
+
+
+def sequential(stages, xs):
+    out = xs
+    for params in stages:
+        out = jax.vmap(lambda mb: stage_fn(params, mb))(out)
+    return out
+
+
+def stage_mesh():
+    return Mesh(np.asarray(jax.devices()[:N_STAGES]), ('stage',))
+
+
+class TestPipelineNumerics(object):
+    def test_matches_sequential(self):
+        stages = make_stages(0)
+        stacked = stack_stage_params(stages)
+        xs = jnp.asarray(np.random.RandomState(1).randn(6, 4, DIM), jnp.float32)
+        pipe = make_pipeline(stage_fn, stage_mesh())
+        ys = jax.jit(pipe)(stacked, xs)
+        np.testing.assert_allclose(np.asarray(ys), np.asarray(sequential(stages, xs)),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_single_microbatch_and_many(self):
+        stages = make_stages(2)
+        stacked = stack_stage_params(stages)
+        pipe = jax.jit(make_pipeline(stage_fn, stage_mesh()))
+        for n_micro in (1, 2, 8):
+            xs = jnp.asarray(np.random.RandomState(n_micro).randn(n_micro, 2, DIM),
+                             jnp.float32)
+            np.testing.assert_allclose(np.asarray(pipe(stacked, xs)),
+                                       np.asarray(sequential(stages, xs)),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_gradients_match_sequential(self):
+        stages = make_stages(3)
+        stacked = stack_stage_params(stages)
+        xs = jnp.asarray(np.random.RandomState(4).randn(4, 2, DIM), jnp.float32)
+        target = jnp.ones_like(xs)
+        pipe = make_pipeline(stage_fn, stage_mesh())
+
+        def pipe_loss(stacked, xs):
+            return jnp.mean((pipe(stacked, xs) - target) ** 2)
+
+        def seq_loss(stacked, xs):
+            out = xs
+            for i in range(N_STAGES):
+                params = unstack_stage_params(stacked, i)
+                out = jax.vmap(lambda mb: stage_fn(params, mb))(out)
+            return jnp.mean((out - target) ** 2)
+
+        g_pipe = jax.jit(jax.grad(pipe_loss))(stacked, xs)
+        g_seq = jax.jit(jax.grad(seq_loss))(stacked, xs)
+        for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_stacked_params_shardable(self):
+        stacked = stack_stage_params(make_stages(5))
+        specs = stage_partition_specs(stacked)
+        assert specs['w'] == P('stage', None, None)
+        assert specs['b'] == P('stage', None)
+        mesh = stage_mesh()
+        placed = jax.device_put(
+            stacked, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                  is_leaf=lambda l: isinstance(l, P)))
+        # Each device holds exactly its stage's slice.
+        shard_shapes = {s.data.shape for s in placed['w'].addressable_shards}
+        assert shard_shapes == {(1, DIM, DIM)}
+
+
+class TestPipelinePlusData(object):
+    def test_dp_pp_mesh(self):
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(N_STAGES, 2),
+                    ('stage', 'data'))
+        stages = make_stages(6)
+        stacked = stack_stage_params(stages)
+        xs = jnp.asarray(np.random.RandomState(7).randn(4, 4, DIM), jnp.float32)
+        xs_sharded = jax.device_put(xs, NamedSharding(mesh, P(None, 'data', None)))
+        pipe = make_pipeline(stage_fn, mesh, xs_spec=P(None, 'data', None),
+                             out_spec=P(None, 'data', None))
+        ys = jax.jit(pipe)(stacked, xs_sharded)
+        np.testing.assert_allclose(np.asarray(ys), np.asarray(sequential(stages, xs)),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_training_step_decreases_loss(self):
+        mesh = stage_mesh()
+        stacked = stack_stage_params(make_stages(8))
+        xs = jnp.asarray(np.random.RandomState(9).randn(4, 4, DIM), jnp.float32)
+        target = jnp.asarray(np.random.RandomState(10).randn(4, 4, DIM) * 0.1,
+                             jnp.float32)
+        pipe = make_pipeline(stage_fn, mesh)
+        optimizer = optax.adam(1e-2)
+        opt_state = optimizer.init(stacked)
+
+        @jax.jit
+        def step(stacked, opt_state):
+            def loss_fn(stacked):
+                return jnp.mean((pipe(stacked, xs) - target) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn)(stacked)
+            updates, opt_state2 = optimizer.update(grads, opt_state, stacked)
+            return optax.apply_updates(stacked, updates), opt_state2, loss
+
+        losses = []
+        for _ in range(10):
+            stacked, opt_state, loss = step(stacked, opt_state)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+
+class TestPipelineGuards(object):
+    def test_missing_axis(self):
+        with pytest.raises(ValueError):
+            make_pipeline(stage_fn, Mesh(np.asarray(jax.devices()[:4]), ('data',)))
+
+    def test_microbatch_split(self):
+        batch = jnp.zeros((8, DIM))
+        assert microbatch(batch, 4).shape == (4, 2, DIM)
+        with pytest.raises(ValueError):
+            microbatch(batch, 3)
+
+    def test_shape_changing_stage_rejected(self):
+        def bad_stage(params, x):
+            return jnp.concatenate([x, x], axis=-1)
+        pipe = make_pipeline(bad_stage, stage_mesh())
+        stacked = stack_stage_params(make_stages(11))
+        with pytest.raises(ValueError):
+            jax.jit(pipe)(stacked, jnp.zeros((2, 2, DIM)))
+
+    def test_empty_stage_list(self):
+        with pytest.raises(ValueError):
+            stack_stage_params([])
