@@ -56,28 +56,24 @@ def _ambient_mesh_axes():
     return None
 
 
-_no_mesh_warned = False
-
-
 def _sharding_hint(x, spec_axes):
     """with_sharding_constraint when a mesh context is active. A mesh that exists but
     lacks the named axis raises — silently skipping the constraint would disable
     expert parallelism with no signal. With no ambient mesh at all (single-chip runs,
     or jit driven purely by in_shardings without a mesh context) the hint cannot be
-    applied as a bare PartitionSpec; that case warns once instead of raising so a
-    model configured with ``expert_axis`` still runs unsharded."""
+    applied as a bare PartitionSpec; that case warns instead of raising so a model
+    configured with ``expert_axis`` still runs unsharded (the default warnings filter
+    dedups repeats per call site — no hand-rolled once flag, which would also
+    suppress the signal for later, genuinely misconfigured models)."""
     import warnings
 
     from jax.sharding import PartitionSpec
     axes = _ambient_mesh_axes()
     if axes is None:
-        global _no_mesh_warned
-        if not _no_mesh_warned:
-            _no_mesh_warned = True
-            warnings.warn(
-                'MoE expert_axis={!r} set but no mesh context is active; the expert '
-                'sharding hint was skipped. Trace under `with mesh:` (or jax.set_mesh)'
-                ' for expert parallelism.'.format(spec_axes[0]), stacklevel=2)
+        warnings.warn(
+            'MoE expert_axis={!r} set but no mesh context is active; the expert '
+            'sharding hint was skipped. Trace under `with mesh:` (or jax.set_mesh)'
+            ' for expert parallelism.'.format(spec_axes[0]), stacklevel=2)
         return x
     wanted = {a for a in spec_axes if a is not None}
     if not wanted <= axes:
@@ -187,15 +183,17 @@ def expert_partition_specs(params, expert_axis='expert'):
     def spec(path, leaf):
         names = [str(getattr(p, 'key', getattr(p, 'name', ''))) for p in path]
         # Expert weights are the 3-D [experts, in, out] leaves named w1/w2 — under a
-        # nested MoEMlp_* scope, or directly under 'params' when MoEMlp is the root
-        # module. Both conditions are required: name alone must not capture unrelated
-        # 3-D params, and an MoE leaf with extra leading axes (nn.scan / stacked
-        # pipeline stages) must fail loudly, not shard the wrong axis.
-        in_moe_scope = any('MoEMlp' in n for n in names)
-        if names and names[-1] in ('w1', 'w2') and (in_moe_scope or len(names) <= 2):
+        # nested MoEMlp_* scope, or at exactly ('params', 'w1'/'w2') when MoEMlp is
+        # the root module. Both the scope and ndim conditions are required: a bare
+        # top-level w1/w2 (e.g. stack_stage_params output) must not be captured, and
+        # an MoE leaf with extra leading axes (nn.scan / stacked pipeline stages)
+        # must fail loudly, not shard the wrong axis.
+        in_moe_scope = (any('MoEMlp' in n for n in names)
+                        or (len(names) == 2 and names[0] == 'params'))
+        if names and names[-1] in ('w1', 'w2') and in_moe_scope:
             if leaf.ndim == 3:
                 return P(expert_axis, *([None] * (leaf.ndim - 1)))
-            if in_moe_scope:
+            if any('MoEMlp' in n for n in names):
                 raise ValueError(
                     'MoE expert weight {} has ndim {} (expected 3): scanned/stacked '
                     'MoE params need hand-written specs'.format(

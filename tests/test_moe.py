@@ -164,6 +164,14 @@ class TestMoEExpertParallel(object):
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
 
+    def test_specs_ignore_non_moe_shallow_3d_leaves(self):
+        # stack_stage_params output (top-level 3-D w1/w2, no MoE scope, no 'params'
+        # root) must NOT be captured as expert weights.
+        stacked = {'w1': jnp.zeros((4, 8, 16)), 'w2': jnp.zeros((4, 16, 8))}
+        specs = expert_partition_specs(stacked)
+        assert specs['w1'] == P(None, None, None)
+        assert specs['w2'] == P(None, None, None)
+
     def test_aux_total_counts_only_latest_sow(self):
         # sow appends per apply; a threaded-through collection must not double-count.
         mods = {'losses': {'MoEMlp_0': {'moe_aux': (jnp.float32(2), jnp.float32(3))}}}
