@@ -67,6 +67,7 @@ class TransformerLM(nn.Module):
     max_len: int = 8192
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -79,12 +80,19 @@ class TransformerLM(nn.Module):
             raise ValueError('sequence length {} exceeds max_len={}; raise max_len'
                              .format(tokens.shape[1], self.max_len))
         attention_fn = self.attention_fn or dense_causal_attention
+        # remat trades FLOPs for HBM: block activations are recomputed in the
+        # backward instead of stored — the standard long-context/deep-stack lever
+        # (pairs with flash/ring attention, which bound the attention memory).
+        block_cls = nn.remat(Block) if self.remat else Block
         x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
         positions = jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.embed, dtype=self.dtype)(positions)[None]
-        for _ in range(self.layers):
-            x = Block(heads=self.heads, attention_fn=attention_fn,
-                      dtype=self.dtype)(x)
+        for i in range(self.layers):
+            # Explicit names keep the param tree identical with and without remat
+            # (nn.remat would otherwise rename the scope), so checkpoints and
+            # sharding specs transfer between the two configurations.
+            x = block_cls(heads=self.heads, attention_fn=attention_fn,
+                          dtype=self.dtype, name='Block_{}'.format(i))(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab, dtype=jnp.float32)(x)
 

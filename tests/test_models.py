@@ -185,6 +185,28 @@ class TestTransformerLM:
         b = flash_model.apply(params, tokens)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
 
+    def test_remat_preserves_outputs_and_grads(self):
+        # remat must change memory behavior only: same params -> identical logits
+        # and gradients (recomputed, not re-randomized).
+        from petastorm_tpu.models import TransformerLM, next_token_loss
+        dense = TransformerLM(vocab=32, embed=16, heads=2, layers=2,
+                              dtype=jnp.float32)
+        remat = TransformerLM(vocab=32, embed=16, heads=2, layers=2,
+                              dtype=jnp.float32, remat=True)
+        tokens = jnp.asarray(np.random.RandomState(0).randint(0, 32, (2, 12)),
+                             jnp.int32)
+        params = dense.init(jax.random.PRNGKey(0), tokens)
+        np.testing.assert_allclose(
+            np.asarray(dense.apply(params, tokens)),
+            np.asarray(remat.apply(params, tokens)), rtol=1e-6, atol=1e-6)
+        g_dense = jax.grad(
+            lambda p: next_token_loss(dense.apply(p, tokens), tokens))(params)
+        g_remat = jax.grad(
+            lambda p: next_token_loss(remat.apply(p, tokens), tokens))(params)
+        for a, b in zip(jax.tree.leaves(g_dense), jax.tree.leaves(g_remat)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
     def test_sequence_beyond_max_len_rejected(self):
         from petastorm_tpu.models import TransformerLM
         model = TransformerLM(vocab=8, embed=16, heads=2, layers=1, max_len=16)
