@@ -86,6 +86,71 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+# Headline fallback chain: when the mnist_inmem headline did not run (section
+# failure, salvage from a dead child, or a deliberate BENCH_SECTIONS subset), the
+# emitted line falls back to the best measured rate WITH a metric/unit that matches
+# its semantics and a config tag naming the substitution — never a bare value=0.0
+# that reads as a performance collapse downstream.
+_HEADLINE_FALLBACKS = (
+    ('streaming_rows_per_sec', 'streaming_vs_baseline',
+     'mnist_train_rows_per_sec_per_chip', 'rows/s/chip', 'streaming_fallback_headline'),
+    ('streaming_scan_rows_per_sec', 'streaming_scan_vs_baseline',
+     'mnist_train_rows_per_sec_per_chip', 'rows/s/chip',
+     'scan_stream_fallback_headline'),
+    ('imagenet_stream_rows_per_sec', None,
+     'imagenet_train_rows_per_sec_per_chip', 'rows/s/chip',
+     'imagenet_stream_fallback_headline'),
+    ('flash_train_tokens_per_sec', None,
+     'flash_train_tokens_per_sec', 'tokens/s', 'flash_fallback_headline'),
+    ('bare_reader_rows_per_sec', 'bare_reader_vs_baseline',
+     'bare_reader_rows_per_sec', 'rows/s', 'bare_reader_fallback_headline'),
+)
+
+
+SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
+                 'mnist_inmem', 'imagenet_stream', 'decode_delta', 'flash')
+
+
+def validate_bench_sections():
+    """Parse BENCH_SECTIONS into an allowlist set (empty = run everything). A typo
+    must fail loudly — before the TPU probe in the parent, again in the child — not
+    silently skip every section and emit value=0.0."""
+    allowlist = {s.strip() for s in
+                 os.environ.get('BENCH_SECTIONS', '').split(',') if s.strip()}
+    unknown = allowlist - set(SECTION_NAMES)
+    if unknown:
+        raise SystemExit('BENCH_SECTIONS contains unknown section(s) {}; known: {}'
+                         .format(sorted(unknown), ', '.join(SECTION_NAMES)))
+    return allowlist
+
+
+def normalize_headline(result):
+    """Enforce the one-JSON-line contract ({metric, value, unit, vs_baseline}) on
+    every emission path (child final line, parent salvage)."""
+    def tag_config(tag):
+        config = result.get('config', '')
+        result['config'] = (config + '+' + tag if config.startswith('sections:')
+                            else tag)
+
+    if 'value' not in result:
+        for key, vs_key, metric, unit, tag in _HEADLINE_FALLBACKS:
+            if key in result:
+                result['value'] = result[key]
+                result['metric'] = metric
+                result['unit'] = unit
+                result['vs_baseline'] = result.get(vs_key, 0.0) if vs_key else 0.0
+                tag_config(tag)
+                break
+        else:
+            result.update(value=0.0, vs_baseline=0.0)
+            tag_config('no_sections_completed')
+    result.setdefault('metric', 'mnist_train_rows_per_sec_per_chip')
+    result.setdefault('unit', 'rows/s/chip')
+    result.setdefault('vs_baseline',
+                      round(result['value'] / REFERENCE_BASELINE_ROWS_PER_SEC, 3))
+    return result
+
+
 def dataset_url():
     return os.path.join(tempfile.gettempdir(),
                         'petastorm_tpu_bench_mnist_{}'.format(NUM_ROWS))
@@ -298,15 +363,7 @@ def orchestrate():
     # Salvaged partials come from PARTIAL_JSON lines emitted BEFORE the child's final
     # normalization — enforce the one-JSON-line contract ({metric, value, unit,
     # vs_baseline}) here for every path.
-    result.setdefault('metric', 'mnist_train_rows_per_sec_per_chip')
-    result.setdefault('unit', 'rows/s/chip')
-    if 'value' not in result:
-        result['value'] = result.get('streaming_rows_per_sec', 0.0)
-        result['vs_baseline'] = result.get('streaming_vs_baseline', 0.0)
-        result['config'] = 'streaming_fallback_headline'
-    result.setdefault('vs_baseline',
-                      round(result['value'] / REFERENCE_BASELINE_ROWS_PER_SEC, 3))
-    print(json.dumps(result))
+    print(json.dumps(normalize_headline(result)))
 
 
 def child_main():
@@ -706,7 +763,15 @@ def child_main():
         # salvages the last PARTIAL_JSON line from this child's stdout.
         print('PARTIAL_JSON ' + json.dumps(dict(results, partial=True)), flush=True)
 
+    section_allowlist = validate_bench_sections()
+    if section_allowlist:
+        results['config'] = 'sections:' + ','.join(
+            s for s in SECTION_NAMES if s in section_allowlist)
+
     def run_section(name, fn):
+        if section_allowlist and name not in section_allowlist:
+            log('section {} skipped (BENCH_SECTIONS)'.format(name))
+            return
         try:
             fn()
         except Exception as exc:  # noqa: BLE001 - a section failure must not zero the rest
@@ -832,18 +897,11 @@ def child_main():
     run_section('decode_delta', run_decode)
     run_section('flash', run_flash)
 
-    results.setdefault('metric', 'mnist_train_rows_per_sec_per_chip')
-    results.setdefault('unit', 'rows/s/chip')
-    if 'value' not in results:
-        # headline section failed: fall back to the streaming number so the line is
-        # still a valid {metric, value, unit, vs_baseline} record
-        results['value'] = results.get('streaming_rows_per_sec', 0.0)
-        results['vs_baseline'] = results.get('streaming_vs_baseline', 0.0)
-        results['config'] = 'streaming_fallback_headline'
-    print(json.dumps(results))
+    print(json.dumps(normalize_headline(results)))
 
 
 def main():
+    validate_bench_sections()  # fail fast on typos before any probe/measure work
     if os.environ.get('BENCH_CHILD') == '1':
         child_main()
     else:

@@ -92,6 +92,33 @@ class TestMoEMlpNumerics(object):
         assert fn(params, x).shape == (1, 8, 8)
 
 
+class TestMoEInvariants(object):
+    def _apply(self, model, x, seed=0):
+        params = model.init(jax.random.PRNGKey(seed), x)
+        return params, model.apply(params, x, mutable='losses')
+
+    def test_permutation_equivariant_with_generous_capacity(self):
+        # With no capacity competition the layer is a per-token function: permuting
+        # tokens must permute outputs identically.
+        model = MoEMlp(num_experts=4, capacity_factor=8.0, dtype=jnp.float32)
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 16, 8), jnp.float32)
+        params, (y, _) = self._apply(model, x)
+        perm = np.random.RandomState(1).permutation(16)
+        y_perm, _ = model.apply(params, x[:, perm], mutable='losses')
+        np.testing.assert_allclose(np.asarray(y_perm), np.asarray(y)[:, perm],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_drop_fraction_monotone_in_capacity(self):
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 32, 8), jnp.float32)
+        drops = []
+        for cf in (0.25, 0.5, 1.0, 8.0):
+            model = MoEMlp(num_experts=4, capacity_factor=cf, dtype=jnp.float32)
+            _, (_, mods) = self._apply(model, x, seed=3)
+            drops.append(float(mods['losses']['moe_drop_fraction'][0]))
+        assert drops == sorted(drops, reverse=True), drops
+        assert drops[-1] == 0.0
+
+
 class TestMoEExpertParallel(object):
     def _mesh(self):
         return Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ('data', 'expert'))
@@ -181,3 +208,28 @@ class TestMoEExpertParallel(object):
         with pytest.raises(ValueError):
             MoEMlp(num_experts=2, num_selected=3, dtype=jnp.float32).init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 4, 8)))
+
+    def test_expert_sharded_checkpoint_round_trip(self, tmp_path):
+        # Expert-parallel params must survive a TrainingCheckpointer save/restore
+        # with values AND shardings intact (orbax restores onto the template's
+        # shardings).
+        from petastorm_tpu.parallel import TrainingCheckpointer
+        mesh = self._mesh()
+        model = MoEMlp(num_experts=4, dtype=jnp.float32)
+        x = jnp.asarray(np.random.RandomState(6).randn(2, 8, 16), jnp.float32)
+        params = model.init(jax.random.PRNGKey(6), x)
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                 expert_partition_specs(params),
+                                 is_leaf=lambda l: isinstance(l, P))
+        params = jax.device_put(params, shardings)
+        template = jax.tree.map(lambda leaf, sh: jax.device_put(
+            jnp.zeros(leaf.shape, leaf.dtype), sh), params, shardings)
+        with TrainingCheckpointer(str(tmp_path)) as ckpt:
+            assert ckpt.save(0, params, force=True)
+            ckpt.wait_until_finished()
+            restored, loader_state = ckpt.restore(template)
+        assert loader_state is None
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        w1 = restored['params']['w1']
+        assert w1.sharding.spec == P('expert', None, None), w1.sharding
