@@ -124,13 +124,18 @@ def validate_bench_sections():
     return allowlist
 
 
+def compose_config(existing, tag):
+    """Config tags must never stomp the 'sections:' provenance of a BENCH_SECTIONS
+    subset run — append to it instead."""
+    existing = existing or ''
+    return existing + '+' + tag if existing.startswith('sections:') else tag
+
+
 def normalize_headline(result):
     """Enforce the one-JSON-line contract ({metric, value, unit, vs_baseline}) on
     every emission path (child final line, parent salvage)."""
     def tag_config(tag):
-        config = result.get('config', '')
-        result['config'] = (config + '+' + tag if config.startswith('sections:')
-                            else tag)
+        result['config'] = compose_config(result.get('config'), tag)
 
     if 'value' not in result:
         for key, vs_key, metric, unit, tag in _HEADLINE_FALLBACKS:
@@ -324,9 +329,13 @@ def orchestrate():
                     log('TPU gone after child failure')
                     break
 
-    if result is None and best_partial is not None and 'value' in best_partial:
-        # The TPU child died mid-run but completed the headline section: a partial
-        # TPU measurement beats a complete CPU fallback.
+    salvageable = best_partial is not None and (
+        'value' in best_partial
+        or any(key in best_partial for key, _, _, _, _ in _HEADLINE_FALLBACKS))
+    if result is None and salvageable:
+        # The TPU child died mid-run but completed the headline section OR any
+        # measured-rate section normalize_headline can promote: a partial TPU
+        # measurement beats a complete CPU fallback.
         log('using salvaged partial TPU results ({} fields)'.format(len(best_partial)))
         result = best_partial
 
@@ -874,7 +883,8 @@ def child_main():
             'vs_baseline': round(value / REFERENCE_BASELINE_ROWS_PER_SEC, 3),
             'input_stall_fraction':
                 round(float(np.median([s for _, s in inmem_results])), 4),
-            'config': 'inmem_hbm_resident_epochs',
+            'config': compose_config(results.get('config'),
+                                     'inmem_hbm_resident_epochs'),
             'fill_epoch_s': round(fill_epoch_s, 3),
             'value_mean': round(float(np.mean(inmem_rates)), 2),
             'estimator': 'median_of_{}_epochs'.format(EPOCHS),

@@ -83,6 +83,45 @@ def _sharding_hint(x, spec_axes):
     return lax.with_sharding_constraint(x, PartitionSpec(*spec_axes))
 
 
+def switch_routing(probs, capacity, num_selected):
+    """Top-k routing with static capacity: ``probs [S, X]`` (row-softmax) ->
+    ``(dispatch [S, X, C], combine [S, X, C], aux, drop_fraction)``.
+
+    Pure function shared by :class:`MoEMlp` (annotation-based expert parallelism)
+    and ``ops.sharded_moe`` (explicit all-to-all under shard_map) so the two
+    execution paths can never route differently. Slot-major priority: all
+    first-choice assignments win capacity before any second choice (Switch/GShard);
+    positions use an int32 cumsum (exact past 2^24 token-slots)."""
+    n_tokens, n_exp = probs.shape
+    k = num_selected
+    gate, expert_idx = lax.top_k(probs, k)                              # [S, k]
+    if k > 1:
+        gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+
+    onehot_i = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.int32)       # [S, k, X]
+    flat_i = onehot_i.transpose(1, 0, 2).reshape(k * n_tokens, n_exp)   # slot-major
+    flat = flat_i.astype(jnp.float32)
+    pos_in_expert = jnp.cumsum(flat_i, axis=0) - flat_i                 # [kS, X]
+    position = jnp.sum(pos_in_expert * flat_i, axis=-1)                 # [kS] int32
+    assigned = jnp.sum(flat, axis=-1)
+    keep = assigned * (position < capacity).astype(jnp.float32)         # [kS]
+
+    pos_onehot = jax.nn.one_hot(position, capacity, dtype=jnp.float32)  # [kS, C]
+    dispatch_flat = (flat[:, :, None] * pos_onehot[:, None, :]
+                     * keep[:, None, None])                             # [kS, X, C]
+    gate_flat = gate.transpose(1, 0).reshape(k * n_tokens)
+    combine_flat = dispatch_flat * gate_flat[:, None, None]
+    dispatch = dispatch_flat.reshape(k, n_tokens, n_exp, capacity).sum(0)
+    combine = combine_flat.reshape(k, n_tokens, n_exp, capacity).sum(0)
+
+    # Switch load-balance loss: X * sum_x f_x * P_x, minimized (=1) when uniform.
+    frac_tokens = jnp.mean(onehot_i[:, 0, :].astype(jnp.float32), axis=0)
+    mean_probs = jnp.mean(probs, axis=0)
+    aux = n_exp * jnp.sum(frac_tokens * mean_probs)
+    drop_fraction = 1.0 - jnp.sum(keep) / float(k * n_tokens)
+    return dispatch, combine, aux, drop_fraction
+
+
 class MoEMlp(nn.Module):
     """Top-k routed expert MLP: ``[B, T, D] -> [B, T, D]``.
 
@@ -116,30 +155,7 @@ class MoEMlp(nn.Module):
                           param_dtype=jnp.float32, name='router')(
                               tokens.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)                      # [S, X]
-        gate, expert_idx = lax.top_k(probs, k)                       # [S, k]
-        if k > 1:
-            gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
-
-        # Slot-major capacity assignment: all first-choice assignments win capacity
-        # before any second choice (Switch/GShard priority). Positions come from an
-        # int32 cumulative count per expert (float32 cumsum loses exactness past
-        # 2^24 token-slots) — static shapes throughout.
-        onehot_i = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.int32)   # [S, k, X]
-        flat_i = onehot_i.transpose(1, 0, 2).reshape(k * n_tokens, n_exp)  # slot-major
-        flat = flat_i.astype(jnp.float32)
-        onehot = onehot_i.astype(jnp.float32)
-        pos_in_expert = jnp.cumsum(flat_i, axis=0) - flat_i             # [kS, X] int32
-        position = jnp.sum(pos_in_expert * flat_i, axis=-1)             # [kS] int32
-        assigned = jnp.sum(flat, axis=-1)
-        keep = assigned * (position < cap).astype(jnp.float32)          # [kS]
-
-        pos_onehot = jax.nn.one_hot(position, cap, dtype=jnp.float32)   # [kS, C]
-        dispatch_flat = (flat[:, :, None] * pos_onehot[:, None, :]
-                         * keep[:, None, None])                         # [kS, X, C]
-        gate_flat = gate.transpose(1, 0).reshape(k * n_tokens)
-        combine_flat = dispatch_flat * gate_flat[:, None, None]
-        dispatch = dispatch_flat.reshape(k, n_tokens, n_exp, cap).sum(0)  # [S, X, C]
-        combine = combine_flat.reshape(k, n_tokens, n_exp, cap).sum(0)
+        dispatch, combine, aux, drop_fraction = switch_routing(probs, cap, k)
 
         w1 = self.param('w1', nn.initializers.lecun_normal(batch_axis=(0,)),
                         (n_exp, d, hidden), jnp.float32)
@@ -162,14 +178,9 @@ class MoEMlp(nn.Module):
         y = jnp.einsum('xcd,sxc->sd', expert_out.astype(jnp.float32),
                        combine.astype(jnp.float32))
 
-        # Switch load-balance loss: X * sum_x f_x * P_x, minimized (=1) when uniform.
-        frac_tokens = jnp.mean(onehot[:, 0, :], axis=0)                 # top-1 share
-        mean_probs = jnp.mean(probs, axis=0)
-        aux = n_exp * jnp.sum(frac_tokens * mean_probs)
         self.sow('losses', 'moe_aux', aux)
         # Diagnostics: fraction of (token, slot) assignments dropped by capacity.
-        self.sow('losses', 'moe_drop_fraction',
-                 1.0 - jnp.sum(keep) / float(k * n_tokens))
+        self.sow('losses', 'moe_drop_fraction', drop_fraction)
 
         return y.reshape(batch, seqlen, d).astype(x.dtype)
 

@@ -5,3 +5,5 @@ OpenCV/numpy decode — SURVEY.md §2.9, §5.7)."""
 from petastorm_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from petastorm_tpu.ops.image import normalize_image, random_crop_flip  # noqa: F401
 from petastorm_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from petastorm_tpu.ops.sharded_moe import (  # noqa: F401
+    expert_alltoall_ffn, sharded_moe_ffn)

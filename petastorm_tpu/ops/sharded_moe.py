@@ -1,0 +1,96 @@
+"""Explicit all-to-all MoE dispatch under ``shard_map`` (the GShard wiring).
+
+:class:`petastorm_tpu.models.MoEMlp` expresses expert parallelism as sharding
+annotations and lets XLA place the all-to-all — the right default under plain
+``jit``. Inside a ``shard_map`` region, however, there is no compiler to place
+collectives: code that already lives there (ring attention over a ``seq`` axis, the
+pipeline schedule over ``stage``) needs the expert exchange written out. This module
+is that spelled-out data path, built on the SAME routing math
+(``models.moe.switch_routing``) so the two paths can never route differently:
+
+1. each data shard dispatches its local tokens into per-expert capacity slots
+   ``[experts, C_local, d]`` (one-hot einsum — MXU work, static shapes);
+2. ``lax.all_to_all`` over the expert axis exchanges expert blocks so every device
+   holds ONLY its own experts' slots from every peer ``[local_experts, ne*C_local, d]``
+   — the collective rides ICI;
+3. the local expert FFN runs (two einsums + activation);
+4. the inverse ``all_to_all`` returns results to the tokens' home shards, where the
+   combine einsum weighs them back into token order.
+
+Gradients flow through both collectives (``all_to_all`` is its own transpose up to
+axis bookkeeping), so ``jax.grad`` of a loss through this op yields the standard
+MoE backward with the same two exchanges.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def expert_alltoall_ffn(tokens, dispatch, combine, w1, w2, axis_name,
+                        activation=jax.nn.gelu):
+    """Run the expert FFN with explicit all-to-all exchange. Call INSIDE shard_map.
+
+    :param tokens: ``[S_local, d]`` this data shard's tokens.
+    :param dispatch: ``[S_local, X, C_local]`` routing dispatch mask over ALL ``X``
+        experts (from :func:`petastorm_tpu.models.moe.switch_routing` on the local
+        shard's router probabilities).
+    :param combine: ``[S_local, X, C_local]`` matching combine weights.
+    :param w1: ``[X_local, d, f]`` THIS device's expert slice (X_local = X / ne).
+    :param w2: ``[X_local, f, d]`` likewise.
+    :param axis_name: mesh axis the experts are sharded over (size ``ne``).
+    :param activation: FFN nonlinearity.
+    :returns: ``[S_local, d]`` expert outputs in token order (dtype of ``tokens``).
+    """
+    ne = lax.psum(1, axis_name)
+    n_exp = dispatch.shape[1]
+    if n_exp % ne != 0:
+        raise ValueError('experts {} not divisible by axis {!r} size {}'
+                         .format(n_exp, axis_name, ne))
+    x_local = n_exp // ne
+    if w1.shape[0] != x_local or w2.shape[0] != x_local:
+        raise ValueError('expert weight leading dim {} != local experts {} '
+                         '(= {} experts / {} devices)'
+                         .format(w1.shape[0], x_local, n_exp, ne))
+    cap = dispatch.shape[2]
+    dtype = tokens.dtype
+
+    # [S, X, C] x [S, d] -> [X, C, d]: local tokens into capacity slots.
+    slots = jnp.einsum('sxc,sd->xcd', dispatch.astype(dtype), tokens)
+    # Group by owning device and exchange: after all_to_all, dim 0 is the SOURCE
+    # data shard and dim 1 this device's local experts.
+    slots = slots.reshape(ne, x_local, cap, -1)
+    slots = lax.all_to_all(slots, axis_name, split_axis=0, concat_axis=0)
+    # [ne, X_local, C, d] -> [X_local, ne*C, d]: every peer's slots for my experts.
+    slots = slots.transpose(1, 0, 2, 3).reshape(x_local, ne * cap, -1)
+
+    h = activation(jnp.einsum('xcd,xdf->xcf', slots, w1.astype(dtype)))
+    out = jnp.einsum('xcf,xfd->xcd', h, w2.astype(dtype))
+
+    # Inverse exchange: back to [S-home-shard, ...] layout, then combine.
+    out = out.reshape(x_local, ne, cap, -1).transpose(1, 0, 2, 3)
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0)
+    out = out.reshape(n_exp, cap, -1)                                  # [X, C, d]
+    return jnp.einsum('xcd,sxc->sd', out.astype(jnp.float32),
+                      combine.astype(jnp.float32)).astype(dtype)
+
+
+def sharded_moe_ffn(tokens, router_kernel, w1, w2, axis_name, capacity_factor=1.25,
+                    num_selected=1, activation=jax.nn.gelu):
+    """Routing + exchange + FFN in one call (inside shard_map): ``[S_local, d]`` ->
+    ``([S_local, d], aux, drop_fraction)``.
+
+    Routing runs per data shard on ``router_kernel [d, X]`` (replicated across the
+    expert axis); capacity is computed from the LOCAL token count, matching what
+    :class:`MoEMlp` computes per global batch divided by data shards. ``aux`` and
+    ``drop_fraction`` are local-shard scalars — ``lax.pmean`` them over the data
+    axis for the global values."""
+    from petastorm_tpu.models.moe import _capacity, switch_routing
+    n_exp = router_kernel.shape[1]
+    probs = jax.nn.softmax(tokens.astype(jnp.float32) @ router_kernel.astype(
+        jnp.float32), axis=-1)
+    cap = _capacity(tokens.shape[0], n_exp, num_selected, capacity_factor)
+    dispatch, combine, aux, drop_fraction = switch_routing(probs, cap, num_selected)
+    out = expert_alltoall_ffn(tokens, dispatch, combine, w1, w2, axis_name,
+                              activation=activation)
+    return out, aux, drop_fraction
