@@ -24,20 +24,28 @@ def params(seed):
             jnp.asarray(rng.randn(N_EXPERTS, HID, DIM) * 0.3, jnp.float32))
 
 
+def shard_reference(tokens, router_kernel, w1, w2, capacity_factor=8.0,
+                    num_selected=1):
+    """ONE shard's route->dispatch->FFN->combine, the slow unsharded way — the
+    reference body for every equivalence test in this file."""
+    n_exp = router_kernel.shape[1]
+    probs = jax.nn.softmax(tokens @ router_kernel, axis=-1)
+    cap = _capacity(tokens.shape[0], n_exp, num_selected, capacity_factor)
+    dispatch, combine, _, _ = switch_routing(probs, cap, num_selected)
+    expert_in = jnp.einsum('sxc,sd->xcd', dispatch, tokens)
+    h = jax.nn.gelu(jnp.einsum('xcd,xdf->xcf', expert_in, w1))
+    out = jnp.einsum('xcf,xfd->xcd', h, w2)
+    return jnp.einsum('xcd,sxc->sd', out, combine)
+
+
 def dense_reference(tokens, router_kernel, w1, w2, capacity_factor=8.0,
                     num_selected=1):
-    """The MoEMlp einsum path, unsharded, with routing computed per data shard of
-    16 tokens (matching what each shard_map instance sees)."""
-    outs = []
-    for shard in (tokens[:16], tokens[16:]):
-        probs = jax.nn.softmax(shard @ router_kernel, axis=-1)
-        cap = _capacity(shard.shape[0], N_EXPERTS, num_selected, capacity_factor)
-        dispatch, combine, _, _ = switch_routing(probs, cap, num_selected)
-        expert_in = jnp.einsum('sxc,sd->xcd', dispatch, shard)
-        h = jax.nn.gelu(jnp.einsum('xcd,xdf->xcf', expert_in, w1))
-        out = jnp.einsum('xcf,xfd->xcd', h, w2)
-        outs.append(jnp.einsum('xcd,sxc->sd', out, combine))
-    return jnp.concatenate(outs, axis=0)
+    """Unsharded reference with routing computed per data shard of 16 tokens
+    (matching what each shard_map instance sees)."""
+    return jnp.concatenate(
+        [shard_reference(shard, router_kernel, w1, w2, capacity_factor,
+                         num_selected)
+         for shard in (tokens[:16], tokens[16:])], axis=0)
 
 
 def mesh_2x4():
@@ -95,6 +103,51 @@ class TestShardedMoE(object):
         got = jax.jit(sharded_fn(mesh_2x4()))(tokens, router_kernel, w1, w2)
         assert got.dtype == jnp.bfloat16
         assert np.all(np.isfinite(np.asarray(got, dtype=np.float32)))
+
+    def test_composes_with_ring_attention_in_one_shard_map(self):
+        """The reason this op exists: sp + ep inside ONE shard_map region (the
+        annotation-based MoEMlp cannot run there). A mini layer — ring attention
+        over 'seq', expert FFN over 'expert' — on a (data, seq, expert) mesh."""
+        from petastorm_tpu.ops.ring_attention import dense_attention, ring_attention
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                    ('data', 'seq', 'expert'))
+        B, T, H, D = 4, 16, 2, 8
+        E = H * D
+        rng = np.random.RandomState(10)
+        x = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+        router_kernel = jnp.asarray(rng.randn(E, 4) * 0.5, jnp.float32)
+        w1 = jnp.asarray(rng.randn(4, E, 2 * E) * 0.3, jnp.float32)
+        w2 = jnp.asarray(rng.randn(4, 2 * E, E) * 0.3, jnp.float32)
+
+        def layer(x, rk, w1, w2):
+            attn = ring_attention(x, x, x, axis_name='seq', causal=True)
+            tokens = attn.reshape(-1, E)
+            out, _, _ = sharded_moe_ffn(tokens, rk, w1, w2, 'expert',
+                                        capacity_factor=8.0)
+            return (tokens + out).reshape(attn.shape)
+
+        x_spec = P('data', 'seq', None, None)
+        fn = shard_map_compat(
+            layer, mesh,
+            (x_spec, P(None, None), P('expert', None, None),
+             P('expert', None, None)), x_spec)
+        got = jax.jit(fn)(x, router_kernel, w1, w2)
+
+        # Reference: dense attention, then per-(data, seq)-shard routing + FFN on
+        # the same weights — each of the 4 (data, seq) shard cells routes its own
+        # B/2 x T/2 token block independently, exactly as the sharded layer does.
+        attn = dense_attention(x, x, x, causal=True)
+        expected = np.empty((B, T, E), np.float32)
+        for bi in range(2):
+            for si in range(2):
+                blk = attn[bi * 2:(bi + 1) * 2, si * 8:(si + 1) * 8]
+                tokens = jnp.asarray(blk.reshape(-1, E))
+                y = tokens + shard_reference(tokens, router_kernel, w1, w2)
+                expected[bi * 2:(bi + 1) * 2, si * 8:(si + 1) * 8] = (
+                    np.asarray(y).reshape(2, 8, E))
+        np.testing.assert_allclose(np.asarray(got.reshape(B, T, E)), expected,
+                                   rtol=2e-5, atol=2e-5)
 
     def test_indivisible_experts_rejected(self):
         rng = np.random.RandomState(8)
