@@ -214,34 +214,47 @@ def expert_partition_specs(params, expert_axis='expert'):
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
-def moe_aux_total(mutables, weight=1.0):
-    """Sum the latest sown ``moe_aux`` scalar of every MoE layer in the ``'losses'``
-    collection (as returned by ``model.apply(..., mutable='losses')``), scaled by
-    ``weight``. ``sow`` appends one value per apply, so only each tuple's LAST entry
-    belongs to the current step — summing the whole tuple would double-count when the
-    collection was threaded through from a previous apply (e.g. from ``init``). Train
-    on ``variables['params']`` only; never feed the init-time ``'losses'`` collection
-    to the optimizer."""
+def collect_sown(mutables, sown_key):
+    """Latest sown value of ``sown_key`` from every MoE layer in a ``'losses'``
+    collection (as returned by ``model.apply(..., mutable='losses')``) — one entry
+    per layer, traced-safe. ``sow`` appends one value per apply, so only each
+    tuple's LAST entry belongs to the current step; taking the whole tuple would
+    double-count when the collection was threaded through from a previous apply
+    (e.g. from ``init``)."""
     losses = mutables.get('losses', mutables)
     leaves = []
 
-    def visit(tree, under_aux=False):
+    def visit(tree, under_key=False):
         if isinstance(tree, dict):
             for key, sub in tree.items():
-                visit(sub, under_aux or key == 'moe_aux')
+                visit(sub, under_key or key == sown_key)
         elif isinstance(tree, (tuple, list)):
-            if under_aux and tree:
-                visit(tree[-1], under_aux)
-            elif not under_aux:
+            if under_key and tree:
+                visit(tree[-1], under_key)
+            elif not under_key:
                 for sub in tree:
-                    visit(sub, under_aux)
-        elif under_aux:
+                    visit(sub, under_key)
+        elif under_key:
             leaves.append(tree)
 
     visit(losses)
+    return leaves
+
+
+def moe_aux_total(mutables, weight=1.0):
+    """Sum of every MoE layer's latest Switch load-balance loss, scaled by
+    ``weight``. Train on ``variables['params']`` only; never feed the init-time
+    ``'losses'`` collection to the optimizer."""
+    leaves = collect_sown(mutables, 'moe_aux')
     if not leaves:
         return jnp.float32(0)
     return weight * sum(leaves)
+
+
+def moe_drop_fractions(mutables):
+    """Every MoE layer's latest capacity drop fraction (list of scalars; empty when
+    the model has no MoE layers)."""
+    return collect_sown(mutables, 'moe_drop_fraction')
 
 
 class MoEBlock(nn.Module):
