@@ -365,7 +365,11 @@ def orchestrate():
             'BENCH_STREAM_EPOCHS': '1', 'BENCH_STREAM_STAGES': '1,1,1,1',
             'BENCH_FLASH_T': '512', 'BENCH_FLASH_BATCH': '1',
             'BENCH_FLASH_LAYERS': '1', 'BENCH_FLASH_STEPS': '2',
-            'BENCH_FLASH_ROWS': '8'})
+            'BENCH_FLASH_ROWS': '8',
+            'BENCH_MOE_T': '256', 'BENCH_MOE_BATCH': '2', 'BENCH_MOE_EMBED': '64',
+            'BENCH_MOE_HEADS': '2', 'BENCH_MOE_EXPERTS': '4',
+            'BENCH_MOE_LAYERS': '1', 'BENCH_MOE_STEPS': '2',
+            'BENCH_MOE_ROWS': '8'})
         if result is None:
             result = partial  # even a partial CPU run beats exiting empty
         if result is not None:

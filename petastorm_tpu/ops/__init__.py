@@ -5,5 +5,8 @@ OpenCV/numpy decode — SURVEY.md §2.9, §5.7)."""
 from petastorm_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from petastorm_tpu.ops.image import normalize_image, random_crop_flip  # noqa: F401
 from petastorm_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from petastorm_tpu.ops.packing import (  # noqa: F401
+    make_packing_transform, pack_sequences, packed_next_token_loss,
+    segment_causal_attention)
 from petastorm_tpu.ops.sharded_moe import (  # noqa: F401
     expert_alltoall_ffn, sharded_moe_ffn)
