@@ -142,6 +142,19 @@ def test_long_context_ngram_frames_trains(tmp_path):
     assert final_loss < 4.0, final_loss
 
 
+def test_long_context_packed_trains(tmp_path):
+    """--packed mode: ragged native-parquet docs packed inside the reader workers,
+    trained with segment-masked attention; the repeating-bigram language is
+    learnable, so loss must beat the uniform baseline ln(256)~5.55."""
+    from examples.long_context import jax_example
+    url = 'file://' + str(tmp_path / 'ragged')
+    jax_example.build_ragged_dataset(url, num_docs=96, max_len=32)
+    _, final_loss = jax_example.train_packed(url, seq_len=64, batch_size=8,
+                                             epochs=6)
+    assert np.isfinite(final_loss)
+    assert final_loss < 4.0, final_loss
+
+
 # ---------------------------------------------------------------- moe / pipeline
 
 def test_moe_expert_parallel_trains(tmp_path):
