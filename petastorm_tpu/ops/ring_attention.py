@@ -31,36 +31,58 @@ def _block_attn(q, k, v, bias):
         s = s + bias
     m = jnp.max(s, axis=-1)                                    # [B,H,Tq]
     p = jnp.exp(s - m[..., None])                              # [B,H,Tq,Tb]
+    # A fully-masked row has every s at _NEG_INF, making exp(s - m) == 1 — zero
+    # those entries so a masked-out block contributes nothing to the accumulator
+    # (segment masking can fully mask a block; plain causal never does).
+    p = p * (s > _NEG_INF / 2)
     l = jnp.sum(p, axis=-1)                                    # [B,H,Tq]
     o = jnp.einsum('bhqk,bkhd->bqhd', p, v)                    # [B,Tq,H,D]
     return m, l, o
 
 
-def ring_attention(q, k, v, axis_name, causal=False):
+def ring_attention(q, k, v, axis_name, causal=False, segments=None):
     """Exact attention with K/V ring-rotated over ``axis_name``. Must run inside
     ``shard_map``; every array is the per-device shard ``[B, T_local, H, D]``. The global
     sequence is the concatenation of shards in ring order.
 
     :param causal: apply a causal mask over GLOBAL positions (shard offsets accounted
         for), so the result equals dense causal attention on the gathered sequence.
+    :param segments: optional ``[B, T_local]`` int32 shard of packed-sequence segment
+        ids (``ops.packing`` convention: 0 = padding, documents numbered from 1).
+        Attention is confined to same-segment pairs; padding positions attend to
+        nothing and return zeros. Segment ids rotate around the ring with their K/V
+        blocks, so packing composes with sequence parallelism.
     """
     axis_size = lax.psum(1, axis_name)
     my_index = lax.axis_index(axis_name)
     t_local = q.shape[1]
     q_positions = my_index * t_local + jnp.arange(t_local)      # global positions
+    has_segments = segments is not None
 
-    def make_bias(source_index):
-        if not causal:
+    def make_bias(source_index, k_seg_blk):
+        if not (causal or has_segments):
             return None
-        k_positions = source_index * t_local + jnp.arange(t_local)
-        mask = q_positions[:, None] >= k_positions[None, :]      # [Tq, Tb]
-        return jnp.where(mask, 0.0, _NEG_INF)[None, None, :, :]
+        allow = jnp.ones((1, 1, t_local, t_local), dtype=bool)  # [B?, 1, Tq, Tb]
+        if causal:
+            k_positions = source_index * t_local + jnp.arange(t_local)
+            allow = allow & (q_positions[:, None]
+                             >= k_positions[None, :])[None, None]
+        if has_segments:
+            # ONE definition of the segment/padding mask (ops.packing convention).
+            from petastorm_tpu.ops.packing import segment_mask
+            allow = allow & segment_mask(segments, k_seg_blk, causal=False)
+        return jnp.where(allow, 0.0, _NEG_INF)
 
     def body(step, carry):
-        o_acc, l_acc, m_acc, k_blk, v_blk = carry
+        if has_segments:
+            o_acc, l_acc, m_acc, k_blk, v_blk, k_seg_blk = carry
+        else:
+            o_acc, l_acc, m_acc, k_blk, v_blk = carry
+            k_seg_blk = None
         # K/V block currently held arrived from (my_index - step) around the ring.
         source_index = (my_index - step) % axis_size
-        m_blk, l_blk, o_blk = _block_attn(q, k_blk, v_blk, make_bias(source_index))
+        m_blk, l_blk, o_blk = _block_attn(q, k_blk, v_blk,
+                                          make_bias(source_index, k_seg_blk))
         # Online softmax merge (flash-attention accumulator).
         m_new = jnp.maximum(m_acc, m_blk)
         corr_acc = jnp.exp(m_acc - m_new)
@@ -72,28 +94,47 @@ def ring_attention(q, k, v, axis_name, causal=False):
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_next = lax.ppermute(k_blk, axis_name, perm)
         v_next = lax.ppermute(v_blk, axis_name, perm)
+        if has_segments:
+            # Segment ids travel WITH their K/V block; unsegmented calls skip this
+            # collective entirely.
+            seg_next = lax.ppermute(k_seg_blk, axis_name, perm)
+            return o_new, l_new, m_new, k_next, v_next, seg_next
         return o_new, l_new, m_new, k_next, v_next
 
     b, t, h, d = q.shape
     o0 = jnp.zeros((b, t, h, d), dtype=jnp.float32)
     l0 = jnp.zeros((b, h, t), dtype=jnp.float32)
     m0 = jnp.full((b, h, t), _NEG_INF, dtype=jnp.float32)
-    o, l, _, _, _ = lax.fori_loop(
-        0, axis_size, body,
-        (o0, l0, m0, k.astype(jnp.float32), v.astype(jnp.float32)))
-    o = o / jnp.swapaxes(l, 1, 2)[..., None]
+    carry = (o0, l0, m0, k.astype(jnp.float32), v.astype(jnp.float32))
+    if has_segments:
+        carry = carry + (segments,)
+    out = lax.fori_loop(0, axis_size, body, carry)
+    o, l = out[0], out[1]
+    # Padding rows attend to nothing (l == 0): emit zeros, not NaN.
+    l = jnp.swapaxes(l, 1, 2)[..., None]
+    o = jnp.where(l > 0, o / jnp.where(l > 0, l, 1.0), 0.0)
     return o.astype(q.dtype)
 
 
-def ring_attention_sharded(mesh, seq_axis, causal=False):
-    """Build a jittable ``fn(q, k, v)`` running ring attention with the sequence dimension
-    sharded over ``mesh[seq_axis]``; batch stays replicated or sharded by the caller's
-    in_specs. Inputs/outputs are GLOBAL arrays of shape [B, T, H, D]."""
+def ring_attention_sharded(mesh, seq_axis, causal=False, with_segments=False):
+    """Build a jittable ``fn(q, k, v)`` — or ``fn(q, k, v, segments)`` when
+    ``with_segments`` — running ring attention with the sequence dimension sharded
+    over ``mesh[seq_axis]``; batch stays replicated or sharded by the caller's
+    in_specs. Inputs/outputs are GLOBAL arrays of shape [B, T, H, D] (segments
+    [B, T] int32, ``ops.packing`` convention)."""
     from jax.sharding import PartitionSpec as P
 
     from petastorm_tpu.parallel.mesh import shard_map_compat
 
     spec = P(None, seq_axis, None, None)
+    if with_segments:
+        inner = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+
+        def with_seg(q, k, v, segments):
+            return inner(q, k, v, segments=segments)
+
+        return jax.jit(shard_map_compat(
+            with_seg, mesh, (spec, spec, spec, P(None, seq_axis)), spec))
     inner = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
     return jax.jit(shard_map_compat(inner, mesh, (spec, spec, spec), spec))
 
