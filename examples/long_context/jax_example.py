@@ -111,13 +111,31 @@ def build_ragged_dataset(url, num_docs=256, max_len=48, seed=0):
             pq.write_table(table, sink)
 
 
-def train_packed(dataset_url, seq_len=64, batch_size=8, epochs=2,
+def _make_data_seq_mesh(data_axis):
+    """ONE definition of the example's (data, seq) device factoring: default data
+    axis 2 on even device counts, seq takes the rest."""
+    import jax
+
+    from petastorm_tpu.parallel import make_mesh
+
+    n_dev = len(jax.devices())
+    if data_axis is None:
+        data_axis = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    if n_dev % data_axis:
+        raise ValueError('data_axis {} does not divide device count {}'
+                         .format(data_axis, n_dev))
+    return make_mesh(('data', 'seq'), axis_sizes=(data_axis, n_dev // data_axis))
+
+
+def train_packed(dataset_url, seq_len=64, batch_size=8, epochs=2, data_axis=None,
                  learning_rate=1e-2):
-    """Packed-mode training: ragged docs -> worker-side first-fit packing
-    (ops.packing.make_packing_transform) -> dense [batch, seq_len] device batches ->
-    TransformerLM with segment-masked attention. The model is constructed INSIDE the
-    jitted step so each batch's segment ids flow through one compiled program — the
-    pattern to copy for packed training."""
+    """Packed-mode training, sequence-parallel: ragged docs -> worker-side first-fit
+    packing (ops.packing.make_packing_transform) -> dense [batch, seq_len] device
+    batches sharded ``P('data', 'seq')`` -> TransformerLM with SEGMENT-masked RING
+    attention (segment ids ring-rotate with their K/V blocks), so packing composes
+    with sequences longer than one chip. The model is constructed INSIDE the jitted
+    step so each batch's segment ids flow through one compiled program — the pattern
+    to copy for packed training."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -126,16 +144,26 @@ def train_packed(dataset_url, seq_len=64, batch_size=8, epochs=2,
     from petastorm_tpu import make_batch_reader
     from petastorm_tpu.models import TransformerLM
     from petastorm_tpu.ops.packing import (make_packing_transform,
-                                           packed_next_token_loss,
-                                           segment_causal_attention)
-    from petastorm_tpu.parallel import JaxDataLoader, make_mesh
+                                           packed_next_token_loss)
+    from petastorm_tpu.ops.ring_attention import ring_attention_sharded
+    from petastorm_tpu.parallel import JaxDataLoader
 
+    mesh = _make_data_seq_mesh(data_axis)
+    if seq_len % mesh.shape['seq']:
+        raise ValueError('seq_len {} not divisible by the seq mesh axis ({}); pick '
+                         'a multiple or set --data-axis'
+                         .format(seq_len, mesh.shape['seq']))
+    if batch_size % mesh.shape['data']:
+        raise ValueError('batch_size {} not divisible by the data mesh axis ({})'
+                         .format(batch_size, mesh.shape['data']))
     optimizer = optax.adam(learning_rate)
+    ring = ring_attention_sharded(mesh, 'seq', causal=True, with_segments=True,
+                                  batch_axis='data')
 
     def model_for(segments):
         return TransformerLM(vocab=VOCAB, embed=EMBED, heads=HEADS, layers=1,
                              dtype=jnp.float32, max_len=seq_len,
-                             attention_fn=segment_causal_attention(segments))
+                             attention_fn=lambda q, k, v: ring(q, k, v, segments))
 
     @jax.jit
     def train_step(params, opt_state, tokens, segments):
@@ -151,11 +179,12 @@ def train_packed(dataset_url, seq_len=64, batch_size=8, epochs=2,
     reader = make_batch_reader(
         dataset_url, transform_spec=make_packing_transform('tokens', seq_len),
         num_epochs=epochs, shuffle_row_groups=True, seed=7)
-    mesh = make_mesh(('data',))
+    spec = {'tokens': P('data', 'seq'), 'tokens_segments': P('data', 'seq'),
+            'tokens_positions': P('data', 'seq')}
     loss = params = opt_state = None
     with mesh:
         with JaxDataLoader(reader, batch_size=batch_size, mesh=mesh,
-                           partition_spec=P('data')) as loader:
+                           partition_spec=spec) as loader:
             for step, batch in enumerate(loader):
                 tokens, segments = batch['tokens'], batch['tokens_segments']
                 if params is None:
@@ -225,15 +254,9 @@ def train(dataset_url, batch_size=8, epochs=2, data_axis=None, ngram_frames=0):
     from jax.sharding import PartitionSpec as P
 
     from petastorm_tpu import make_reader
-    from petastorm_tpu.parallel import JaxDataLoader, make_mesh
+    from petastorm_tpu.parallel import JaxDataLoader
 
-    n_dev = len(jax.devices())
-    if data_axis is None:
-        data_axis = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
-    if n_dev % data_axis:
-        raise ValueError('data_axis {} does not divide device count {}'
-                         .format(data_axis, n_dev))
-    mesh = make_mesh(('data', 'seq'), axis_sizes=(data_axis, n_dev // data_axis))
+    mesh = _make_data_seq_mesh(data_axis)
     model = make_model(mesh)
     train_step, optimizer = make_train_step(mesh, model)
 
@@ -310,7 +333,8 @@ def main():
                 build_ragged_dataset(url, num_docs=args.num_docs, max_len=max_len)
         _, final_loss = train_packed(url, seq_len=args.seq_len,
                                      batch_size=args.batch_size,
-                                     epochs=args.epochs)
+                                     epochs=args.epochs,
+                                     data_axis=args.data_axis)
         print('final loss: {:.4f}'.format(final_loss))
         return
 

@@ -116,26 +116,25 @@ def ring_attention(q, k, v, axis_name, causal=False, segments=None):
     return o.astype(q.dtype)
 
 
-def ring_attention_sharded(mesh, seq_axis, causal=False, with_segments=False):
+def ring_attention_sharded(mesh, seq_axis, causal=False, with_segments=False,
+                           batch_axis=None):
     """Build a jittable ``fn(q, k, v)`` — or ``fn(q, k, v, segments)`` when
     ``with_segments`` — running ring attention with the sequence dimension sharded
-    over ``mesh[seq_axis]``; batch stays replicated or sharded by the caller's
-    in_specs. Inputs/outputs are GLOBAL arrays of shape [B, T, H, D] (segments
-    [B, T] int32, ``ops.packing`` convention)."""
+    over ``mesh[seq_axis]``. ``batch_axis`` optionally shards the batch dimension
+    (dp+sp); default replicates it. Inputs/outputs are GLOBAL arrays of shape
+    [B, T, H, D] (segments [B, T] int32, ``ops.packing`` convention)."""
     from jax.sharding import PartitionSpec as P
 
     from petastorm_tpu.parallel.mesh import shard_map_compat
 
-    spec = P(None, seq_axis, None, None)
+    spec = P(batch_axis, seq_axis, None, None)
+    inner = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
     if with_segments:
-        inner = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-
         def with_seg(q, k, v, segments):
             return inner(q, k, v, segments=segments)
 
         return jax.jit(shard_map_compat(
-            with_seg, mesh, (spec, spec, spec, P(None, seq_axis)), spec))
-    inner = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+            with_seg, mesh, (spec, spec, spec, P(batch_axis, seq_axis)), spec))
     return jax.jit(shard_map_compat(inner, mesh, (spec, spec, spec), spec))
 
 

@@ -144,13 +144,14 @@ def test_long_context_ngram_frames_trains(tmp_path):
 
 def test_long_context_packed_trains(tmp_path):
     """--packed mode: ragged native-parquet docs packed inside the reader workers,
-    trained with segment-masked attention; the repeating-bigram language is
-    learnable, so loss must beat the uniform baseline ln(256)~5.55."""
+    trained with SEGMENT-masked RING attention over the (data, seq) mesh — packing
+    composed with sequence parallelism. The repeating-bigram language is learnable,
+    so loss must beat the uniform baseline ln(256)~5.55."""
     from examples.long_context import jax_example
     url = 'file://' + str(tmp_path / 'ragged')
     jax_example.build_ragged_dataset(url, num_docs=96, max_len=32)
     _, final_loss = jax_example.train_packed(url, seq_len=64, batch_size=8,
-                                             epochs=6)
+                                             epochs=6, data_axis=2)
     assert np.isfinite(final_loss)
     assert final_loss < 4.0, final_loss
 
