@@ -296,6 +296,7 @@ class MoETransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
     expert_axis: Optional[str] = None
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -307,18 +308,29 @@ class MoETransformerLM(nn.Module):
             raise ValueError('sequence length {} exceeds max_len={}'
                              .format(tokens.shape[1], self.max_len))
         attention_fn = self.attention_fn or dense_causal_attention
+        # Same remat/naming treatment as TransformerLM: recompute block activations
+        # in the backward, with explicit per-class names reproducing the auto scheme
+        # so the param tree is identical with and without remat (the sown 'losses'
+        # collection passes through nn.remat unchanged).
+        dense_cls = nn.remat(Block) if self.remat else Block
+        moe_cls = nn.remat(MoEBlock) if self.remat else MoEBlock
         x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
         positions = jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.embed, dtype=self.dtype)(positions)[None]
+        n_moe = n_dense = 0
         for i in range(self.layers):
             if (i + 1) % self.moe_every == 0:
-                x = MoEBlock(heads=self.heads, num_experts=self.num_experts,
-                             capacity_factor=self.capacity_factor,
-                             num_selected=self.num_selected,
-                             attention_fn=attention_fn, dtype=self.dtype,
-                             expert_axis=self.expert_axis)(x)
+                x = moe_cls(heads=self.heads, num_experts=self.num_experts,
+                            capacity_factor=self.capacity_factor,
+                            num_selected=self.num_selected,
+                            attention_fn=attention_fn, dtype=self.dtype,
+                            expert_axis=self.expert_axis,
+                            name='MoEBlock_{}'.format(n_moe))(x)
+                n_moe += 1
             else:
-                x = Block(heads=self.heads, attention_fn=attention_fn,
-                          dtype=self.dtype)(x)
+                x = dense_cls(heads=self.heads, attention_fn=attention_fn,
+                              dtype=self.dtype,
+                              name='Block_{}'.format(n_dense))(x)
+                n_dense += 1
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab, dtype=jnp.float32)(x)

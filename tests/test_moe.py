@@ -199,6 +199,38 @@ class TestMoEExpertParallel(object):
         assert specs['w1'] == P(None, None, None)
         assert specs['w2'] == P(None, None, None)
 
+    def test_remat_preserves_outputs_and_sown_losses(self):
+        # remat must change memory behavior only: identical logits, grads, and sown
+        # aux values from the same params.
+        dense = MoETransformerLM(vocab=32, embed=16, heads=2, layers=2,
+                                 num_experts=2, moe_every=2, max_len=32,
+                                 dtype=jnp.float32)
+        remat = MoETransformerLM(vocab=32, embed=16, heads=2, layers=2,
+                                 num_experts=2, moe_every=2, max_len=32,
+                                 dtype=jnp.float32, remat=True)
+        tokens = jnp.asarray(np.random.RandomState(7).randint(0, 32, (2, 12)),
+                             jnp.int32)
+        params = {'params': dense.init(jax.random.PRNGKey(7), tokens)['params']}
+        out_d, mods_d = dense.apply(params, tokens, mutable='losses')
+        out_r, mods_r = remat.apply(params, tokens, mutable='losses')
+        np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_r),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(float(moe_aux_total(mods_d)),
+                                   float(moe_aux_total(mods_r)), rtol=1e-6)
+
+        def loss(model):
+            def fn(p):
+                logits, mods = model.apply(p, tokens, mutable='losses')
+                from petastorm_tpu.models import next_token_loss
+                return next_token_loss(logits, tokens) + moe_aux_total(mods, 0.01)
+            return fn
+
+        g_d = jax.grad(loss(dense))(params)
+        g_r = jax.grad(loss(remat))(params)
+        for a, b in zip(jax.tree.leaves(g_d), jax.tree.leaves(g_r)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
     def test_aux_total_counts_only_latest_sow(self):
         # sow appends per apply; a threaded-through collection must not double-count.
         mods = {'losses': {'MoEMlp_0': {'moe_aux': (jnp.float32(2), jnp.float32(3))}}}
