@@ -105,6 +105,40 @@ class TestShufflingBufferProperties:
         assert got == expected
 
 
+class TestPackingProperties:
+    @settings(max_examples=40, deadline=None)
+    @given(lengths=st.lists(st.integers(1, 16), min_size=1, max_size=30),
+           seq_len=st.integers(16, 48), seed=st.integers(0, 2 ** 16))
+    def test_pack_round_trip_and_invariants(self, lengths, seq_len, seed):
+        import numpy as np
+        from petastorm_tpu.ops.packing import pack_sequences
+        rng = np.random.RandomState(seed)
+        seqs = [rng.randint(1, 1000, size=n).astype(np.int32) for n in lengths]
+        packed = pack_sequences(seqs, seq_len)
+        tokens, segments, positions = (packed['tokens'], packed['segments'],
+                                       packed['positions'])
+        # Multiset of non-padding tokens is exactly the input tokens.
+        assert sorted(tokens[segments > 0].tolist()) == sorted(
+            t for s in seqs for t in s.tolist())
+        # Each (bin, segment) is one input sequence, contiguous, positions 0..n-1.
+        recovered = []
+        for b in range(tokens.shape[0]):
+            max_seg = int(segments[b].max())
+            # Segment ids are consecutive from 1 within a bin.
+            assert set(segments[b][segments[b] > 0].tolist()) == set(
+                range(1, max_seg + 1))
+            for seg in range(1, max_seg + 1):
+                idx = np.nonzero(segments[b] == seg)[0]
+                assert np.array_equal(idx, np.arange(idx[0], idx[-1] + 1))
+                np.testing.assert_array_equal(positions[b][idx],
+                                              np.arange(len(idx)))
+                recovered.append(tokens[b][idx].tolist())
+        assert sorted(map(tuple, recovered)) == sorted(tuple(s.tolist())
+                                                       for s in seqs)
+        # Never wasteful beyond first-fit's bound: bins <= number of sequences.
+        assert tokens.shape[0] <= len(seqs)
+
+
 class TestSplitPredicateProperties:
     @given(st.lists(st.floats(0.05, 1.0), min_size=2, max_size=5),
            st.integers(0, 1000))
