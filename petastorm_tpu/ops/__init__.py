@@ -2,7 +2,8 @@
 (the compute-side extension points the TPU build adds over the reference's host-only
 OpenCV/numpy decode — SURVEY.md §2.9, §5.7)."""
 
-from petastorm_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from petastorm_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_segmented)
 from petastorm_tpu.ops.image import normalize_image, random_crop_flip  # noqa: F401
 from petastorm_tpu.ops.ring_attention import ring_attention  # noqa: F401
 from petastorm_tpu.ops.packing import (  # noqa: F401

@@ -24,11 +24,25 @@ _NEG_INF = -1e30
 _LANE = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, causal,
-                  block_q, block_k, scale):
+def _block_segment_mask(qseg, kseg):
+    """[Bq], [Bk] int32 -> [Bq, Bk] bool: same packed segment, both non-padding
+    (``ops.packing`` convention: 0 = padding)."""
+    same = qseg[:, None] == kseg[None, :]
+    valid = (qseg[:, None] > 0) & (kseg[None, :] > 0)
+    return same & valid
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, *rest, causal, segmented, block_q, block_k,
+                  scale):
     """One (bh, qi, ki) grid step: fold K/V block ``ki`` into the online softmax
-    accumulator for Q block ``qi``."""
+    accumulator for Q block ``qi``. With ``segmented``, two extra int32 refs carry
+    the packed-segment ids and attention is confined within segments."""
     from jax.experimental import pallas as pl
+
+    if segmented:
+        qseg_ref, kseg_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
 
     # program_id must be read at kernel top level: inside a pl.when closure it does not
     # substitute under the CPU interpreter.
@@ -54,9 +68,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        if segmented:
+            s = jnp.where(_block_segment_mask(qseg_ref[0], kseg_ref[0]), s,
+                          _NEG_INF)
         m_prev = m_scr[:, :1]                                  # [Bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                                 # [Bq, Bk]
+        if segmented:
+            # A fully-masked row has every s at _NEG_INF and would get p == 1
+            # everywhere (exp(0)); zero those so empty rows accumulate nothing.
+            p = p * (s > _NEG_INF / 2)
         corr = jnp.exp(m_prev - m_new)                         # [Bq, 1]
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
@@ -74,13 +95,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
-        # log-sum-exp per query row: the backward's softmax replay key
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(l_scr[:, :1]))[:, 0]
+        if segmented:
+            l = l_scr[:, :1]
+            nonempty = l > 0
+            # Padding rows attend to nothing: emit zeros, and an lse of 0 so the
+            # backward's replay exp(s - lse) underflows to 0 instead of NaN.
+            o_ref[0] = jnp.where(
+                nonempty, acc_scr[:] / jnp.where(nonempty, l, 1.0), 0.0
+            ).astype(o_ref.dtype)
+            lse_ref[0] = jnp.where(nonempty, m_scr[:, :1] + jnp.log(
+                jnp.where(nonempty, l, 1.0)), 0.0)[:, 0]
+        else:
+            o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+            # log-sum-exp per query row: the backward's softmax replay key
+            lse_ref[0] = (m_scr[:, :1] + jnp.log(l_scr[:, :1]))[:, 0]
 
 
-def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
-    """q/k/v: [BH, T, D] -> (o: [BH, T, D], lse: [BH, T] float32)."""
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret, segments=None,
+                   heads=None):
+    """q/k/v: [BH, T, D] -> (o: [BH, T, D], lse: [BH, T] float32). ``segments`` is
+    the [B, T] int32 packed-segment array (shared across the ``heads`` interleaved
+    into the BH dim)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -88,19 +123,29 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     tk = k.shape[1]
     nq, nk = t // block_q, tk // block_k
     scale = d ** -0.5
-    kernel = functools.partial(_flash_kernel, causal=causal, block_q=block_q,
-                               block_k=block_k, scale=scale)
+    segmented = segments is not None
+    kernel = functools.partial(_flash_kernel, causal=causal, segmented=segmented,
+                               block_q=block_q, block_k=block_k, scale=scale)
     grid = (bh, nq, nk)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if segmented:
+        h = heads
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b // h, i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, j)),
+        ]
+        operands += [segments, segments]
     return pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
                    jax.ShapeDtypeStruct((bh, t), jnp.float32)],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
                    pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))],
         scratch_shapes=[
@@ -111,15 +156,17 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
 
 
 def _rematerialized_p_ds(q, k, v, do, lse, delta, qi, ki, causal, block_q, block_k,
-                         scale):
+                         scale, seg_mask=None):
     """Shared backward-block math: replay P from (Q, K, LSE), form dS.
 
     Returns (p, ds), both [Bq, Bk] fp32. ``delta = rowsum(dO * O)`` is the softmax
-    jacobian's diagonal correction (flash-attention backward identity)."""
+    jacobian's diagonal correction (flash-attention backward identity).
+    ``seg_mask`` re-applies the forward's segment confinement (the replayed
+    exp(s - lse) is only meaningful where the forward attended)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     p = jnp.exp(s - lse[:, None])                               # [Bq, Bk]
@@ -127,16 +174,23 @@ def _rematerialized_p_ds(q, k, v, do, lse, delta, qi, ki, causal, block_q, block
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         p = jnp.where(q_pos >= k_pos, p, 0.0)
+    if seg_mask is not None:
+        p = jnp.where(seg_mask, p, 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)  # [Bq, Bk]
     ds = p * (dp - delta[:, None])
     return p, ds
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                         dq_scr, *, causal, block_q, block_k, scale):
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                         causal, segmented, block_q, block_k, scale):
     """Grid (bh, qi, ki): accumulate dQ for q-block qi over all k-blocks."""
     from jax.experimental import pallas as pl
+
+    if segmented:
+        qseg_ref, kseg_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
 
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -151,8 +205,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
+        seg_mask = (_block_segment_mask(qseg_ref[0], kseg_ref[0])
+                    if segmented else None)
         _, ds = _rematerialized_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
-                                     causal, block_q, block_k, scale)
+                                     causal, block_q, block_k, scale, seg_mask)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -169,10 +225,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                          dv_ref, dk_scr, dv_scr, *, causal, block_q, block_k, scale):
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                          causal, segmented, block_q, block_k, scale):
     """Grid (bh, ki, qi): accumulate dK/dV for k-block ki over all q-blocks."""
     from jax.experimental import pallas as pl
+
+    if segmented:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
 
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -188,8 +249,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
+        seg_mask = (_block_segment_mask(qseg_ref[0], kseg_ref[0])
+                    if segmented else None)
         p, ds = _rematerialized_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
-                                     causal, block_q, block_k, scale)
+                                     causal, block_q, block_k, scale, seg_mask)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
@@ -210,7 +273,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+                    segments=None, heads=None):
     """q/k/v/o/do: [BH, T, D], lse: [BH, T] -> (dq, dk, dv), blockwise (no [T, T])."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -218,6 +282,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     bh, t, d = q.shape
     nq, nk = t // block_q, t // block_k
     scale = d ** -0.5
+    segmented = segments is not None
     # Softmax jacobian diagonal: delta_i = sum_d dO_id * O_id (O(T*D), no score matrix).
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, T]
 
@@ -225,37 +290,51 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     qrow = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
 
+    dq_in_specs = [qspec, kspec, kspec, qspec, qrow, qrow]
+    dq_operands = [q, k, v, do, lse, delta]
+    if segmented:
+        h = heads
+        dq_in_specs += [pl.BlockSpec((1, block_q), lambda b, i, j: (b // h, i)),
+                        pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, j))]
+        dq_operands += [segments, segments]
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, causal=causal, block_q=block_q,
-                          block_k=block_k, scale=scale),
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, segmented=segmented,
+                          block_q=block_q, block_k=block_k, scale=scale),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, qrow, qrow],
+        in_specs=dq_in_specs,
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_operands)
 
     # dK/dV iterate the OTHER way: outer over k-blocks, inner over q-blocks.
     kspec_o = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
     qspec_i = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
     qrow_i = pl.BlockSpec((1, block_q), lambda b, i, j: (b, j))
+    dkv_in_specs = [qspec_i, kspec_o, kspec_o, qspec_i, qrow_i, qrow_i]
+    dkv_operands = [q, k, v, do, lse, delta]
+    if segmented:
+        h = heads
+        dkv_in_specs += [pl.BlockSpec((1, block_q), lambda b, i, j: (b // h, j)),
+                         pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, i))]
+        dkv_operands += [segments, segments]
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, causal=causal, block_q=block_q,
-                          block_k=block_k, scale=scale),
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, segmented=segmented,
+                          block_q=block_q, block_k=block_k, scale=scale),
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
         grid=(bh, nk, nq),
-        in_specs=[qspec_i, kspec_o, kspec_o, qspec_i, qrow_i, qrow_i],
+        in_specs=dkv_in_specs,
         out_specs=[kspec_o, kspec_o],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
@@ -320,3 +399,54 @@ def _bwd(causal, block_q, block_k, residuals, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_segmented(q, k, v, segments, causal=False, block_q=256,
+                              block_k=256):
+    """Flash attention confined to packed-sequence segments: ``[B, T, H, D]``
+    inputs plus ``segments [B, T]`` int32 (``ops.packing`` convention — 0 is
+    padding, documents numbered from 1; padding rows emit zeros). Same Pallas
+    kernels as :func:`flash_attention` with the segment mask fused into every
+    block, so packed single-chip training keeps the O(T * block) memory bound;
+    falls back to the masked XLA dense path when shapes don't tile."""
+    return _seg_fwd(q, k, v, segments, causal, block_q, block_k)[0]
+
+
+def _seg_fwd(q, k, v, segments, causal, block_q, block_k):
+    if not _use_pallas(q, k, block_q, block_k):
+        from petastorm_tpu.ops.packing import masked_dense_attention, segment_mask
+        mask = segment_mask(segments, segments, causal=causal)
+        return (masked_dense_attention(q, k, v, mask),
+                (q, k, v, segments, None, None, None))
+    b, t, h, d = q.shape
+    interpret = jax.default_backend() != 'tpu'
+    q_bh, k_bh, v_bh = _to_bh(q), _to_bh(k), _to_bh(v)
+    o_bh, lse = _flash_forward(q_bh, k_bh, v_bh, causal, block_q, block_k,
+                               interpret, segments=segments, heads=h)
+    return _from_bh(o_bh, b, h), (q_bh, k_bh, v_bh, segments, o_bh, lse, (b, h))
+
+
+def _seg_zero_cotangent(segments):
+    import numpy as np
+    return np.zeros(segments.shape, dtype=jax.dtypes.float0)
+
+
+def _seg_bwd(causal, block_q, block_k, residuals, g):
+    q_bh, k_bh, v_bh, segments, o_bh, lse, bh_dims = residuals
+    if o_bh is None:
+        from petastorm_tpu.ops.packing import masked_dense_attention, segment_mask
+        mask = segment_mask(segments, segments, causal=causal)
+        _, vjp = jax.vjp(lambda a, b_, c: masked_dense_attention(a, b_, c, mask),
+                         q_bh, k_bh, v_bh)
+        return vjp(g) + (_seg_zero_cotangent(segments),)
+    b, h = bh_dims
+    interpret = jax.default_backend() != 'tpu'
+    dq, dk, dv = _flash_backward(q_bh, k_bh, v_bh, o_bh, lse, _to_bh(g), causal,
+                                 block_q, block_k, interpret, segments=segments,
+                                 heads=h)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h),
+            _seg_zero_cotangent(segments))
+
+
+flash_attention_segmented.defvjp(_seg_fwd, _seg_bwd)

@@ -134,12 +134,33 @@ def masked_dense_attention(q, k, v, mask):
     return jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def segment_causal_attention(segments):
+def segment_causal_attention(segments, use_flash=False, block_q=256, block_k=256):
     """Attention backend for packed batches — inject into ``TransformerLM``:
 
         model = TransformerLM(attention_fn=segment_causal_attention(batch['tokens_segments']))
 
-    Tokens attend causally WITHIN their segment only; padding attends nowhere."""
+    Tokens attend causally WITHIN their segment only; padding attends nowhere.
+    ``use_flash`` routes through the Pallas segmented flash kernels
+    (:func:`petastorm_tpu.ops.flash_attention.flash_attention_segmented`,
+    O(T * block) memory; falls back to this dense path when shapes don't tile)."""
+    if use_flash:
+        from petastorm_tpu.ops.flash_attention import (_use_pallas,
+                                                       flash_attention_segmented)
+
+        def attention_fn(q, k, v):
+            if not _use_pallas(q, k, block_q, block_k):
+                # The flag promises the O(T*block) flash memory bound; a silent
+                # dense fallback here would materialize [B, H, T, T] with no signal.
+                import warnings
+                warnings.warn(
+                    'segment_causal_attention(use_flash=True): shapes {}x{} head_dim'
+                    ' {} do not tile (need T % block == 0 and head_dim % 128 == 0); '
+                    'running the O(T^2) masked dense path instead.'.format(
+                        q.shape[1], k.shape[1], q.shape[-1]), stacklevel=2)
+            return flash_attention_segmented(q, k, v, segments, True,
+                                             block_q, block_k)
+        return attention_fn
+
     def attention_fn(q, k, v):
         return masked_dense_attention(q, k, v, segment_mask(segments, segments))
     return attention_fn

@@ -76,6 +76,64 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
                                        atol=5e-4, rtol=5e-4, err_msg='d' + name)
 
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_segmented_matches_masked_dense(self, causal):
+        """flash_attention_segmented (segment mask fused into every Pallas block,
+        incl. fully-masked blocks and padding rows) must match the dense
+        segment-masked reference, forward and backward."""
+        from petastorm_tpu.ops.flash_attention import flash_attention_segmented
+        from petastorm_tpu.ops.packing import masked_dense_attention, segment_mask
+        rng = np.random.RandomState(3)
+        b, t, h, d = 1, 512, 2, 128
+        q, k, v = (jnp.asarray(rng.randn(b, t, h, d) * 0.5, dtype=jnp.float32)
+                   for _ in range(3))
+        # Segments spanning block boundaries (blocks of 128), plus trailing padding.
+        seg = np.zeros((b, t), np.int32)
+        seg[0, :200] = 1
+        seg[0, 200:430] = 2
+        seg[0, 430:480] = 3                      # rest stays 0 = padding
+        segments = jnp.asarray(seg)
+
+        out = flash_attention_segmented(q, k, v, segments, causal, 128, 128)
+        expected = masked_dense_attention(
+            q, k, v, segment_mask(segments, segments, causal=causal))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_array_equal(np.asarray(out[0, 480:]), 0.0)
+
+        def loss(fn):
+            return lambda a, b_, c: (fn(a, b_, c) * jnp.cos(
+                jnp.arange(c.size, dtype=jnp.float32).reshape(c.shape))).sum()
+
+        g_flash = jax.grad(
+            loss(lambda a, b_, c: flash_attention_segmented(a, b_, c, segments,
+                                                            causal, 128, 128)),
+            argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(
+            loss(lambda a, b_, c: masked_dense_attention(
+                a, b_, c, segment_mask(segments, segments, causal=causal))),
+            argnums=(0, 1, 2))(q, k, v)
+        for gf, gd, name in zip(g_flash, g_dense, 'qkv'):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                       atol=5e-4, rtol=5e-4, err_msg='d' + name)
+
+    def test_segmented_fallback_path(self):
+        """Non-tiling shapes take the masked dense fallback — value and grads."""
+        from petastorm_tpu.ops.flash_attention import flash_attention_segmented
+        from petastorm_tpu.ops.packing import masked_dense_attention, segment_mask
+        rng = np.random.RandomState(4)
+        q, k, v = (jnp.asarray(rng.randn(1, 24, 2, 16), dtype=jnp.float32)
+                   for _ in range(3))
+        segments = jnp.asarray(np.r_[[1] * 10, [2] * 10, [0] * 4][None], jnp.int32)
+        out = flash_attention_segmented(q, k, v, segments, True, 128, 128)
+        expected = masked_dense_attention(
+            q, k, v, segment_mask(segments, segments, causal=True))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=1e-5, rtol=1e-5)
+        g = jax.grad(lambda a: jnp.sum(flash_attention_segmented(
+            a, k, v, segments, True, 128, 128) ** 2))(q)
+        assert np.all(np.isfinite(np.asarray(g)))
+
     def test_backward_never_materializes_txt(self):
         """The training-time memory claim (VERDICT round 1 item 7): no [T, T] tensor
         may exist anywhere in the lowered backward — scores are rematerialized
