@@ -168,33 +168,80 @@ class TestPackedLoss(object):
         assert float(loss) == 0.0
 
 
+def write_ragged_store(root, n_docs, n_parts=1, seed=11, min_len=4, max_len=13):
+    """Native parquet list<int32> store of variable-length docs — the ONE builder
+    for every ragged-store test in this file."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.RandomState(seed)
+    docs = [rng.randint(0, 32, size=rng.randint(min_len, max_len))
+            .astype(np.int32) for _ in range(n_docs)]
+    root.mkdir()
+    per_part = n_docs // n_parts
+    for part in range(n_parts):
+        chunk = docs[part * per_part:(part + 1) * per_part]
+        table = pa.table({
+            'doc_id': np.arange(part * per_part, (part + 1) * per_part,
+                                dtype=np.int64),
+            'tokens': pa.array([d.tolist() for d in chunk],
+                               type=pa.list_(pa.int32())),
+        })
+        pq.write_table(table, str(root / 'part_{}.parquet'.format(part)))
+    return 'file://' + str(root)
+
+
+class TestPackingCrossFramework(object):
+    """The packing TransformSpec is framework-neutral: the same reader feeds the
+    torch and TF adapters with dense packed columns."""
+
+    def _ragged_store(self, tmp_path):
+        return write_ragged_store(tmp_path / 'ragged', n_docs=32)
+
+    def test_torch_batched_loader_gets_packed_columns(self, tmp_path):
+        torch = pytest.importorskip('torch')
+
+        from petastorm_tpu import make_batch_reader
+        from petastorm_tpu.pytorch import BatchedDataLoader
+
+        url = self._ragged_store(tmp_path)
+        reader = make_batch_reader(
+            url, transform_spec=make_packing_transform('tokens', 24), num_epochs=1)
+        with BatchedDataLoader(reader, batch_size=4) as loader:
+            batch = next(iter(loader))
+        assert batch['tokens'].shape[1] == 24
+        assert isinstance(batch['tokens'], torch.Tensor)
+        assert int(batch['tokens_segments'].max()) >= 1
+
+    def test_tf_dataset_gets_packed_columns(self, tmp_path):
+        tf = pytest.importorskip('tensorflow')
+
+        from petastorm_tpu import make_batch_reader
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+        url = self._ragged_store(tmp_path)
+        with make_batch_reader(
+                url, transform_spec=make_packing_transform('tokens', 24),
+                num_epochs=1) as reader:
+            dataset = make_petastorm_dataset(reader)
+            batch = next(iter(dataset))
+        assert batch.tokens.shape[1] == 24
+        assert batch.tokens.dtype == tf.int32
+        assert int(tf.reduce_max(batch.tokens_segments)) >= 1
+
+
 class TestPackingEndToEnd(object):
     def test_ragged_store_to_packed_training_step(self, tmp_path):
         """native parquet list<int32> store -> make_batch_reader(TransformSpec=
         packing) -> JaxDataLoader -> TransformerLM steps with segment attention."""
         import optax
-        import pyarrow as pa
-        import pyarrow.parquet as pq
         from jax.sharding import PartitionSpec as P
 
         from petastorm_tpu import make_batch_reader
         from petastorm_tpu.models import TransformerLM
         from petastorm_tpu.parallel import JaxDataLoader, make_mesh
 
-        rng = np.random.RandomState(4)
-        docs = [rng.randint(0, 32, size=rng.randint(4, 17)).astype(np.int32)
-                for _ in range(64)]
-        root = tmp_path / 'ragged'
-        root.mkdir()
-        for part in range(4):
-            chunk = docs[part * 16:(part + 1) * 16]
-            table = pa.table({
-                'doc_id': np.arange(part * 16, (part + 1) * 16, dtype=np.int64),
-                'tokens': pa.array([d.tolist() for d in chunk],
-                                   type=pa.list_(pa.int32())),
-            })
-            pq.write_table(table, str(root / 'part_{}.parquet'.format(part)))
-        url = 'file://' + str(root)
+        url = write_ragged_store(tmp_path / 'ragged', n_docs=64, n_parts=4,
+                                 seed=4, max_len=17)
 
         seq_len = 32
         reader = make_batch_reader(
