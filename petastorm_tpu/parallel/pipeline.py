@@ -52,16 +52,25 @@ def stage_partition_specs(stacked, stage_axis='stage'):
                         stacked)
 
 
-def make_pipeline(stage_fn, mesh, stage_axis='stage', xs_spec=P(), out_spec=P()):
+def make_pipeline(stage_fn, mesh, stage_axis='stage', xs_spec=P(), out_spec=P(),
+                  params_spec=None):
     """Build ``fn(stacked_params, xs) -> ys`` running ``stage_fn`` as a pipeline.
 
     :param stage_fn: ``(stage_params, microbatch) -> microbatch`` — one stage's
-        computation; must preserve shape and dtype.
+        computation; must preserve shape and dtype. It runs inside ``shard_map``, so
+        it may use collectives over the mesh's OTHER axes (e.g.
+        ``ops.sharded_moe.expert_alltoall_ffn`` over an ``'expert'`` axis — pipeline
+        and expert parallelism in one program).
     :param mesh: mesh containing ``stage_axis``; other axes pass through (shard
         ``xs``'s non-microbatch dims over them via ``xs_spec``).
     :param xs_spec: PartitionSpec of ``xs`` (``[n_micro, ...microbatch...]``); dim 0
         is the microbatch stream and must NOT be sharded over ``stage_axis``.
     :param out_spec: PartitionSpec of the output (same layout as ``xs``).
+    :param params_spec: in_spec (pytree prefix) for the stacked params; default
+        ``P(stage_axis)`` shards only the leading stages axis and replicates the
+        rest. Pass per-leaf specs like ``P('stage', 'expert', None, None)`` to ALSO
+        shard stage weights over other mesh axes; every leaf's dim 0 must still be
+        sharded over ``stage_axis`` (each device holds exactly its stage's slice).
     :returns: a function usable under ``jit``: feeds microbatch ``m`` to stage 0 at
         tick ``m``, collects stage ``n-1`` outputs, returns them replicated over the
         stage axis (other axes per ``out_spec``).
@@ -69,6 +78,19 @@ def make_pipeline(stage_fn, mesh, stage_axis='stage', xs_spec=P(), out_spec=P())
     if stage_axis not in mesh.shape:
         raise ValueError('mesh has no axis {!r} (axes: {})'
                          .format(stage_axis, dict(mesh.shape)))
+    if params_spec is None:
+        params_spec = P(stage_axis)
+    # None-preserving traversal: a None leaf is the conventional 'replicated'
+    # spelling and MUST be rejected too — shard_map would replicate the stacked
+    # params over the stage axis and leaf[0] would silently serve stage 0's
+    # weights on every stage.
+    specs = jax.tree.leaves(params_spec,
+                            is_leaf=lambda leaf: leaf is None or isinstance(leaf, P))
+    for spec in specs:
+        if spec is None or not spec or spec[0] != stage_axis:
+            raise ValueError('params_spec leaf {} must shard dim 0 over {!r} '
+                             '(each device holds its own stage)'
+                             .format(spec, stage_axis))
     n_stages = mesh.shape[stage_axis]
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -106,7 +128,7 @@ def make_pipeline(stage_fn, mesh, stage_axis='stage', xs_spec=P(), out_spec=P())
         return lax.psum(jnp.where(is_last, outputs, jnp.zeros_like(outputs)),
                         stage_axis)
 
-    return shard_map_compat(local_fn, mesh, (P(stage_axis), xs_spec), out_spec)
+    return shard_map_compat(local_fn, mesh, (params_spec, xs_spec), out_spec)
 
 
 def microbatch(batch, n_micro):
