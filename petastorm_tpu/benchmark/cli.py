@@ -40,6 +40,12 @@ def main(argv=None):
     parser.add_argument('--ngram-delta-threshold', type=int,
                         help='max timestamp gap between consecutive window timesteps '
                              '(default: unbounded)')
+    parser.add_argument('--pack-field',
+                        help='measure packed-bin formation: pack this native list '
+                             'column inside the batch-reader workers '
+                             '(ops.packing.make_packing_transform)')
+    parser.add_argument('--pack-seq-len', type=int,
+                        help='bin length for --pack-field')
     parser.add_argument('-v', '--verbose', action='store_true')
     args = parser.parse_args(argv)
 
@@ -53,8 +59,10 @@ def main(argv=None):
         jax_batch_size=args.jax_batch_size, spawn_new_process=not args.in_process,
         profile_threads=args.profile_threads, ngram_length=args.ngram_length,
         ngram_ts_field=args.ngram_ts_field,
-        ngram_delta_threshold=args.ngram_delta_threshold)
-    unit = 'windows/sec' if args.ngram_length else 'samples/sec'
+        ngram_delta_threshold=args.ngram_delta_threshold,
+        pack_field=args.pack_field, pack_seq_len=args.pack_seq_len)
+    unit = ('windows/sec' if args.ngram_length
+            else 'bins/sec' if args.pack_field else 'samples/sec')
     print('Throughput: {:.2f} {}; RSS: {:.2f} MB; CPU: {:.2f}%{}'.format(
         result.samples_per_second, unit, result.memory_info.rss / (1 << 20), result.cpu,
         '; input-stall: {:.1%}'.format(result.input_stall_fraction)

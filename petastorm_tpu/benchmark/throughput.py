@@ -24,7 +24,7 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
                       read_method=READ_PYTHON, shuffle_row_groups=True,
                       jax_batch_size=256, spawn_new_process=True,
                       profile_threads=False, ngram_length=None, ngram_ts_field=None,
-                      ngram_delta_threshold=None):
+                      ngram_delta_threshold=None, pack_field=None, pack_seq_len=None):
     """Measure read throughput of a dataset (reference: throughput.py:112-172).
 
     ``read_method='python'`` iterates raw reader rows; ``'jax'`` drives a JaxDataLoader
@@ -37,7 +37,12 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
 
     ``ngram_length`` + ``ngram_ts_field`` switch the measurement to NGram window
     formation (cycle = one window of ``ngram_length`` timesteps, every field at every
-    offset): the windows/sec figure benchmarks the columnar gather path."""
+    offset): the windows/sec figure benchmarks the columnar gather path.
+
+    ``pack_field`` + ``pack_seq_len`` switch to packed-bin formation over a NATIVE
+    parquet list column (cycle = one worker batch of packed bins; the rate reported
+    is bins/sec): benchmarks ``ops.packing.make_packing_transform`` inside
+    ``make_batch_reader`` workers."""
     # Argument validation stays ahead of the spawn so bad combinations raise in the
     # caller, not through a child interpreter.
     if profile_threads and pool_type != 'thread':
@@ -49,6 +54,16 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
             raise ValueError('ngram_ts_field is required with ngram_length')
         if read_method != READ_PYTHON:
             raise ValueError('NGram benchmarking uses the python read method')
+    if (pack_field is None) != (pack_seq_len is None):
+        raise ValueError('pack_field and pack_seq_len must be given together')
+    if pack_field is not None:
+        if ngram_length is not None:
+            raise ValueError('packing and NGram modes are mutually exclusive')
+        if read_method != READ_PYTHON:
+            raise ValueError('packing benchmarking uses the python read method')
+        if profile_threads:
+            # make_batch_reader takes pool_type/workers_count, not a pre-built pool.
+            raise ValueError('profile_threads is not supported with pack_field')
 
     if spawn_new_process:
         from petastorm_tpu.utils import run_in_subprocess
@@ -56,7 +71,8 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
                                  warmup_cycles_count, measure_cycles_count, pool_type,
                                  loaders_count, read_method, shuffle_row_groups,
                                  jax_batch_size, False, profile_threads, ngram_length,
-                                 ngram_ts_field, ngram_delta_threshold)
+                                 ngram_ts_field, ngram_delta_threshold, pack_field,
+                                 pack_seq_len)
 
     import psutil
     from petastorm_tpu.reader import make_reader
@@ -77,10 +93,22 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
                               timestamp_field=ngram_ts_field)
     pool_kwargs = ({'reader_pool': reader_pool} if reader_pool is not None
                    else {'reader_pool_type': pool_type, 'workers_count': loaders_count})
-    reader = make_reader(dataset_url, schema_fields=schema_fields,
-                         shuffle_row_groups=shuffle_row_groups, num_epochs=None,
-                         **pool_kwargs)
+    if pack_field is not None:
+        from petastorm_tpu.ops.packing import make_packing_transform
+        from petastorm_tpu.reader import make_batch_reader
+        reader = make_batch_reader(
+            dataset_url,
+            # Only the packed column need ever leave the parquet files (the
+            # transform's selected_fields discards everything else anyway).
+            schema_fields=field_regex if field_regex else [pack_field],
+            transform_spec=make_packing_transform(pack_field, pack_seq_len),
+            shuffle_row_groups=shuffle_row_groups, num_epochs=None, **pool_kwargs)
+    else:
+        reader = make_reader(dataset_url, schema_fields=schema_fields,
+                             shuffle_row_groups=shuffle_row_groups, num_epochs=None,
+                             **pool_kwargs)
     stall = 0.0
+    packed_units = 0
     try:
         if read_method == READ_PYTHON:
             iterator = iter(reader)
@@ -99,7 +127,11 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
         start = time.perf_counter()
         next_report = start + 5
         for cycle in range(measure_cycles_count):
-            next(iterator)
+            item = next(iterator)
+            if pack_field is not None:
+                # A batch-reader cycle yields one worker batch of packed bins;
+                # the honest unit is bins, counted from the actual batch.
+                packed_units += len(getattr(item, pack_field))
             now = time.perf_counter()
             if now > next_report:
                 logger.debug('cycle %d/%d, %.1f rows/s, diagnostics=%s', cycle,
@@ -112,7 +144,10 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
         memory = process.memory_info()
         if read_method == READ_JAX:
             stall = loader.stats.input_stall_fraction
-        rate = measure_cycles_count * rows_per_cycle / elapsed
+        if pack_field is not None:
+            rate = packed_units / elapsed
+        else:
+            rate = measure_cycles_count * rows_per_cycle / elapsed
         return BenchmarkResult(time_mean=elapsed / measure_cycles_count,
                                samples_per_second=rate, memory_info=memory, cpu=cpu,
                                input_stall_fraction=stall)

@@ -121,6 +121,37 @@ class TestThroughput:
         with pytest.raises(ValueError, match='ngram_ts_field'):
             reader_throughput(synthetic_dataset.url, ngram_length=3)
 
+    def test_packing_throughput(self, tmp_path):
+        """Packed-bin formation mode: cycle = one worker batch of packed bins over a
+        native list column; rate is bins/sec."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from petastorm_tpu.benchmark.throughput import reader_throughput
+
+        rng = np.random.RandomState(0)
+        root = tmp_path / 'ragged'
+        root.mkdir()
+        docs = [rng.randint(0, 99, size=rng.randint(4, 13)).astype(np.int32)
+                for _ in range(200)]
+        table = pa.table({'doc_id': np.arange(200, dtype=np.int64),
+                          'tokens': pa.array([d.tolist() for d in docs],
+                                             type=pa.list_(pa.int32()))})
+        pq.write_table(table, str(root / 'part_0.parquet'), row_group_size=50)
+
+        result = reader_throughput('file://' + str(root), warmup_cycles_count=2,
+                                   measure_cycles_count=10, loaders_count=1,
+                                   pack_field='tokens', pack_seq_len=32,
+                                   spawn_new_process=False)
+        assert result.samples_per_second > 0
+
+    def test_packing_throughput_guards(self, synthetic_dataset):
+        from petastorm_tpu.benchmark.throughput import reader_throughput
+        with pytest.raises(ValueError, match='together'):
+            reader_throughput(synthetic_dataset.url, pack_field='tokens')
+        with pytest.raises(ValueError, match='mutually exclusive'):
+            reader_throughput(synthetic_dataset.url, pack_field='tokens',
+                              pack_seq_len=8, ngram_length=3, ngram_ts_field='id')
+
     def test_spawn_new_process_isolated_rss(self, synthetic_dataset):
         """Default path (reference parity, throughput.py:144-149): the measurement
         respawns in a fresh interpreter so RSS excludes the caller's footprint."""
