@@ -236,6 +236,34 @@ class TestMoEExpertParallel(object):
         mods = {'losses': {'MoEMlp_0': {'moe_aux': (jnp.float32(2), jnp.float32(3))}}}
         assert float(moe_aux_total(mods)) == 3.0
 
+    def test_packed_batches_through_moe_model(self):
+        # Packing composes with MoE: segment-masked attention injected into
+        # MoETransformerLM, boundary-masked loss, finite grads.
+        from petastorm_tpu.ops.packing import (pack_sequences,
+                                               packed_next_token_loss,
+                                               segment_causal_attention)
+        rng = np.random.RandomState(8)
+        packed = pack_sequences(
+            [rng.randint(1, 32, size=n).astype(np.int32)
+             for n in (10, 7, 12, 5, 9, 6)], 16)
+        tokens = jnp.asarray(packed['tokens'])
+        segments = jnp.asarray(packed['segments'])
+        model = MoETransformerLM(vocab=32, embed=16, heads=2, layers=2,
+                                 num_experts=2, moe_every=2, max_len=16,
+                                 dtype=jnp.float32,
+                                 attention_fn=segment_causal_attention(segments))
+        params = {'params': model.init(jax.random.PRNGKey(8), tokens)['params']}
+
+        def loss_fn(p):
+            logits, mods = model.apply(p, tokens, mutable='losses')
+            return (packed_next_token_loss(logits, tokens, segments)
+                    + moe_aux_total(mods, 0.01))
+
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+        assert np.isfinite(float(loss))
+        for leaf in jax.tree.leaves(grads):
+            assert np.all(np.isfinite(np.asarray(leaf)))
+
     def test_capacity_guard(self):
         with pytest.raises(ValueError):
             MoEMlp(num_experts=2, num_selected=3, dtype=jnp.float32).init(
