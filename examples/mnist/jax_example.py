@@ -47,24 +47,77 @@ def make_train_step(model, optimizer):
 
 
 def train(dataset_url, batch_size=128, epochs=1, learning_rate=1e-3,
-          shuffling_queue_capacity=1024):
+          shuffling_queue_capacity=None, checkpoint_dir=None, save_every=100,
+          max_steps=None):
+    """Streaming training. With ``checkpoint_dir``, the model AND the input position
+    save atomically every ``save_every`` steps (``TrainingCheckpointer``) and a
+    restart resumes mid-epoch from the saved position — item-granular,
+    at-least-once (a partially delivered rowgroup is re-read whole; see
+    ``JaxDataLoader.state_dict``). Delivery-exact input accounting needs an
+    unbuffered stream, so the checkpointed configuration runs without the shuffling
+    buffer (rowgroup + in-rowgroup shuffle still apply) and rejects an explicit
+    ``shuffling_queue_capacity``."""
+    if checkpoint_dir and shuffling_queue_capacity:
+        raise ValueError('checkpoint_dir needs the unbuffered stream; do not pass '
+                         'shuffling_queue_capacity with it')
+    if shuffling_queue_capacity is None:
+        shuffling_queue_capacity = 0 if checkpoint_dir else 1024
     model = MnistCNN()
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))['params']
     optimizer = optax.adam(learning_rate)
     opt_state = optimizer.init(params)
     train_step = make_train_step(model, optimizer)
 
+    ckpt = resume_state = None
+    start_step = 0
     loss = accuracy = None
-    with make_reader('{}/train'.format(dataset_url.rstrip('/')), num_epochs=epochs,
-                     transform_spec=TRANSFORM, shuffle_rows=True, seed=42) as reader:
-        loader = JaxDataLoader(reader, batch_size=batch_size,
-                               shuffling_queue_capacity=shuffling_queue_capacity, seed=42)
-        for step, batch in enumerate(loader):
-            params, opt_state, loss, accuracy = train_step(
-                params, opt_state, batch['image'], batch['digit'])
-            if step % 50 == 0:
-                print('step {} loss {:.4f} acc {:.3f}'.format(step, loss, accuracy))
-        print('input pipeline stats:', loader.stats.as_dict())
+    try:
+        if checkpoint_dir:
+            from petastorm_tpu.parallel import TrainingCheckpointer
+            ckpt = TrainingCheckpointer(checkpoint_dir,
+                                        save_interval_steps=save_every)
+            if ckpt.latest_step is not None:
+                (params, opt_state), loader_state = ckpt.restore((params, opt_state))
+                resume_state = loader_state['reader'] if loader_state else None
+                start_step = int(ckpt.latest_step) + 1
+                print('resuming from step {} (input position restored)'.format(
+                    start_step))
+        try:
+            reader = make_reader('{}/train'.format(dataset_url.rstrip('/')),
+                                 num_epochs=epochs, transform_spec=TRANSFORM,
+                                 shuffle_rows=True, seed=42,
+                                 resume_state=resume_state)
+        except ValueError as exc:
+            if resume_state is not None and 'already consumed' in str(exc):
+                # The reader refuses an all-consumed resume by design; for the
+                # example a completed run restarting is informational, not an error.
+                print('nothing left to train: input fully consumed at resume point')
+                return params, None, None
+            raise
+        with reader:
+            loader = JaxDataLoader(reader, batch_size=batch_size,
+                                   shuffling_queue_capacity=shuffling_queue_capacity,
+                                   seed=42)
+            for step, batch in enumerate(loader, start=start_step):
+                params, opt_state, loss, accuracy = train_step(
+                    params, opt_state, batch['image'], batch['digit'])
+                if ckpt is not None:
+                    ckpt.save(step, (params, opt_state), loader=loader)
+                if step % 50 == 0:
+                    print('step {} loss {:.4f} acc {:.3f}'.format(step, loss,
+                                                                  accuracy))
+                if max_steps is not None and step - start_step + 1 >= max_steps:
+                    break
+            print('input pipeline stats:', loader.stats.as_dict())
+    finally:
+        if ckpt is not None:
+            ckpt.wait_until_finished()
+            ckpt.close()
+    if loss is None:
+        # A resume can also yield zero batches without tripping the reader's
+        # all-consumed guard (e.g. only a drop_last partial batch remained).
+        print('nothing left to train: input fully consumed at resume point')
+        return params, None, None
     return params, float(loss), float(accuracy)
 
 
@@ -166,13 +219,26 @@ def main():
     parser.add_argument('--scan-stream', action='store_true',
                         help='compiled-chunk streaming via JaxDataLoader.scan_stream '
                              '(recommended when it does NOT fit in HBM)')
+    parser.add_argument('--checkpoint-dir',
+                        help='save (model, input position) atomically every '
+                             '--save-every steps and resume from it on restart '
+                             '(streaming mode only)')
+    parser.add_argument('--save-every', type=int, default=100)
     args = parser.parse_args()
     if args.inmem and args.scan_stream:
         parser.error('--inmem and --scan-stream are mutually exclusive')
-    train_fn = (train_inmem if args.inmem
-                else train_scan_stream if args.scan_stream else train)
-    params, _, _ = train_fn(args.dataset_url, batch_size=args.batch_size,
-                            epochs=args.epochs, learning_rate=args.learning_rate)
+    if args.checkpoint_dir and (args.inmem or args.scan_stream):
+        parser.error('--checkpoint-dir applies to the streaming mode')
+    if args.inmem or args.scan_stream:
+        train_fn = train_inmem if args.inmem else train_scan_stream
+        params, _, _ = train_fn(args.dataset_url, batch_size=args.batch_size,
+                                epochs=args.epochs,
+                                learning_rate=args.learning_rate)
+    else:
+        params, _, _ = train(args.dataset_url, batch_size=args.batch_size,
+                             epochs=args.epochs, learning_rate=args.learning_rate,
+                             checkpoint_dir=args.checkpoint_dir,
+                             save_every=args.save_every)
     evaluate(params, args.dataset_url, batch_size=args.batch_size)
 
 

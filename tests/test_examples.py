@@ -212,6 +212,28 @@ def test_mnist_jax_scan_stream_trains(mnist_dataset):
     assert test_accuracy > 0.3
 
 
+def test_mnist_checkpoint_resume(mnist_dataset, tmp_path, capsys):
+    """--checkpoint-dir: interrupt after 2 steps, restart, and training resumes from
+    the saved (model, input-position) pair; a third restart finds everything
+    consumed and says so instead of crashing."""
+    from examples.mnist import jax_example
+    ck = str(tmp_path / 'ck')
+    _, _, _ = jax_example.train(mnist_dataset, batch_size=32, epochs=1,
+                                checkpoint_dir=ck, save_every=1, max_steps=2)
+    assert 'resuming' not in capsys.readouterr().out
+
+    _, loss2, _ = jax_example.train(mnist_dataset, batch_size=32, epochs=1,
+                                    checkpoint_dir=ck, save_every=1)
+    out2 = capsys.readouterr().out
+    assert 'resuming from step 2 (input position restored)' in out2
+    assert loss2 is not None and np.isfinite(loss2)
+
+    _, loss3, _ = jax_example.train(mnist_dataset, batch_size=32, epochs=1,
+                                    checkpoint_dir=ck)
+    assert loss3 is None
+    assert 'fully consumed' in capsys.readouterr().out
+
+
 def test_mnist_pytorch_trains(mnist_dataset):
     from examples.mnist import pytorch_example
     accuracy = pytorch_example.main(['--dataset-url', mnist_dataset, '--epochs', '6',
