@@ -166,11 +166,15 @@ def train_packed(dataset_url, seq_len=64, batch_size=8, epochs=2, data_axis=None
                              attention_fn=lambda q, k, v: ring(q, k, v, segments))
 
     @jax.jit
-    def train_step(params, opt_state, tokens, segments):
+    def train_step(params, opt_state, tokens, segments, positions):
         model = model_for(segments)
 
         def loss_fn(p):
-            return packed_next_token_loss(model.apply(p, tokens), tokens, segments)
+            # positions: the packer's per-segment restart column, so every packed
+            # document's position embedding starts at 0 (the attention mask alone
+            # only isolates segments — it does not fix their positions).
+            return packed_next_token_loss(model.apply(p, tokens, positions),
+                                          tokens, segments)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -187,13 +191,15 @@ def train_packed(dataset_url, seq_len=64, batch_size=8, epochs=2, data_axis=None
                            partition_spec=spec) as loader:
             for step, batch in enumerate(loader):
                 tokens, segments = batch['tokens'], batch['tokens_segments']
+                positions = batch['tokens_positions']
                 if params is None:
                     # Params are independent of the (parameter-free) attention
                     # backend: init once with any segments.
-                    params = model_for(segments).init(jax.random.PRNGKey(0), tokens)
+                    params = model_for(segments).init(jax.random.PRNGKey(0), tokens,
+                                                      positions)
                     opt_state = optimizer.init(params)
                 params, opt_state, loss = train_step(params, opt_state, tokens,
-                                                     segments)
+                                                     segments, positions)
                 if step % 20 == 0:
                     print('step {} loss {:.4f}'.format(step, float(loss)))
             print('input pipeline stats:', loader.stats.as_dict())

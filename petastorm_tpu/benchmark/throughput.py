@@ -6,6 +6,7 @@ through the JAX loader.
 """
 
 import logging
+import re
 import time
 from collections import namedtuple
 
@@ -64,6 +65,15 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
         if profile_threads:
             # make_batch_reader takes pool_type/workers_count, not a pre-built pool.
             raise ValueError('profile_threads is not supported with pack_field')
+        if field_regex and not any(re.fullmatch(pattern, pack_field)
+                                   for pattern in field_regex):
+            # fullmatch mirrors Unischema.match_unischema_fields (the selection
+            # this guard predicts): a prefix-only pattern must fail here too.
+            # A regex set that drops the packed column would otherwise surface as an
+            # opaque KeyError inside a worker (ADVICE r3).
+            raise ValueError(
+                'field_regex {!r} does not match pack_field {!r}; the packed column '
+                'must be read for packing to run'.format(field_regex, pack_field))
 
     if spawn_new_process:
         from petastorm_tpu.utils import run_in_subprocess

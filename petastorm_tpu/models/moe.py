@@ -44,14 +44,17 @@ def _ambient_mesh_axes():
         mesh = get_abstract_mesh()
         if mesh is not None and mesh.axis_names:
             return set(mesh.axis_names)
-    except ImportError:
+    except (ImportError, AttributeError):
         pass
     try:
+        # Private-API fallback for older jax: a rename that keeps the module but
+        # moves an attribute must degrade to the no-mesh path, not raise from
+        # inside every forward pass (ADVICE r3).
         from jax._src.mesh import thread_resources
         mesh = thread_resources.env.physical_mesh
         if mesh.axis_names:
             return set(mesh.axis_names)
-    except ImportError:
+    except (ImportError, AttributeError):
         pass
     return None
 
@@ -191,16 +194,26 @@ def expert_partition_specs(params, expert_axis='expert'):
     ``expert_axis``, everything else replicated. Feed to ``NamedSharding``/jit."""
     from jax.sharding import PartitionSpec as P
 
+    # Scopes holding a 'router' child: MoEMlp always carries its router Dense beside
+    # w1/w2, so a router sibling — not path depth — is the signal that a top-level
+    # w1/w2 belongs to a root-module MoEMlp. A non-MoE root module with 3-D params
+    # that happen to be named w1/w2 has no router and stays replicated (ADVICE r3).
+    router_scopes = set()
+    for path, _ in jax.tree_util.tree_flatten_with_path(params)[0]:
+        names = tuple(str(getattr(p, 'key', getattr(p, 'name', ''))) for p in path)
+        if 'router' in names:
+            router_scopes.add(names[:names.index('router')])
+
     def spec(path, leaf):
         names = [str(getattr(p, 'key', getattr(p, 'name', ''))) for p in path]
         # Expert weights are the 3-D [experts, in, out] leaves named w1/w2 — under a
-        # nested MoEMlp_* scope, or at exactly ('params', 'w1'/'w2') when MoEMlp is
-        # the root module. Both the scope and ndim conditions are required: a bare
-        # top-level w1/w2 (e.g. stack_stage_params output) must not be captured, and
-        # an MoE leaf with extra leading axes (nn.scan / stacked pipeline stages)
-        # must fail loudly, not shard the wrong axis.
+        # nested MoEMlp_* scope, or beside a router Dense when MoEMlp is the root
+        # module. Both the scope and ndim conditions are required: a bare top-level
+        # w1/w2 (e.g. stack_stage_params output) must not be captured, and an MoE
+        # leaf with extra leading axes (nn.scan / stacked pipeline stages) must fail
+        # loudly, not shard the wrong axis.
         in_moe_scope = (any('MoEMlp' in n for n in names)
-                        or (len(names) == 2 and names[0] == 'params'))
+                        or tuple(names[:-1]) in router_scopes)
         if names and names[-1] in ('w1', 'w2') and in_moe_scope:
             if leaf.ndim == 3:
                 return P(expert_axis, *([None] * (leaf.ndim - 1)))
@@ -299,7 +312,9 @@ class MoETransformerLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None):
+        """``positions`` mirrors TransformerLM: optional [B, T] per-token position
+        ids so packed batches restart each document at position 0."""
         from petastorm_tpu.models.transformer import Block, dense_causal_attention
         if self.embed % self.heads != 0:
             raise ValueError('embed={} must be divisible by heads={}'
@@ -315,8 +330,11 @@ class MoETransformerLM(nn.Module):
         dense_cls = nn.remat(Block) if self.remat else Block
         moe_cls = nn.remat(MoEBlock) if self.remat else MoEBlock
         x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
-        positions = jnp.arange(tokens.shape[1])
-        x = x + nn.Embed(self.max_len, self.embed, dtype=self.dtype)(positions)[None]
+        pos_table = nn.Embed(self.max_len, self.embed, dtype=self.dtype)
+        if positions is None:
+            x = x + pos_table(jnp.arange(tokens.shape[1]))[None]
+        else:
+            x = x + pos_table(positions)
         n_moe = n_dense = 0
         for i in range(self.layers):
             if (i + 1) % self.moe_every == 0:

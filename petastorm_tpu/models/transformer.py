@@ -70,7 +70,12 @@ class TransformerLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None):
+        """``positions`` (optional [B, T] int): explicit per-token position ids.
+        Packed batches pass the packer's ``*_positions`` column here so each packed
+        document restarts from position 0 instead of inheriting the bin-global
+        arange (ADVICE r3); default None keeps the plain contiguous-sequence
+        behavior."""
         if self.embed % self.heads != 0:
             raise ValueError('embed={} must be divisible by heads={}'
                              .format(self.embed, self.heads))
@@ -85,8 +90,11 @@ class TransformerLM(nn.Module):
         # (pairs with flash/ring attention, which bound the attention memory).
         block_cls = nn.remat(Block) if self.remat else Block
         x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
-        positions = jnp.arange(tokens.shape[1])
-        x = x + nn.Embed(self.max_len, self.embed, dtype=self.dtype)(positions)[None]
+        pos_table = nn.Embed(self.max_len, self.embed, dtype=self.dtype)
+        if positions is None:
+            x = x + pos_table(jnp.arange(tokens.shape[1]))[None]
+        else:
+            x = x + pos_table(positions)
         for i in range(self.layers):
             # Explicit names keep the param tree identical with and without remat
             # (nn.remat would otherwise rename the scope), so checkpoints and

@@ -14,9 +14,10 @@ fixed-length rows); this is the TPU-first treatment of ragged text:
   ``attention_fn``) masks attention to (same segment AND causal AND not padding), and
   :func:`packed_next_token_loss` masks targets that would cross a boundary.
 
-Note on positions: ``TransformerLM`` adds a global-arange position embedding; the
-packed ``<field>_positions`` column carries per-segment positions for consumers that
-embed positions themselves.
+Note on positions: pass the packed ``<field>_positions`` column as the models'
+``positions`` argument (``TransformerLM``/``MoETransformerLM`` accept explicit
+per-token position ids) so every packed document's position embedding restarts at
+0; without it the bin-global arange leaks positions across document boundaries.
 """
 
 import jax
