@@ -109,6 +109,9 @@ _HEADLINE_FALLBACKS = (
     ('imagenet_stream_rows_per_sec', None,
      'imagenet_train_rows_per_sec_per_chip', 'rows/s/chip',
      'imagenet_stream_fallback_headline'),
+    ('imagenet_scan_rows_per_sec', None,
+     'imagenet_train_rows_per_sec_per_chip', 'rows/s/chip',
+     'imagenet_scan_fallback_headline'),
     ('flash_train_tokens_per_sec', None,
      'flash_train_tokens_per_sec', 'tokens/s', 'flash_fallback_headline'),
     ('moe_train_tokens_per_sec', None,
@@ -119,7 +122,8 @@ _HEADLINE_FALLBACKS = (
 
 
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
-                 'mnist_inmem', 'imagenet_stream', 'decode_delta', 'flash', 'moe')
+                 'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
+                 'flash', 'moe')
 
 
 def validate_bench_sections():
@@ -350,6 +354,12 @@ def orchestrate():
         log('using salvaged partial TPU results ({} fields)'.format(len(best_partial)))
         result = best_partial
 
+    if result is None and os.environ.get('BENCH_SKIP_CPU_FALLBACK') == '1':
+        # The session probe loop sets this: it only wants TPU lines and will retry
+        # later itself, so a CPU fallback here is pure wasted wall-clock.
+        log('TPU unavailable and BENCH_SKIP_CPU_FALLBACK=1 — exiting without a '
+            'CPU fallback measurement')
+        sys.exit(3)
     if result is None:
         log('FALLBACK: TPU unavailable — measuring on CPU so the round still has a '
             'number. vs_baseline from a CPU run is NOT the headline TPU metric.')
@@ -581,15 +591,12 @@ def child_main():
             .format(host, onchip, onchip / max(host, 1e-9)))
         return host, onchip
 
-    def run_imagenet_stream():
-        """The larger-than-HBM streaming configuration (VERDICT r2 item 2): DCT store
-        read by the BENCH_STREAM_POOL pool (spawn + Arrow IPC wire for 'process'),
-        raw int16 coefficient blocks to the chip, dequant+IDCT on the MXU inside the
-        jitted real-depth ResNet train step, JaxDataLoader prefetch double-buffering.
-        ONE reader serves warmup+measured epochs so per-epoch numbers measure the
-        steady state, not worker-spawn cost; per-epoch stall comes from loader.stats
-        deltas. This is the config where the streaming machinery itself must carry
-        the north star (stall < 0.10) — the dataset is never HBM-resident."""
+    def imagenet_train_setup():
+        """ONE definition of the imagenet-bench pieces shared by the __iter__
+        (imagenet_stream) and scan_stream (imagenet_scan) sections — store, DCT
+        read-time override, ResNet config, optimizer, and the decode+train loss —
+        so the two sections measure the SAME model and math and can only differ in
+        how batches reach the chip."""
         from petastorm_tpu.codecs import DctCoefficientsCodec
         from petastorm_tpu.models.resnet import ResNet
         from petastorm_tpu.ops.image import normalize_image
@@ -599,49 +606,80 @@ def child_main():
         if not os.path.exists(os.path.join(img_url, '_common_metadata')):
             log('materializing {} DCT images to {}'.format(IMG_ROWS, img_url))
             build_imagenet_dataset(img_url)
-
         model = ResNet(stage_sizes=list(STREAM_STAGES), num_classes=1000,
                        num_filters=64)
         variables = model.init(jax.random.PRNGKey(0),
                                jnp.zeros((IMG_BATCH, IMG_HW, IMG_HW, 3)))
-        params, batch_stats = variables['params'], variables['batch_stats']
-        optimizer = optax.sgd(0.1, momentum=0.9)
+
+        def decoded_loss(params, batch_stats, coeffs, labels):
+            """On-chip DCT decode + normalize + ResNet train-mode loss; returns
+            ``(loss, new_batch_stats)`` for ``value_and_grad(has_aux=True)``."""
+            images = dct_decode_images_jax(coeffs, quality=90)
+            images = normalize_image(images, mean=127.5, std=127.5,
+                                     dtype=jnp.bfloat16)
+            logits, updates = model.apply(
+                {'params': params, 'batch_stats': batch_stats}, images, train=True,
+                mutable=['batch_stats'])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, updates['batch_stats']
+
+        return {
+            'img_url': img_url,
+            'variables': variables,
+            'optimizer': optax.sgd(0.1, momentum=0.9),
+            'override': UnischemaField('image', np.int16,
+                                       (IMG_HW // 8, IMG_HW // 8, 8, 8, 3),
+                                       DctCoefficientsCodec(quality=90), False),
+            'decoded_loss': decoded_loss,
+        }
+
+    def run_imagenet_stream():
+        """The larger-than-HBM streaming configuration (VERDICT r2 item 2): DCT store
+        read by the BENCH_STREAM_POOL pool (spawn + Arrow IPC wire for 'process'),
+        raw int16 coefficient blocks to the chip, dequant+IDCT on the MXU inside the
+        jitted real-depth ResNet train step, JaxDataLoader prefetch double-buffering.
+        ONE reader serves warmup+measured epochs so per-epoch numbers measure the
+        steady state, not worker-spawn cost; per-epoch stall comes from loader.stats
+        deltas. This is the config where the streaming machinery itself must carry
+        the north star (stall < 0.10) — the dataset is never HBM-resident."""
+        setup = imagenet_train_setup()
+        optimizer = setup['optimizer']
+        params = setup['variables']['params']
+        batch_stats = setup['variables']['batch_stats']
         opt_state = optimizer.init(params)
 
         @jax.jit
         def stream_step(params, batch_stats, opt_state, coeffs, labels):
-            images = dct_decode_images_jax(coeffs, quality=90)
-            images = normalize_image(images, mean=127.5, std=127.5,
-                                     dtype=jnp.bfloat16)
-
-            def loss_fn(p):
-                logits, updates = model.apply(
-                    {'params': p, 'batch_stats': batch_stats}, images, train=True,
-                    mutable=['batch_stats'])
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, labels).mean()
-                return loss, updates['batch_stats']
-
-            (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            (loss, new_stats), grads = jax.value_and_grad(
+                lambda p: setup['decoded_loss'](p, batch_stats, coeffs, labels),
+                has_aux=True)(params)
             updates, opt_state2 = optimizer.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), new_stats, opt_state2, loss
 
-        override = UnischemaField('image', np.int16,
-                                  (IMG_HW // 8, IMG_HW // 8, 8, 8, 3),
-                                  DctCoefficientsCodec(quality=90), False)
+        img_url = setup['img_url']
         reader = make_reader(img_url, reader_pool_type=STREAM_POOL,
                              workers_count=WORKERS, num_epochs=STREAM_EPOCHS + 1,
                              shuffle_row_groups=True, seed=13,
-                             field_overrides=[override])
+                             field_overrides=[setup['override']])
         loader = JaxDataLoader(reader, batch_size=IMG_BATCH, prefetch=4,
                                drop_last=True)
         rows_per_epoch = (len(reader) // IMG_BATCH) * IMG_BATCH
         rates, stalls = [], []
         epoch_rows = 0
         loss = None
+        step_flops = None
         prev_stats = dict(loader.stats.as_dict())
         epoch_start = time.perf_counter()
         for batch in loader:
+            if step_flops is None:
+                # XLA cost analysis of the compiled step (epoch 0 is warmup, so
+                # the extra lowering never lands in a measured epoch). The ResNet
+                # step is pure HLO — no custom calls — so executed == model FLOPs.
+                from petastorm_tpu.benchmark.mfu import xla_cost_flops
+                step_flops = xla_cost_flops(
+                    stream_step, params, batch_stats, opt_state,
+                    batch['image'], batch['label']) or 0.0
             params, batch_stats, opt_state, loss = stream_step(
                 params, batch_stats, opt_state, batch['image'], batch['label'])
             epoch_rows += IMG_BATCH
@@ -662,14 +700,105 @@ def child_main():
         reader.join()
         # epoch 0 carries every compile: it is warmup, not steady state
         measured_rates, measured_stalls = rates[1:] or rates, stalls[1:] or stalls
+        median_rate = float(np.median(measured_rates))
         results.update({
-            'imagenet_stream_rows_per_sec': round(float(np.median(measured_rates)), 2),
+            'imagenet_stream_rows_per_sec': round(median_rate, 2),
             'imagenet_stream_input_stall_fraction':
                 round(float(np.median(measured_stalls)), 4),
             'imagenet_stream_config': '{}_pool+dct_onchip_decode+resnet{}x{}@{}px_b{}'
                 .format(STREAM_POOL, '-'.join(map(str, STREAM_STAGES)), 64,
                         IMG_HW, IMG_BATCH),
         })
+        if step_flops and median_rate > 0:
+            from petastorm_tpu.benchmark.mfu import mfu_fields
+            results.update(mfu_fields('imagenet_train', step_flops, steps=1,
+                                      elapsed_s=IMG_BATCH / median_rate))
+
+    def run_imagenet_scan():
+        """Larger-than-HBM streaming through compiled chunk programs (VERDICT r3
+        item 3): the same DCT store + on-chip decode + real-depth ResNet as
+        imagenet_stream, but driven by ``JaxDataLoader.scan_stream`` — one H2D
+        upload and ONE XLA dispatch per chunk of batches instead of per batch.
+        Reports its own efficiency: measured streaming rate over the rate of the
+        SAME compiled chunk program on a device-resident chunk (pure compute).
+        efficiency >= 0.90 == the streaming north star (BASELINE.md) with the
+        input pipeline in the loop."""
+        setup = imagenet_train_setup()
+        optimizer = setup['optimizer']
+        variables = setup['variables']
+        carry0 = (variables['params'], variables['batch_stats'],
+                  optimizer.init(variables['params']))
+
+        def scan_step(carry, batch):
+            params, batch_stats, opt_state = carry
+            (loss, new_stats), grads = jax.value_and_grad(
+                lambda p: setup['decoded_loss'](p, batch_stats, batch['image'],
+                                                batch['label']),
+                has_aux=True)(params)
+            updates, opt_state2 = optimizer.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_stats, opt_state2), loss
+
+        chunk_batches = int(os.environ.get('BENCH_IMG_CHUNK', 4))
+        reader = make_reader(setup['img_url'], reader_pool_type=STREAM_POOL,
+                             workers_count=WORKERS, num_epochs=1,
+                             shuffle_row_groups=True, seed=17,
+                             field_overrides=[setup['override']])
+        loader = JaxDataLoader(reader, batch_size=IMG_BATCH, drop_last=True)
+        carry = carry0
+        rates = []
+        for epoch in range(IMG_EPOCHS + 1):  # epoch 0 absorbs the compiles
+            start = time.perf_counter()
+            carry, aux = loader.scan_stream(scan_step, carry,
+                                            chunk_batches=chunk_batches, seed=epoch)
+            rows = sum(int(np.asarray(a).shape[0]) for a in aux) * IMG_BATCH
+            float(np.asarray(aux[-1])[-1])  # gate on device readback
+            elapsed = time.perf_counter() - start
+            if epoch > 0:
+                rates.append(rows / elapsed)
+                log('imagenet scan epoch: {} rows in {:.2f}s -> {:.1f} rows/s'
+                    .format(rows, elapsed, rows / elapsed))
+        reader.stop()
+        reader.join()
+        stream_rate = float(np.median(rates))
+
+        # Pure-compute reference: the same chunk program over a device-resident
+        # chunk (synthetic coefficients — identical shapes/dtypes, identical
+        # compiled program). The gap to stream_rate is exactly what the input
+        # pipeline costs.
+        rng = np.random.RandomState(0)
+        chunk = {
+            'image': jnp.asarray(rng.randint(
+                -512, 512, (chunk_batches, IMG_BATCH, IMG_HW // 8, IMG_HW // 8,
+                            8, 8, 3)).astype(np.int16)),
+            'label': jnp.asarray(rng.randint(
+                0, 1000, (chunk_batches, IMG_BATCH)).astype(np.int64)),
+        }
+        chunk_program = jax.jit(
+            lambda c, ch: jax.lax.scan(scan_step, c, ch))
+        carry_c, aux_c = chunk_program(carry0, chunk)  # compile warmup
+        float(np.asarray(aux_c)[-1])
+        compute_runs = 3
+        start = time.perf_counter()
+        for _ in range(compute_runs):
+            carry_c, aux_c = chunk_program(carry_c, chunk)
+        float(np.asarray(aux_c)[-1])
+        compute_elapsed = time.perf_counter() - start
+        chunk_rows = chunk_batches * IMG_BATCH
+        compute_rate = compute_runs * chunk_rows / compute_elapsed
+        log('imagenet scan: stream {:.1f} rows/s vs compute-only {:.1f} rows/s '
+            '-> efficiency {:.3f}'.format(stream_rate, compute_rate,
+                                          stream_rate / compute_rate))
+        results.update({
+            'imagenet_scan_rows_per_sec': round(stream_rate, 2),
+            'imagenet_scan_compute_rows_per_sec': round(compute_rate, 2),
+            'imagenet_scan_efficiency': round(stream_rate / compute_rate, 4),
+            'imagenet_scan_chunk_batches': chunk_batches,
+        })
+        from petastorm_tpu.benchmark.mfu import mfu_fields, xla_cost_flops
+        chunk_flops = xla_cost_flops(chunk_program, carry0, chunk)
+        if chunk_flops and stream_rate > 0:
+            results.update(mfu_fields('imagenet_scan_train', chunk_flops, steps=1,
+                                      elapsed_s=chunk_rows / stream_rate))
 
     def ensure_token_store(rows, seq_len):
         """Synthetic rolled-pattern token store (learnable, compressible) shared by
@@ -753,6 +882,11 @@ def child_main():
             '(loss {:.3f}, max drop {:.3f})'.format(
                 MOE_STEPS, MOE_BATCH, MOE_T, MOE_EXPERTS, elapsed, tokens_per_sec,
                 final_loss, drop))
+        from petastorm_tpu.benchmark.mfu import (
+            mfu_fields, moe_transformer_train_flops_per_step)
+        step_flops = moe_transformer_train_flops_per_step(
+            MOE_BATCH, MOE_T, vocab=256, embed=MOE_EMBED, layers=MOE_LAYERS,
+            num_experts=MOE_EXPERTS, num_selected=1, moe_every=1)
         results.update({
             'moe_train_tokens_per_sec': round(tokens_per_sec, 1),
             'moe_seq_len': MOE_T,
@@ -761,6 +895,7 @@ def child_main():
             'moe_model': 'MoETransformerLM(embed={},heads={},layers={})'.format(
                 MOE_EMBED, MOE_HEADS, MOE_LAYERS),
         })
+        results.update(mfu_fields('moe_train', step_flops, MOE_STEPS, elapsed))
 
     def run_flash():
         """Long-context compute section (VERDICT r2 item 6): train TransformerLM with
@@ -844,6 +979,11 @@ def child_main():
             '(no_fallback={}, loss {:.3f})'.format(
                 FLASH_STEPS, FLASH_BATCH, FLASH_T, elapsed, tokens_per_sec,
                 no_fallback, final_loss))
+        from petastorm_tpu.benchmark.mfu import (
+            mfu_fields, transformer_train_flops_per_step)
+        step_flops = transformer_train_flops_per_step(
+            FLASH_BATCH, FLASH_T, vocab=256, embed=FLASH_EMBED,
+            layers=FLASH_LAYERS)
         results.update({
             'flash_train_tokens_per_sec': round(tokens_per_sec, 1),
             'flash_seq_len': FLASH_T,
@@ -852,6 +992,7 @@ def child_main():
             'flash_model': 'TransformerLM(embed={},heads={},layers={})'.format(
                 FLASH_EMBED, FLASH_HEADS, FLASH_LAYERS),
         })
+        results.update(mfu_fields('flash_train', step_flops, FLASH_STEPS, elapsed))
 
     # ---------------------------------------------------------------- orchestration
     platform = jax.devices()[0].platform
@@ -994,6 +1135,7 @@ def child_main():
     run_section('bare_reader', run_bare_reader)
     run_section('mnist_inmem', run_mnist_inmem)
     run_section('imagenet_stream', run_imagenet_stream)
+    run_section('imagenet_scan', run_imagenet_scan)
     run_section('decode_delta', run_decode)
     run_section('flash', run_flash)
     run_section('moe', run_moe)
