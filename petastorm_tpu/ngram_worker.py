@@ -18,20 +18,32 @@ import numpy as np
 
 class NGramWindows(object):
     """Columnar window set of one rowgroup piece: ``starts[i]`` is the first row of
-    window i; every window spans ``length`` consecutive rows of ``columns``."""
+    window i; every window spans ``length`` consecutive rows of ``columns``.
+    ``item_id`` is the ventilated work item's ``(epoch, piece, drop_partition)`` —
+    the unit of NGram checkpoint/resume accounting (VERDICT r3 item 4); zero-window
+    pieces still publish (empty ``starts``) solely to carry it."""
 
-    __slots__ = ('columns', 'starts')
+    __slots__ = ('columns', 'starts', 'item_id')
 
-    def __init__(self, columns, starts):
+    def __init__(self, columns, starts, item_id=None):
         self.columns = columns
         self.starts = starts
+        self.item_id = item_id
 
     def __len__(self):
         return len(self.starts)
 
+    @property
+    def num_rows(self):
+        """Windows in this payload (a window is the NGram path's row unit)."""
+        return len(self.starts)
+
 
 def process_ngram_piece(worker, piece_index, fragment_path, row_group_id, partition_keys,
-                        worker_predicate, shuffle_row_drop_partition):
+                        worker_predicate, shuffle_row_drop_partition, epoch_index=0):
+    """Decode one ventilated rowgroup piece and form its NGram windows: returns an
+    :class:`NGramWindows` payload (possibly zero windows) tagged with the piece's
+    ``(epoch_index, piece_index, drop_partition)`` item id."""
     from petastorm_tpu.reader_worker import _take
     setup = worker._setup
     ngram = setup.ngram
@@ -68,8 +80,10 @@ def process_ngram_piece(worker, piece_index, fragment_path, row_group_id, partit
     starts = payload['starts']
 
     if setup.shuffle_rows and len(starts):
+        # Seeded per piece: replaying the piece reproduces the window order, which
+        # is what makes window-exact resume possible (seed=None degrades resume to
+        # piece-exact, same caveat as the row path).
         seed = None if setup.seed is None else (setup.seed + piece_index) % (2 ** 31)
         starts = starts[np.random.RandomState(seed).permutation(len(starts))]
-    if not len(starts):
-        return None
-    return NGramWindows(payload['columns'], starts)
+    item_id = (epoch_index, piece_index, shuffle_row_drop_partition[0])
+    return NGramWindows(payload['columns'], starts, item_id=item_id)

@@ -106,11 +106,12 @@ class RowGroupWorker(WorkerBase):
                 worker_predicate=None, shuffle_row_drop_partition=(0, 1), epoch_index=0):
         setup = self._setup
         if setup.ngram is not None:
-            batch = self._process_ngram(piece_index, fragment_path, row_group_id,
-                                        partition_keys, worker_predicate,
-                                        shuffle_row_drop_partition)
-            if batch:
-                self.publish_func(batch)
+            # Always published — a zero-window piece still carries its item_id so
+            # the reader's consumption accounting stays exact (same contract as the
+            # row path's empty ColumnarBatch below).
+            self.publish_func(self._process_ngram(
+                piece_index, fragment_path, row_group_id, partition_keys,
+                worker_predicate, shuffle_row_drop_partition, epoch_index))
             return
 
         predicate_token = _predicate_token(worker_predicate)
@@ -306,11 +307,11 @@ class RowGroupWorker(WorkerBase):
     # ----------------------------------------------------------------- ngram
 
     def _process_ngram(self, piece_index, fragment_path, row_group_id, partition_keys,
-                       worker_predicate, shuffle_row_drop_partition):
+                       worker_predicate, shuffle_row_drop_partition, epoch_index=0):
         from petastorm_tpu.ngram_worker import process_ngram_piece
         return process_ngram_piece(self, piece_index, fragment_path, row_group_id,
                                    partition_keys, worker_predicate,
-                                   shuffle_row_drop_partition)
+                                   shuffle_row_drop_partition, epoch_index)
 
 
 # ------------------------------------------------------------------ helpers

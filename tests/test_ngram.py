@@ -264,11 +264,16 @@ class TestNgramEndToEnd:
         assert set(w[0]._fields) == {'ts'}
         assert set(w[1]._fields) == {'ts', 'value', 'label'}
 
-    def test_ngram_resume_rejected(self, seq_dataset):
+    def test_ngram_state_dict_supported(self, seq_dataset):
+        # VERDICT r3 item 4: NGram readers checkpoint with the window as the row
+        # unit (full resume coverage lives in test_checkpoint.py).
         ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
-        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1) as reader:
-            with pytest.raises(ValueError, match='NGram'):
-                reader.state_dict()
+        with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
+                         num_epochs=1) as reader:
+            next(reader)
+            state = reader.state_dict()
+        assert state['version'] == 1
+        assert 'row_cursor' in state  # mid-piece: the window cursor is recorded
 
 
 class TestNgramDeviceLayer:
@@ -431,14 +436,14 @@ class TestNgramDeviceLayer:
             carry, aux = loader.scan_epochs(step, jnp.float32(0), num_epochs=2)
         assert np.isfinite(float(carry))
 
-    def test_loader_state_dict_rejected_for_ngram(self, seq_dataset):
+    def test_loader_state_dict_supported_for_ngram(self, seq_dataset):
+        # Window batches carry item identity (VERDICT r3 item 4), so the loader's
+        # delivery-exact accounting works for NGram like any columnar reader.
         from petastorm_tpu.parallel import JaxDataLoader
         ngram = NGram({0: ['ts'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
         with make_reader(seq_dataset, schema_fields=ngram, workers_count=1,
                          num_epochs=1) as reader:
             loader = JaxDataLoader(reader, batch_size=4, device_put=False)
-            with pytest.raises(ValueError):
-                loader.state_dict()  # before iteration (delivery state still unknown)
             next(iter(loader))
-            with pytest.raises(ValueError):
-                loader.state_dict()
+            state = loader.state_dict()
+        assert state['items_per_epoch'] == reader.items_per_epoch
