@@ -141,6 +141,10 @@ class DatasetConverter(object):
             if not silent:
                 raise
         _active_converters.pop(self.cache_dir_url, None)
+        # A deleted store must not be served to a later same-plan make_converter.
+        for key, conv in list(_spark_plan_converters.items()):
+            if conv is self:
+                del _spark_plan_converters[key]
 
 
 class _TfDatasetContextManager(object):
@@ -269,13 +273,44 @@ def make_converter(df, parent_cache_dir_url=None, rowgroup_size_mb=32, compressi
     return converter
 
 
+#: live Spark-branch converters by query-plan hash — IN-SESSION dedup only, like the
+#: reference's plan ``sameResult`` scoping (spark_dataset_converter.py:585-607): a
+#: plan hash identifies the query, not the data, so persisting it across sessions
+#: would serve stale rows after the source data changed.
+_spark_plan_converters = {}
+
+
 def _make_converter_spark(df, parent, rowgroup_size_mb):
+    """Spark-DataFrame branch: executors write the parquet (the data may not fit the
+    driver), then the driver embeds petastorm metadata (Unischema JSON + rowgroup
+    index — inferred from the files the executors wrote) via
+    :func:`materialize_dataset`, so the cache is a full petastorm_tpu store exactly
+    like the Arrow branch — ``make_reader`` works on it, not just
+    ``make_batch_reader``. Dedup keys on ``DataFrame.semanticHash`` (query-plan
+    identity), valid only within this session — see ``_spark_plan_converters``."""
+    try:
+        plan_key = df.semanticHash()
+    except Exception:  # semanticHash is best-effort; None disables in-session dedup
+        plan_key = None
+    if plan_key is not None and plan_key in _spark_plan_converters:
+        converter = _spark_plan_converters[plan_key]
+        logger.info('Converter reuse (same spark plan this session): %s',
+                    converter.cache_dir_url)
+        return converter
+
     cache_dir = '{}/{}'.format(parent, uuid.uuid4().hex)
     df.write.option('parquet.block.size', rowgroup_size_mb << 20).parquet(cache_dir)
-    from petastorm_tpu.etl.dataset_metadata import open_dataset
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset, open_dataset
+    from petastorm_tpu.unischema import Unischema
+    handle = open_dataset(cache_dir)
+    schema = Unischema.from_arrow_schema(handle.schema)
+    with materialize_dataset(cache_dir, schema):
+        pass  # files already written by the executors; exit embeds the metadata
     handle = open_dataset(cache_dir)
     files = sorted(f.path for f in handle.arrow_dataset.get_fragments())
     count = df.count()
     converter = DatasetConverter(cache_dir, files, count)
     _active_converters[cache_dir] = converter
+    if plan_key is not None:
+        _spark_plan_converters[plan_key] = converter
     return converter
