@@ -9,6 +9,8 @@ from petastorm_tpu.benchmark.throughput import READ_JAX, READ_PYTHON, reader_thr
 
 
 def main(argv=None):
+    """``petastorm-tpu-throughput`` console entry: parse args, run
+    :func:`petastorm_tpu.benchmark.throughput.reader_throughput`, print the report."""
     parser = argparse.ArgumentParser(
         description='Measure petastorm_tpu reader throughput on a dataset')
     parser.add_argument('dataset_url')

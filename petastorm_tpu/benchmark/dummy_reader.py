@@ -48,6 +48,8 @@ class DummyReader(object):
 
 
 def measure_loader(loader_factory, batches=100):
+    """Rows/sec through ``batches`` batches of the loader built by
+    ``loader_factory`` — the loader-overhead micro-benchmark's measuring loop."""
     loader = loader_factory()
     iterator = iter(loader)
     next(iterator)  # warmup
@@ -61,6 +63,8 @@ def measure_loader(loader_factory, batches=100):
 
 
 def main():
+    """Run the dummy-reader micro-bench over each loader adapter and print rates
+    (reference: petastorm/benchmark/dummy_reader.py)."""
     from petastorm_tpu.parallel.loader import JaxDataLoader
     from petastorm_tpu.pytorch import DataLoader
     for batch_size in (16, 256, 1024):

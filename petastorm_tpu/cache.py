@@ -15,6 +15,9 @@ MB = 1 << 20
 
 
 class CacheBase(object):
+    """Rowgroup-cache interface (reference: petastorm/cache.py): ``get`` with a
+    fill function; implementations decide storage and eviction."""
+
     def get(self, key, fill_cache_func):
         """Return the cached value for ``key``, calling ``fill_cache_func()`` and storing
         its result on a miss (reference: petastorm/cache.py:24-32)."""

@@ -281,6 +281,7 @@ def _scan_row_groups_per_file(handle):
 
 
 def common_metadata_path(handle):
+    """Path of the dataset's ``_common_metadata`` file under the handle's root."""
     return handle.root_path.rstrip('/') + '/_common_metadata'
 
 

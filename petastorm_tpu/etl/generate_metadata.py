@@ -44,6 +44,8 @@ def _has_embedded(handle):
 
 
 def main(argv=None):
+    """``petastorm-tpu-generate-metadata`` console entry: (re)write petastorm
+    metadata for an existing Parquet store (reference: etl/petastorm_generate_metadata.py)."""
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('dataset_url')
     parser.add_argument('--unischema-class',

@@ -10,6 +10,8 @@ from petastorm_tpu.etl import dataset_metadata
 
 
 def main(argv=None):
+    """``petastorm-tpu-metadata-util`` console entry: inspect a store's schema and
+    rowgroup index (reference: etl/metadata_util.py)."""
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('dataset_url')
     parser.add_argument('--skip-schema', action='store_true')

@@ -123,4 +123,5 @@ _INDEXER_TYPES = {'single_field': SingleFieldIndexer, 'field_not_null': FieldNot
 
 
 def indexer_from_json_dict(d):
+    """Rebuild an indexer from its ``to_json_dict()`` persistence form."""
     return _INDEXER_TYPES[d['type']].from_json_dict(d)

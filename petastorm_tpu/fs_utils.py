@@ -181,4 +181,6 @@ class FilesystemFactory(object):
 
 
 def make_filesystem_factory(url, storage_options=None):
+    """Picklable zero-arg factory resolving ``url``'s filesystem — what worker
+    processes ship instead of a live (unpicklable) filesystem object."""
     return FilesystemFactory(url, storage_options)

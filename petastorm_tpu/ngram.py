@@ -21,6 +21,12 @@ from petastorm_tpu.unischema import Unischema, UnischemaField, match_unischema_f
 
 
 class NGram(object):
+    """Sequence-window spec (reference: petastorm/ngram.py): ``{offset: fields}``
+    windows over timestamp-ordered rows, gated by ``delta_threshold``. Pass as
+    ``schema_fields`` to ``make_reader``; the row path yields ``{offset:
+    namedtuple}`` per window, the device path window-major sequence batches
+    (:meth:`windows_as_arrays`)."""
+
     def __init__(self, fields, delta_threshold, timestamp_field, timestamp_overlap=True):
         """
         :param fields: dict {offset(int): list of UnischemaField or regex str}

@@ -26,6 +26,9 @@ def _is_torch_tensor(value):
 
 
 class ShufflingBufferBase(object):
+    """Columnar shuffling-buffer interface (reference: petastorm/reader_impl/
+    shuffling_buffer.py): ``add_many`` columns in, ``retrieve`` rows out."""
+
     def add_many(self, columns):
         raise NotImplementedError()
 

@@ -13,6 +13,9 @@ import numpy as np
 
 
 class PredicateBase(object):
+    """Row-predicate interface (reference: petastorm/predicates.py): ``get_fields``
+    names the columns needed, ``do_include`` decides per row."""
+
     def get_fields(self):
         raise NotImplementedError()
 

@@ -3,6 +3,9 @@ fully functional here; the reference disables them at Reader level, reader.py:55
 
 
 class RowGroupSelectorBase(object):
+    """Rowgroup-selector interface (reference: petastorm/selectors.py) over built
+    rowgroup indexes."""
+
     def select_row_groups(self, index_dict):
         """Return the set of piece indexes to read, given {index_name: indexer}."""
         raise NotImplementedError()

@@ -14,6 +14,11 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+# Well-known graph op name carrying the shuffling queue's current size — monitoring
+# code fetches it with graph.get_tensor_by_name(RANDOM_SHUFFLING_QUEUE_SIZE + ':0')
+# (reference: tf_utils.py:45-47, same name for drop-in diagnostics compatibility).
+RANDOM_SHUFFLING_QUEUE_SIZE = 'random_shuffling_queue_size'
+
 # numpy -> tf dtype sanitization map (reference: tf_utils.py:27-96): TF has no uint16/32
 # kernels for most ops and no Decimal/datetime; strings pass through as tf.string.
 _PROMOTIONS = {
@@ -192,7 +197,7 @@ def _flat_graph_values(next_fn, fields, shuffling_queue_capacity, min_after_dequ
         runner = tf.compat.v1.train.QueueRunner(queue, [enqueue])
         tf.compat.v1.train.add_queue_runner(runner)
         # Well-known op name so queue depth is observable (reference: tf_utils.py:45-47).
-        tf.identity(queue.size(), name='random_shuffling_queue_size')
+        tf.identity(queue.size(), name=RANDOM_SHUFFLING_QUEUE_SIZE)
         values = queue.dequeue()
         if len(fields) == 1:
             # dequeue() returns a lone Tensor (not a list) for single-component queues.

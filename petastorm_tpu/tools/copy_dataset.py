@@ -57,6 +57,9 @@ def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
 
 
 def main(argv=None):
+    """``petastorm-tpu-copy-dataset`` console entry: re-materialize a store subset
+    (field regexes / not-null filter) to a new location (reference:
+    tools/copy_dataset.py)."""
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('source_url')
     parser.add_argument('target_url')

@@ -7,6 +7,10 @@ from petastorm_tpu.workers import EmptyResultError, VentilatedItemProcessedMessa
 
 
 class DummyPool(object):
+    """Zero-parallelism pool: ventilated items are processed synchronously inside
+    ``get_results`` on the caller's thread (reference: workers_pool/dummy_pool.py)
+    — determinism for tests and debugging."""
+
     def __init__(self, results_queue_size=None):
         self._ventilator_queue = deque()
         self._results = deque()

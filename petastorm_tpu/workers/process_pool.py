@@ -35,6 +35,10 @@ class WorkerTerminationError(Exception):
 
 
 class ProcessPool(object):
+    """Spawned-process worker pool over a ZMQ ventilator/sink pair (reference:
+    workers_pool/process_pool.py): dill-bootstrapped spawn (never fork), Arrow-IPC
+    or pickle wire, orphan watchdog, exception propagation."""
+
     def __init__(self, workers_count, results_queue_size=50, zmq_copy_buffers=False,
                  payload_serializer=None):
         """``payload_serializer`` picks the wire format for worker results (reference:

@@ -21,6 +21,8 @@ def _watch_parent(parent_pid):
 
 
 def main(bootstrap_path):
+    """Spawned worker-process entry: load the dill bootstrap file, connect the ZMQ
+    sockets, loop ventilated items until the stop message."""
     with open(bootstrap_path, 'rb') as f:
         bootstrap = pickle.load(f)
     try:

@@ -1,0 +1,91 @@
+"""Documentation-coverage gate (VERDICT r3 item 8 / missing #4).
+
+The reference gates CI on a sphinx autodoc build (`/root/reference/docs/`,
+readthedocs.yml + unittest.yml sphinx step); this repo documents the API by hand in
+docs/api.md. This gate keeps that honest and machine-checked, locally and in CI:
+
+- every public module under ``petastorm_tpu`` has a module docstring;
+- every public class and function defined in those modules has a docstring;
+- docs/api.md mentions every public module (nothing ships undocumented).
+"""
+import importlib
+import inspect
+import os
+import pkgutil
+
+import pytest
+
+import petastorm_tpu
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Modules whose import requires an optional heavyweight dependency present in the
+# image; none are skipped silently — this list is the explicit manifest.
+OPTIONAL_IMPORT_MODULES = {
+    'petastorm_tpu.tf_utils': 'tensorflow',
+    'petastorm_tpu.pytorch': 'torch',
+    'petastorm_tpu.spark_utils': 'pyspark',
+    'petastorm_tpu.tools.spark_session_cli': 'pyspark',
+}
+
+
+def _walk_public_modules():
+    names = []
+    for info in pkgutil.walk_packages(petastorm_tpu.__path__,
+                                      prefix='petastorm_tpu.'):
+        if any(part.startswith('_') for part in info.name.split('.')[1:]):
+            continue
+        names.append(info.name)
+    return sorted(names)
+
+
+PUBLIC_MODULES = _walk_public_modules()
+
+
+def _import(name):
+    dep = OPTIONAL_IMPORT_MODULES.get(name)
+    if dep is not None:
+        pytest.importorskip(dep)
+    return importlib.import_module(name)
+
+
+def test_module_manifest_is_nonempty():
+    # the walker found the real package, not an empty namespace
+    assert len(PUBLIC_MODULES) > 25, PUBLIC_MODULES
+
+
+@pytest.mark.parametrize('module_name', PUBLIC_MODULES)
+def test_module_has_docstring(module_name):
+    module = _import(module_name)
+    assert (module.__doc__ or '').strip(), \
+        '{} has no module docstring'.format(module_name)
+
+
+@pytest.mark.parametrize('module_name', PUBLIC_MODULES)
+def test_public_callables_documented(module_name):
+    module = _import(module_name)
+    undocumented = []
+    for name, obj in vars(module).items():
+        if name.startswith('_'):
+            continue
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, '__module__', None) != module_name:
+            continue  # re-export; documented where defined
+        if not (inspect.getdoc(obj) or '').strip():
+            undocumented.append(name)
+    assert not undocumented, \
+        '{}: public callables without docstrings: {}'.format(
+            module_name, sorted(undocumented))
+
+
+def test_api_md_mentions_every_public_module():
+    with open(os.path.join(REPO_ROOT, 'docs', 'api.md')) as f:
+        api_text = f.read()
+    missing = []
+    for module_name in PUBLIC_MODULES:
+        short = module_name.replace('petastorm_tpu.', '')
+        if short not in api_text and module_name not in api_text:
+            missing.append(module_name)
+    assert not missing, \
+        'docs/api.md does not mention public modules: {}'.format(missing)

@@ -135,6 +135,27 @@ def test_tf_tensors_ngram_with_shuffling_queue(tmp_path):
         assert int(value[1].ts) == int(value[0].ts) + 1
 
 
+def test_shuffling_queue_size_op_addressable_by_name(synthetic_dataset):
+    """The queue-depth diagnostic op is addressable by its well-known name
+    (reference: tf_utils.py:45-47,205-209) — monitoring code reads it without any
+    handle to the queue object."""
+    from petastorm_tpu.tf_utils import RANDOM_SHUFFLING_QUEUE_SIZE
+    with make_reader(synthetic_dataset.url, schema_fields=['id'], workers_count=1,
+                     num_epochs=None, shuffle_row_groups=False) as reader:
+        with tf.Graph().as_default() as graph:
+            row = tf_tensors(reader, shuffling_queue_capacity=8, min_after_dequeue=2)
+            size_tensor = graph.get_tensor_by_name(
+                RANDOM_SHUFFLING_QUEUE_SIZE + ':0')
+            with tf.compat.v1.Session() as session:
+                coord = tf.train.Coordinator()
+                threads = tf.compat.v1.train.start_queue_runners(session, coord)
+                session.run(row)
+                size = session.run(size_tensor)
+                coord.request_stop()
+                coord.join(threads, stop_grace_period_secs=5)
+    assert 0 <= int(size) <= 8
+
+
 # ------------------------------------------------------- dtype sanitization edges
 
 class TestDtypeSanitization:
