@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Round-4 tunnel watcher (VERDICT r3, next-round item 1).
+
+Probes the axon TPU tunnel on a fixed cadence; every probe attempt is appended
+with a timestamp to ``r04_probe_log.txt`` so that — if the tunnel never rises —
+the committed log itself is the round's evidence. The moment a probe succeeds,
+runs the full ``bench.py`` (with ``BENCH_SKIP_CPU_FALLBACK=1``: this loop only
+wants TPU lines) and appends the emitted JSON line to ``r04_tpu_runs.jsonl``
+when ``platform`` is not cpu. After a successful capture it keeps watching and
+re-captures on a longer cadence, so the round accumulates multiple TPU lines
+like ``r02_tpu_runs.jsonl`` did.
+
+Run from the repo root:  python bench_results/probe_loop_r04.py
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PROBE_LOG = os.path.join(HERE, 'r04_probe_log.txt')
+RUNS = os.path.join(HERE, 'r04_tpu_runs.jsonl')
+PROBE_TIMEOUT_S = int(os.environ.get('PROBE_TIMEOUT', 90))
+PROBE_EVERY_S = int(os.environ.get('PROBE_EVERY', 240))
+RECAPTURE_EVERY_S = int(os.environ.get('RECAPTURE_EVERY', 2400))
+BENCH_TIMEOUT_S = int(os.environ.get('PROBE_BENCH_TIMEOUT', 4200))
+TOTAL_S = int(os.environ.get('PROBE_TOTAL', int(11.0 * 3600)))
+
+
+def now():
+    return datetime.datetime.now().isoformat(timespec='seconds')
+
+
+def plog(msg):
+    line = '{} {}'.format(now(), msg)
+    print(line, flush=True)
+    with open(PROBE_LOG, 'a') as f:
+        f.write(line + '\n')
+
+
+def probe():
+    """True iff a non-cpu jax backend initializes within the timeout."""
+    code = ("import jax; ds = jax.devices(); "
+            "print('PROBE_OK' if ds and ds[0].platform != 'cpu' else 'PROBE_CPU')")
+    try:
+        out = subprocess.run([sys.executable, '-c', code], cwd=REPO,
+                             capture_output=True, text=True,
+                             timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        plog('probe TIMEOUT after {}s'.format(PROBE_TIMEOUT_S))
+        return False
+    ok = 'PROBE_OK' in out.stdout
+    plog('probe {} (rc={} stdout={!r})'.format(
+        'UP' if ok else 'DOWN', out.returncode, out.stdout.strip()[:120]))
+    return ok
+
+
+def run_bench():
+    env = dict(os.environ)
+    env['BENCH_SKIP_CPU_FALLBACK'] = '1'
+    plog('bench START')
+    t0 = time.time()
+    try:
+        out = subprocess.run([sys.executable, 'bench.py'], cwd=REPO,
+                             capture_output=True, text=True,
+                             timeout=BENCH_TIMEOUT_S, env=env)
+    except subprocess.TimeoutExpired as exc:
+        plog('bench TIMEOUT after {}s'.format(BENCH_TIMEOUT_S))
+        # salvage any PARTIAL_JSON the parent printed before dying
+        stdout = (exc.stdout or b'')
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode('utf-8', 'replace')
+        _append_lines(stdout, elapsed=time.time() - t0, salvaged=True)
+        return False
+    plog('bench DONE rc={} in {:.0f}s'.format(out.returncode, time.time() - t0))
+    if out.returncode != 0:
+        tail = out.stderr.strip().splitlines()[-8:]
+        for line in tail:
+            plog('bench-stderr: ' + line[:200])
+        return False
+    return _append_lines(out.stdout, elapsed=time.time() - t0)
+
+
+def _append_lines(stdout, elapsed, salvaged=False):
+    got = False
+    for line in stdout.strip().splitlines():
+        line = line.strip()
+        if not line.startswith('{'):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get('platform') == 'cpu':
+            plog('bench produced a CPU line — NOT appending')
+            continue
+        rec['_captured_at'] = now()
+        rec['_bench_elapsed_s'] = round(elapsed, 1)
+        if salvaged:
+            rec['_salvaged_from_timeout'] = True
+        with open(RUNS, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+        plog('bench line APPENDED to {} (metric={} value={})'.format(
+            os.path.basename(RUNS), rec.get('metric'), rec.get('value')))
+        got = True
+    if not got and not salvaged:
+        plog('bench rc=0 but no appendable JSON line')
+    return got
+
+
+def main():
+    plog('watcher start: probe every {}s, recapture every {}s, total {}s'.format(
+        PROBE_EVERY_S, RECAPTURE_EVERY_S, TOTAL_S))
+    t_start = time.time()
+    last_capture = 0.0
+    while time.time() - t_start < TOTAL_S:
+        if probe():
+            if time.time() - last_capture >= RECAPTURE_EVERY_S:
+                if run_bench():
+                    last_capture = time.time()
+                else:
+                    # failed mid-run (tunnel flake): brief backoff, then re-probe
+                    time.sleep(60)
+                continue  # re-probe immediately after a capture decision
+        time.sleep(PROBE_EVERY_S)
+    plog('watcher done after {:.0f}s'.format(time.time() - t_start))
+
+
+if __name__ == '__main__':
+    main()
