@@ -19,7 +19,7 @@ from petastorm_tpu.fs_utils import (as_arrow_filesystem, check_hdfs_driver,
                                     make_filesystem_factory,
                                     normalize_dataset_url_or_urls)
 from petastorm_tpu.reader_worker import ColumnarBatch, RowGroupWorker, WorkerSetup
-from petastorm_tpu.unischema import Unischema, match_unischema_fields
+from petastorm_tpu.unischema import Unischema
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.dummy_pool import DummyPool
 from petastorm_tpu.workers.thread_pool import ThreadPool

@@ -21,7 +21,6 @@ import pyarrow.dataset as pads
 
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.transform import transform_schema
-from petastorm_tpu.unischema import Unischema
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 logger = logging.getLogger(__name__)

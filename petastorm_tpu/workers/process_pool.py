@@ -20,8 +20,7 @@ import sys
 import tempfile
 import time
 
-from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
-                                   VentilatedItemProcessedMessage)
+from petastorm_tpu.workers import EmptyResultError, TimeoutWaitingForResultError
 
 logger = logging.getLogger(__name__)
 
