@@ -1069,6 +1069,7 @@ def child_main():
         # ONE loader across epochs (reader.reset() between passes): the compiled
         # chunk programs live on the loader instance, so epochs 1..N measure the
         # steady state while epoch 0 absorbs the compiles.
+        scan_chunk = int(os.environ.get('BENCH_SCAN_CHUNK', 8))
         reader = make_reader(url, workers_count=WORKERS, shuffle_row_groups=True,
                              seed=42, num_epochs=1)
         loader = JaxDataLoader(reader, batch_size=BATCH_SIZE)
@@ -1076,7 +1077,7 @@ def child_main():
         for epoch in range(EPOCHS + 1):  # epoch 0 = compile warmup; auto-reset after
             start = time.perf_counter()
             (params, opt_state), aux = loader.scan_stream(
-                step, (params, opt_state), chunk_batches=8, seed=epoch)
+                step, (params, opt_state), chunk_batches=scan_chunk, seed=epoch)
             rows = sum(int(np.asarray(a).shape[0]) for a in aux) * BATCH_SIZE
             float(np.asarray(aux[-1])[-1])  # gate on device readback
             elapsed = time.perf_counter() - start
@@ -1093,17 +1094,17 @@ def child_main():
             'streaming_scan_rows_per_sec': round(value, 2),
             'streaming_scan_vs_baseline':
                 round(value / REFERENCE_BASELINE_ROWS_PER_SEC, 3),
-            'streaming_scan_chunk_batches': 8,
+            'streaming_scan_chunk_batches': scan_chunk,
         })
         rng = np.random.RandomState(1)
         chunk = {
             'image': jnp.asarray(rng.randint(
-                0, 255, (8, BATCH_SIZE, 28, 28)).astype(np.uint8)),
+                0, 255, (scan_chunk, BATCH_SIZE, 28, 28)).astype(np.uint8)),
             'digit': jnp.asarray(rng.randint(
-                0, 10, (8, BATCH_SIZE)).astype(np.int64)),
+                0, 10, (scan_chunk, BATCH_SIZE)).astype(np.int64)),
         }
         compute_rate, _ = compute_reference_rate(
-            step, (params, opt_state), chunk, 8 * BATCH_SIZE, runs=4)
+            step, (params, opt_state), chunk, scan_chunk * BATCH_SIZE, runs=4)
         log('scan_stream: streamed {:.0f} rows/s vs compute-only {:.0f} rows/s '
             '-> efficiency {:.3f}'.format(value, compute_rate, value / compute_rate))
         results.update({

@@ -1,0 +1,33 @@
+#!/bin/sh
+# Targeted TPU measurements beyond the watcher's full-bench capture (run manually
+# when the tunnel is up; each run appends its own labeled JSON line via tee).
+# Sections are BENCH_SECTIONS subsets so a flaky tunnel loses one sweep point,
+# not the whole sweep.
+set -x
+cd "$(dirname "$0")/.."
+OUT=bench_results/r04_tpu_extras.jsonl
+
+# flash tile-size sweep at T=8192 (MXU-aligned candidates)
+for BQ in 128 256 512; do
+  for BK in 128 256 512; do
+    BENCH_SKIP_CPU_FALLBACK=1 BENCH_SECTIONS=flash \
+    BENCH_FLASH_BLOCK_Q=$BQ BENCH_FLASH_BLOCK_K=$BK \
+    timeout 900 python bench.py 2>>bench_results/r04_extras_stderr.log \
+      | sed "s/^{/{\"sweep\": \"flash_b${BQ}x${BK}\", /" >> "$OUT"
+  done
+done
+
+# scan_stream chunk-size sweep (dispatch amortization curve)
+for CB in 4 16 64; do
+  BENCH_SKIP_CPU_FALLBACK=1 BENCH_SECTIONS=mnist_scan_stream BENCH_EPOCHS=3 \
+  BENCH_SCAN_CHUNK=$CB \
+  timeout 900 python bench.py 2>>bench_results/r04_extras_stderr.log \
+    | sed "s/^{/{\"sweep\": \"scan_chunk${CB}\", /" >> "$OUT"
+done
+
+# imagenet scan chunk sweep
+for CB in 2 4 8; do
+  BENCH_SKIP_CPU_FALLBACK=1 BENCH_SECTIONS=imagenet_scan BENCH_IMG_CHUNK=$CB \
+  timeout 1200 python bench.py 2>>bench_results/r04_extras_stderr.log \
+    | sed "s/^{/{\"sweep\": \"imagenet_chunk${CB}\", /" >> "$OUT"
+done
