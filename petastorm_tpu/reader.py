@@ -291,6 +291,7 @@ class Reader(object):
         # SURVEY.md §5.4 prescribes over the reference's epoch-only restart granularity.
         self._items_per_epoch = len(items)
         self._accounting_lock = threading.Lock()
+        self._next_lock = threading.Lock()  # concurrent next() support (see __next__)
         self._epochs_consumed = 0
         self._consumed_by_epoch = {}  # absolute epoch -> set of (piece, drop)
         iterations = num_epochs
@@ -360,7 +361,12 @@ class Reader(object):
         if self._stopped:
             raise RuntimeError('Trying to read a sample from a stopped reader')
         try:
-            result = self._results_reader.read_next(self._pool)
+            # Serialized: the results reader buffers a batch across calls, and the
+            # reference supports concurrent next() from many threads
+            # (reference test_end_to_end.py:832-842) — per-row lock cost is noise
+            # next to namedtuple assembly.
+            with self._next_lock:
+                result = self._results_reader.read_next(self._pool)
             return result
         except EmptyResultError:
             self.last_row_consumed = True
@@ -484,8 +490,11 @@ class Reader(object):
         cursor = None
         if isinstance(self._results_reader, (_RowResultsReader, _NGramResultsReader)):
             # NGram: the work-item unit is identical; the cursor's row index counts
-            # WINDOWS (the NGram path's row unit) instead of rows.
-            cursor = self._results_reader.cursor()
+            # WINDOWS (the NGram path's row unit) instead of rows. Under _next_lock:
+            # with concurrent next() threads, an unlocked read could catch the
+            # last-row/acknowledge window mid-flight and snapshot a torn position.
+            with self._next_lock:
+                cursor = self._results_reader.cursor()
         with self._accounting_lock:
             state = {
                 'version': 1,

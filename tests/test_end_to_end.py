@@ -377,3 +377,42 @@ def test_batch_reader_warns_on_unischema_store(synthetic_dataset):
 def test_make_reader_on_plain_store_raises(scalar_dataset):
     with pytest.raises(RuntimeError, match='make_batch_reader'):
         make_reader(scalar_dataset.url)
+
+
+def test_multithreaded_reads(synthetic_dataset):
+    """Concurrent next() from many threads covers the dataset exactly once
+    (reference: test_end_to_end.py:832-842 — migrating users rely on this)."""
+    from concurrent.futures import ThreadPoolExecutor
+    with make_reader(synthetic_dataset.url, workers_count=4, num_epochs=1) as reader:
+        with ThreadPoolExecutor(max_workers=10) as executor:
+            futures = [executor.submit(lambda: next(reader))
+                       for _ in range(len(synthetic_dataset.rows))]
+            results = [f.result() for f in futures]
+    assert len(results) == len(synthetic_dataset.rows)
+    assert set(r.id for r in results) == set(d['id'] for d in synthetic_dataset.rows)
+
+
+def test_read_moved_dataset(tmp_path):
+    """A materialized store survives a directory MOVE — the embedded metadata holds
+    relative paths only (reference: test_end_to_end.py:306-315). A dedicated store
+    is written and genuinely moved (source removed), so an absolute path anywhere
+    in the metadata or index would fail the relocated read."""
+    import os
+    import shutil
+    from test_common import create_test_dataset
+    src = str(tmp_path / 'original')
+    rows = create_test_dataset(src, num_rows=30)
+    dst = str(tmp_path / 'relocated')
+    shutil.move(src, dst)
+    assert not os.path.exists(src)
+    with make_reader('file://' + dst, workers_count=1, num_epochs=1) as reader:
+        ids = sorted(row.id for row in reader)
+    assert ids == sorted(r['id'] for r in rows)
+
+
+def test_invalid_schema_field_name_raises(synthetic_dataset):
+    """schema_fields naming nothing in the store must fail loudly, not read zero
+    columns (reference: test_end_to_end.py:527-540)."""
+    with pytest.raises(ValueError):
+        make_reader(synthetic_dataset.url, schema_fields=['no_such_field_xyz'],
+                    workers_count=1)
