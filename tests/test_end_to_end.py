@@ -416,3 +416,19 @@ def test_invalid_schema_field_name_raises(synthetic_dataset):
     with pytest.raises(ValueError):
         make_reader(synthetic_dataset.url, schema_fields=['no_such_field_xyz'],
                     workers_count=1)
+
+
+def test_use_persisted_codec_not_user_provided(synthetic_dataset):
+    """schema_fields may contain UnischemaField OBJECTS; they select fields — the
+    PERSISTED codec/shape always decodes the data (reference:
+    test_end_to_end.py:543-551; explicit reinterpretation is what field_overrides
+    is for)."""
+    from petastorm_tpu.codecs import CompressedNdarrayCodec
+    from petastorm_tpu.unischema import UnischemaField
+    wrong = UnischemaField('matrix', np.uint16, (9, 9), CompressedNdarrayCodec(),
+                           False)
+    with _reader(synthetic_dataset.url, schema_fields=[wrong]) as reader:
+        row = next(reader)
+    # persisted spec: float32 (4, 3) NdarrayCodec (test_common.TestSchema)
+    assert row.matrix.shape == (4, 3)
+    assert row.matrix.dtype == np.float32
