@@ -432,3 +432,48 @@ def test_use_persisted_codec_not_user_provided(synthetic_dataset):
     # persisted spec: float32 (4, 3) NdarrayCodec (test_common.TestSchema)
     assert row.matrix.shape == (4, 3)
     assert row.matrix.dtype == np.float32
+
+
+class TestHivePartitionedStore:
+    """Hive-partitioned (directory-keyed) Parquet stores (reference:
+    test_parquet_reader.py:106-116,213-222): the partition column is reconstructed
+    from directory keys, partition-key predicates prune fragments up front, and
+    reads that exclude the partition column never query it."""
+
+    @pytest.fixture(scope='class')
+    def partitioned_store(self, tmp_path_factory):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        root = str(tmp_path_factory.mktemp('hive') / 'ds')
+        table = pa.table({
+            'id': np.arange(100, dtype=np.int64),
+            'val': np.arange(100, dtype=np.float64) / 2,
+            'city': pa.array(['nyc', 'sfo', 'ams', 'ber'] * 25),
+        })
+        pq.write_to_dataset(table, root, partition_cols=['city'])
+        return 'file://' + root
+
+    def test_partition_column_reconstructed(self, partitioned_store):
+        with make_batch_reader(partitioned_store, workers_count=1) as reader:
+            ids, cities = [], []
+            for batch in reader:
+                ids.extend(np.asarray(batch.id).tolist())
+                cities.extend(str(c) for c in np.asarray(batch.city))
+        assert sorted(ids) == list(range(100))
+        assert sorted(set(cities)) == ['ams', 'ber', 'nyc', 'sfo']
+
+    def test_string_partition_predicate_prunes(self, partitioned_store):
+        with make_batch_reader(partitioned_store, workers_count=1,
+                               predicate=in_lambda(['city'],
+                                                   lambda c: c == 'sfo')) as reader:
+            rows = [i for b in reader for i in np.asarray(b.id).tolist()]
+        assert len(rows) == 25
+        assert all(i % 4 == 1 for i in rows)  # 'sfo' rows are id % 4 == 1
+
+    def test_partitioned_field_not_queried(self, partitioned_store):
+        # selecting only data columns must not try to read the partition key from
+        # the parquet files (it exists only in directory names)
+        with make_batch_reader(partitioned_store, workers_count=1,
+                               schema_fields=['id', 'val']) as reader:
+            batch = next(reader)
+        assert set(batch._fields) == {'id', 'val'}
