@@ -50,3 +50,8 @@ def _random_value(field, shape, rng, string_length):
         return data if np.isscalar(data) or isinstance(data, np.generic) \
             else dtype.type(data)
     return np.asarray(data, dtype=dtype).reshape(shape)
+
+
+#: Reference-name alias (petastorm/generator.py:21 ``generate_datapoint``) for
+#: drop-in migration; same callable.
+generate_datapoint = generate_random_datapoint
