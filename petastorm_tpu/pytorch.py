@@ -51,13 +51,19 @@ def _writable_contiguous(arr):
 
 
 def decimal_friendly_collate(rows):
-    """Collate a list of row dicts into a dict of stacked torch tensors (reference:
-    pytorch.py:68-90)."""
+    """Collate a list of rows (dicts, tuples/namedtuples, or leaves) into stacked
+    torch tensors with the same nesting (reference: pytorch.py:68-90 — its collate
+    recurses into mappings AND tuples)."""
     import torch
     first = rows[0]
     if isinstance(first, Mapping):
         return {name: decimal_friendly_collate([row[name] for row in rows])
                 for name in first}
+    if isinstance(first, tuple):
+        collated = [decimal_friendly_collate(list(col)) for col in zip(*rows)]
+        if hasattr(first, '_fields'):  # namedtuple: rebuild the same row type
+            return type(first)(*collated)
+        return type(first)(collated)
     sanitized = [_sanitize_value('<collate>', v) for v in rows]
     return torch.as_tensor(np.stack(sanitized))
 

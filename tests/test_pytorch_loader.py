@@ -131,3 +131,36 @@ def test_weighted_sampling_validates_schemas(synthetic_dataset):
         for r in (r1, r2):
             r.stop()
             r.join()
+
+
+def test_decimal_friendly_collate_dicts_and_tuples():
+    """Decimal values survive collate into float tensors whether nested in dicts or
+    tuples (reference: test_pytorch_dataloader.py:126-152)."""
+    import decimal
+
+    import torch
+
+    from petastorm_tpu.pytorch import decimal_friendly_collate
+    rows = [{'d': decimal.Decimal('1.5'), 'x': np.int64(1)},
+            {'d': decimal.Decimal('2.5'), 'x': np.int64(2)}]
+    out = decimal_friendly_collate(rows)
+    assert torch.is_tensor(out['d'])
+    np.testing.assert_allclose(out['d'].numpy(), [1.5, 2.5])
+    tuples = [(decimal.Decimal('0.25'), np.float32(1.0)),
+              (decimal.Decimal('0.75'), np.float32(2.0))]
+    out_t = decimal_friendly_collate(tuples)
+    np.testing.assert_allclose(out_t[0].numpy(), [0.25, 0.75])
+
+
+def test_dataloader_reiteration_after_exhaustion(synthetic_dataset):
+    """iter() works repeatedly on the same loader: each pass re-reads the store
+    (reference: test_pytorch_dataloader.py:243-259)."""
+    from petastorm_tpu.pytorch import DataLoader
+    with make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                     schema_fields=['id'], shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=10)
+        first = sorted(int(i) for b in loader for i in b['id'])
+        second = sorted(int(i) for b in loader for i in b['id'])
+    expected = sorted(r['id'] for r in synthetic_dataset.rows)
+    assert first == expected
+    assert second == expected
