@@ -260,6 +260,13 @@ class TestNgramResumeProperty:
         if TestNgramResumeProperty._baseline is None:
             TestNgramResumeProperty._baseline, _ = self._read(url)
         baseline = TestNgramResumeProperty._baseline
+        assert len(baseline) == 27  # 3 pieces x (10 rows -> 9 two-row windows)
         first, state = self._read(url, limit=cut)
+        if cut >= len(baseline):
+            # Fully consumed: resuming a finished stream must fail loudly, the
+            # same contract as the row path (reader.py resume validation).
+            with pytest.raises(ValueError, match='already consumed'):
+                self._read(url, resume_state=state)
+            return
         rest, _ = self._read(url, resume_state=state)
         assert first + rest == baseline, 'cut at {}'.format(cut)
