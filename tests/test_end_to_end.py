@@ -477,3 +477,21 @@ class TestHivePartitionedStore:
                                schema_fields=['id', 'val']) as reader:
             batch = next(reader)
         assert set(batch._fields) == {'id', 'val'}
+
+
+def test_invalid_pool_and_cache_types_rejected(synthetic_dataset):
+    """Bad reader_pool_type / cache_type fail loudly at construction (reference:
+    test_reader.py:81-91)."""
+    with pytest.raises(ValueError, match='reader_pool_type'):
+        make_reader(synthetic_dataset.url, reader_pool_type='no-such-pool')
+    with pytest.raises(ValueError, match='cache_type'):
+        make_reader(synthetic_dataset.url, cache_type='no-such-cache')
+
+
+def test_reader_diagnostics_surface(synthetic_dataset):
+    """Reader.diagnostics exposes the pool's counters (reference:
+    test_reader.py:40-47)."""
+    with _reader(synthetic_dataset.url, reader_pool_type='thread') as reader:
+        next(reader)
+        diag = reader.diagnostics
+    assert isinstance(diag, dict) and diag
