@@ -55,7 +55,7 @@ def make_label_transform(class_to_label, image_field_spec):
 def train(dataset_url, batch_size=8, epochs=1, learning_rate=1e-3,
           stage_sizes=(1, 1, 1, 1), num_filters=16, on_chip_decode=False,
           image_hw=IMAGE_HW, dct_quality=90, reader_pool_type='thread',
-          workers_count=4, prefetch=2, verbose=True):
+          workers_count=4, prefetch=2, scan_chunk=0, verbose=True):
     """``on_chip_decode=True`` reads a DCT-domain store (generate with ``--dct-hw``)
     through a field override so workers ship raw int16 coefficient blocks; dequant +
     IDCT + color conversion then run inside the jitted train step on the device
@@ -109,13 +109,34 @@ def train(dataset_url, batch_size=8, epochs=1, learning_rate=1e-3,
                      **reader_kwargs) as reader:
         loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True,
                                prefetch=prefetch)
-        for step, batch in enumerate(loader):
-            rng, step_rng = jax.random.split(rng)
-            params, batch_stats, opt_state, loss = train_step(
-                params, batch_stats, opt_state, step_rng,
-                batch['image'], batch['label'])
+        if scan_chunk:
+            # Compiled-chunk streaming: one upload + one dispatch per scan_chunk
+            # batches (JaxDataLoader.scan_stream) — the dispatch-bound config for
+            # larger-than-HBM stores; the augmentation rng rides the carry.
+            def scan_body(carry, batch):
+                params, batch_stats, opt_state, rng = carry
+                rng, step_rng = jax.random.split(rng)
+                params, batch_stats, opt_state, loss = train_step(
+                    params, batch_stats, opt_state, step_rng,
+                    batch['image'], batch['label'])
+                return (params, batch_stats, opt_state, rng), loss
+
+            (params, batch_stats, opt_state, rng), losses = loader.scan_stream(
+                scan_body, (params, batch_stats, opt_state, rng),
+                chunk_batches=scan_chunk, seed=0)
+            loss = losses[-1][-1] if losses else None
             if verbose:
-                print('step {} loss {:.4f}'.format(step, loss))
+                for chunk in losses:
+                    for l in np.asarray(chunk):
+                        print('loss {:.4f}'.format(float(l)))
+        else:
+            for step, batch in enumerate(loader):
+                rng, step_rng = jax.random.split(rng)
+                params, batch_stats, opt_state, loss = train_step(
+                    params, batch_stats, opt_state, step_rng,
+                    batch['image'], batch['label'])
+                if verbose:
+                    print('step {} loss {:.4f}'.format(step, loss))
         stats = loader.stats.as_dict()
         if verbose:
             print('input pipeline stats:', stats)
@@ -139,12 +160,16 @@ def main():
                              'Arrow IPC wire; the larger-than-HBM streaming config)')
     parser.add_argument('--workers', type=int, default=4)
     parser.add_argument('--prefetch', type=int, default=2)
+    parser.add_argument('--scan-chunk', type=int, default=0,
+                        help='>0: drive training through scan_stream with this '
+                             'many batches per compiled chunk (one H2D + one '
+                             'dispatch per chunk)')
     args = parser.parse_args()
     train(args.dataset_url, batch_size=args.batch_size, epochs=args.epochs,
           on_chip_decode=args.on_chip_decode, image_hw=args.image_hw,
           stage_sizes=tuple(args.stage_sizes), num_filters=args.num_filters,
           reader_pool_type=args.pool, workers_count=args.workers,
-          prefetch=args.prefetch)
+          prefetch=args.prefetch, scan_chunk=args.scan_chunk)
 
 
 if __name__ == '__main__':

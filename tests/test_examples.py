@@ -308,3 +308,12 @@ def test_converter_tensorflow_example(tmp_path):
     from examples.converter.tensorflow_converter_example import run
     loss = run(cache_dir=str(tmp_path), steps=5)
     assert np.isfinite(loss)
+
+
+def test_imagenet_jax_trains_with_scan_chunk(dct_imagenet_dataset):
+    """--scan-chunk drives the same training through compiled chunk programs
+    (scan_stream): one upload + one dispatch per chunk, on-chip decode included."""
+    from examples.imagenet.jax_example import train
+    _, _, loss, _ = train(dct_imagenet_dataset, batch_size=4, epochs=1,
+                          on_chip_decode=True, scan_chunk=2, verbose=False)
+    assert loss is not None and np.isfinite(loss)
