@@ -187,3 +187,33 @@ class TestArrowInference:
         arrow_schema = pa.schema([pa.field('bad', pa.list_(pa.list_(pa.int32())))])
         with pytest.raises(ValueError):
             Unischema.from_arrow_schema(arrow_schema, omit_unsupported_fields=False)
+
+
+class TestReferenceEdgeParity:
+    """Edge behaviors the reference pins (test_unischema.py:field-name conflicts,
+    mixed-view duplicates)."""
+
+    def test_field_name_conflicting_with_attribute(self):
+        # A field named like a Unischema attribute/method must not shadow it:
+        # the real API wins, the field stays reachable via .fields['name'].
+        schema = Unischema('S', [
+            UnischemaField('fields', np.int64, (), ScalarCodec(), False),
+            UnischemaField('create_schema_view', np.int64, (), ScalarCodec(), False),
+        ])
+        assert isinstance(schema.fields, dict)
+        assert callable(schema.create_schema_view)
+        assert schema.fields['fields'].name == 'fields'
+        view = schema.create_schema_view(['fields'])
+        assert list(view.fields) == ['fields']
+
+    def test_view_mixed_regex_and_field_instances_dedup(self):
+        # Regexes and UnischemaField instances mix in one view; overlapping
+        # selections dedup (reference: create_schema_view_using_regex_and_
+        # unischema_fields_with_duplicates).
+        f_id = UnischemaField('id', np.int64, (), ScalarCodec(), False)
+        schema = Unischema('S2', [
+            f_id, UnischemaField('id2', np.int64, (), ScalarCodec(), False),
+            UnischemaField('other', np.int64, (), ScalarCodec(), False),
+        ])
+        view = schema.create_schema_view(['id.*', f_id])
+        assert sorted(view.fields) == ['id', 'id2']
