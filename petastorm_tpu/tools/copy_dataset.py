@@ -10,6 +10,7 @@ import sys
 
 import pyarrow.compute as pc
 import pyarrow.dataset as pads
+import pyarrow.fs as pa_fs
 
 
 from petastorm_tpu.etl import dataset_metadata
@@ -19,10 +20,13 @@ logger = logging.getLogger(__name__)
 
 
 def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
-                 rowgroup_size_mb=32, rows_per_file=None, storage_options=None):
+                 rowgroup_size_mb=32, rows_per_file=None, storage_options=None,
+                 overwrite=False):
     """Copy a (petastorm_tpu or petastorm) dataset, optionally selecting a column subset
     and dropping rows with nulls in ``not_null_fields``; the target gets fresh
-    metadata."""
+    metadata. A non-empty target is refused unless ``overwrite=True`` (then deleted
+    first) — writing into an existing store would leave stale part files mixed with
+    the copy (reference: tools/copy_dataset.py --overwrite-output)."""
     source = dataset_metadata.open_dataset(source_url, storage_options=storage_options)
     schema = dataset_metadata.infer_or_load_unischema(source)
     if field_regex:
@@ -38,9 +42,19 @@ def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
         expr = ~pc.field(field_name).is_null()
         filter_expr = expr if filter_expr is None else (filter_expr & expr)
 
-    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    from petastorm_tpu.fs_utils import (delete_path, get_filesystem_and_path_or_paths,
+                                        path_exists)
     target_fs, target_path = get_filesystem_and_path_or_paths(
         target_url, storage_options=storage_options)
+    if path_exists(target_fs, target_path):
+        infos = target_fs.get_file_info(pa_fs.FileSelector(target_path,
+                                                           allow_not_found=True))
+        if infos and not overwrite:
+            raise ValueError('Target {} exists and is not empty; pass '
+                             'overwrite=True (--overwrite) to replace it'
+                             .format(target_url))
+        if infos:
+            delete_path(target_fs, target_path)
 
     with dataset_metadata.materialize_dataset(target_url, schema,
                                               rowgroup_size_mb=rowgroup_size_mb,
@@ -67,12 +81,14 @@ def main(argv=None):
     parser.add_argument('--not-null-fields', nargs='+')
     parser.add_argument('--rowgroup-size-mb', type=int, default=32)
     parser.add_argument('--rows-per-file', type=int)
+    parser.add_argument('--overwrite', action='store_true',
+                        help='replace a non-empty target instead of refusing')
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     copy_dataset(args.source_url, args.target_url, field_regex=args.field_regex,
                  not_null_fields=args.not_null_fields,
                  rowgroup_size_mb=args.rowgroup_size_mb,
-                 rows_per_file=args.rows_per_file)
+                 rows_per_file=args.rows_per_file, overwrite=args.overwrite)
     return 0
 
 

@@ -336,3 +336,33 @@ class TestBenchHelpers:
         photo_ratio = len(zlib.compress(photo_bytes)) / len(photo_bytes)
         noise_ratio = len(zlib.compress(noise_bytes)) / len(noise_bytes)
         assert photo_ratio < 0.5 * noise_ratio, (photo_ratio, noise_ratio)
+
+
+class TestCopyDatasetOverwrite:
+    def test_nonempty_target_refused_without_overwrite(self, synthetic_dataset,
+                                                       tmp_path):
+        from petastorm_tpu.tools.copy_dataset import copy_dataset
+        target = 'file://' + str(tmp_path / 'copy')
+        copy_dataset(synthetic_dataset.url, target, field_regex=['id'])
+        with pytest.raises(ValueError, match='overwrite'):
+            copy_dataset(synthetic_dataset.url, target, field_regex=['id'])
+
+    def test_overwrite_replaces_stale_files(self, synthetic_dataset, tmp_path):
+        # The second copy selects FEWER rows; without the delete, part files of
+        # the first copy would survive and double-serve.
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.tools.copy_dataset import copy_dataset
+        target = 'file://' + str(tmp_path / 'copy2')
+        copy_dataset(synthetic_dataset.url, target, rows_per_file=10)
+        copy_dataset(synthetic_dataset.url, target, rows_per_file=100,
+                     overwrite=True)
+        with make_reader(target, workers_count=1, num_epochs=1) as reader:
+            n = sum(1 for _ in reader)
+        assert n == len(synthetic_dataset.rows)
+
+    def test_bad_regex_raises(self, synthetic_dataset, tmp_path):
+        from petastorm_tpu.tools.copy_dataset import copy_dataset
+        with pytest.raises(ValueError, match='matched no fields'):
+            copy_dataset(synthetic_dataset.url,
+                         'file://' + str(tmp_path / 'never'),
+                         field_regex=['bogus_name_xyz'])
