@@ -132,3 +132,10 @@ def test_read_reference_written_schema(version):
     schema = get_schema(handle)
     assert 'id' in schema.fields
     assert schema.fields['id'].codec is not None
+
+
+def test_get_schema_from_bogus_url_raises():
+    """A nonexistent store fails loudly with the filesystem error (reference:
+    test_dataset_metadata.py:33-38)."""
+    with pytest.raises(FileNotFoundError):
+        get_schema_from_dataset_url('file:///no/such/path/anywhere_xyz')
