@@ -10,8 +10,9 @@ double-buffered host->device transfer; PyTorch and TF adapters are thin wrappers
 capability parity (reference: petastorm/pytorch.py, petastorm/tf_utils.py).
 """
 
-__version__ = '0.1.0'
+__version__ = '0.4.0'
 
+from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
 from petastorm_tpu.reader import Reader, make_batch_reader, make_reader  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 from petastorm_tpu.unischema import Unischema, UnischemaField  # noqa: F401
