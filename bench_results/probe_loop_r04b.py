@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Round-4 section-cycling TPU capture loop (supersedes probe_loop_r04.py).
+
+The 03:48 tunnel-up capture showed the round-4 tunnel is far slower than
+round 2's: a full ``bench.py`` run blew the 1500s child timeout with only the
+streaming section complete, so one bad timeout cost every other section its
+TPU line.  This loop instead drives bench.py ONE section at a time
+(``BENCH_SECTIONS=<s>``), each invocation with its own generous timeout, and
+always picks the least-captured section next — the first cycle covers every
+section, later cycles accumulate repeat lines for medians.  The persistent
+XLA compilation cache (bench.py child_main) makes repeat sections cheap.
+
+Run from the repo root:  python bench_results/probe_loop_r04b.py
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PROBE_LOG = os.path.join(HERE, 'r04_probe_log.txt')
+RUNS = os.path.join(HERE, 'r04_tpu_runs.jsonl')
+PROBE_TIMEOUT_S = int(os.environ.get('PROBE_TIMEOUT', 90))
+PROBE_EVERY_S = int(os.environ.get('PROBE_EVERY', 240))
+TOTAL_S = int(os.environ.get('PROBE_TOTAL', int(11.0 * 3600)))
+
+# (section, outer timeout seconds).  Priority order: the headline first, then
+# the round-3 features that have never touched a chip, then the rest.
+SECTIONS = [
+    ('mnist_inmem', 1500),
+    ('flash', 1500),
+    ('moe', 1200),
+    ('imagenet_scan', 1800),
+    ('imagenet_stream', 1800),
+    ('mnist_scan_stream', 1200),
+    ('decode_delta', 1200),
+    ('bare_reader', 600),
+    ('mnist_stream', 1200),
+]
+
+
+def now():
+    return datetime.datetime.now().isoformat(timespec='seconds')
+
+
+def plog(msg):
+    line = '{} {}'.format(now(), msg)
+    print(line, flush=True)
+    with open(PROBE_LOG, 'a') as f:
+        f.write(line + '\n')
+
+
+def probe():
+    code = ("import jax; ds = jax.devices(); "
+            "print('PROBE_OK' if ds and ds[0].platform != 'cpu' else 'PROBE_CPU')")
+    try:
+        out = subprocess.run([sys.executable, '-c', code], cwd=REPO,
+                             capture_output=True, text=True,
+                             timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        plog('probe TIMEOUT after {}s'.format(PROBE_TIMEOUT_S))
+        return False
+    ok = 'PROBE_OK' in out.stdout
+    plog('probe {} (rc={} stdout={!r})'.format(
+        'UP' if ok else 'DOWN', out.returncode, out.stdout.strip()[:120]))
+    return ok
+
+
+def captured_counts():
+    """How many committed TPU lines already cover each section (by config tag
+    or by a section-identifying field), so restarts resume where we left off."""
+    counts = {name: 0 for name, _ in SECTIONS}
+    field_probe = {
+        'mnist_inmem': 'inmem_scan_rows_per_sec',
+        'flash': 'flash_train_tokens_per_sec',
+        'moe': 'moe_train_tokens_per_sec',
+        'imagenet_scan': 'imagenet_scan_rows_per_sec',
+        'imagenet_stream': 'imagenet_stream_rows_per_sec',
+        'mnist_scan_stream': 'streaming_scan_rows_per_sec',
+        'decode_delta': 'imagenet_onchip_decode_rows_per_sec',
+        'bare_reader': 'bare_reader_rows_per_sec',
+        'mnist_stream': 'streaming_rows_per_sec',
+    }
+    try:
+        with open(RUNS) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                for name, field in field_probe.items():
+                    if field in rec:
+                        counts[name] += 1
+    except IOError:
+        pass
+    return counts
+
+
+def run_section(name, timeout_s):
+    env = dict(os.environ)
+    env['BENCH_SKIP_CPU_FALLBACK'] = '1'
+    env['BENCH_SECTIONS'] = name
+    # leave salvage headroom: inner child dies before the outer watchdog
+    env.setdefault('BENCH_CHILD_TIMEOUT', str(timeout_s - 120))
+    env.setdefault('BENCH_CHILD_ATTEMPTS', '1')
+    plog('section {} START (timeout {}s)'.format(name, timeout_s))
+    t0 = time.time()
+    try:
+        out = subprocess.run([sys.executable, 'bench.py'], cwd=REPO,
+                             capture_output=True, text=True,
+                             timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired as exc:
+        plog('section {} OUTER-TIMEOUT after {}s'.format(name, timeout_s))
+        stdout = exc.stdout or b''
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode('utf-8', 'replace')
+        return _append_lines(name, stdout, time.time() - t0, salvaged=True)
+    plog('section {} done rc={} in {:.0f}s'.format(
+        name, out.returncode, time.time() - t0))
+    if out.returncode != 0:
+        for line in out.stderr.strip().splitlines()[-6:]:
+            plog('stderr: ' + line[:200])
+        return False
+    return _append_lines(name, out.stdout, time.time() - t0)
+
+
+def _append_lines(section, stdout, elapsed, salvaged=False):
+    got = False
+    for line in stdout.strip().splitlines():
+        line = line.strip()
+        if not line.startswith('{'):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get('platform') == 'cpu':
+            plog('section {} produced a CPU line — NOT appending'.format(section))
+            continue
+        rec['_captured_at'] = now()
+        rec['_section'] = section
+        rec['_bench_elapsed_s'] = round(elapsed, 1)
+        if salvaged:
+            rec['_salvaged_from_timeout'] = True
+        with open(RUNS, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+        plog('section {} line APPENDED (metric={} value={})'.format(
+            section, rec.get('metric'), rec.get('value')))
+        got = True
+    if not got and not salvaged:
+        plog('section {} rc=0 but no appendable JSON line'.format(section))
+    return got
+
+
+def main():
+    plog('section-cycling watcher start: {} sections, total {}s'.format(
+        len(SECTIONS), TOTAL_S))
+    t_start = time.time()
+    while time.time() - t_start < TOTAL_S:
+        if not probe():
+            time.sleep(PROBE_EVERY_S)
+            continue
+        counts = captured_counts()
+        # least-captured first; SECTIONS order breaks ties
+        name, timeout_s = min(SECTIONS, key=lambda s: counts[s[0]])
+        remaining = TOTAL_S - (time.time() - t_start)
+        if remaining < 180:
+            break
+        run_section(name, min(timeout_s, max(int(remaining) - 60, 180)))
+        time.sleep(5)
+    plog('section-cycling watcher done after {:.0f}s'.format(
+        time.time() - t_start))
+
+
+if __name__ == '__main__':
+    main()
