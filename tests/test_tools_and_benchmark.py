@@ -366,3 +366,35 @@ class TestCopyDatasetOverwrite:
             copy_dataset(synthetic_dataset.url,
                          'file://' + str(tmp_path / 'never'),
                          field_regex=['bogus_name_xyz'])
+
+
+class TestBenchHarness:
+    """Contracts on the repo-root bench.py the driver runs on hardware."""
+
+    def _load_bench(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            'bench_module', os.path.join(os.path.dirname(__file__), '..', 'bench.py'))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_headline_section_runs_first(self):
+        # Cumulative PARTIAL_JSON salvage keeps a timed-out run's completed
+        # prefix, so the headline-carrying section must lead the run order
+        # (2026-07-31: a slow-tunnel full run died with only its first
+        # section complete).
+        bench = self._load_bench()
+        assert bench.SECTION_RUN_ORDER[0] == 'mnist_inmem'
+        assert sorted(bench.SECTION_RUN_ORDER) == sorted(bench.SECTION_NAMES)
+
+    def test_headline_fallback_prefers_any_measured_rate(self):
+        bench = self._load_bench()
+        rec = bench.normalize_headline(
+            {'streaming_rows_per_sec': 123.0, 'streaming_vs_baseline': 0.17})
+        assert rec['value'] == 123.0
+        assert rec['metric'] == 'mnist_train_rows_per_sec_per_chip'
+        assert rec['config'] == 'streaming_fallback_headline'
+        empty = bench.normalize_headline({})
+        assert empty['value'] == 0.0
+        assert empty['config'] == 'no_sections_completed'
