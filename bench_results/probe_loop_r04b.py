@@ -23,6 +23,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 PROBE_LOG = os.path.join(HERE, 'r04_probe_log.txt')
 RUNS = os.path.join(HERE, 'r04_tpu_runs.jsonl')
+LINK_RUNS = os.path.join(HERE, 'r04_link_probes.jsonl')
 PROBE_TIMEOUT_S = int(os.environ.get('PROBE_TIMEOUT', 90))
 PROBE_EVERY_S = int(os.environ.get('PROBE_EVERY', 240))
 TOTAL_S = int(os.environ.get('PROBE_TOTAL', int(11.0 * 3600)))
@@ -74,7 +75,7 @@ def captured_counts():
     or by a section-identifying field), so restarts resume where we left off."""
     counts = {name: 0 for name, _ in SECTIONS}
     field_probe = {
-        'mnist_inmem': 'inmem_scan_rows_per_sec',
+        'mnist_inmem': 'fill_epoch_s',  # emitted only by the inmem section
         'flash': 'flash_train_tokens_per_sec',
         'moe': 'moe_train_tokens_per_sec',
         'imagenet_scan': 'imagenet_scan_rows_per_sec',
@@ -127,7 +128,7 @@ def run_section(name, timeout_s):
     return _append_lines(name, out.stdout, time.time() - t0)
 
 
-def _append_lines(section, stdout, elapsed, salvaged=False):
+def _append_lines(section, stdout, elapsed, salvaged=False, target=RUNS):
     got = False
     for line in stdout.strip().splitlines():
         line = line.strip()
@@ -145,24 +146,56 @@ def _append_lines(section, stdout, elapsed, salvaged=False):
         rec['_bench_elapsed_s'] = round(elapsed, 1)
         if salvaged:
             rec['_salvaged_from_timeout'] = True
-        with open(RUNS, 'a') as f:
+        with open(target, 'a') as f:
             f.write(json.dumps(rec) + '\n')
-        plog('section {} line APPENDED (metric={} value={})'.format(
-            section, rec.get('metric'), rec.get('value')))
+        plog('section {} line APPENDED to {} (metric={} value={})'.format(
+            section, os.path.basename(target), rec.get('metric'),
+            rec.get('value')))
         got = True
     if not got and not salvaged:
         plog('section {} rc=0 but no appendable JSON line'.format(section))
     return got
 
 
+def run_linkprobe():
+    """One link characterization line per tunnel-up window: dispatch RTT +
+    H2D/D2H bandwidth (petastorm_tpu.benchmark.linkprobe), the denominator for
+    every streaming-ceiling claim in docs/performance.md."""
+    plog('linkprobe START')
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, '-m', 'petastorm_tpu.benchmark.linkprobe'],
+            cwd=REPO, capture_output=True, text=True, timeout=420)
+    except subprocess.TimeoutExpired:
+        plog('linkprobe TIMEOUT')
+        return False
+    if out.returncode != 0:
+        plog('linkprobe rc={} stderr tail={!r}'.format(
+            out.returncode, out.stderr.strip()[-200:]))
+        return False
+    # Link lines live in their own file: r04_tpu_runs.jsonl holds bench-section
+    # lines only (its README documents last-line-is-final-result semantics, and
+    # a value=0.0 link record must never be readable as the round's result).
+    return _append_lines('linkprobe', out.stdout, time.time() - t0,
+                         target=LINK_RUNS)
+
+
 def main():
     plog('section-cycling watcher start: {} sections, total {}s'.format(
         len(SECTIONS), TOTAL_S))
     t_start = time.time()
+    link_probed_this_window = False
     while time.time() - t_start < TOTAL_S:
         if not probe():
+            link_probed_this_window = False
             time.sleep(PROBE_EVERY_S)
             continue
+        if not link_probed_this_window:
+            # one ATTEMPT per up-window: a degraded-but-up tunnel that hangs
+            # the linkprobe must not burn its 420s timeout before every section
+            run_linkprobe()
+            link_probed_this_window = True
         counts = captured_counts()
         # least-captured first; SECTIONS order breaks ties
         name, timeout_s = min(SECTIONS, key=lambda s: counts[s[0]])
