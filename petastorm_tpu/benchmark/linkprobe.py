@@ -143,6 +143,7 @@ def streaming_ceiling_rows_per_sec(link, row_bytes, batch_size):
 
 
 def main():
+    """CLI: print one JSON line of link measurements on the default device."""
     import os
     if os.environ.get('JAX_PLATFORMS') == 'cpu':
         # the axon accelerator plugin pins the platform at import and ignores
