@@ -342,18 +342,52 @@ def _tiles(t, d, block_q, block_k):
     return t % block_q == 0 and t % block_k == 0 and d % _LANE == 0
 
 
+# 'auto' preference order: 256 first (the measured default — keeps behavior
+# identical for every shape that already tiled), then 128 to widen Pallas
+# coverage (e.g. T=384, T=1920). Both MXU/VPU-lane aligned.
+_BLOCK_CANDIDATES = (256, 128)
+
+
+def _resolve_blocks(t, block_q, block_k):
+    """Turn ``'auto'`` block sizes into concrete tile sizes for sequence
+    length ``t``. Deterministic in (t, request), so the custom-vjp forward and
+    backward always resolve identically. When nothing divides ``t`` the 256
+    placeholder simply fails ``_tiles`` and the dense path runs, exactly like
+    an explicit non-dividing request."""
+    def one(req):
+        if req == 'auto':
+            for cand in _BLOCK_CANDIDATES:
+                if t % cand == 0:
+                    return cand
+            return 256
+        return req
+    return one(block_q), one(block_k)
+
+
+def _dispatch(q, k, block_q, block_k):
+    """Single resolve-then-decide point shared by every fwd/bwd path:
+    ``(use_pallas, resolved_block_q, resolved_block_k)``."""
+    b, t, h, d = q.shape
+    block_q, block_k = _resolve_blocks(t, block_q, block_k)
+    return (_tiles(t, d, block_q, block_k) and t == k.shape[1],
+            block_q, block_k)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal=False, block_q=256, block_k=256):
+def flash_attention(q, k, v, causal=False, block_q='auto', block_k='auto'):
     """Flash attention over ``[B, T, H, D]`` inputs (same layout as
     :func:`~petastorm_tpu.ops.ring_attention.dense_attention`). Exact; both passes run
     as Pallas TPU kernels when shapes tile (XLA dense fallback otherwise), with
-    O(T * block) memory in forward AND backward."""
+    O(T * block) memory in forward AND backward. Block sizes default to
+    ``'auto'``: 256 when it divides T (the measured default), else 128 — pass
+    ints to pin them (e.g. from a tile-size sweep)."""
     return _attention_impl(q, k, v, causal, block_q, block_k)
 
 
 def _use_pallas(q, k, block_q, block_k):
-    b, t, h, d = q.shape
-    return _tiles(t, d, block_q, block_k) and t == k.shape[1]
+    """Dispatch predicate only (bench.py asserts flash_no_fallback with it);
+    kernel paths use _dispatch to also get the resolved block sizes."""
+    return _dispatch(q, k, block_q, block_k)[0]
 
 
 def _to_bh(x):
@@ -372,7 +406,8 @@ def _attention_impl(q, k, v, causal, block_q, block_k):
 
 def _fwd(q, k, v, causal, block_q, block_k):
     from petastorm_tpu.ops.ring_attention import dense_attention
-    if not _use_pallas(q, k, block_q, block_k):
+    use, block_q, block_k = _dispatch(q, k, block_q, block_k)
+    if not use:
         return dense_attention(q, k, v, causal=causal), (q, k, v, None, None, None)
     b, t, h, d = q.shape
     interpret = jax.default_backend() != 'tpu'
@@ -393,28 +428,32 @@ def _bwd(causal, block_q, block_k, residuals, g):
         return vjp(g)
     b, h = bh_dims
     interpret = jax.default_backend() != 'tpu'
+    block_q, block_k = _resolve_blocks(q_bh.shape[1], block_q, block_k)
     dq, dk, dv = _flash_backward(q_bh, k_bh, v_bh, o_bh, lse, _to_bh(g), causal,
                                  block_q, block_k, interpret)
     return _from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h)
+
 
 
 flash_attention.defvjp(_fwd, _bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def flash_attention_segmented(q, k, v, segments, causal=False, block_q=256,
-                              block_k=256):
+def flash_attention_segmented(q, k, v, segments, causal=False, block_q='auto',
+                              block_k='auto'):
     """Flash attention confined to packed-sequence segments: ``[B, T, H, D]``
     inputs plus ``segments [B, T]`` int32 (``ops.packing`` convention — 0 is
     padding, documents numbered from 1; padding rows emit zeros). Same Pallas
     kernels as :func:`flash_attention` with the segment mask fused into every
     block, so packed single-chip training keeps the O(T * block) memory bound;
-    falls back to the masked XLA dense path when shapes don't tile."""
+    falls back to the masked XLA dense path when shapes don't tile. Block
+    sizes default to ``'auto'`` (see :func:`flash_attention`)."""
     return _seg_fwd(q, k, v, segments, causal, block_q, block_k)[0]
 
 
 def _seg_fwd(q, k, v, segments, causal, block_q, block_k):
-    if not _use_pallas(q, k, block_q, block_k):
+    use, block_q, block_k = _dispatch(q, k, block_q, block_k)
+    if not use:
         from petastorm_tpu.ops.packing import masked_dense_attention, segment_mask
         mask = segment_mask(segments, segments, causal=causal)
         return (masked_dense_attention(q, k, v, mask),
@@ -442,6 +481,7 @@ def _seg_bwd(causal, block_q, block_k, residuals, g):
         return vjp(g) + (_seg_zero_cotangent(segments),)
     b, h = bh_dims
     interpret = jax.default_backend() != 'tpu'
+    block_q, block_k = _resolve_blocks(q_bh.shape[1], block_q, block_k)
     dq, dk, dv = _flash_backward(q_bh, k_bh, v_bh, o_bh, lse, _to_bh(g), causal,
                                  block_q, block_k, interpret, segments=segments,
                                  heads=h)

@@ -135,7 +135,8 @@ def masked_dense_attention(q, k, v, mask):
     return jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def segment_causal_attention(segments, use_flash=False, block_q=256, block_k=256):
+def segment_causal_attention(segments, use_flash=False, block_q='auto',
+                             block_k='auto'):
     """Attention backend for packed batches — inject into ``TransformerLM``:
 
         model = TransformerLM(attention_fn=segment_causal_attention(batch['tokens_segments']))

@@ -255,3 +255,64 @@ class TestRandomIndexShuffle:
 
         out = np.asarray(gather_epoch(jax.random.PRNGKey(0)))
         assert sorted(out.tolist()) == list(range(n))
+
+
+class TestFlashAutoBlocks:
+    """'auto' block resolution: 256 when it divides T (identical to the old
+    fixed default), else 128 (widening Pallas coverage to shapes the fixed-256
+    default silently sent down the dense path); non-tiling shapes still take
+    the dense path."""
+
+    def test_resolution_preference(self):
+        from petastorm_tpu.ops.flash_attention import _resolve_blocks
+        assert _resolve_blocks(512, 'auto', 'auto') == (256, 256)
+        assert _resolve_blocks(8192, 'auto', 'auto') == (256, 256)
+        assert _resolve_blocks(384, 'auto', 'auto') == (128, 128)
+        assert _resolve_blocks(100, 'auto', 'auto') == (256, 256)  # -> dense
+        assert _resolve_blocks(384, 64, 'auto') == (64, 128)  # ints pass through
+
+    def test_dispatch_predicate(self):
+        from petastorm_tpu.ops.flash_attention import _use_pallas
+        mk = lambda t: jnp.zeros((1, t, 2, 128), jnp.float32)
+        assert _use_pallas(mk(384), mk(384), 'auto', 'auto')       # 128 tiles
+        assert not _use_pallas(mk(384), mk(384), 256, 256)         # old default
+        assert not _use_pallas(mk(100), mk(100), 'auto', 'auto')   # nothing tiles
+
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_auto_t384_matches_dense(self, causal):
+        """T=384 took the dense path under the fixed-256 default; under 'auto'
+        it must run the Pallas kernels (asserted via the dispatch predicate)
+        and still match dense in values and gradients."""
+        from petastorm_tpu.ops.flash_attention import flash_attention
+        rng = np.random.RandomState(7)
+        b, t, h, d = 1, 384, 2, 128
+        q = jnp.asarray(rng.randn(b, t, h, d), dtype=jnp.float32)
+        k = jnp.asarray(rng.randn(b, t, h, d), dtype=jnp.float32)
+        v = jnp.asarray(rng.randn(b, t, h, d), dtype=jnp.float32)
+        out = flash_attention(q, k, v, causal)
+        expected = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-4, rtol=2e-4)
+        g_flash = jax.grad(lambda a: jnp.sum(flash_attention(a, k, v, causal)))(q)
+        g_dense = jax.grad(
+            lambda a: jnp.sum(dense_attention(a, k, v, causal=causal)))(q)
+        np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_dense),
+                                   atol=2e-3, rtol=2e-3)
+
+    def test_segmented_auto_t384_matches_masked_dense(self):
+        from petastorm_tpu.ops.flash_attention import flash_attention_segmented
+        from petastorm_tpu.ops.packing import (masked_dense_attention,
+                                               segment_mask)
+        rng = np.random.RandomState(8)
+        b, t, h, d = 1, 384, 2, 128
+        q = jnp.asarray(rng.randn(b, t, h, d), dtype=jnp.float32)
+        k = jnp.asarray(rng.randn(b, t, h, d), dtype=jnp.float32)
+        v = jnp.asarray(rng.randn(b, t, h, d), dtype=jnp.float32)
+        segments = jnp.asarray(
+            np.concatenate([np.full(200, 1), np.full(120, 2), np.zeros(64)])[None, :]
+            .astype(np.int32))
+        out = flash_attention_segmented(q, k, v, segments, True)
+        mask = segment_mask(segments, segments, causal=True)
+        expected = masked_dense_attention(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-4, rtol=2e-4)
