@@ -1,0 +1,226 @@
+"""Environment doctor: one command that answers "is this install healthy and
+what will it be fast at?".
+
+``petastorm-tpu-doctor`` (or ``python -m petastorm_tpu.tools.doctor``) checks,
+in order:
+
+1. **Versions** — python / jax / pyarrow / numpy (flax, optax, orbax if present).
+2. **Accelerator backend** — probed in a SUBPROCESS with a hard timeout: on
+   tunneled deployments backend init can *hang* rather than fail (the axon
+   plugin ignores ``JAX_PLATFORMS`` and probes its tunnel at import), and a
+   doctor that wedges on the exact condition it exists to diagnose is useless.
+3. **Link characterization** — dispatch RTT + H2D/D2H bandwidth
+   (:mod:`petastorm_tpu.benchmark.linkprobe`) when a device is up, plus the
+   implied per-batch streaming ceiling for a reference 1 KiB row — this is the
+   number that says whether streaming or HBM-resident (``scan_epochs``)
+   configurations fit today's link.
+4. **Store roundtrip** — write a small dataset to a temp dir through the real
+   codec/metadata path, read it back with ``make_reader`` across the thread
+   pool, verify row integrity, report rows/s.
+
+Prints a human-readable report; with ``--json``, one machine-readable JSON
+line (the same dict :func:`collect_report` returns). Exit code 0 iff the
+store roundtrip passed — that is the install-health criterion. Backend DOWN
+and link-probe failures are reported as warnings, not failures: they describe
+the attached environment (CPU development installs are healthy installs; a
+flaky tunnel is the environment's fault, and diagnosing it is this tool's
+job, not a reason for it to fail).
+
+The reference ships per-task CLIs (generate-metadata, copy-dataset,
+throughput); the doctor composes this repo's equivalents into the first
+command to run on a new box.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import tempfile
+import time
+
+# The child honors JAX_PLATFORMS=cpu explicitly: the axon plugin pins the
+# platform at import and ignores the env var (same gotcha bench.py handles).
+PROBE_CODE = (
+    "import os, jax\n"
+    "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
+    "    jax.config.update('jax_platforms', 'cpu')\n"
+    "ds = jax.devices()\n"
+    "print(ds[0].platform, len(ds))\n")
+
+
+def check_versions():
+    """Importable-library report; missing optional libraries are reported, not
+    fatal."""
+    import numpy
+    import pyarrow
+    report = {'python': sys.version.split()[0],
+              'numpy': numpy.__version__,
+              'pyarrow': pyarrow.__version__}
+    import importlib
+    for name in ('jax', 'flax', 'optax', 'orbax.checkpoint', 'torch',
+                 'tensorflow'):
+        try:
+            # import_module resolves the dotted submodule (orbax.checkpoint's
+            # version lives there; the bare orbax namespace package has none)
+            mod = importlib.import_module(name)
+            report[name.split('.')[0]] = getattr(mod, '__version__', 'present')
+        except Exception:  # noqa: BLE001 - absence is information, not error
+            report[name.split('.')[0]] = None
+    from petastorm_tpu import __version__ as pt_version
+    report['petastorm_tpu'] = pt_version
+    return report
+
+
+def check_backend(timeout_s=60):
+    """Probe ``jax.devices()`` in a subprocess with a hard timeout.
+
+    Returns ``{'status': 'up'|'down'|'timeout', 'platform': ..., 'devices': N}``.
+    """
+    try:
+        out = subprocess.run([sys.executable, '-c', PROBE_CODE],
+                             capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {'status': 'timeout', 'platform': None, 'devices': 0,
+                'detail': 'backend init exceeded {}s — tunneled device '
+                          'unreachable?'.format(timeout_s)}
+    if out.returncode != 0:
+        return {'status': 'down', 'platform': None, 'devices': 0,
+                'detail': out.stderr.strip().splitlines()[-1][:200]
+                if out.stderr.strip() else 'unknown'}
+    # parse the LAST line only: accelerator plugins/libtpu may write banner
+    # text to the child's stdout before the probe's own print
+    try:
+        platform, n = out.stdout.strip().splitlines()[-1].split()
+        return {'status': 'up', 'platform': platform, 'devices': int(n)}
+    except (IndexError, ValueError):
+        return {'status': 'down', 'platform': None, 'devices': 0,
+                'detail': 'unparseable probe output: {!r}'.format(
+                    out.stdout.strip()[-200:])}
+
+
+def check_link(reference_row_bytes=1024, reference_batch=1024):
+    """Link probe + the per-batch streaming ceiling it implies (only call when
+    the backend is up — this one runs in-process)."""
+    from petastorm_tpu.benchmark.linkprobe import (
+        probe_link, streaming_ceiling_rows_per_sec)
+    link = probe_link(sizes_mb=(1, 4), dispatch_iters=10, transfer_iters=3)
+    link['streaming_ceiling_rows_per_sec_at_1kib'] = round(
+        streaming_ceiling_rows_per_sec(link, reference_row_bytes,
+                                       reference_batch), 1)
+    return link
+
+
+def check_store_roundtrip(rows=200, workers=2):
+    """Write a real store (scalar + ndarray codecs) to a temp dir, read it back
+    through ``make_reader``, verify integrity, report rows/s."""
+    import numpy as np
+    import pyarrow as pa
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_rows
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('DoctorSchema', [
+        UnischemaField('idx', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('vec', np.float32, (8,), NdarrayCodec(), False),
+    ])
+    with tempfile.TemporaryDirectory(prefix='petastorm_tpu_doctor_') as tmp:
+        url = 'file://' + tmp
+        write_rows(url, schema,
+                   ({'idx': i, 'vec': np.full(8, i, np.float32)}
+                    for i in range(rows)),
+                   rowgroup_size_mb=1)
+        start = time.perf_counter()
+        seen = []
+        with make_reader(url, workers_count=workers, num_epochs=1) as reader:
+            for row in reader:
+                seen.append(int(row.idx))
+                if row.vec[0] != row.idx:
+                    return {'status': 'fail',
+                            'detail': 'row {} decoded wrong vec'.format(row.idx)}
+        elapsed = time.perf_counter() - start
+    if sorted(seen) != list(range(rows)):
+        return {'status': 'fail',
+                'detail': 'expected {} distinct rows, got {}'.format(
+                    rows, len(set(seen)))}
+    return {'status': 'ok', 'rows': rows,
+            'rows_per_sec': round(rows / elapsed, 1)}
+
+
+def collect_report(probe_timeout_s=60, link=True):
+    """Run every check; returns the full report dict (no printing)."""
+    report = {'versions': check_versions()}
+    report['backend'] = check_backend(timeout_s=probe_timeout_s)
+    if link and report['backend']['status'] == 'up':
+        try:
+            report['link'] = check_link()
+        except Exception as exc:  # noqa: BLE001 - link probe is best-effort
+            report['link'] = {'status': 'fail', 'detail': repr(exc)}
+    try:
+        report['store_roundtrip'] = check_store_roundtrip()
+    except Exception as exc:  # noqa: BLE001 - the report must always complete
+        report['store_roundtrip'] = {'status': 'fail', 'detail': repr(exc)}
+    report['healthy'] = report['store_roundtrip'].get('status') == 'ok'
+    return report
+
+
+def _print_human(report):
+    v = report['versions']
+    print('petastorm-tpu doctor')
+    print('  versions: petastorm_tpu {} / python {} / jax {} / pyarrow {}'
+          .format(v['petastorm_tpu'], v['python'], v['jax'], v['pyarrow']))
+    optional = ', '.join('{} {}'.format(k, v[k]) for k in
+                         ('flax', 'optax', 'orbax', 'torch', 'tensorflow')
+                         if v.get(k))
+    if optional:
+        print('  optional: ' + optional)
+    b = report['backend']
+    if b['status'] == 'up':
+        print('  backend: UP — {} x{}'.format(b['platform'], b['devices']))
+    else:
+        print('  backend: {} ({}) — CPU development still works; streaming '
+              'benchmarks need the device'.format(
+                  b['status'].upper(), b.get('detail', '')))
+    link = report.get('link')
+    if link and 'dispatch_rtt_ms' in link:
+        print('  link: RTT {} ms, H2D {} MB/s, D2H {} MB/s -> streaming '
+              'ceiling ~{} rows/s at 1 KiB rows'.format(
+                  link['dispatch_rtt_ms'], link['h2d_mbytes_per_sec'],
+                  link['d2h_mbytes_per_sec'],
+                  link['streaming_ceiling_rows_per_sec_at_1kib']))
+    elif link:
+        print('  link: FAIL ({}) — device up but unmeasurable; expect '
+              'streaming anomalies'.format(link.get('detail', 'unknown')))
+    s = report['store_roundtrip']
+    if s.get('status') == 'ok':
+        print('  store roundtrip: OK — {} rows at {} rows/s'.format(
+            s['rows'], s['rows_per_sec']))
+    else:
+        print('  store roundtrip: FAIL — {}'.format(s.get('detail')))
+    print('  verdict: {}'.format('healthy' if report['healthy'] else 'BROKEN'))
+
+
+def main(argv=None):
+    """CLI: run all checks, print the report, exit 0 iff healthy."""
+    parser = argparse.ArgumentParser(
+        description='petastorm-tpu environment doctor')
+    parser.add_argument('--json', action='store_true',
+                        help='print one machine-readable JSON line instead')
+    parser.add_argument('--probe-timeout', type=int, default=60,
+                        help='backend probe subprocess timeout (seconds)')
+    parser.add_argument('--no-link', action='store_true',
+                        help='skip the link bandwidth probe')
+    args = parser.parse_args(argv)
+    report = collect_report(probe_timeout_s=args.probe_timeout,
+                            link=not args.no_link)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        _print_human(report)
+    return 0 if report['healthy'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

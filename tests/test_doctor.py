@@ -1,0 +1,45 @@
+"""Doctor CLI tests — every check runs for real on the CPU backend; the
+subprocess backend probe inherits the conftest's ``JAX_PLATFORMS=cpu`` and the
+probe child honors it explicitly (the axon-plugin gotcha)."""
+import json
+
+from petastorm_tpu.tools import doctor
+
+
+def test_versions_report_core_libs():
+    v = doctor.check_versions()
+    assert v['petastorm_tpu']
+    assert v['jax'] is not None
+    assert v['pyarrow'] is not None
+
+
+def test_backend_probe_up_on_cpu():
+    b = doctor.check_backend(timeout_s=120)
+    assert b == {'status': 'up', 'platform': 'cpu', 'devices': b['devices']}
+    assert b['devices'] >= 1
+
+
+def test_store_roundtrip_ok():
+    s = doctor.check_store_roundtrip(rows=60, workers=2)
+    assert s['status'] == 'ok'
+    assert s['rows'] == 60
+    assert s['rows_per_sec'] > 0
+
+
+def test_collect_report_healthy_and_json_clean(capsys):
+    rc = doctor.main(['--json', '--no-link', '--probe-timeout', '120'])
+    out = capsys.readouterr().out.strip()
+    report = json.loads(out)
+    assert rc == 0
+    assert report['healthy'] is True
+    assert report['backend']['status'] == 'up'
+    assert 'link' not in report  # --no-link honored
+    assert report['store_roundtrip']['status'] == 'ok'
+
+
+def test_human_report_prints_verdict(capsys):
+    rc = doctor.main(['--no-link', '--probe-timeout', '120'])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert 'verdict: healthy' in out
+    assert 'store roundtrip: OK' in out
