@@ -284,7 +284,7 @@ class TestScanStream:
         import jax.numpy as jnp
         loader = JaxDataLoader(self._reader(synthetic_dataset), batch_size=10)
         carry, aux = loader.scan_stream(
-            lambda c, b: (c + jnp.sum(b['id']), b['id']), jnp.int64(0) + 0,
+            lambda c, b: (c + jnp.sum(b['id']), b['id']), jnp.int32(0) + 0,  # int32: x64 is disabled (conftest), int64 would warn-truncate
             chunk_batches=4, seed=None)
         ids = np.concatenate([np.asarray(a).ravel() for a in aux])
         assert sorted(ids.tolist()) == sorted(r['id'] for r in synthetic_dataset.rows)
@@ -346,10 +346,10 @@ class TestScanStream:
                 with mesh_arg:
                     return loader.scan_stream(
                         lambda c, b: (c + jnp.sum(b['id']), b['id']),
-                        jnp.int64(0) + 0, chunk_batches=3)
+                        jnp.int32(0) + 0, chunk_batches=3)
             return loader.scan_stream(
                 lambda c, b: (c + jnp.sum(b['id']), b['id']),
-                jnp.int64(0) + 0, chunk_batches=3)
+                jnp.int32(0) + 0, chunk_batches=3)
 
         carry_mesh, aux_mesh = run(mesh)
         carry_one, aux_one = run(None)
