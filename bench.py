@@ -497,6 +497,16 @@ def child_main():
             log('link floor probe failed for {}: {!r}'.format(prefix, exc))
             return {}
 
+    def deadline_exceeded(section_start, done, total, label):
+        """True once the section has outlived SECTION_DEADLINE_S, logging the
+        uniform stopped-early line. Call only after at least one measured
+        epoch so every section keeps a result."""
+        if time.monotonic() - section_start <= SECTION_DEADLINE_S:
+            return False
+        log('{}: epoch loop stopped early at the section deadline '
+            '({} of {} epochs)'.format(label, done, total))
+        return True
+
     def run_epoch(measure):
         nonlocal params, opt_state, mnist_row_bytes
         reader = make_reader(url, workers_count=WORKERS, shuffle_row_groups=True,
@@ -588,9 +598,7 @@ def child_main():
             log('inmem epoch: {} rows in {:.4f}s -> {:.1f} rows/s; input overhead '
                 '{:.1%} (sequential floor {:.4f}s)'.format(
                     rows, elapsed, rows / elapsed, stall, compute_floor_s))
-            if time.monotonic() - section_start > SECTION_DEADLINE_S:
-                log('inmem: measured-epoch loop stopped early at the section '
-                    'deadline ({} of {} epochs)'.format(epoch + 1, EPOCHS))
+            if deadline_exceeded(section_start, epoch + 1, EPOCHS, 'inmem'):
                 break
         return results, fill_epoch_s
 
@@ -771,12 +779,10 @@ def child_main():
                 log('imagenet stream epoch: {} rows in {:.2f}s -> {:.1f} rows/s, '
                     'stall {:.3f}'.format(epoch_rows, now - epoch_start, rate, stall))
                 prev_stats, epoch_rows, epoch_start = stats, 0, now
-                if (len(rates) > 1
-                        and time.monotonic() - img_section_start
-                        > SECTION_DEADLINE_S):
-                    # >1: epoch 0 is compile warmup; keep >=1 measured epoch
-                    log('imagenet stream: stopped early at the section deadline '
-                        '({} epochs incl. warmup)'.format(len(rates)))
+                # len > 1: epoch 0 is compile warmup; keep >= 1 measured epoch
+                if len(rates) > 1 and deadline_exceeded(
+                        img_section_start, len(rates), STREAM_EPOCHS + 1,
+                        'imagenet stream (incl. warmup)'):
                     break
         reader.stop()
         reader.join()
@@ -835,6 +841,7 @@ def child_main():
         loader = JaxDataLoader(reader, batch_size=IMG_BATCH, drop_last=True)
         carry = carry0
         rates = []
+        section_start = time.monotonic()
         for epoch in range(IMG_EPOCHS + 1):  # epoch 0 absorbs the compiles
             start = time.perf_counter()
             carry, aux = loader.scan_stream(scan_step, carry,
@@ -846,6 +853,9 @@ def child_main():
                 rates.append(rows / elapsed)
                 log('imagenet scan epoch: {} rows in {:.2f}s -> {:.1f} rows/s'
                     .format(rows, elapsed, rows / elapsed))
+                if deadline_exceeded(section_start, len(rates), IMG_EPOCHS,
+                                     'imagenet scan'):
+                    break
         reader.stop()
         reader.join()
         stream_rate = float(np.median(rates))
@@ -856,7 +866,11 @@ def child_main():
         results.update({
             'imagenet_scan_rows_per_sec': round(stream_rate, 2),
             'imagenet_scan_chunk_batches': chunk_batches,
+            'imagenet_scan_epochs_measured': len(rates),
         })
+        # Emit the measured line before any best-effort extras (see
+        # run_mnist_stream: a link-probe hang must not lose the section).
+        emit_partial()
         rng = np.random.RandomState(0)
         chunk = {
             'image': jnp.asarray(rng.randint(
@@ -865,6 +879,13 @@ def child_main():
             'label': jnp.asarray(rng.randint(
                 0, 1000, (chunk_batches, IMG_BATCH)).astype(np.int64)),
         }
+        # Link ceiling for CHUNK-granular transfer+dispatch; row bytes measured
+        # from the reference chunk (same shapes/dtypes the loader streams), not
+        # hand-derived from the codec layout.
+        results.update(link_floor_fields(
+            'imagenet_scan',
+            sum(v.nbytes for v in chunk.values()) / chunk_rows,
+            chunk_rows, stream_rate))
         compute_rate, chunk_program = compute_reference_rate(
             scan_step, carry0, chunk, chunk_rows)
         log('imagenet scan: stream {:.1f} rows/s vs compute-only {:.1f} rows/s '
@@ -1120,10 +1141,8 @@ def child_main():
             rate, stall = run_epoch(measure=True)
             stream_rates.append(rate)
             stream_stalls.append(stall)
-            if time.monotonic() - section_start > SECTION_DEADLINE_S:
-                log('streaming: epoch loop stopped early at the section '
-                    'deadline ({} of {} epochs)'.format(
-                        len(stream_rates), EPOCHS))
+            if deadline_exceeded(section_start, len(stream_rates), EPOCHS,
+                                 'streaming'):
                 break
         stream_value = float(np.median(stream_rates))
         results.update({
@@ -1175,9 +1194,8 @@ def child_main():
                 rates.append(rows / elapsed)
                 log('scan_stream epoch: {} rows in {:.2f}s -> {:.0f} rows/s'
                     .format(rows, elapsed, rows / elapsed))
-                if time.monotonic() - section_start > SECTION_DEADLINE_S:
-                    log('scan_stream: epoch loop stopped early at the section '
-                        'deadline ({} of {} epochs)'.format(len(rates), EPOCHS))
+                if deadline_exceeded(section_start, len(rates), EPOCHS,
+                                     'scan_stream'):
                     break
         reader.stop()
         reader.join()
