@@ -43,6 +43,24 @@ SECTIONS = [
 ]
 
 
+EXTRAS = os.path.join(HERE, 'r04_tpu_extras.jsonl')
+
+# Sweep points (tag, section, extra env, timeout) — run only AFTER every base
+# section has at least one captured line; tags mirror tpu_extras_r04.sh.
+SWEEPS = [
+    ('flash_b128x128', 'flash',
+     {'BENCH_FLASH_BLOCK_Q': '128', 'BENCH_FLASH_BLOCK_K': '128'}, 1200),
+    ('flash_b512x512', 'flash',
+     {'BENCH_FLASH_BLOCK_Q': '512', 'BENCH_FLASH_BLOCK_K': '512'}, 1200),
+    ('flash_b128x512', 'flash',
+     {'BENCH_FLASH_BLOCK_Q': '128', 'BENCH_FLASH_BLOCK_K': '512'}, 1200),
+    ('scan_chunk4', 'mnist_scan_stream', {'BENCH_SCAN_CHUNK': '4'}, 1200),
+    ('scan_chunk64', 'mnist_scan_stream', {'BENCH_SCAN_CHUNK': '64'}, 1200),
+    ('imagenet_chunk2', 'imagenet_scan', {'BENCH_IMG_CHUNK': '2'}, 1500),
+    ('imagenet_chunk8', 'imagenet_scan', {'BENCH_IMG_CHUNK': '8'}, 1500),
+]
+
+
 def now():
     return datetime.datetime.now().isoformat(timespec='seconds')
 
@@ -100,35 +118,73 @@ def captured_counts():
     return counts
 
 
-def run_section(name, timeout_s):
+def run_section(name, timeout_s, extra_env=None, target=RUNS, tag=None):
     env = dict(os.environ)
     env['BENCH_SKIP_CPU_FALLBACK'] = '1'
     env['BENCH_SECTIONS'] = name
+    for key, value in (extra_env or {}).items():
+        env[key] = value
     # leave salvage headroom: inner child dies before the outer watchdog
     env.setdefault('BENCH_CHILD_TIMEOUT', str(timeout_s - 120))
     env.setdefault('BENCH_CHILD_ATTEMPTS', '1')
-    plog('section {} START (timeout {}s)'.format(name, timeout_s))
+    label = tag or name
+    plog('section {} START (timeout {}s)'.format(label, timeout_s))
     t0 = time.time()
     try:
         out = subprocess.run([sys.executable, 'bench.py'], cwd=REPO,
                              capture_output=True, text=True,
                              timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired as exc:
-        plog('section {} OUTER-TIMEOUT after {}s'.format(name, timeout_s))
+        plog('section {} OUTER-TIMEOUT after {}s'.format(label, timeout_s))
         stdout = exc.stdout or b''
         if isinstance(stdout, bytes):
             stdout = stdout.decode('utf-8', 'replace')
-        return _append_lines(name, stdout, time.time() - t0, salvaged=True)
+        # _section stays the REAL section name (README documents grouping by
+        # it); the sweep tag travels in its own field
+        return _append_lines(name, stdout, time.time() - t0, salvaged=True,
+                             target=target, tag=tag)
     plog('section {} done rc={} in {:.0f}s'.format(
-        name, out.returncode, time.time() - t0))
+        label, out.returncode, time.time() - t0))
     if out.returncode != 0:
         for line in out.stderr.strip().splitlines()[-6:]:
             plog('stderr: ' + line[:200])
         return False
-    return _append_lines(name, out.stdout, time.time() - t0)
+    return _append_lines(name, out.stdout, time.time() - t0, target=target,
+                         tag=tag)
 
 
-def _append_lines(section, stdout, elapsed, salvaged=False, target=RUNS):
+def captured_sweep_tags():
+    """Tags with at least one CLEAN (non-salvaged) captured line. Salvaged
+    timeout-partials don't count as done, so a later healthier window retries
+    the point (bounded by the in-memory attempt cap)."""
+    tags = set()
+    try:
+        with open(EXTRAS) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not rec.get('_salvaged_from_timeout'):
+                    tags.add(rec.get('sweep'))
+    except IOError:
+        pass
+    return tags
+
+
+def next_sweep(attempts, max_attempts=2):
+    """First sweep point without a clean captured line and under the attempt
+    cap (a persistently failing point must not starve later sweeps or the
+    base-section median accumulation), or None."""
+    done = captured_sweep_tags()
+    for tag, section, env, timeout_s in SWEEPS:
+        if tag not in done and attempts.get(tag, 0) < max_attempts:
+            return tag, section, env, timeout_s
+    return None
+
+
+def _append_lines(section, stdout, elapsed, salvaged=False, target=RUNS,
+                  tag=None):
     got = False
     for line in stdout.strip().splitlines():
         line = line.strip()
@@ -144,6 +200,8 @@ def _append_lines(section, stdout, elapsed, salvaged=False, target=RUNS):
         rec['_captured_at'] = now()
         rec['_section'] = section
         rec['_bench_elapsed_s'] = round(elapsed, 1)
+        if tag:
+            rec['sweep'] = tag
         if salvaged:
             rec['_salvaged_from_timeout'] = True
         with open(target, 'a') as f:
@@ -186,6 +244,7 @@ def main():
         len(SECTIONS), TOTAL_S))
     t_start = time.time()
     link_probed_this_window = False
+    sweep_attempts = {}
     while time.time() - t_start < TOTAL_S:
         if not probe():
             link_probed_this_window = False
@@ -197,12 +256,21 @@ def main():
             run_linkprobe()
             link_probed_this_window = True
         counts = captured_counts()
-        # least-captured first; SECTIONS order breaks ties
-        name, timeout_s = min(SECTIONS, key=lambda s: counts[s[0]])
         remaining = TOTAL_S - (time.time() - t_start)
         if remaining < 180:
             break
-        run_section(name, min(timeout_s, max(int(remaining) - 60, 180)))
+        sweep = (next_sweep(sweep_attempts)
+                 if min(counts.values()) >= 1 else None)
+        if sweep is not None:
+            # base coverage complete: spend the up-window on sweep points
+            tag, name, extra_env, timeout_s = sweep
+            sweep_attempts[tag] = sweep_attempts.get(tag, 0) + 1
+            run_section(name, min(timeout_s, max(int(remaining) - 60, 180)),
+                        extra_env=extra_env, target=EXTRAS, tag=tag)
+        else:
+            # least-captured first; SECTIONS order breaks ties
+            name, timeout_s = min(SECTIONS, key=lambda s: counts[s[0]])
+            run_section(name, min(timeout_s, max(int(remaining) - 60, 180)))
         time.sleep(5)
     plog('section-cycling watcher done after {:.0f}s'.format(
         time.time() - t_start))
