@@ -43,3 +43,37 @@ def test_human_report_prints_verdict(capsys):
     assert rc == 0
     assert 'verdict: healthy' in out
     assert 'store roundtrip: OK' in out
+
+
+def test_backend_probe_timeout_reported(monkeypatch):
+    # A hanging backend init (the tunneled-device failure mode) must come back
+    # as a structured 'timeout', not a wedged doctor.
+    monkeypatch.setattr(doctor, 'PROBE_CODE', 'import time; time.sleep(30)')
+    b = doctor.check_backend(timeout_s=2)
+    assert b['status'] == 'timeout'
+    assert b['devices'] == 0
+
+
+def test_backend_probe_down_reported(monkeypatch):
+    monkeypatch.setattr(doctor, 'PROBE_CODE',
+                        'import sys; sys.stderr.write("boom\\n"); sys.exit(3)')
+    b = doctor.check_backend(timeout_s=30)
+    assert b['status'] == 'down'
+    assert 'boom' in b['detail']
+
+
+def test_backend_probe_skips_plugin_banners(monkeypatch):
+    # Accelerator plugins write banner text to stdout before the probe's own
+    # print; the parser must take the LAST line.
+    monkeypatch.setattr(
+        doctor, 'PROBE_CODE',
+        'print("some plugin banner text"); print("tpu 4")')
+    b = doctor.check_backend(timeout_s=30)
+    assert b == {'status': 'up', 'platform': 'tpu', 'devices': 4}
+
+
+def test_backend_probe_unparseable_output(monkeypatch):
+    monkeypatch.setattr(doctor, 'PROBE_CODE', 'print("just noise here")')
+    b = doctor.check_backend(timeout_s=30)
+    assert b['status'] == 'down'
+    assert 'unparseable' in b['detail']
