@@ -176,6 +176,30 @@ class TestFlashAttention:
                                    np.asarray(expected, dtype=np.float32),
                                    atol=3e-2, rtol=3e-2)
 
+    def test_bf16_gradients_match_dense(self):
+        """The blockwise backward in the dtype the bench actually trains in
+        (bf16 params, f32 VMEM accumulators)."""
+        from petastorm_tpu.ops.flash_attention import flash_attention
+        rng = np.random.RandomState(4)
+        q, k, v = (jnp.asarray(rng.randn(1, 256, 1, 128), dtype=jnp.bfloat16)
+                   for _ in range(3))
+
+        def loss(fn):
+            return lambda a, b_, c: jnp.sum(fn(a, b_, c).astype(jnp.float32) ** 2)
+
+        g_flash = jax.grad(
+            loss(lambda a, b_, c: flash_attention(a, b_, c, True, 128, 128)),
+            argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(
+            loss(lambda a, b_, c: dense_attention(a, b_, c, causal=True)),
+            argnums=(0, 1, 2))(q, k, v)
+        for gf, gd, name in zip(g_flash, g_dense, 'qkv'):
+            assert gf.dtype == jnp.bfloat16, name
+            np.testing.assert_allclose(
+                np.asarray(gf, dtype=np.float32),
+                np.asarray(gd, dtype=np.float32),
+                atol=0.25, rtol=0.1, err_msg='d{} mismatch'.format(name))
+
 
 class TestImageOps:
     def test_normalize(self):
