@@ -14,6 +14,8 @@ dataset shard-blocked across device HBM and shuffles SHARD-LOCALLY, which keeps 
 gathers collective-free — whole-epoch compilation composed with data parallelism.
 """
 
+import logging
+import time
 import warnings
 
 import numpy as np
@@ -22,9 +24,25 @@ from petastorm_tpu.parallel.loader import (FieldShardings, iter_reader_chunks,
                                            reader_may_be_infinite, resolve_sharding,
                                            sanitize_columns, sharding_for_field)
 
+logger = logging.getLogger(__name__)
+
 _FILL_SAFETY_CAP = 100_000_000
 #: scan_epochs keeps this many compiled (step_fn, shuffle) programs before evicting
 _SCAN_CACHE_MAX = 8
+
+
+def _timed_upload_log(data, upload_bytes, t0, detail):
+    """Log an upload's true duration, gated on a value readback per column:
+    ``block_until_ready`` has been observed returning before the tunneled
+    device's queue drains (see bench.py force_done / benchmark.linkprobe), and
+    a transfer log that under-reports on exactly the slow link it exists to
+    diagnose would be worse than none. Callers only invoke this when INFO
+    logging is enabled, so the readback sync is never paid silently."""
+    import jax
+    for arr in data.values():
+        jax.device_get(arr.reshape(-1)[-1:])
+    logger.info('uploaded %s (%.1f MB) in %.2fs', detail,
+                upload_bytes / 2**20, time.perf_counter() - t0)
 
 
 class InMemJaxLoader(object):
@@ -152,7 +170,16 @@ class InMemJaxLoader(object):
     def _ensure_device_data(self):
         import jax
         if self._data is None:
+            # INFO disabled -> pure async device_put (transfer overlaps the
+            # jit tracing below); INFO enabled -> readback-gated honest timing
+            # of the one visible pause on a slow link.
+            want_log = logger.isEnabledFor(logging.INFO)
+            upload_bytes = sum(col.nbytes for col in self._columns.values())
+            t0 = time.perf_counter()
             self._data = jax.device_put(self._columns)
+            if want_log:
+                _timed_upload_log(self._data, upload_bytes, t0,
+                                  '{} rows'.format(self._num_rows))
             # The on-device path never reads the host copy again; holding it would
             # double the dataset's memory footprint.
             self._columns = None
@@ -225,11 +252,21 @@ class InMemJaxLoader(object):
                               'splits evenly over the {} batch-axis shards'
                               .format(self._num_rows - usable, num_shards))
             sharding = NamedSharding(self._mesh, PartitionSpec(axis))
-            self._data = {
-                name: jax.device_put(
-                    col[:usable].reshape((num_shards, rows_per_shard) + col.shape[1:]),
-                    sharding)
+            blocks = {
+                name: col[:usable].reshape(
+                    (num_shards, rows_per_shard) + col.shape[1:])
                 for name, col in self._columns.items()}
+            want_log = logger.isEnabledFor(logging.INFO)
+            # bytes of what is ACTUALLY uploaded (trailing remainder dropped)
+            upload_bytes = sum(col.nbytes for col in blocks.values())
+            t0 = time.perf_counter()
+            self._data = {name: jax.device_put(col, sharding)
+                          for name, col in blocks.items()}
+            if want_log:
+                _timed_upload_log(
+                    self._data, upload_bytes, t0,
+                    '{} rows shard-blocked over {} devices'.format(
+                        usable, num_shards))
             self._sharded_meta = (usable, num_shards)
             self._columns = None  # single copy: the host arrays are no longer read
         return self._data, self._sharded_meta[0], self._sharded_meta[1]
