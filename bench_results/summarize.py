@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Aggregate committed TPU capture lines into a per-section summary table.
+
+Reads every ``r*_tpu_runs.jsonl`` (and ``r*_tpu_extras.jsonl`` /
+``r*_link_probes.jsonl``) in this directory and prints, per round and section,
+the median of each section's key metric with its capture count — the quick
+answer to "what hardware evidence does this round actually have?".
+
+Round-2 lines predate the ``_section`` field; they are full-bench lines, so
+every known section metric present on the line is attributed to its section.
+
+Run: ``python bench_results/summarize.py`` (add ``--json`` for one JSON line).
+"""
+import argparse
+import glob
+import json
+import os
+import re
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# section -> (identifying metric field, unit)
+SECTION_METRICS = {
+    'mnist_inmem': ('value', 'rows/s/chip'),
+    'mnist_stream': ('streaming_rows_per_sec', 'rows/s'),
+    'mnist_scan_stream': ('streaming_scan_rows_per_sec', 'rows/s'),
+    'bare_reader': ('bare_reader_rows_per_sec', 'rows/s'),
+    'imagenet_stream': ('imagenet_stream_rows_per_sec', 'rows/s'),
+    'imagenet_scan': ('imagenet_scan_rows_per_sec', 'rows/s'),
+    'decode_delta': ('imagenet_onchip_decode_rows_per_sec', 'rows/s'),
+    'flash': ('flash_train_tokens_per_sec', 'tokens/s'),
+    'moe': ('moe_train_tokens_per_sec', 'tokens/s'),
+}
+# secondary fields worth surfacing beside the headline metric
+SECONDARY = {
+    'mnist_inmem': ('input_stall_fraction', 'mnist_train_mfu'),
+    'mnist_stream': ('streaming_input_stall_fraction', 'streaming_link_efficiency'),
+    'mnist_scan_stream': ('streaming_scan_efficiency',),
+    'imagenet_stream': ('imagenet_stream_input_stall_fraction',
+                        'imagenet_stream_link_efficiency', 'imagenet_train_mfu'),
+    'imagenet_scan': ('imagenet_scan_efficiency', 'imagenet_scan_link_efficiency'),
+    'decode_delta': ('onchip_decode_speedup',),
+    'flash': ('flash_no_fallback', 'flash_train_mfu'),
+    'moe': ('moe_max_drop_fraction', 'moe_train_mfu'),
+}
+LINK_FIELDS = ('dispatch_rtt_ms', 'h2d_mbytes_per_sec', 'd2h_mbytes_per_sec')
+
+
+def _median(values):
+    values = sorted(values)
+    n = len(values)
+    mid = n // 2
+    return values[mid] if n % 2 else (values[mid - 1] + values[mid]) / 2.0
+
+
+def _round_of(path):
+    match = re.search(r'r(\d+)_', os.path.basename(path))
+    return int(match.group(1)) if match else -1
+
+
+def load_lines(pattern):
+    out = []
+    for path in sorted(glob.glob(os.path.join(HERE, pattern))):
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                rec['_round'] = _round_of(path)
+                out.append(rec)
+    return out
+
+
+def summarize():
+    runs = load_lines('r*_tpu_runs.jsonl')
+    extras = load_lines('r*_tpu_extras.jsonl')
+    links = load_lines('r*_link_probes.jsonl')
+
+    sections = {}  # (round, section) -> {metric: [...], secondary: {f: [...]}}
+    for rec in runs:
+        for section, (field, unit) in SECTION_METRICS.items():
+            if rec.get('_section') not in (None, section):
+                continue  # single-section line for a different section
+            if field not in rec:
+                continue
+            if section == 'mnist_inmem' and 'fill_epoch_s' not in rec:
+                continue  # 'value' may be a fallback-promoted other metric
+            entry = sections.setdefault((rec['_round'], section),
+                                        {'values': [], 'secondary': {}})
+            entry['values'].append(rec[field])
+            entry['unit'] = unit
+            for sec_field in SECONDARY.get(section, ()):
+                if sec_field in rec:
+                    entry['secondary'].setdefault(sec_field, []).append(
+                        rec[sec_field])
+
+    sweeps = {}
+    for rec in extras:
+        tag = rec.get('sweep')
+        section = rec.get('_section')
+        field = SECTION_METRICS.get(section, (None,))[0]
+        if tag and field and field in rec:
+            sweeps.setdefault((rec['_round'], tag), []).append(rec[field])
+
+    link_summary = {}
+    for rec in links:
+        entry = link_summary.setdefault(rec['_round'], {})
+        for field in LINK_FIELDS:
+            if field in rec:
+                entry.setdefault(field, []).append(rec[field])
+
+    return sections, sweeps, link_summary
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument('--json', action='store_true')
+    args = parser.parse_args(argv)
+    sections, sweeps, links = summarize()
+
+    if args.json:
+        payload = {
+            'sections': {'r{}:{}'.format(r, s): {
+                'median': _median(e['values']), 'n': len(e['values']),
+                'unit': e.get('unit'),
+                **{f: _median(v) for f, v in e['secondary'].items()
+                   if v and not isinstance(v[0], bool)}}
+                for (r, s), e in sorted(sections.items())},
+            'sweeps': {'r{}:{}'.format(r, t): {
+                'median': _median(v), 'n': len(v)}
+                for (r, t), v in sorted(sweeps.items())},
+            'links': {'r{}'.format(r): {
+                f: _median(v) for f, v in e.items()}
+                for r, e in sorted(links.items())},
+        }
+        print(json.dumps(payload))
+        return 0
+
+    print('== TPU capture summary (medians; n = captured lines) ==')
+    for (rnd, section), entry in sorted(sections.items()):
+        extras_txt = ' '.join(
+            '{}={}'.format(f, round(_median(v), 4)
+                           if not isinstance(v[0], bool) else all(v))
+            for f, v in sorted(entry['secondary'].items()))
+        print('r{:02d} {:18s} {:>14,.1f} {:11s} n={} {}'.format(
+            rnd, section, _median(entry['values']), entry.get('unit', ''),
+            len(entry['values']), extras_txt))
+    if sweeps:
+        print('-- sweeps --')
+        for (rnd, tag), values in sorted(sweeps.items()):
+            print('r{:02d} {:18s} {:>14,.1f} n={}'.format(
+                rnd, tag, _median(values), len(values)))
+    if links:
+        print('-- link probes --')
+        for rnd, entry in sorted(links.items()):
+            print('r{:02d} {}'.format(rnd, ' '.join(
+                '{}={}'.format(f, round(_median(v), 2))
+                for f, v in sorted(entry.items()))))
+    if not sections:
+        print('(no TPU lines captured yet)')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

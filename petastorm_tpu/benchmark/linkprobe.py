@@ -33,9 +33,10 @@ __all__ = ['probe_link', 'streaming_ceiling_rows_per_sec']
 
 
 def _readback_gate(x):
-    """Force completion by pulling one reduced scalar to the host."""
-    import jax.numpy as jnp
-    return float(np.asarray(jnp.sum(x.reshape(-1)[-1:])))
+    """Force completion by pulling a value to the host (the project-wide
+    honest-timing idiom, shared with the loaders)."""
+    from petastorm_tpu.utils import value_readback_gate
+    value_readback_gate(x)
 
 
 def _median_time(fn, iters):

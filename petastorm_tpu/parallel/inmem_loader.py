@@ -31,18 +31,22 @@ _FILL_SAFETY_CAP = 100_000_000
 _SCAN_CACHE_MAX = 8
 
 
-def _timed_upload_log(data, upload_bytes, t0, detail):
-    """Log an upload's true duration, gated on a value readback per column:
-    ``block_until_ready`` has been observed returning before the tunneled
-    device's queue drains (see bench.py force_done / benchmark.linkprobe), and
-    a transfer log that under-reports on exactly the slow link it exists to
-    diagnose would be worse than none. Callers only invoke this when INFO
-    logging is enabled, so the readback sync is never paid silently."""
-    import jax
-    for arr in data.values():
-        jax.device_get(arr.reshape(-1)[-1:])
-    logger.info('uploaded %s (%.1f MB) in %.2fs', detail,
-                upload_bytes / 2**20, time.perf_counter() - t0)
+def _put_with_log(put_fn, upload_bytes, detail):
+    """Run an upload and, when INFO logging is enabled, log its TRUE duration
+    gated on :func:`petastorm_tpu.utils.value_readback_gate` (the project-wide
+    honest-timing convention — ``block_until_ready`` lies through the device
+    tunnel, and a transfer log that under-reports on exactly the slow link it
+    exists to diagnose would be worse than none). With INFO disabled the
+    upload stays fully async: no sync is paid for a discarded measurement."""
+    want_log = logger.isEnabledFor(logging.INFO)
+    t0 = time.perf_counter()
+    data = put_fn()
+    if want_log:
+        from petastorm_tpu.utils import value_readback_gate
+        value_readback_gate(data)
+        logger.info('uploaded %s (%.1f MB) in %.2fs', detail,
+                    upload_bytes / 2**20, time.perf_counter() - t0)
+    return data
 
 
 class InMemJaxLoader(object):
@@ -170,16 +174,11 @@ class InMemJaxLoader(object):
     def _ensure_device_data(self):
         import jax
         if self._data is None:
-            # INFO disabled -> pure async device_put (transfer overlaps the
-            # jit tracing below); INFO enabled -> readback-gated honest timing
-            # of the one visible pause on a slow link.
-            want_log = logger.isEnabledFor(logging.INFO)
-            upload_bytes = sum(col.nbytes for col in self._columns.values())
-            t0 = time.perf_counter()
-            self._data = jax.device_put(self._columns)
-            if want_log:
-                _timed_upload_log(self._data, upload_bytes, t0,
-                                  '{} rows'.format(self._num_rows))
+            columns = self._columns
+            self._data = _put_with_log(
+                lambda: jax.device_put(columns),
+                sum(col.nbytes for col in columns.values()),
+                '{} rows'.format(self._num_rows))
             # The on-device path never reads the host copy again; holding it would
             # double the dataset's memory footprint.
             self._columns = None
@@ -256,17 +255,13 @@ class InMemJaxLoader(object):
                 name: col[:usable].reshape(
                     (num_shards, rows_per_shard) + col.shape[1:])
                 for name, col in self._columns.items()}
-            want_log = logger.isEnabledFor(logging.INFO)
-            # bytes of what is ACTUALLY uploaded (trailing remainder dropped)
-            upload_bytes = sum(col.nbytes for col in blocks.values())
-            t0 = time.perf_counter()
-            self._data = {name: jax.device_put(col, sharding)
-                          for name, col in blocks.items()}
-            if want_log:
-                _timed_upload_log(
-                    self._data, upload_bytes, t0,
-                    '{} rows shard-blocked over {} devices'.format(
-                        usable, num_shards))
+            self._data = _put_with_log(
+                lambda: {name: jax.device_put(col, sharding)
+                         for name, col in blocks.items()},
+                # bytes of what is ACTUALLY uploaded (trailing remainder dropped)
+                sum(col.nbytes for col in blocks.values()),
+                '{} rows shard-blocked over {} devices'.format(
+                    usable, num_shards))
             self._sharded_meta = (usable, num_shards)
             self._columns = None  # single copy: the host arrays are no longer read
         return self._data, self._sharded_meta[0], self._sharded_meta[1]

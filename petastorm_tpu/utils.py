@@ -50,3 +50,35 @@ def run_in_subprocess(func, *args, **kwargs):
         exc, tb = pickle.loads(payload)
         raise RuntimeError('Subprocess failed:\n{}'.format(tb)) from exc
     return pickle.loads(payload)
+
+
+def value_readback_gate(tree):
+    """Force completion of every jax array in ``tree`` by pulling one element
+    back to the host.
+
+    ``jax.block_until_ready`` has been observed returning before the tunneled
+    device's queue drains, so honest wall-clock timing (and "transfer
+    finished" logging) must gate on a real value transfer — the project-wide
+    convention (bench.py ``force_done``, ``benchmark.linkprobe``). Safe on
+    multi-process meshes: reads from an ADDRESSABLE shard of each array
+    (``jax.device_get`` on a global array spanning other processes raises).
+    Fetches are issued async first, so gating k arrays costs ~one link round
+    trip rather than k sequential ones.
+    """
+    import jax
+    import numpy as np
+    gates = []
+    for leaf in jax.tree.leaves(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        shards = leaf.addressable_shards
+        if not shards:
+            continue
+        gates.append(shards[-1].data.reshape(-1)[-1:])
+    for gate in gates:
+        try:
+            gate.copy_to_host_async()
+        except AttributeError:  # older jax Array without the async hint
+            pass
+    for gate in gates:
+        np.asarray(gate)

@@ -57,3 +57,18 @@ def test_streaming_ceiling_math():
     # a faster link raises the ceiling
     faster = dict(link, h2d_mbytes_per_sec=80.0)
     assert streaming_ceiling_rows_per_sec(faster, 1024, 2048) > rows_per_sec
+
+
+def test_value_readback_gate_handles_trees_and_shards():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from petastorm_tpu.utils import value_readback_gate
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ('data',))
+    sharded = jax.device_put(jnp.arange(16.0).reshape(4, 4),
+                             NamedSharding(mesh, P('data')))
+    tree = {'a': jnp.ones((3, 2)), 'b': sharded, 'c': 'not-an-array',
+            'd': jnp.zeros((0,))}
+    value_readback_gate(tree)  # must not raise on shards/non-arrays/empties
