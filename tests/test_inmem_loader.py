@@ -290,3 +290,29 @@ class TestScanEpochsMesh:
             _, aux = loader.scan_epochs(lambda c, b: (c, b['id']), None, num_epochs=1)
             return np.asarray(aux[0]).ravel().tolist()
         assert run() == run()
+
+
+def test_fill_upload_logged_at_info(synthetic_dataset, caplog):
+    import logging
+    with caplog.at_level(logging.INFO,
+                         logger='petastorm_tpu.parallel.inmem_loader'):
+        reader = make_reader(synthetic_dataset.url, workers_count=1,
+                             num_epochs=1, schema_fields=['id'])
+        loader = InMemJaxLoader(reader, batch_size=4, num_epochs=1)
+        list(loader)
+    assert 'uploaded' in caplog.text and 'rows' in caplog.text
+
+
+def test_sharded_fill_upload_logged_at_info(synthetic_dataset, caplog):
+    import logging
+    mesh = make_mesh(('data',), axis_sizes=(4,),
+                     devices=jax.devices()[:4])
+    with caplog.at_level(logging.INFO,
+                         logger='petastorm_tpu.parallel.inmem_loader'):
+        reader = make_reader(synthetic_dataset.url, workers_count=1,
+                             num_epochs=1, schema_fields=['id'])
+        loader = InMemJaxLoader(reader, batch_size=8, num_epochs=None,
+                                mesh=mesh)
+        loader.scan_epochs(lambda c, b: (c + b['id'].sum(), None), 0,
+                           num_epochs=1)
+    assert 'shard-blocked over 4 devices' in caplog.text
