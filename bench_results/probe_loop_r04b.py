@@ -21,9 +21,13 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-PROBE_LOG = os.path.join(HERE, 'r04_probe_log.txt')
-RUNS = os.path.join(HERE, 'r04_tpu_runs.jsonl')
-LINK_RUNS = os.path.join(HERE, 'r04_link_probes.jsonl')
+# Round tag: later rounds reuse this script unchanged via PROBE_ROUND=r05 —
+# fresh artifact files per round, per-section capture counts resume from the
+# round's own runs file.
+ROUND = os.environ.get('PROBE_ROUND', 'r04')
+PROBE_LOG = os.path.join(HERE, '{}_probe_log.txt'.format(ROUND))
+RUNS = os.path.join(HERE, '{}_tpu_runs.jsonl'.format(ROUND))
+LINK_RUNS = os.path.join(HERE, '{}_link_probes.jsonl'.format(ROUND))
 PROBE_TIMEOUT_S = int(os.environ.get('PROBE_TIMEOUT', 90))
 PROBE_EVERY_S = int(os.environ.get('PROBE_EVERY', 240))
 TOTAL_S = int(os.environ.get('PROBE_TOTAL', int(11.0 * 3600)))
@@ -43,7 +47,7 @@ SECTIONS = [
 ]
 
 
-EXTRAS = os.path.join(HERE, 'r04_tpu_extras.jsonl')
+EXTRAS = os.path.join(HERE, '{}_tpu_extras.jsonl'.format(ROUND))
 
 # Sweep points (tag, section, extra env, timeout) — run only AFTER every base
 # section has at least one captured line; tags mirror tpu_extras_r04.sh.
