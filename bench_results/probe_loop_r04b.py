@@ -128,8 +128,11 @@ def run_section(name, timeout_s, extra_env=None, target=RUNS, tag=None):
     env['BENCH_SECTIONS'] = name
     for key, value in (extra_env or {}).items():
         env[key] = value
-    # leave salvage headroom: inner child dies before the outer watchdog
+    # leave salvage headroom: inner child dies before the outer watchdog, and
+    # the round-5 parent budget makes the parent itself emit + exit cleanly
+    # (rc=0, streamed lines parsed normally) before our SIGKILL would land
     env.setdefault('BENCH_CHILD_TIMEOUT', str(timeout_s - 120))
+    env.setdefault('BENCH_TOTAL_BUDGET', str(timeout_s - 60))
     env.setdefault('BENCH_CHILD_ATTEMPTS', '1')
     label = tag or name
     plog('section {} START (timeout {}s)'.format(label, timeout_s))
@@ -189,8 +192,11 @@ def next_sweep(attempts, max_attempts=2):
 
 def _append_lines(section, stdout, elapsed, salvaged=False, target=RUNS,
                   tag=None):
+    # Newest line only: the round-5 bench parent STREAMS cumulative lines (one
+    # per completed section) — each supersedes the previous, so appending all of
+    # them would double-count sections in captured_counts().
     got = False
-    for line in stdout.strip().splitlines():
+    for line in reversed((stdout or '').strip().splitlines()):
         line = line.strip()
         if not line.startswith('{'):
             continue
@@ -200,6 +206,10 @@ def _append_lines(section, stdout, elapsed, salvaged=False, target=RUNS,
             continue
         if rec.get('platform') == 'cpu':
             plog('section {} produced a CPU line — NOT appending'.format(section))
+            continue
+        if rec.get('platform') == 'unknown':
+            # round-5 bench parent bootstrap line: parseable but carries no
+            # measurement — keep scanning for an older measured line
             continue
         rec['_captured_at'] = now()
         rec['_section'] = section
@@ -214,6 +224,7 @@ def _append_lines(section, stdout, elapsed, salvaged=False, target=RUNS,
             section, os.path.basename(target), rec.get('metric'),
             rec.get('value')))
         got = True
+        break
     if not got and not salvaged:
         plog('section {} rc=0 but no appendable JSON line'.format(section))
     return got
@@ -261,7 +272,10 @@ def main():
             link_probed_this_window = True
         counts = captured_counts()
         remaining = TOTAL_S - (time.time() - t_start)
-        if remaining < 180:
+        if remaining < 420:
+            # A child launched now would get <240s after the 120s salvage
+            # headroom — on the degraded link that's a guaranteed wasted
+            # attempt, so stop instead of burning the tail of the window.
             break
         sweep = (next_sweep(sweep_attempts)
                  if min(counts.values()) >= 1 else None)
@@ -269,12 +283,12 @@ def main():
             # base coverage complete: spend the up-window on sweep points
             tag, name, extra_env, timeout_s = sweep
             sweep_attempts[tag] = sweep_attempts.get(tag, 0) + 1
-            run_section(name, min(timeout_s, max(int(remaining) - 60, 180)),
+            run_section(name, min(timeout_s, int(remaining) - 60),
                         extra_env=extra_env, target=EXTRAS, tag=tag)
         else:
             # least-captured first; SECTIONS order breaks ties
             name, timeout_s = min(SECTIONS, key=lambda s: counts[s[0]])
-            run_section(name, min(timeout_s, max(int(remaining) - 60, 180)))
+            run_section(name, min(timeout_s, int(remaining) - 60))
         time.sleep(5)
     plog('section-cycling watcher done after {:.0f}s'.format(
         time.time() - t_start))

@@ -298,25 +298,101 @@ def test_spark_session_cli_bad_pair_rejected():
         spark_session_cli._parse_config_pairs(['no_equals_sign'])
 
 
+class TestBenchNeverEmptyArtifact:
+    """Round-5 driver-artifact guarantee (VERDICT r4 item 1): the bench parent's
+    stdout always ends with a parseable headline JSON line, even when the parent
+    itself is SIGKILLed mid-run — the exact round-4 failure mode (driver outer
+    timeout, rc=124, BENCH_r04.json parsed=null)."""
+
+    BENCH = os.path.join(os.path.dirname(__file__), '..', 'bench.py')
+
+    def _popen(self, env_extra):
+        import subprocess
+        import sys
+        env = dict(os.environ)
+        env.pop('BENCH_SKIP_CPU_FALLBACK', None)  # driver mode, not watcher mode
+        env.update(env_extra)
+        return subprocess.Popen([sys.executable, self.BENCH],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True, env=env)
+
+    @staticmethod
+    def _assert_headline_contract(line):
+        import json
+        rec = json.loads(line)
+        for key in ('metric', 'value', 'unit', 'vs_baseline'):
+            assert key in rec, (key, rec)
+        return rec
+
+    def test_sigkill_during_probe_leaves_bootstrap_line(self):
+        # The bootstrap line is flushed before the TPU probe even starts, so a
+        # kill at ANY later instant leaves at least this parseable artifact.
+        proc = self._popen({'BENCH_PROBE_TIMEOUT': '30'})
+        try:
+            first_line = proc.stdout.readline()
+        finally:
+            proc.kill()
+            proc.wait()
+        rec = self._assert_headline_contract(first_line)
+        assert rec['platform'] == 'unknown'
+        assert rec['value'] == 0.0
+
+    def test_sigkill_after_section_keeps_streamed_measurement(self, tmp_path):
+        # CPU path, one fast section: the parent must re-emit the section's
+        # cumulative line the moment it completes — SIGKILL the parent right
+        # then and assert the measured line (not the bootstrap) is what's left.
+        import json
+        import signal
+        import time
+        proc = self._popen({
+            'BENCH_PROBE_TIMEOUT': '10', 'BENCH_PROBE_ATTEMPTS': '1',
+            'BENCH_SECTIONS': 'bare_reader', 'BENCH_ROWS': '64',
+            'BENCH_WORKERS': '1', 'BENCH_TOTAL_BUDGET': '600',
+            'JAX_PLATFORMS': 'cpu', 'TMPDIR': str(tmp_path)})
+        lines, deadline = [], time.monotonic() + 240
+        try:
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                lines.append(line)
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if 'bare_reader_rows_per_sec' in rec:
+                    os.kill(proc.pid, signal.SIGKILL)  # the r4 driver-kill moment
+                    break
+        finally:
+            proc.kill()
+            proc.wait()
+        assert lines, 'parent printed nothing'
+        rec = self._assert_headline_contract(lines[-1])
+        assert rec['value'] > 0
+        assert rec['bare_reader_rows_per_sec'] > 0
+        assert rec['platform'] == 'cpu'
+
+    def test_budget_exhaustion_exits_cleanly_with_artifact(self):
+        # BENCH_TOTAL_BUDGET too small for any child: the parent must still
+        # exit rc=0 with the bootstrap line as a parseable artifact instead of
+        # hanging into the driver's SIGKILL.
+        proc = self._popen({'BENCH_PROBE_TIMEOUT': '10',
+                            'BENCH_PROBE_ATTEMPTS': '1',
+                            'JAX_PLATFORMS': 'cpu',
+                            'BENCH_TOTAL_BUDGET': '1'})
+        try:
+            out, _ = proc.communicate(timeout=120)
+        except Exception:
+            proc.kill()
+            raise
+        assert proc.returncode == 0
+        last = [ln for ln in out.strip().splitlines() if ln.startswith('{')][-1]
+        self._assert_headline_contract(last)
+
+
 class TestBenchHelpers:
-    """bench.py robustness pieces (VERDICT r2 item 1): partial-result salvage and the
-    DCT-compressible synthetic images."""
-
-    def test_salvage_partial_takes_newest(self):
-        import bench
-        stdout = ('noise\n'
-                  'PARTIAL_JSON {"platform": "tpu", "a": 1, "partial": true}\n'
-                  'mid\n'
-                  'PARTIAL_JSON {"platform": "tpu", "a": 1, "b": 2, "partial": true}\n')
-        got = bench._salvage_partial(stdout)
-        assert got == {'platform': 'tpu', 'a': 1, 'b': 2, 'partial': True}
-
-    def test_salvage_partial_none_cases(self):
-        import bench
-        assert bench._salvage_partial('') is None
-        assert bench._salvage_partial(None) is None
-        assert bench._salvage_partial('{"final": 1}\n') is None
-        assert bench._salvage_partial('PARTIAL_JSON not-json\n') is None
+    """bench.py robustness pieces (VERDICT r2 item 1): the DCT-compressible
+    synthetic images."""
 
     def test_synthetic_photo_compresses_in_dct_domain(self):
         """The imagenet stream story depends on it: quantized DCT coefficients of the
