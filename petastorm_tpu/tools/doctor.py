@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import tempfile
@@ -47,6 +48,22 @@ PROBE_CODE = (
     "    jax.config.update('jax_platforms', 'cpu')\n"
     "ds = jax.devices()\n"
     "print(ds[0].platform, len(ds))\n")
+
+# Link probe child (r4 advisor, medium): a tunnel that wedges AFTER the backend
+# probe subprocess succeeded — or degrades mid-run — used to hang the doctor
+# in-process on exactly the condition it exists to diagnose. Same
+# subprocess+timeout pattern as check_backend; the tagged last line survives
+# plugin banner noise on stdout.
+LINK_PROBE_CODE = (
+    "import json, os, jax\n"
+    "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
+    "    jax.config.update('jax_platforms', 'cpu')\n"
+    "from petastorm_tpu.benchmark.linkprobe import (\n"
+    "    probe_link, streaming_ceiling_rows_per_sec)\n"
+    "link = probe_link(sizes_mb=(1, 4), dispatch_iters=10, transfer_iters=3)\n"
+    "link['streaming_ceiling_rows_per_sec_at_1kib'] = round(\n"
+    "    streaming_ceiling_rows_per_sec(link, {row_bytes}, {batch}), 1)\n"
+    "print('LINKPROBE_JSON ' + json.dumps(link))\n")
 
 
 def check_versions():
@@ -72,22 +89,37 @@ def check_versions():
     return report
 
 
+def _probe_subprocess(code, timeout_s, timeout_detail, env=None):
+    """Run probe ``code`` in a subprocess with a hard timeout.
+
+    Returns ``(completed_process, None)`` on a clean exit, else
+    ``(None, error_dict)`` with ``status`` 'timeout'/'down' and a ``detail``
+    drawn from the child's stderr tail — the shared scaffolding for every
+    doctor check that must survive a wedged tunnel."""
+    try:
+        out = subprocess.run([sys.executable, '-c', code], env=env,
+                             capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, {'status': 'timeout',
+                      'detail': timeout_detail.format(timeout_s)}
+    if out.returncode != 0:
+        return None, {'status': 'down',
+                      'detail': out.stderr.strip().splitlines()[-1][:200]
+                      if out.stderr.strip() else 'unknown'}
+    return out, None
+
+
 def check_backend(timeout_s=60):
     """Probe ``jax.devices()`` in a subprocess with a hard timeout.
 
     Returns ``{'status': 'up'|'down'|'timeout', 'platform': ..., 'devices': N}``.
     """
-    try:
-        out = subprocess.run([sys.executable, '-c', PROBE_CODE],
-                             capture_output=True, text=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return {'status': 'timeout', 'platform': None, 'devices': 0,
-                'detail': 'backend init exceeded {}s — tunneled device '
-                          'unreachable?'.format(timeout_s)}
-    if out.returncode != 0:
-        return {'status': 'down', 'platform': None, 'devices': 0,
-                'detail': out.stderr.strip().splitlines()[-1][:200]
-                if out.stderr.strip() else 'unknown'}
+    out, error = _probe_subprocess(
+        PROBE_CODE, timeout_s,
+        'backend init exceeded {}s — tunneled device unreachable?')
+    if error is not None:
+        error.update(platform=None, devices=0)
+        return error
     # parse the LAST line only: accelerator plugins/libtpu may write banner
     # text to the child's stdout before the probe's own print
     try:
@@ -99,16 +131,43 @@ def check_backend(timeout_s=60):
                     out.stdout.strip()[-200:])}
 
 
-def check_link(reference_row_bytes=1024, reference_batch=1024):
-    """Link probe + the per-batch streaming ceiling it implies (only call when
-    the backend is up — this one runs in-process)."""
-    from petastorm_tpu.benchmark.linkprobe import (
-        probe_link, streaming_ceiling_rows_per_sec)
-    link = probe_link(sizes_mb=(1, 4), dispatch_iters=10, transfer_iters=3)
-    link['streaming_ceiling_rows_per_sec_at_1kib'] = round(
-        streaming_ceiling_rows_per_sec(link, reference_row_bytes,
-                                       reference_batch), 1)
-    return link
+def check_link(reference_row_bytes=1024, reference_batch=1024, timeout_s=180):
+    """Link probe + the per-batch streaming ceiling it implies, run in a
+    subprocess with a hard timeout (only call when the backend is up).
+
+    A hang — the tunnel's documented failure mode, which can start *between*
+    the backend probe and this measurement — is reported as
+    ``{'status': 'timeout', ...}``, a link failure, instead of wedging the
+    doctor."""
+    code = LINK_PROBE_CODE.format(row_bytes=int(reference_row_bytes),
+                                  batch=int(reference_batch))
+    env = dict(os.environ)
+    # the child must find petastorm_tpu even when the doctor runs from a
+    # source checkout that was put on sys.path by hand
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    existing = env.get('PYTHONPATH', '')
+    # no trailing separator when PYTHONPATH was unset: an empty entry means
+    # cwd, where a stray jax.py/json.py would shadow the real module
+    env['PYTHONPATH'] = (pkg_root + os.pathsep + existing if existing
+                         else pkg_root)
+    out, error = _probe_subprocess(
+        code, timeout_s,
+        'link probe exceeded {}s — tunnel wedged after backend probe?',
+        env=env)
+    if error is not None:
+        if error['status'] == 'down':
+            error['status'] = 'fail'  # backend was up; this is a link failure
+        return error
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith('LINKPROBE_JSON '):
+            try:
+                return json.loads(line[len('LINKPROBE_JSON '):])
+            except ValueError:
+                break
+    return {'status': 'fail',
+            'detail': 'unparseable link probe output: {!r}'.format(
+                out.stdout.strip()[-200:])}
 
 
 def check_store_roundtrip(rows=200, workers=2):
@@ -149,13 +208,13 @@ def check_store_roundtrip(rows=200, workers=2):
             'rows_per_sec': round(rows / elapsed, 1)}
 
 
-def collect_report(probe_timeout_s=60, link=True):
+def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
     """Run every check; returns the full report dict (no printing)."""
     report = {'versions': check_versions()}
     report['backend'] = check_backend(timeout_s=probe_timeout_s)
     if link and report['backend']['status'] == 'up':
         try:
-            report['link'] = check_link()
+            report['link'] = check_link(timeout_s=link_timeout_s)
         except Exception as exc:  # noqa: BLE001 - link probe is best-effort
             report['link'] = {'status': 'fail', 'detail': repr(exc)}
     try:
@@ -210,11 +269,14 @@ def main(argv=None):
                         help='print one machine-readable JSON line instead')
     parser.add_argument('--probe-timeout', type=int, default=60,
                         help='backend probe subprocess timeout (seconds)')
+    parser.add_argument('--link-timeout', type=int, default=180,
+                        help='link probe subprocess timeout (seconds)')
     parser.add_argument('--no-link', action='store_true',
                         help='skip the link bandwidth probe')
     args = parser.parse_args(argv)
     report = collect_report(probe_timeout_s=args.probe_timeout,
-                            link=not args.no_link)
+                            link=not args.no_link,
+                            link_timeout_s=args.link_timeout)
     if args.json:
         print(json.dumps(report))
     else:

@@ -60,10 +60,13 @@ def value_readback_gate(tree):
     device's queue drains, so honest wall-clock timing (and "transfer
     finished" logging) must gate on a real value transfer — the project-wide
     convention (bench.py ``force_done``, ``benchmark.linkprobe``). Safe on
-    multi-process meshes: reads from an ADDRESSABLE shard of each array
+    multi-process meshes: reads from the ADDRESSABLE shards of each array
     (``jax.device_get`` on a global array spanning other processes raises).
-    Fetches are issued async first, so gating k arrays costs ~one link round
-    trip rather than k sequential ones.
+    Gates on one element of EVERY addressable shard — not just the last — so a
+    shard-blocked multi-device upload (inmem_loader's sharded ``_put_with_log``)
+    cannot report done while transfers to other devices are still in flight
+    (r4 advisor). Fetches are issued async first, so gating k shards costs ~one
+    link round trip rather than k sequential ones.
     """
     import jax
     import numpy as np
@@ -71,10 +74,8 @@ def value_readback_gate(tree):
     for leaf in jax.tree.leaves(tree):
         if not isinstance(leaf, jax.Array):
             continue
-        shards = leaf.addressable_shards
-        if not shards:
-            continue
-        gates.append(shards[-1].data.reshape(-1)[-1:])
+        for shard in leaf.addressable_shards:
+            gates.append(shard.data.reshape(-1)[-1:])
     for gate in gates:
         try:
             gate.copy_to_host_async()

@@ -77,3 +77,42 @@ def test_backend_probe_unparseable_output(monkeypatch):
     b = doctor.check_backend(timeout_s=30)
     assert b['status'] == 'down'
     assert 'unparseable' in b['detail']
+
+
+def test_link_probe_timeout_reported(monkeypatch):
+    # The r4 advisor's medium finding: a tunnel that wedges AFTER the backend
+    # probe succeeded used to hang the doctor in-process. Now it's a
+    # subprocess with a hard timeout reporting a structured link failure.
+    monkeypatch.setattr(doctor, 'LINK_PROBE_CODE', 'import time; time.sleep(30)')
+    link = doctor.check_link(timeout_s=2)
+    assert link['status'] == 'timeout'
+    assert 'wedged' in link['detail']
+
+
+def test_link_probe_crash_reported(monkeypatch):
+    monkeypatch.setattr(
+        doctor, 'LINK_PROBE_CODE',
+        'import sys; sys.stderr.write("tunnel broke\\n"); sys.exit(2)')
+    link = doctor.check_link(timeout_s=30)
+    assert link['status'] == 'fail'
+    assert 'tunnel broke' in link['detail']
+
+
+def test_link_probe_parses_past_banner_noise(monkeypatch):
+    monkeypatch.setattr(
+        doctor, 'LINK_PROBE_CODE',
+        'print("plugin banner"); '
+        'print(\'LINKPROBE_JSON {{"dispatch_rtt_ms": 1.5, '
+        '"streaming_ceiling_rows_per_sec_at_1kib": {row_bytes}.0}}\')')
+    link = doctor.check_link(reference_row_bytes=2048, timeout_s=30)
+    assert link['dispatch_rtt_ms'] == 1.5
+    # the format() substitution reached the child code
+    assert link['streaming_ceiling_rows_per_sec_at_1kib'] == 2048.0
+
+
+def test_link_probe_real_on_cpu():
+    # Real in-subprocess probe against the CPU backend: exercises the
+    # PYTHONPATH plumbing and the linkprobe import inside the child.
+    link = doctor.check_link(timeout_s=120)
+    assert 'dispatch_rtt_ms' in link, link
+    assert link['streaming_ceiling_rows_per_sec_at_1kib'] > 0
