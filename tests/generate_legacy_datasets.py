@@ -1,0 +1,398 @@
+"""Generate the vendored golden legacy stores under ``tests/data/legacy/``.
+
+Role model: the reference's ``petastorm/tests/generate_dataset_for_legacy_tests.py:1``
+— it checks stores written by REAL old petastorm versions into its own test tree so
+back-compat is covered forever without external mounts. This repo cannot copy those
+binary stores (they are reference artifacts), so this script SYNTHESIZES stores in
+the same on-disk metadata dialect each petastorm vintage produced, verified against
+the real stores' pickle disassembly (``pickletools`` over
+``dataset-toolkit.unischema.v1``) and physical Arrow schemas:
+
+- protocol-0 pickled Unischema under ``dataset-toolkit.unischema.v1`` in
+  ``_common_metadata`` (petastorm/etl/dataset_metadata.py:209-220), with the
+  py2-era module spellings (``copy_reg``, ``__builtin__``);
+- ``pyspark.serializers._restore`` namedtuple-hijack field pickles for vintages
+  <= 0.7.0, and ``copy_reg._reconstructor(UnischemaField, tuple, ...)`` field
+  pickles for 0.7.6 — the two constructions
+  :mod:`petastorm_tpu.etl.legacy` must depickle;
+- numpy 1.x scalar-type names (``unicode_``, ``string_``) that no longer exist
+  in numpy 2.x;
+- pyspark.sql.types codec state (``ScalarCodec`` carrying a Spark type
+  instance, ``DecimalType`` with precision/scale state);
+- the field-set evolution across versions (0.5.1 adds id_float/id_odd, 0.7.0
+  widens matrix_string to 2-D, 0.7.6 adds integer_nullable/matrix_uint32);
+- hive partitioning on ``partition_key`` with the codec-encoded binary columns
+  (npy blobs for NdarrayCodec, PNG bytes for CompressedImageCodec) and the
+  vintage physical types (int16 for ShortType-coded scalars,
+  ``decimal128(10, 9)``);
+- a ``prehistoric`` store whose pickle refers to the pre-rename
+  ``av.ml.dataset_toolkit.*`` package names (petastorm/etl/legacy.py:57-81),
+  exercising :func:`petastorm_tpu.etl.legacy._rewrite_prehistoric_names`.
+
+Run once from the repo root and commit the output; tests read the committed
+stores and never invoke this script:
+
+    python tests/generate_legacy_datasets.py
+"""
+
+import collections
+import io
+import os
+import pickle
+import shutil
+import sys
+import types
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+OUT_BASE = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'data', 'legacy')
+
+UNISCHEMA_KEY = b'dataset-toolkit.unischema.v1'
+ROW_GROUPS_KEY = b'dataset-toolkit.num_row_groups_per_file.v1'
+
+NUM_ROWS = 100
+
+
+# ---------------------------------------------------------------------------
+# Fake legacy modules (exist only while pickling)
+# ---------------------------------------------------------------------------
+
+def _register_module(name):
+    """Create (or fetch) a module entry in sys.modules, wiring parent attrs so
+    pickle's ``__import__(name)`` resolves through the chain."""
+    created = []
+    parts = name.split('.')
+    for depth in range(1, len(parts) + 1):
+        mod_name = '.'.join(parts[:depth])
+        if mod_name not in sys.modules:
+            sys.modules[mod_name] = types.ModuleType(mod_name)
+            created.append(mod_name)
+        if depth > 1:
+            parent = sys.modules['.'.join(parts[:depth - 1])]
+            setattr(parent, parts[depth - 1], sys.modules[mod_name])
+    return created
+
+
+class _LegacyPickleWorld(object):
+    """Context manager that builds the module universe old petastorm pickles
+    refer to — ``<package>.unischema`` / ``<package>.codecs``, pyspark's types
+    and serializer hijack, and the numpy 1.x scalar names — and tears every
+    bit of it down afterwards."""
+
+    _MISSING = object()
+
+    def __init__(self, package='petastorm'):
+        self.package = package
+        self._created_modules = []
+        self._numpy_added = []
+        # (module, attr, prior value or _MISSING) for attrs set on modules we
+        # did NOT create (an installed pyspark/petastorm must come back intact)
+        self._clobbered = []
+
+    def _set_attr(self, mod, name, value):
+        if mod.__name__ not in self._created_modules:
+            self._clobbered.append((mod, name, getattr(mod, name, self._MISSING)))
+        setattr(mod, name, value)
+        return value
+
+    def __enter__(self):
+        package = self.package
+        for name in (package + '.unischema', package + '.codecs',
+                     'pyspark.serializers', 'pyspark.sql.types'):
+            self._created_modules.extend(_register_module(name))
+
+        uni_mod = sys.modules[package + '.unischema']
+        codec_mod = sys.modules[package + '.codecs']
+        spark_types_mod = sys.modules['pyspark.sql.types']
+        serializers_mod = sys.modules['pyspark.serializers']
+
+        # numpy 1.x scalar names removed in numpy 2.x: stand-in classes whose
+        # protocol-0 pickle is exactly GLOBAL 'numpy unicode_' / 'numpy string_'
+        for legacy_name in ('unicode_', 'string_'):
+            if not hasattr(np, legacy_name):
+                stub = type(legacy_name, (), {'__module__': 'numpy',
+                                              '__qualname__': legacy_name})
+                setattr(np, legacy_name, stub)
+                self._numpy_added.append(legacy_name)
+
+        def module_class(mod, name, bases=(object,), ns=None):
+            cls = type(name, bases, dict(ns or {}, __module__=mod.__name__,
+                                         __qualname__=name))
+            return self._set_attr(mod, name, cls)
+
+        self.Unischema = module_class(uni_mod, 'Unischema')
+        self.ScalarCodec = module_class(codec_mod, 'ScalarCodec')
+        self.NdarrayCodec = module_class(codec_mod, 'NdarrayCodec')
+        self.CompressedImageCodec = module_class(codec_mod, 'CompressedImageCodec')
+        for spark_name in ('StringType', 'LongType', 'ShortType', 'DoubleType',
+                           'BooleanType', 'DecimalType'):
+            setattr(self, spark_name, module_class(spark_types_mod, spark_name))
+
+        # pyspark's namedtuple hijack: instances pickle as
+        # _restore(class_name, field_names, values)
+        def _restore(name, fields, values):  # pragma: no cover - pickle-time only
+            return collections.namedtuple(name, fields)(*values)
+        _restore.__module__ = 'pyspark.serializers'
+        _restore.__qualname__ = '_restore'
+        self._set_attr(serializers_mod, '_restore', _restore)
+        self._restore = _restore
+
+        field_cls = collections.namedtuple(
+            'UnischemaField', ['name', 'numpy_dtype', 'shape', 'codec', 'nullable'])
+        field_cls.__module__ = uni_mod.__name__
+        field_cls.__qualname__ = 'UnischemaField'
+        self._set_attr(uni_mod, 'UnischemaField', field_cls)
+        self.UnischemaField = field_cls
+
+        hijacked = collections.namedtuple(
+            'UnischemaField', ['name', 'numpy_dtype', 'shape', 'codec', 'nullable'])
+
+        def _hijack_reduce(nt_self):
+            return (_restore, ('UnischemaField', nt_self._fields, tuple(nt_self)))
+        hijacked.__reduce__ = _hijack_reduce
+        self.HijackedField = hijacked
+        return self
+
+    def __exit__(self, *exc_info):
+        for mod, name, prior in self._clobbered:
+            if prior is self._MISSING:
+                try:
+                    delattr(mod, name)
+                except AttributeError:
+                    pass
+            else:
+                setattr(mod, name, prior)
+        for name in self._created_modules:
+            parent, _, leaf = name.rpartition('.')
+            if parent and parent in sys.modules:
+                try:
+                    delattr(sys.modules[parent], leaf)
+                except AttributeError:
+                    pass
+            sys.modules.pop(name, None)
+        for legacy_name in self._numpy_added:
+            delattr(np, legacy_name)
+        return False
+
+    def numpy_dtype(self, name):
+        return getattr(np, name)
+
+    def scalar_codec(self, spark_type_name, **spark_state):
+        codec = object.__new__(self.ScalarCodec)
+        spark_type = object.__new__(getattr(self, spark_type_name))
+        spark_type.__dict__.update(spark_state)
+        codec.__dict__['_spark_type'] = spark_type
+        return codec
+
+    def ndarray_codec(self):
+        return object.__new__(self.NdarrayCodec)
+
+    def png_codec(self):
+        codec = object.__new__(self.CompressedImageCodec)
+        codec.__dict__.update(_image_codec='.png', _quality=80)
+        return codec
+
+
+def _py2ify(blob):
+    """Rewrite the py3 pickler's module spellings to the py2 ones found in the
+    real vintage blobs (protocol 0 has no length-prefixed frames, so plain byte
+    substitution of GLOBAL lines is safe)."""
+    return (blob.replace(b'ccopyreg\n', b'ccopy_reg\n')
+                .replace(b'cbuiltins\n', b'c__builtin__\n'))
+
+
+# ---------------------------------------------------------------------------
+# Vintage schema descriptions (verified against the real stores' depickled
+# field sets — see module docstring)
+# ---------------------------------------------------------------------------
+
+def _field_descriptions(version):
+    scalar, nd, png = 'scalar', 'ndarray', 'png'
+    fields = [
+        ('decimal', Decimal, (), (scalar, 'DecimalType',
+                                  {'precision': 10, 'scale': 9}), False),
+        ('empty_matrix_string', 'string_', (None,), (nd,), False),
+        ('id', 'int64', (), (scalar, 'LongType', {}), False),
+        ('id2', 'int32', (), (scalar, 'ShortType', {}), False),
+        ('image_png', 'uint8', (32, 16, 3), (png,), False),
+        ('matrix', 'float32', (32, 16, 3), (nd,), False),
+        ('matrix_nullable', 'uint16', (32, 16, 3), (nd,), True),
+        ('matrix_string', 'string_',
+         (None, None) if version >= (0, 7, 0) else (None,), (nd,), False),
+        ('matrix_uint16', 'uint16', (32, 16, 3), (nd,), False),
+        ('partition_key', 'unicode_', (), (scalar, 'StringType', {}), False),
+        ('python_primitive_uint8', 'uint8', (), (scalar, 'ShortType', {}), False),
+        ('sensor_name', 'unicode_', (1,), (nd,), False),
+        ('string_array_nullable', 'unicode_', (None,), (nd,), True),
+    ]
+    if version >= (0, 5, 1):
+        fields += [
+            ('id_float', 'float64', (), (scalar, 'DoubleType', {}), False),
+            ('id_odd', 'bool_', (), (scalar, 'BooleanType', {}), False),
+        ]
+    if version >= (0, 7, 6):
+        fields += [
+            ('integer_nullable', 'int32', (), (scalar, 'ShortType', {}), True),
+            ('matrix_uint32', 'uint32', (32, 16, 3), (nd,), False),
+        ]
+    return sorted(fields)
+
+
+def build_unischema_pickle(version, package='petastorm', field_style='restore'):
+    """Protocol-0 Unischema pickle in the requested vintage dialect."""
+    with _LegacyPickleWorld(package) as world:
+        field_cls = (world.HijackedField if field_style == 'restore'
+                     else world.UnischemaField)
+        fields = collections.OrderedDict()
+        for name, dtype, shape, codec_desc, nullable in _field_descriptions(version):
+            if codec_desc[0] == 'scalar':
+                codec = world.scalar_codec(codec_desc[1], **codec_desc[2])
+            elif codec_desc[0] == 'png':
+                codec = world.png_codec()
+            else:
+                codec = world.ndarray_codec()
+            numpy_dtype = dtype if dtype is Decimal else world.numpy_dtype(dtype)
+            fields[name] = field_cls(name, numpy_dtype, shape, codec, nullable)
+        schema = object.__new__(world.Unischema)
+        schema.__dict__.update(_name='TestSchema', _fields=fields)
+        return _py2ify(pickle.dumps(schema, protocol=0))
+
+
+# ---------------------------------------------------------------------------
+# Row data + parquet writing
+# ---------------------------------------------------------------------------
+
+def _npy(arr):
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def _png(arr):
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.unischema import UnischemaField
+    field = UnischemaField('image_png', np.uint8, arr.shape,
+                           CompressedImageCodec('png'), False)
+    return CompressedImageCodec('png').encode(field, arr)
+
+
+def _row_values(i, version):
+    """Deterministic synthetic row i — structured (compressible) tensors."""
+    base = (np.arange(32 * 16 * 3).reshape(32, 16, 3) + i)
+    row = {
+        'decimal': Decimal(i % 10) + Decimal(1) / Decimal(8),  # exact at scale 9
+        'empty_matrix_string': _npy(np.array([], dtype='S8')),
+        'id': i,
+        'id2': i % 3,
+        'image_png': _png((base % 255).astype(np.uint8)),
+        # small-period patterns: snappy squeezes the npy blobs so the committed
+        # golden stores stay a few MB total
+        'matrix': _npy((base % 16).astype(np.float32) / 4.0),
+        'matrix_nullable': (None if i % 4 == 0
+                            else _npy((base % 32).astype(np.uint16))),
+        'matrix_string': _npy(
+            np.array([b'row_%d' % i, b'mx'],
+                     dtype='S8').reshape((2, 1) if version >= (0, 7, 0) else (2,))),
+        'matrix_uint16': _npy(((base * 3) % 64).astype(np.uint16)),
+        'partition_key': 'p_{}'.format(i % 10),
+        'python_primitive_uint8': i % 255,
+        'sensor_name': _npy(np.array(['sensor_{}'.format(i % 4)])),
+        'string_array_nullable': (None if i % 3 == 0 else
+                                  _npy(np.array(['a_%d' % i, 'b']))),
+    }
+    if version >= (0, 5, 1):
+        row['id_float'] = float(i) / 2.0
+        row['id_odd'] = bool(i % 2)
+    if version >= (0, 7, 6):
+        row['integer_nullable'] = None if i % 2 == 0 else i
+        row['matrix_uint32'] = _npy(((base * 5) % 128).astype(np.uint32))
+    return row
+
+
+def _arrow_schema(version):
+    """Physical types as the spark writes produced them: ShortType-coded scalars
+    land as int16, DecimalType as decimal128(10, 9), codec blobs as binary."""
+    cols = [
+        pa.field('decimal', pa.decimal128(10, 9), nullable=False),
+        pa.field('empty_matrix_string', pa.binary(), nullable=False),
+        pa.field('id', pa.int64(), nullable=False),
+        pa.field('id2', pa.int16(), nullable=False),
+        pa.field('image_png', pa.binary(), nullable=False),
+        pa.field('matrix', pa.binary(), nullable=False),
+        pa.field('matrix_nullable', pa.binary()),
+        pa.field('matrix_string', pa.binary(), nullable=False),
+        pa.field('matrix_uint16', pa.binary(), nullable=False),
+        pa.field('python_primitive_uint8', pa.int16(), nullable=False),
+        pa.field('sensor_name', pa.binary(), nullable=False),
+        pa.field('string_array_nullable', pa.binary()),
+    ]
+    if version >= (0, 5, 1):
+        cols += [pa.field('id_float', pa.float64(), nullable=False),
+                 pa.field('id_odd', pa.bool_(), nullable=False)]
+    if version >= (0, 7, 6):
+        cols += [pa.field('integer_nullable', pa.int16()),
+                 pa.field('matrix_uint32', pa.binary(), nullable=False)]
+    return pa.schema(sorted(cols, key=lambda f: f.name))
+
+
+def write_store(out_dir, version, package='petastorm', field_style='restore'):
+    if os.path.isdir(out_dir):
+        shutil.rmtree(out_dir)
+    os.makedirs(out_dir)
+    rows = [_row_values(i, version) for i in range(NUM_ROWS)]
+    schema = _arrow_schema(version)
+
+    row_groups_per_file = {}
+    partitions = sorted({r['partition_key'] for r in rows})
+    for pk in partitions:
+        part_rows = [r for r in rows if r['partition_key'] == pk]
+        columns = {name: [r[name] for r in part_rows] for name in schema.names}
+        table = pa.table(
+            {name: pa.array(columns[name], type=schema.field(name).type)
+             for name in schema.names}, schema=schema)
+        rel_dir = 'partition_key={}'.format(pk)
+        os.makedirs(os.path.join(out_dir, rel_dir))
+        rel_path = rel_dir + '/part_00000.parquet'
+        pq.write_table(table, os.path.join(out_dir, rel_path),
+                       row_group_size=4, compression='snappy')
+        md = pq.read_metadata(os.path.join(out_dir, rel_path))
+        row_groups_per_file[rel_path] = md.num_row_groups
+
+    unischema_blob = build_unischema_pickle(version, package, field_style)
+    metadata = {
+        UNISCHEMA_KEY: unischema_blob,
+        ROW_GROUPS_KEY: _py2ify(pickle.dumps(row_groups_per_file, protocol=0)),
+    }
+    pq.write_metadata(schema.with_metadata(metadata),
+                      os.path.join(out_dir, '_common_metadata'))
+    with open(os.path.join(out_dir, '_SUCCESS'), 'w'):
+        pass
+    return out_dir
+
+
+#: (dir name, vintage tuple, pickle package, field pickle construction)
+STORES = [
+    ('0.4.0', (0, 4, 0), 'petastorm', 'restore'),
+    ('0.4.3', (0, 4, 3), 'petastorm', 'restore'),
+    ('0.5.1', (0, 5, 1), 'petastorm', 'restore'),
+    ('0.6.0', (0, 6, 0), 'petastorm', 'restore'),
+    ('0.7.0', (0, 7, 0), 'petastorm', 'restore'),
+    ('0.7.6', (0, 7, 6), 'petastorm', 'reconstructor'),
+    # pre-rename ancestor package: exercises _rewrite_prehistoric_names
+    ('prehistoric', (0, 4, 0), 'av.ml.dataset_toolkit', 'restore'),
+]
+
+
+def main():
+    for name, version, package, style in STORES:
+        out = write_store(os.path.join(OUT_BASE, name), version, package, style)
+        total = sum(os.path.getsize(os.path.join(root, f))
+                    for root, _, files in os.walk(out) for f in files)
+        print('wrote {} ({} KiB)'.format(out, total // 1024))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,7 +1,19 @@
-"""Golden back-compat: read the REAL datasets petastorm 0.4.0-0.7.6 shipped in its test
-tree (pickled Unischemas incl. pyspark-namedtuple-hijack pickles and pre-numpy-2 scalar
-names), end to end through make_reader (model: petastorm/tests/
-test_reading_legacy_datasets.py). Skipped when the reference checkout is absent."""
+"""Golden back-compat, self-contained: read datasets in the petastorm legacy
+metadata dialect (protocol-0 pickled Unischemas incl. pyspark-namedtuple-hijack
+pickles and pre-numpy-2 scalar names) end to end through make_reader (model:
+petastorm/tests/test_reading_legacy_datasets.py).
+
+Two layers of golden data:
+
+- **vendored** (``tests/data/legacy/`` — always present, committed): stores
+  synthesized by ``tests/generate_legacy_datasets.py`` in each vintage's exact
+  pickle dialect, verified against the real stores' pickle disassembly. These
+  keep back-compat covered when this repo stands alone (the reference vendors
+  its own golden stores the same way,
+  petastorm/tests/generate_dataset_for_legacy_tests.py:1).
+- **reference** (``/root/reference/.../data/legacy`` — extra layer, skipped
+  when the mount is absent): stores written by REAL petastorm 0.4.0-0.7.6.
+"""
 
 import os
 
@@ -10,20 +22,26 @@ import pytest
 
 from petastorm_tpu import make_reader
 
-LEGACY_BASE = '/root/reference/petastorm/tests/data/legacy'
+VENDORED_BASE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             'data', 'legacy')
+REFERENCE_BASE = '/root/reference/petastorm/tests/data/legacy'
 VERSIONS = ['0.4.0', '0.4.3', '0.5.1', '0.6.0', '0.7.0', '0.7.6']
 
-pytestmark = pytest.mark.skipif(not os.path.isdir(LEGACY_BASE),
-                                reason='reference legacy datasets not available')
+BASES = [pytest.param(VENDORED_BASE, id='vendored'),
+         pytest.param(REFERENCE_BASE, id='reference',
+                      marks=pytest.mark.skipif(
+                          not os.path.isdir(REFERENCE_BASE),
+                          reason='reference legacy datasets not mounted'))]
 
 
-def _url(version):
-    return 'file://' + os.path.join(LEGACY_BASE, version)
+def _url(base, version):
+    return 'file://' + os.path.join(base, version)
 
 
+@pytest.mark.parametrize('base', BASES)
 @pytest.mark.parametrize('version', VERSIONS)
-def test_legacy_dataset_reads_and_decodes(version):
-    with make_reader(_url(version), workers_count=1, num_epochs=1,
+def test_legacy_dataset_reads_and_decodes(base, version):
+    with make_reader(_url(base, version), workers_count=1, num_epochs=1,
                      shuffle_row_groups=False) as reader:
         rows = {row.id: row for row in reader}
     assert len(rows) == 100
@@ -34,7 +52,8 @@ def test_legacy_dataset_reads_and_decodes(version):
     assert isinstance(row.decimal, Decimal)
 
 
-def test_legacy_versions_core_schema_stable():
+@pytest.mark.parametrize('base', BASES)
+def test_legacy_versions_core_schema_stable(base):
     """Each version's pickled Unischema depickles through a different pickle vintage
     (copyreg protocol-0, NEWOBJ, pyspark's namedtuple-hijack ``_restore``); petastorm
     grew fields over time, but the core fields must resolve to identical dtype/shape in
@@ -46,7 +65,7 @@ def test_legacy_versions_core_schema_stable():
 
     def fields(version):
         from petastorm_tpu.etl.dataset_metadata import get_schema, open_dataset
-        schema = get_schema(open_dataset(_url(version)))
+        schema = get_schema(open_dataset(_url(base, version)))
         return {name: (np.dtype(f.numpy_dtype).str if f.numpy_dtype is not None
                        and np.dtype(f.numpy_dtype).kind not in ('U', 'S', 'O') else None,
                        tuple(f.shape))
@@ -62,14 +81,27 @@ def test_legacy_versions_core_schema_stable():
                 assert got_dtype == expected_dtype, (version, name, got_dtype)
 
 
-def test_legacy_store_feeds_jitted_training(tmp_path):
-    """The full switch-from-petastorm story: a store WRITTEN BY REAL PETASTORM 0.7.6
+def test_prehistoric_package_names_rewritten():
+    """The vendored ``prehistoric`` store's pickle refers to the pre-rename
+    ``av.ml.dataset_toolkit.*`` modules (reference rule: petastorm/etl/legacy.py:57-81);
+    reading it end to end proves ``_rewrite_prehistoric_names`` fires on a whole
+    store, not just on crafted blobs."""
+    with make_reader(_url(VENDORED_BASE, 'prehistoric'), workers_count=1,
+                     num_epochs=1, shuffle_row_groups=False) as reader:
+        rows = {row.id: row for row in reader}
+    assert len(rows) == 100
+    assert rows[3].image_png.shape == (32, 16, 3)
+
+
+@pytest.mark.parametrize('base', BASES)
+def test_legacy_store_feeds_jitted_training(base):
+    """The full switch-from-petastorm story: a store in the legacy petastorm dialect
     flows through make_reader -> JaxDataLoader -> a jitted step on device arrays,
     with no re-materialization and no petastorm install."""
     import jax
     import jax.numpy as jnp
     from petastorm_tpu.parallel import JaxDataLoader
-    with make_reader(_url('0.7.6'), workers_count=1, num_epochs=1,
+    with make_reader(_url(base, '0.7.6'), workers_count=1, num_epochs=1,
                      schema_fields=['id', 'image_png'],
                      shuffle_row_groups=False) as reader:
         loader = JaxDataLoader(reader, batch_size=16, drop_last=True)
@@ -89,11 +121,12 @@ def test_legacy_store_feeds_jitted_training(tmp_path):
     assert np.isfinite(float(total))
 
 
-def test_legacy_partition_predicate_prunes(tmp_path):
+@pytest.mark.parametrize('base', BASES)
+def test_legacy_partition_predicate_prunes(base):
     """Partition-key predicates prune legacy stores' rowgroups in the main process."""
     from petastorm_tpu.predicates import in_lambda
     pred = in_lambda(['partition_key'], lambda pk: pk == 'p_2')
-    with make_reader(_url('0.7.6'), workers_count=1, num_epochs=1,
+    with make_reader(_url(base, '0.7.6'), workers_count=1, num_epochs=1,
                      predicate=pred) as reader:
         rows = list(reader)
     assert rows
