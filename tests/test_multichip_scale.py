@@ -1,0 +1,88 @@
+"""Multichip proofs past the 8-device conftest mesh (VERDICT r4 item 4): 16 and
+32 virtual CPU devices, a 4-axis ``(data, seq, stage, model)`` composed phase,
+pipeline depth 4 with tensor-parallel stages, and axis sizes >2 on two axes at
+once (a 4-hop ring × 4-way tensor parallelism). Each case runs in a subprocess
+because the device count is fixed at backend init
+(``--xla_force_host_platform_device_count``); the worker asserts value AND
+gradient parity against the dense network plus a real loss decrease, the
+assertion style of ``test_pipeline.py::TestPipelineTensorParallel``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_WORKER = os.path.join(os.path.dirname(__file__), '_multichip_scale_worker.py')
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(_WORKER)))
+
+PARITY_TOL = 3e-4
+
+
+def _run_phase(phase, n_devices, tmp_path, timeout=900):
+    out = str(tmp_path / '{}_{}.json'.format(phase, n_devices))
+    env = dict(os.environ)
+    env['XLA_FLAGS'] = '--xla_force_host_platform_device_count={}'.format(n_devices)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = os.pathsep.join(
+        [_REPO] + ([env['PYTHONPATH']] if env.get('PYTHONPATH') else []))
+    proc = subprocess.run([sys.executable, _WORKER, phase, str(n_devices), out],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=env, cwd=_REPO)
+    assert proc.returncode == 0, 'worker failed:\n' + proc.stderr[-4000:]
+    with open(out) as f:
+        return json.load(f)
+
+
+def _assert_parity_and_descent(res):
+    assert res['loss_delta'] < PARITY_TOL, res
+    assert res['grad_max_delta'] < PARITY_TOL, res
+    losses = res['adam_losses']
+    assert losses[-1] < losses[0] - 1e-3, losses
+
+
+def test_compose4_16_devices(tmp_path):
+    """dp x sp x pp x tp in ONE 4-axis mesh at 16 devices — every family
+    genuinely >1."""
+    res = _run_phase('compose4', 16, tmp_path)
+    assert res['mesh'] == {'data': 2, 'seq': 2, 'stage': 2, 'model': 2}
+    _assert_parity_and_descent(res)
+
+
+def test_compose4_32_devices_pipeline_depth_4(tmp_path):
+    """Same 4-axis composition at 32 devices with pipeline depth 4: four
+    tensor-parallel stages in flight behind ring attention."""
+    res = _run_phase('compose4', 32, tmp_path)
+    assert res['mesh'] == {'data': 2, 'seq': 2, 'stage': 4, 'model': 2}
+    _assert_parity_and_descent(res)
+
+
+def test_wide3_32_devices_two_axes_past_2(tmp_path):
+    """(data=2, seq=4, model=4): a 4-hop ring (multi-step ppermute ordering —
+    the halo-arithmetic bug class invisible at 2-way axes) composed with 4-way
+    Megatron tensor parallelism."""
+    res = _run_phase('wide3', 32, tmp_path)
+    assert res['mesh'] == {'data': 2, 'seq': 4, 'model': 4}
+    _assert_parity_and_descent(res)
+
+
+def test_dryrun_multichip_16_devices(tmp_path):
+    """The driver contract itself at n=16: the generalized _mesh_axis_sizes
+    must compose all six dryrun phases on a (2,2,4) mesh."""
+    res = _run_phase('dryrun', 16, tmp_path, timeout=1200)
+    assert res['dryrun_ok'] is True
+
+
+def test_mesh_axis_sizes_widen_with_device_count():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'graft_entry', os.path.join(_REPO, '__graft_entry__.py'))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod._mesh_axis_sizes(8) == (2, 2, 2)     # historical driver shape
+    assert mod._mesh_axis_sizes(16) == (2, 2, 4)
+    assert mod._mesh_axis_sizes(32) == (2, 4, 4)
+    assert mod._mesh_axis_sizes(64) == (4, 4, 4)
+    for n in (1, 2, 4, 6, 8, 12, 16, 24, 32, 64, 128, 256):
+        data, seq, model = mod._mesh_axis_sizes(n)
+        assert data * seq * model == n, n
