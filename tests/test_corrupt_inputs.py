@@ -1,0 +1,177 @@
+"""Corrupt-input hardening (VERDICT r4 weak #6, SURVEY §5.3): feed REAL damage —
+a truncated Parquet file, mangled ``_common_metadata``, a file deleted
+mid-epoch — through ``make_reader`` and assert a clear exception reaches the
+CONSUMING thread for all three pools and through ``JaxDataLoader``: no hang, no
+silent skip (reference anchor: the thread pool's worker-exception re-raise,
+petastorm/workers_pool/thread_pool.py:68-73).
+
+Every consume runs in a watchdog thread with a deadline so a hang fails the
+test explicitly instead of wedging the suite.
+"""
+
+import glob
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import write_rows
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+POOLS = ['dummy', 'thread', 'process']
+CONSUME_TIMEOUT_S = 120
+
+
+def _write_store(root, num_rows=48, n_files=4):
+    schema = Unischema('CorruptProbe', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('vec', np.float32, (8,), NdarrayCodec(), False),
+    ])
+    url = 'file://' + str(root)
+    write_rows(url, schema,
+               [{'id': i, 'vec': np.full(8, i, np.float32)} for i in range(num_rows)],
+               n_files=n_files, rowgroup_size_mb=1)
+    return url
+
+
+def _part_files(root):
+    files = sorted(glob.glob(os.path.join(str(root), '**', '*.parquet'),
+                             recursive=True))
+    assert files, 'no part files under {}'.format(root)
+    return files
+
+
+def _consume_expect_error(iterate, match=None):
+    """Run ``iterate()`` in a watchdog thread: it must finish within the
+    deadline (no hang) AND raise (no silent skip). Returns the exception."""
+    box = {}
+
+    def run():
+        try:
+            iterate()
+        except BaseException as exc:  # noqa: BLE001 - the exception IS the assertion target
+            box['exc'] = exc
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(CONSUME_TIMEOUT_S)
+    assert not t.is_alive(), 'consumer hung >{:.0f}s on corrupt input'.format(
+        CONSUME_TIMEOUT_S)
+    assert 'exc' in box, 'corrupt input was silently skipped (no exception)'
+    if match is not None:
+        assert match(box['exc']), 'unexpected exception: {!r}'.format(box['exc'])
+    return box['exc']
+
+
+def _truncate(path, keep_fraction=0.5):
+    size = os.path.getsize(path)
+    with open(path, 'r+b') as f:
+        f.truncate(max(16, int(size * keep_fraction)))
+
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_truncated_parquet_raises_in_consumer(tmp_path, pool):
+    url = _write_store(tmp_path / 'store')
+    for path in _part_files(tmp_path / 'store'):
+        _truncate(path)
+
+    def iterate():
+        with make_reader(url, reader_pool_type=pool, workers_count=2,
+                         num_epochs=1) as reader:
+            list(reader)
+
+    exc = _consume_expect_error(iterate)
+    assert not isinstance(exc, StopIteration)
+
+
+@pytest.mark.parametrize('pool', POOLS)
+def test_file_deleted_mid_epoch_raises(tmp_path, pool):
+    store = tmp_path / 'store'
+    url = _write_store(store, num_rows=64, n_files=8)
+
+    def iterate():
+        with make_reader(url, reader_pool_type=pool, workers_count=1,
+                         shuffle_row_groups=False, num_epochs=1) as reader:
+            next(reader)  # pipeline is live and mid-epoch
+            for path in _part_files(store)[2:]:
+                os.remove(path)
+            list(reader)
+
+    _consume_expect_error(iterate)
+
+
+def test_corrupt_common_metadata_fails_loudly(tmp_path):
+    url = _write_store(tmp_path / 'store')
+    md = os.path.join(str(tmp_path / 'store'), '_common_metadata')
+    with open(md, 'wb') as f:
+        f.write(b'this is not a parquet footer')
+    with pytest.raises(Exception) as excinfo:
+        with make_reader(url, workers_count=1, num_epochs=1) as reader:
+            list(reader)
+    assert not isinstance(excinfo.value, StopIteration)
+
+
+def test_corrupt_unischema_metadata_value_fails_loudly(tmp_path):
+    """Valid parquet footer, garbage under the unischema key: the schema load
+    must raise a clear error, not serve rows with a half-parsed schema."""
+    import pyarrow.parquet as pq
+    url = _write_store(tmp_path / 'store')
+    md_path = os.path.join(str(tmp_path / 'store'), '_common_metadata')
+    schema = pq.read_schema(md_path)
+    metadata = dict(schema.metadata or {})
+    for key in list(metadata):
+        if b'unischema' in key:
+            metadata[key] = b'{"not": "a schema"'  # truncated JSON
+    pq.write_metadata(schema.with_metadata(metadata), md_path)
+    with pytest.raises(Exception) as excinfo:
+        with make_reader(url, workers_count=1, num_epochs=1) as reader:
+            list(reader)
+    assert not isinstance(excinfo.value, StopIteration)
+
+
+def test_truncated_parquet_raises_through_jax_loader(tmp_path):
+    """The device-loader path must latch the worker failure too: consuming
+    through JaxDataLoader raises instead of hanging on an empty queue."""
+    from petastorm_tpu.parallel import JaxDataLoader
+    url = _write_store(tmp_path / 'store')
+    for path in _part_files(tmp_path / 'store'):
+        _truncate(path)
+
+    def iterate():
+        reader = make_reader(url, reader_pool_type='thread', workers_count=2,
+                             num_epochs=1)
+        loader = JaxDataLoader(reader, batch_size=8)
+        try:
+            for _ in loader:
+                pass
+        finally:
+            loader.stop()
+            loader.join()
+
+    _consume_expect_error(iterate)
+
+
+def test_file_deleted_mid_epoch_raises_through_jax_loader(tmp_path):
+    from petastorm_tpu.parallel import JaxDataLoader
+    store = tmp_path / 'store'
+    url = _write_store(store, num_rows=64, n_files=8)
+
+    def iterate():
+        reader = make_reader(url, reader_pool_type='thread', workers_count=1,
+                             shuffle_row_groups=False, num_epochs=1)
+        loader = JaxDataLoader(reader, batch_size=4)
+        try:
+            it = iter(loader)
+            next(it)
+            for path in _part_files(store)[2:]:
+                os.remove(path)
+            for _ in it:
+                pass
+        finally:
+            loader.stop()
+            loader.join()
+
+    _consume_expect_error(iterate)
