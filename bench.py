@@ -124,11 +124,13 @@ def log(msg):
 # its semantics and a config tag naming the substitution — never a bare value=0.0
 # that reads as a performance collapse downstream.
 _HEADLINE_FALLBACKS = (
-    ('streaming_rows_per_sec', 'streaming_vs_baseline',
-     'mnist_train_rows_per_sec_per_chip', 'rows/s/chip', 'streaming_fallback_headline'),
+    # scan_stream before per-batch streaming: the compiled-chunk path is the
+    # framework's measured streaming headline (VERDICT r4 item 2)
     ('streaming_scan_rows_per_sec', 'streaming_scan_vs_baseline',
      'mnist_train_rows_per_sec_per_chip', 'rows/s/chip',
      'scan_stream_fallback_headline'),
+    ('streaming_rows_per_sec', 'streaming_vs_baseline',
+     'mnist_train_rows_per_sec_per_chip', 'rows/s/chip', 'streaming_fallback_headline'),
     ('imagenet_stream_rows_per_sec', None,
      'imagenet_train_rows_per_sec_per_chip', 'rows/s/chip',
      'imagenet_stream_fallback_headline'),
@@ -161,8 +163,8 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'flash', 'moe', 'imagenet_scan',
-                     'imagenet_stream', 'mnist_scan_stream', 'decode_delta',
+SECTION_RUN_ORDER = ('mnist_inmem', 'mnist_scan_stream', 'flash', 'moe',
+                     'imagenet_scan', 'imagenet_stream', 'decode_delta',
                      'bare_reader', 'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
@@ -611,7 +613,12 @@ def child_main():
         nonlocal params, opt_state, mnist_row_bytes
         reader = make_reader(url, workers_count=WORKERS, shuffle_row_groups=True,
                              seed=42, num_epochs=1)
-        loader = JaxDataLoader(reader, batch_size=BATCH_SIZE, prefetch=2)
+        # prefetch 4 (was 2): on a high-RTT link more transfers in flight hide
+        # more of the serial transfer+dispatch path (VERDICT r4 item 2); the
+        # loader's coalesce_fields auto default collapses per-field transfers
+        # to one on accelerator backends
+        loader = JaxDataLoader(reader, batch_size=BATCH_SIZE,
+                               prefetch=int(os.environ.get('BENCH_PREFETCH', 4)))
         rows = 0
         start = time.perf_counter()
         loss = None

@@ -36,11 +36,11 @@ TOTAL_S = int(os.environ.get('PROBE_TOTAL', int(11.0 * 3600)))
 # the round-3 features that have never touched a chip, then the rest.
 SECTIONS = [
     ('mnist_inmem', 1500),
+    ('mnist_scan_stream', 1200),  # the streaming headline (VERDICT r5 item 2)
     ('flash', 1500),
     ('moe', 1200),
     ('imagenet_scan', 1800),
     ('imagenet_stream', 1800),
-    ('mnist_scan_stream', 1200),
     ('decode_delta', 1200),
     ('bare_reader', 600),
     ('mnist_stream', 1200),

@@ -435,3 +435,129 @@ class TestScanStream:
         loader.scan_stream(lambda c, b: (c, None), 0, chunk_batches=2)
         with pytest.raises(ValueError, match='scan_stream'):
             loader.state_dict()
+
+
+class TestCoalescedUpload:
+    """coalesce_fields=True (the default): every field of a batch ships in ONE
+    host->device transfer and unpacks on device through a cached jitted
+    slice+bitcast program (VERDICT r4 item 2: per-field device_put pays one
+    dispatch round trip per field on a tunneled link). The unpack must be
+    bit-exact with the per-field path, INCLUDING jax's x32 canonicalization of
+    64-bit ints (mod-2^32 truncation)."""
+
+    def _write_mixed_store(self, tmp_path):
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_rows
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        url = 'file://' + str(tmp_path / 'mixed')
+        schema = Unischema('Mixed', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('img', np.uint8, (5, 7), NdarrayCodec(), False),
+            UnischemaField('vec', np.float32, (3,), NdarrayCodec(), False),
+            UnischemaField('flag', np.bool_, (), ScalarCodec(), False),
+            UnischemaField('small', np.int8, (), ScalarCodec(), False),
+            UnischemaField('short', np.int16, (2,), NdarrayCodec(), False),
+        ])
+        rows = [{'id': (2 ** 40 + i if i == 3 else i),  # exercises truncation
+                 'img': np.arange(35, dtype=np.uint8).reshape(5, 7) + i,
+                 'vec': np.full(3, i * 1.5, np.float32),
+                 'flag': bool(i % 2), 'small': np.int8(i - 5),
+                 'short': np.array([-i, i * 300], np.int16)}
+                for i in range(24)]
+        write_rows(url, schema, rows, n_files=2)
+        return url
+
+    def _collect(self, url, coalesce):
+        reader = make_reader(url, workers_count=1, num_epochs=1,
+                             shuffle_row_groups=False)
+        loader = JaxDataLoader(reader, batch_size=8, coalesce_fields=coalesce)
+        try:
+            return [{k: (np.asarray(v), v.dtype) for k, v in b.items()}
+                    for b in loader]
+        finally:
+            loader.stop()
+            loader.join()
+
+    def test_bit_exact_with_per_field_path(self, tmp_path):
+        url = self._write_mixed_store(tmp_path)
+        coalesced = self._collect(url, True)
+        per_field = self._collect(url, False)
+        assert len(coalesced) == len(per_field) == 3
+        for ba, bb in zip(coalesced, per_field):
+            assert set(ba) == set(bb)
+            for name in ba:
+                got, got_dtype = ba[name]
+                want, want_dtype = bb[name]
+                assert got_dtype == want_dtype, name
+                np.testing.assert_array_equal(got, want, err_msg=name)
+
+    def test_unpack_program_cached_per_layout(self, tmp_path):
+        url = self._write_mixed_store(tmp_path)
+        reader = make_reader(url, workers_count=1, num_epochs=1,
+                             shuffle_row_groups=False)
+        loader = JaxDataLoader(reader, batch_size=8, coalesce_fields=True)
+        try:
+            list(loader)
+        finally:
+            loader.stop()
+            loader.join()
+        # one stable layout -> exactly one compiled unpack program
+        assert len(loader._unpack_programs) == 1
+
+    def test_auto_default_disabled_on_cpu(self, tmp_path):
+        """coalesce_fields=None resolves to False on the CPU backend (device_put
+        is a near-free buffer share there; the packed unpack is a memcpy tax)."""
+        url = self._write_mixed_store(tmp_path)
+        reader = make_reader(url, workers_count=1, num_epochs=1,
+                             shuffle_row_groups=False)
+        loader = JaxDataLoader(reader, batch_size=8)
+        try:
+            list(loader)
+        finally:
+            loader.stop()
+            loader.join()
+        assert loader._coalesce_fields is False
+        assert loader._unpack_programs == {}
+
+    def test_float64_falls_back_under_x32(self):
+        """float64's x32 canonicalization is a value (rounding) conversion the
+        byte-level unpack cannot reproduce — the layout must be ineligible."""
+        from petastorm_tpu.parallel.loader import coalescible_layout
+        assert not jax.config.jax_enable_x64
+        cols = {'a': np.zeros((4, 2), np.float64)}
+        assert coalescible_layout(cols) is None
+        # 64-bit ints ARE eligible (low-word truncation matches device_put)
+        assert coalescible_layout({'a': np.zeros(4, np.int64)}) is not None
+
+    def test_non_contiguous_and_object_ineligible(self):
+        from petastorm_tpu.parallel.loader import coalescible_layout
+        strided = np.zeros((8, 8), np.float32)[:, ::2]
+        assert coalescible_layout({'a': strided}) is None
+        assert coalescible_layout({'a': np.array(['x', 'y'], object)}) is None
+        assert coalescible_layout({}) is None
+
+    def test_scan_stream_chunk_coalesces(self, tmp_path):
+        """scan_stream's single-device chunk upload rides the same packed-buffer
+        path; results must match the uncoalesced run exactly."""
+        url = self._write_mixed_store(tmp_path)
+
+        def step(carry, batch):
+            return carry + batch['vec'].sum() + batch['id'].sum(), batch['id']
+
+        results = {}
+        for coalesce in (True, False):
+            reader = make_reader(url, workers_count=1, num_epochs=1,
+                                 shuffle_row_groups=False,
+                                 schema_fields=['id', 'vec'])
+            loader = JaxDataLoader(reader, batch_size=4,
+                                   coalesce_fields=coalesce)
+            try:
+                carry, aux = loader.scan_stream(step, 0.0, chunk_batches=3)
+                results[coalesce] = (float(carry),
+                                     [np.asarray(a) for a in aux])
+            finally:
+                loader.stop()
+                loader.join()
+        assert results[True][0] == results[False][0]
+        for a, b in zip(results[True][1], results[False][1]):
+            np.testing.assert_array_equal(a, b)
