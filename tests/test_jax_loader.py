@@ -283,8 +283,9 @@ class TestScanStream:
     def test_covers_dataset_in_stream_order_chunks(self, synthetic_dataset):
         import jax.numpy as jnp
         loader = JaxDataLoader(self._reader(synthetic_dataset), batch_size=10)
+        # int32 carry: x64 is disabled (conftest), int64 would warn-truncate
         carry, aux = loader.scan_stream(
-            lambda c, b: (c + jnp.sum(b['id']), b['id']), jnp.int32(0) + 0,  # int32: x64 is disabled (conftest), int64 would warn-truncate
+            lambda c, b: (c + jnp.sum(b['id']), b['id']), jnp.int32(0) + 0,
             chunk_batches=4, seed=None)
         ids = np.concatenate([np.asarray(a).ravel() for a in aux])
         assert sorted(ids.tolist()) == sorted(r['id'] for r in synthetic_dataset.rows)
