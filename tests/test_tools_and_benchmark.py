@@ -474,3 +474,23 @@ class TestBenchHarness:
         empty = bench.normalize_headline({})
         assert empty['value'] == 0.0
         assert empty['config'] == 'no_sections_completed'
+
+    def test_headline_fallback_scan_stream_outranks_per_batch_streaming(self):
+        # r5: the compiled-chunk path is the streaming headline
+        bench = self._load_bench()
+        rec = bench.normalize_headline(
+            {'streaming_rows_per_sec': 10.0, 'streaming_vs_baseline': 0.01,
+             'streaming_scan_rows_per_sec': 50.0,
+             'streaming_scan_vs_baseline': 0.07})
+        assert rec['value'] == 50.0
+        assert rec['config'] == 'scan_stream_fallback_headline'
+
+    def test_headline_fallback_covers_decode_delta(self):
+        # r5 code-review catch: a decode-only partial must not normalize to a
+        # value=0.0 'no_sections_completed' placeholder
+        bench = self._load_bench()
+        rec = bench.normalize_headline(
+            {'imagenet_onchip_decode_rows_per_sec': 321.0})
+        assert rec['value'] == 321.0
+        assert rec['config'] == 'decode_delta_fallback_headline'
+        assert rec['unit'] == 'rows/s'
