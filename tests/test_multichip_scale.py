@@ -57,6 +57,21 @@ def test_compose4_32_devices_pipeline_depth_4(tmp_path):
     _assert_parity_and_descent(res)
 
 
+def test_compose4_expert_16_devices(tmp_path):
+    """The 'model-or-expert' variant: dp x sp x pp x EP in one 4-axis mesh —
+    ring attention feeding a pipeline of expert-parallel MoE stages
+    (all-to-all over 'expert' inside each stage)."""
+    res = _run_phase('compose4_expert', 16, tmp_path)
+    assert res['mesh'] == {'data': 2, 'seq': 2, 'stage': 2, 'expert': 2}
+    _assert_parity_and_descent(res)
+
+
+def test_compose4_expert_32_devices_depth_4(tmp_path):
+    res = _run_phase('compose4_expert', 32, tmp_path)
+    assert res['mesh'] == {'data': 2, 'seq': 2, 'stage': 4, 'expert': 2}
+    _assert_parity_and_descent(res)
+
+
 def test_wide3_32_devices_two_axes_past_2(tmp_path):
     """(data=2, seq=4, model=4): a 4-hop ring (multi-step ppermute ordering —
     the halo-arithmetic bug class invisible at 2-way axes) composed with 4-way
