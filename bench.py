@@ -637,7 +637,7 @@ def child_main():
         if measure:
             log('epoch: {} rows in {:.2f}s -> {:.1f} rows/s; loader stats {}'
                 .format(rows, elapsed, rows / elapsed, loader.stats.as_dict()))
-        return rows / elapsed, loader.stats.input_stall_fraction
+        return rows / elapsed, loader.stats
 
     def force_done(loss_stack):
         """Read one scalar back to the host: on this tunneled platform
@@ -1247,10 +1247,11 @@ def child_main():
         section_start = time.monotonic()
         run_epoch(measure=False)
         stream_rates, stream_stalls = [], []
+        stats = None
         for _ in range(EPOCHS):
-            rate, stall = run_epoch(measure=True)
+            rate, stats = run_epoch(measure=True)
             stream_rates.append(rate)
-            stream_stalls.append(stall)
+            stream_stalls.append(stats.input_stall_fraction)
             if deadline_exceeded(section_start, len(stream_rates), EPOCHS,
                                  'streaming'):
                 break
@@ -1263,6 +1264,13 @@ def child_main():
                 round(float(np.median(stream_stalls)), 4),
             'streaming_epochs_measured': len(stream_rates),
         })
+        if stats is not None:  # BENCH_EPOCHS=0 runs zero measured epochs
+            # proves which H2D path the capture used (r5: coalesced uploads
+            # engage on accelerator backends only)
+            results.update({
+                'streaming_coalesced_uploads': stats.coalesced_uploads,
+                'streaming_per_field_uploads': stats.per_field_uploads,
+            })
         if mnist_row_bytes is not None:
             # the section's own measurement is already in results — emit it
             # before the link probe so a probe HANG (tunnel stall past the

@@ -53,13 +53,19 @@ def _trace_span(name):
 
 class LoaderStats(object):
     """Thread-safe loader counters (batches/rows, wait vs total time); the input
-    stall fraction ``wait_time_s / total_time_s`` is the bench's efficiency metric."""
+    stall fraction ``wait_time_s / total_time_s`` is the bench's efficiency
+    metric. The upload-mode counters make the H2D path observable in captured
+    bench lines: a hardware capture can PROVE whether the coalesced
+    single-transfer path engaged (``coalesced_uploads``) or each field shipped
+    separately (``per_field_uploads`` — also counts mesh-path uploads)."""
 
     def __init__(self):
         self.batches = 0
         self.rows = 0
         self.wait_time_s = 0.0
         self.total_time_s = 0.0
+        self.coalesced_uploads = 0
+        self.per_field_uploads = 0
 
     @property
     def input_stall_fraction(self):
@@ -71,7 +77,9 @@ class LoaderStats(object):
         return {'batches': self.batches, 'rows': self.rows,
                 'wait_time_s': round(self.wait_time_s, 4),
                 'total_time_s': round(self.total_time_s, 4),
-                'input_stall_fraction': round(self.input_stall_fraction, 4)}
+                'input_stall_fraction': round(self.input_stall_fraction, 4),
+                'coalesced_uploads': self.coalesced_uploads,
+                'per_field_uploads': self.per_field_uploads}
 
 
 class JaxDataLoader(object):
@@ -308,11 +316,14 @@ class JaxDataLoader(object):
                     batch = {name: jax.make_array_from_process_local_data(
                                  sharding_for_field(sharding, name), col)
                              for name, col in columns.items()}
+                    self.stats.per_field_uploads += 1
                 elif (self._coalesce_enabled()
                       and (layout := coalescible_layout(columns)) is not None):
                     batch = self._put_coalesced(columns, sharding, layout)
+                    self.stats.coalesced_uploads += 1
                 else:
                     batch = jax.device_put(columns, sharding)
+                    self.stats.per_field_uploads += 1
         else:
             batch = columns
         # Host-local row count travels alongside: with a multi-process mesh the device
