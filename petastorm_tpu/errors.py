@@ -1,5 +1,12 @@
 """Framework error types (reference: petastorm/errors.py:16-17, petastorm/utils.py:50-51,
-petastorm/etl/dataset_metadata.py PetastormMetadataError)."""
+petastorm/etl/dataset_metadata.py PetastormMetadataError).
+
+The resilience subsystem (petastorm_tpu/resilience.py, docs/robustness.md) splits
+failures into two classes: *transient* (retryable — network hiccups, throttled object
+stores, flaky tunnels) and *permanent* (corrupt data, schema bugs). ``TransientIOError``
+marks the former explicitly; ``QuarantinedRowGroupError`` reports a rowgroup that was
+skipped under ``on_error='skip'`` and landed in the quarantine ledger.
+"""
 
 
 class PetastormTpuError(Exception):
@@ -13,9 +20,48 @@ class NoDataAvailableError(PetastormTpuError):
 
 class DecodeFieldError(PetastormTpuError):
     """Raised when a codec fails to decode a field value (reference:
-    petastorm/utils.py:50-51)."""
+    petastorm/utils.py:50-51).
+
+    Structured attributes (machine-readable, not just message text):
+
+    - ``field_name``: the Unischema field that failed to decode (None if unknown).
+    - ``fragment_path``: the Parquet fragment being read when the decode failed
+      (None when decoding outside a rowgroup read, e.g. ``decode_row``).
+    """
+
+    def __init__(self, message, field_name=None, fragment_path=None):
+        super().__init__(message)
+        self.field_name = field_name
+        self.fragment_path = fragment_path
 
 
 class MetadataError(PetastormTpuError):
     """Raised when dataset metadata (schema / rowgroup index) is missing or unreadable
     (reference: petastorm/etl/dataset_metadata.py:30-33)."""
+
+
+class TransientIOError(PetastormTpuError, OSError):
+    """An IO failure that is expected to succeed on retry (connection reset, throttled
+    object store, wedged tunnel). Subclasses ``OSError`` so generic IO-error handling
+    (and the default transient classifier in :mod:`petastorm_tpu.resilience`) treats it
+    uniformly with errno-style failures; raise it from custom filesystems to opt an
+    error into the retry path explicitly."""
+
+
+class QuarantinedRowGroupError(PetastormTpuError):
+    """A rowgroup exhausted its error budget under ``on_error='skip'`` and was excluded
+    from the stream. Not raised on the hot path (skip mode degrades silently-but-visibly
+    through the quarantine ledger); raised by APIs that convert ledger entries back into
+    exceptions (e.g. strict post-epoch validation).
+
+    Structured attributes: ``piece_index``, ``fragment_path``, ``row_group_id``,
+    ``attempts``, and ``cause`` (the final underlying exception, if available)."""
+
+    def __init__(self, message, piece_index=None, fragment_path=None, row_group_id=None,
+                 attempts=None, cause=None):
+        super().__init__(message)
+        self.piece_index = piece_index
+        self.fragment_path = fragment_path
+        self.row_group_id = row_group_id
+        self.attempts = attempts
+        self.cause = cause

@@ -317,11 +317,18 @@ def write_dataset_metadata(handle, new_keys):
 # Read path
 # ---------------------------------------------------------------------------
 
-def load_row_groups(handle):
+def load_row_groups(handle, on_fragment_error=None):
     """List every rowgroup of the dataset in deterministic (path-sorted) order — the
     reproducible-shuffle prerequisite (reference: petastorm/etl/dataset_metadata.py:237-275).
     Prefers the metadata JSON index; silently recomputes from footers when it is absent or
-    stale."""
+    stale.
+
+    ``on_fragment_error`` — optional ``callback(exc, fragment_path, fragment_index)``
+    for the reader's skip-with-quarantine mode (docs/robustness.md): a fragment whose
+    footer cannot be read for a PERMANENT reason (truncated/corrupt file) is excluded
+    from the enumeration and reported to the callback instead of aborting; transient IO
+    failures still raise so the caller's retry policy governs them. Default (None)
+    preserves the raise-on-first-error behavior."""
     metadata = read_metadata_dict(handle)
     root = handle.root_path
     index_map = None
@@ -346,8 +353,18 @@ def load_row_groups(handle):
                 logger.warning('Rowgroup index for %s is stale (size %s != %s); '
                                'recomputing from footer', rel, entry.get('size'), actual_size)
         if counts is None:
-            fragment.ensure_complete_metadata()
-            counts = [rg.num_rows for rg in fragment.row_groups]
+            try:
+                fragment.ensure_complete_metadata()
+                counts = [rg.num_rows for rg in fragment.row_groups]
+            except Exception as exc:  # noqa: BLE001 - policy decides below
+                from petastorm_tpu.resilience import is_transient_error
+                if on_fragment_error is None or is_transient_error(exc):
+                    raise
+                logger.warning('Excluding fragment %s from the rowgroup schedule: '
+                               'footer unreadable (%s: %s)', fragment.path,
+                               type(exc).__name__, exc)
+                on_fragment_error(exc, fragment.path, fragment_index)
+                continue
         for row_group_id, num_rows in enumerate(counts):
             row_groups.append(RowGroupIndices(fragment_index, fragment.path, row_group_id,
                                               num_rows, partition_keys))

@@ -167,20 +167,36 @@ def delete_path(filesystem, path, recursive=True):
 
 class FilesystemFactory(object):
     """A picklable zero-arg callable re-creating the filesystem — for shipping to worker
-    processes (reference: fs_utils.py:166-172)."""
+    processes (reference: fs_utils.py:166-172).
 
-    def __init__(self, url, storage_options=None):
+    With a ``retry_policy`` (:class:`~petastorm_tpu.resilience.RetryPolicy`), transient
+    resolution failures — DNS blips, throttled object-store auth, namenode failover
+    races — are retried with deterministic backoff before surfacing: workers re-invoke
+    this factory whenever they (re)connect, including after a mid-read retry dropped a
+    broken connection, so the connect path needs the same resilience as the read path
+    (docs/robustness.md)."""
+
+    def __init__(self, url, storage_options=None, retry_policy=None):
         self._url = url
         self._storage_options = storage_options
+        self._retry_policy = retry_policy
 
     def __call__(self):
         # Workers hand this filesystem straight into Arrow C++ (make_fragment) — a
         # python HA proxy is not accepted there, so unwrap. Connect-time namenode
         # failover still applies on each worker's fresh connection.
-        return as_arrow_filesystem(_resolve_single(self._url, self._storage_options)[0])
+        def resolve():
+            return as_arrow_filesystem(
+                _resolve_single(self._url, self._storage_options)[0])
+        if self._retry_policy is None:
+            return resolve()
+        from petastorm_tpu.resilience import run_with_retry
+        filesystem, _ = run_with_retry(resolve, self._retry_policy)
+        return filesystem
 
 
-def make_filesystem_factory(url, storage_options=None):
+def make_filesystem_factory(url, storage_options=None, retry_policy=None):
     """Picklable zero-arg factory resolving ``url``'s filesystem — what worker
-    processes ship instead of a live (unpicklable) filesystem object."""
-    return FilesystemFactory(url, storage_options)
+    processes ship instead of a live (unpicklable) filesystem object. ``retry_policy``
+    makes the resolution itself retry transient failures."""
+    return FilesystemFactory(url, storage_options, retry_policy=retry_policy)

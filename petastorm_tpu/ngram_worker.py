@@ -21,14 +21,18 @@ class NGramWindows(object):
     window i; every window spans ``length`` consecutive rows of ``columns``.
     ``item_id`` is the ventilated work item's ``(epoch, piece, drop_partition)`` —
     the unit of NGram checkpoint/resume accounting (VERDICT r3 item 4); zero-window
-    pieces still publish (empty ``starts``) solely to carry it."""
+    pieces still publish (empty ``starts``) solely to carry it. ``retries`` /
+    ``quarantine`` are the resilience sidecar, same contract as
+    :class:`~petastorm_tpu.reader_worker.ColumnarBatch` (docs/robustness.md)."""
 
-    __slots__ = ('columns', 'starts', 'item_id')
+    __slots__ = ('columns', 'starts', 'item_id', 'retries', 'quarantine')
 
-    def __init__(self, columns, starts, item_id=None):
+    def __init__(self, columns, starts, item_id=None, retries=0, quarantine=None):
         self.columns = columns
         self.starts = starts
         self.item_id = item_id
+        self.retries = retries
+        self.quarantine = quarantine
 
     def __len__(self):
         return len(self.starts)
@@ -54,7 +58,8 @@ def process_ngram_piece(worker, piece_index, fragment_path, row_group_id, partit
     def load_windows():
         fragment = worker._make_fragment(fragment_path, row_group_id)
         table = fragment.to_table(columns=worker._storage_columns(setup.fields_to_read))
-        columns = worker._decode_table(table, partition_keys, setup.fields_to_read)
+        columns = worker._decode_table(table, partition_keys, setup.fields_to_read,
+                                       fragment_path=fragment_path)
         num_rows = table.num_rows
 
         part_index, num_parts = shuffle_row_drop_partition

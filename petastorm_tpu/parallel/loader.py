@@ -57,7 +57,12 @@ class LoaderStats(object):
     metric. The upload-mode counters make the H2D path observable in captured
     bench lines: a hardware capture can PROVE whether the coalesced
     single-transfer path engaged (``coalesced_uploads``) or each field shipped
-    separately (``per_field_uploads`` — also counts mesh-path uploads)."""
+    separately (``per_field_uploads`` — also counts mesh-path uploads).
+
+    ``io_retries`` / ``rowgroups_quarantined`` mirror the reader's resilience
+    counters (docs/robustness.md) into the loader's own stats surface: a training
+    job that only watches ``LoaderStats`` still sees degradation — a non-zero
+    quarantine count means the epoch silently served fewer rowgroups."""
 
     def __init__(self):
         self.batches = 0
@@ -66,6 +71,8 @@ class LoaderStats(object):
         self.total_time_s = 0.0
         self.coalesced_uploads = 0
         self.per_field_uploads = 0
+        self.io_retries = 0
+        self.rowgroups_quarantined = 0
 
     @property
     def input_stall_fraction(self):
@@ -79,7 +86,9 @@ class LoaderStats(object):
                 'total_time_s': round(self.total_time_s, 4),
                 'input_stall_fraction': round(self.input_stall_fraction, 4),
                 'coalesced_uploads': self.coalesced_uploads,
-                'per_field_uploads': self.per_field_uploads}
+                'per_field_uploads': self.per_field_uploads,
+                'io_retries': self.io_retries,
+                'rowgroups_quarantined': self.rowgroups_quarantined}
 
 
 class JaxDataLoader(object):
@@ -289,16 +298,30 @@ class JaxDataLoader(object):
     def _reader_chunks(self):
         """Yield sanitized columnar chunks from the reader, tracking delivery when the
         columnar fast path provides item identity."""
-        for columns, num_rows, item_id in iter_reader_chunks(
-                self.reader, accum_rows=self.batch_size, include_empty=True):
-            if item_id is None:
-                self._delivery_supported = False
-            else:
-                self._delivery_supported = self._delivery_supported is not False
-                with self._fifo_lock:
-                    self._delivery_fifo.append([item_id, num_rows])
-            if num_rows:
-                yield self._sanitize(columns)
+        try:
+            for columns, num_rows, item_id in iter_reader_chunks(
+                    self.reader, accum_rows=self.batch_size, include_empty=True):
+                if item_id is None:
+                    self._delivery_supported = False
+                else:
+                    self._delivery_supported = self._delivery_supported is not False
+                    with self._fifo_lock:
+                        self._delivery_fifo.append([item_id, num_rows])
+                if num_rows:
+                    yield self._sanitize(columns)
+        finally:
+            self._sync_resilience_stats()
+
+    def _sync_resilience_stats(self):
+        """Mirror the reader's retry/quarantine counters into LoaderStats so training
+        jobs watching only the loader still see input degradation
+        (docs/robustness.md)."""
+        retries = getattr(self.reader, 'io_retries', None)
+        if retries is not None:
+            self.stats.io_retries = retries
+        ledger = getattr(self.reader, 'quarantine', None)
+        if ledger is not None:
+            self.stats.rowgroups_quarantined = len(ledger)
 
     def _sanitize(self, columns):
         return sanitize_columns(columns, self._pad_ragged, self._device_put)
@@ -525,6 +548,7 @@ class JaxDataLoader(object):
             carry, aux = run_chunk(carry, merged, pending_rows // batch_size,
                                    chunk_index)
             aux_chunks.append(aux)
+        self._sync_resilience_stats()
         return carry, aux_chunks
 
     # ------------------------------------------------------------------ checkpoint

@@ -49,6 +49,24 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size):
                      .format(reader_pool_type))
 
 
+def _retrying(fn, retry_policy, counter=None):
+    """Run a construction-time filesystem operation (dataset open, rowgroup
+    enumeration) under the reader's retry policy; ``counter`` (a 1-element list)
+    accumulates retries so they surface in ``diagnostics['io_retries']`` like any
+    worker-side retry."""
+    if retry_policy is None:
+        return fn()
+    from petastorm_tpu.resilience import run_with_retry
+
+    def on_retry(attempt, exc, delay):
+        logger.warning('Transient IO failure opening dataset (attempt %d): %s; '
+                       'retrying in %.3fs', attempt, exc, delay)
+    result, retries = run_with_retry(fn, retry_policy, on_retry=on_retry)
+    if counter is not None:
+        counter[0] += retries
+    return result
+
+
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
                 cache_extra_settings):
     if cache_type in (None, 'null'):
@@ -69,7 +87,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 cache_size_limit=None, cache_row_size_estimate=None,
                 cache_extra_settings=None, transform_spec=None, storage_options=None,
                 filesystem=None, resume_state=None, reader_pool=None,
-                field_overrides=None, hdfs_driver='libhdfs'):
+                field_overrides=None, hdfs_driver='libhdfs', on_error='raise',
+                retry_policy=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -79,12 +98,25 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     same-named stored fields for THIS read (read-time reinterpretation: e.g. swap a
     ``DctImageCodec`` field to ``DctCoefficientsCodec`` so raw coefficients flow to an
     on-device decode). ``hdfs_driver`` — petastorm API compatibility (reference:
-    reader.py:126-127); pyarrow.fs provides libhdfs only, 'libhdfs3' warns."""
+    reader.py:126-127); pyarrow.fs provides libhdfs only, 'libhdfs3' warns.
+
+    Resilience (docs/robustness.md): ``on_error`` is the per-rowgroup failure policy —
+    ``'raise'`` (default; any failure aborts the read, today's exact behavior),
+    ``'retry'`` (transient IO failures are retried per ``retry_policy``, then raised),
+    ``'skip'`` (after retries, the failing rowgroup is excluded and recorded in the
+    quarantine ledger, visible via ``Reader.diagnostics['quarantine']``). ``retry_policy``
+    is a :class:`~petastorm_tpu.resilience.RetryPolicy` (default: 3 attempts,
+    exponential backoff with seeded jitter)."""
+    from petastorm_tpu.resilience import resolve_retry_policy
     check_hdfs_driver(hdfs_driver)
+    retry_policy = resolve_retry_policy(on_error, retry_policy)
+    construction_retries = [0]
     dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
-    handle = dataset_metadata.open_dataset(dataset_url_or_urls,
-                                           storage_options=storage_options,
-                                           filesystem=filesystem)
+    handle = _retrying(
+        lambda: dataset_metadata.open_dataset(dataset_url_or_urls,
+                                              storage_options=storage_options,
+                                              filesystem=filesystem),
+        retry_policy, construction_retries)
     try:
         schema = dataset_metadata.get_schema(handle)
     except MetadataError:
@@ -117,7 +149,9 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   shard_seed=shard_seed, cache=cache, transform_spec=transform_spec,
                   is_batched_reader=False, decode=True,
                   storage_options=storage_options, filesystem=filesystem,
-                  resume_state=resume_state)
+                  resume_state=resume_state, on_error=on_error,
+                  retry_policy=retry_policy,
+                  initial_io_retries=construction_retries[0])
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -128,15 +162,22 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, storage_options=None, filesystem=None,
-                      resume_state=None, hdfs_driver='libhdfs'):
+                      resume_state=None, hdfs_driver='libhdfs', on_error='raise',
+                      retry_policy=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
+    ``on_error`` / ``retry_policy`` behave exactly as in :func:`make_reader`.
     """
+    from petastorm_tpu.resilience import resolve_retry_policy
     check_hdfs_driver(hdfs_driver)
+    retry_policy = resolve_retry_policy(on_error, retry_policy)
+    construction_retries = [0]
     dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
-    handle = dataset_metadata.open_dataset(dataset_url_or_urls,
-                                           storage_options=storage_options,
-                                           filesystem=filesystem)
+    handle = _retrying(
+        lambda: dataset_metadata.open_dataset(dataset_url_or_urls,
+                                              storage_options=storage_options,
+                                              filesystem=filesystem),
+        retry_policy, construction_retries)
     try:
         dataset_metadata.get_schema(handle)
         warnings.warn('This store was written with a Unischema; use make_reader to get '
@@ -155,7 +196,9 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   cur_shard=cur_shard, shard_count=shard_count, shard_seed=shard_seed,
                   cache=cache, transform_spec=transform_spec, is_batched_reader=True,
                   decode=False, storage_options=storage_options, filesystem=filesystem,
-                  resume_state=resume_state)
+                  resume_state=resume_state, on_error=on_error,
+                  retry_policy=retry_policy,
+                  initial_io_retries=construction_retries[0])
 
 
 class Reader(object):
@@ -167,11 +210,21 @@ class Reader(object):
                  shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None, shard_seed=None,
                  cache=None, transform_spec=None, is_batched_reader=False, decode=True,
-                 storage_options=None, filesystem=None, resume_state=None):
+                 storage_options=None, filesystem=None, resume_state=None,
+                 on_error='raise', retry_policy=None, initial_io_retries=0):
+        from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
+        retry_policy = resolve_retry_policy(on_error, retry_policy)
+        construction_retries = [initial_io_retries]
+        construction_policy = retry_policy
         self.num_epochs = num_epochs
         self.is_batched_reader = is_batched_reader
         self.last_row_consumed = False
         self._stopped = False
+        self.on_error = on_error
+        #: skip-with-quarantine ledger — records arrive on the results channel attached
+        #: to the empty stand-in batches of skipped rowgroups (docs/robustness.md)
+        self.quarantine = QuarantineLedger()
+        self._io_retries = 0
 
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
@@ -182,9 +235,11 @@ class Reader(object):
                              '(reference semantics: reader.py:430-434)')
 
         if handle is None:
-            handle = dataset_metadata.open_dataset(dataset_url_or_urls,
-                                                   storage_options=storage_options,
-                                                   filesystem=filesystem)
+            handle = _retrying(
+                lambda: dataset_metadata.open_dataset(dataset_url_or_urls,
+                                                      storage_options=storage_options,
+                                                      filesystem=filesystem),
+                construction_policy, construction_retries)
         self._handle = handle
         if schema is None:
             schema = Unischema.from_arrow_schema(handle.arrow_dataset.schema)
@@ -228,8 +283,11 @@ class Reader(object):
         url_for_factory = dataset_url_or_urls if not isinstance(dataset_url_or_urls, list) \
             else dataset_url_or_urls[0]
         # Workers feed this filesystem into Arrow C++ — unwrap any HA failover proxy
-        # (as_arrow_filesystem) when the caller supplied one explicitly.
-        filesystem_factory = (make_filesystem_factory(url_for_factory, storage_options)
+        # (as_arrow_filesystem) when the caller supplied one explicitly. Under a
+        # retrying on_error policy the factory itself retries filesystem RESOLUTION
+        # (connection setup is as transient-failure-prone as reads).
+        filesystem_factory = (make_filesystem_factory(url_for_factory, storage_options,
+                                                      retry_policy=retry_policy)
                               if filesystem is None
                               else (lambda: as_arrow_filesystem(filesystem)))
         worker_setup = WorkerSetup(
@@ -244,12 +302,49 @@ class Reader(object):
             cache=cache,
             shuffle_rows=shuffle_rows,
             seed=seed,
-            partition_field_names=partition_names)
+            partition_field_names=partition_names,
+            on_error=on_error,
+            retry_policy=retry_policy)
         # Single source of truth for the emitted schema: the workers' own derivation.
         self.result_schema = worker_setup.result_schema
 
         # ------------------------------------------------ rowgroup schedule
-        row_groups = dataset_metadata.load_row_groups(handle)
+        # Under 'skip', permanently unreadable footers (truncated part-files) are
+        # excluded from the schedule and quarantined at enumeration time — workers
+        # would only re-discover the same corruption per rowgroup. Records are staged
+        # per attempt and committed once, so a transient mid-enumeration failure that
+        # triggers a construction retry cannot double-record a corrupt fragment.
+        # NOT with a rowgroup_selector: its selected indexes refer to the FULL
+        # enumeration (see below), and dropping a fragment would silently shift every
+        # later piece under the selection — a corrupt footer is loud in that combination.
+        def enumerate_row_groups():
+            staged = []
+            on_fragment_error = None
+            if on_error == 'skip' and rowgroup_selector is None:
+                from petastorm_tpu.resilience import QuarantineRecord
+
+                def on_fragment_error(exc, fragment_path, fragment_index):
+                    staged.append(QuarantineRecord.from_exception(
+                        exc, piece_index=fragment_index, fragment_path=fragment_path,
+                        row_group_id=None, attempts=1, epoch=0))
+            return dataset_metadata.load_row_groups(
+                handle, on_fragment_error=on_fragment_error), staged
+
+        row_groups, construction_quarantine = _retrying(
+            enumerate_row_groups, construction_policy, construction_retries)
+        if construction_quarantine and resume_state is not None:
+            # Fragments dropped at enumeration shift the (piece, drop) coordinates the
+            # checkpoint's consumed sets refer to; a shifted resume would silently
+            # re-serve or lose the wrong rowgroups. items_per_epoch validation below
+            # only catches COUNT changes — refuse explicitly.
+            raise ValueError(
+                'Cannot resume: {} fragment(s) became unreadable since the checkpoint '
+                'was taken ({}); resume coordinates would not match the checkpoint'
+                .format(len(construction_quarantine),
+                        ', '.join(r.fragment_path for r in construction_quarantine)))
+        for record in construction_quarantine:
+            self.quarantine.add(record)
+        self._io_retries = construction_retries[0]
         if rowgroup_selector is not None:
             # Selector piece indexes refer to the FULL load_row_groups enumeration (what
             # build_rowgroup_index scanned) — apply before any other filtering.
@@ -429,6 +524,15 @@ class Reader(object):
     # ----------------------------------------------------------- checkpoint / resume
 
     def _note_item_consumed(self, batch):
+        # Resilience sidecar first: retry/quarantine accounting applies to every result
+        # (on_batch fires exactly once per published batch on every pool).
+        record = getattr(batch, 'quarantine', None)
+        if record is not None:
+            self.quarantine.add(record)
+        retries = getattr(batch, 'retries', 0)
+        if retries:
+            with self._accounting_lock:
+                self._io_retries += retries
         item_id = getattr(batch, 'item_id', None)
         if item_id is None:
             return
@@ -517,6 +621,12 @@ class Reader(object):
     def items_per_epoch(self):
         return self._items_per_epoch
 
+    @property
+    def io_retries(self):
+        """Cumulative transient-IO retries spent by workers on this reader's behalf."""
+        with self._accounting_lock:
+            return self._io_retries
+
     # ------------------------------------------------------------- lifecycle
 
     def stop(self):
@@ -531,7 +641,15 @@ class Reader(object):
 
     @property
     def diagnostics(self):
-        return self._pool.diagnostics
+        """Pool counters plus the resilience view: cumulative transient-IO retries and
+        the quarantine ledger (always present, so dashboards can alert on non-zero
+        values without key-existence checks)."""
+        diag = dict(self._pool.diagnostics)
+        with self._accounting_lock:
+            diag['io_retries'] = self._io_retries
+        diag['rowgroups_quarantined'] = len(self.quarantine)
+        diag['quarantine'] = self.quarantine.as_dicts()
+        return diag
 
     def __enter__(self):
         return self

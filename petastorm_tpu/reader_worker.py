@@ -31,14 +31,22 @@ class ColumnarBatch(object):
     Arrays are ``(n,) + field.shape`` when shapes are uniform; ragged fields stay as lists
     of per-row arrays. ``item_id`` identifies the ventilated work item
     ``(piece_index, drop_partition)`` that produced this batch — the unit of the reader's
-    checkpoint/resume accounting (empty batches are published solely to carry it)."""
+    checkpoint/resume accounting (empty batches are published solely to carry it).
 
-    __slots__ = ('columns', 'num_rows', 'item_id')
+    Resilience sidecar (docs/robustness.md): ``retries`` counts transient-IO retries the
+    worker spent producing this batch (zero on the fault-free path); ``quarantine`` is a
+    :class:`~petastorm_tpu.resilience.QuarantineRecord` when this batch stands in for a
+    rowgroup skipped under ``on_error='skip'`` (such batches are empty — the record rides
+    the results channel so the ledger works identically across all pools)."""
 
-    def __init__(self, columns, num_rows, item_id=None):
+    __slots__ = ('columns', 'num_rows', 'item_id', 'retries', 'quarantine')
+
+    def __init__(self, columns, num_rows, item_id=None, retries=0, quarantine=None):
         self.columns = columns
         self.num_rows = num_rows
         self.item_id = item_id
+        self.retries = retries
+        self.quarantine = quarantine
 
 
 class WorkerSetup(object):
@@ -46,11 +54,19 @@ class WorkerSetup(object):
 
     __slots__ = ('dataset_path_or_paths', 'filesystem_factory', 'schema', 'fields_to_read',
                  'result_schema', 'transform_spec', 'batched_output', 'decode', 'ngram',
-                 'cache', 'shuffle_rows', 'seed', 'partition_field_names', 'dataset_token')
+                 'cache', 'shuffle_rows', 'seed', 'partition_field_names', 'dataset_token',
+                 'on_error', 'retry_policy')
 
     def __init__(self, dataset_path_or_paths, filesystem_factory, schema, fields_to_read,
                  transform_spec=None, batched_output=False, decode=True, ngram=None,
-                 cache=None, shuffle_rows=False, seed=None, partition_field_names=()):
+                 cache=None, shuffle_rows=False, seed=None, partition_field_names=(),
+                 on_error='raise', retry_policy=None):
+        from petastorm_tpu.resilience import resolve_retry_policy
+        self.on_error = on_error
+        # One normalization for the whole stack: 'raise' means today's exact behavior
+        # (no retry even of transient faults), other modes get the given or default
+        # policy.
+        self.retry_policy = resolve_retry_policy(on_error, retry_policy)
         self.dataset_path_or_paths = dataset_path_or_paths
         self.filesystem_factory = filesystem_factory
         self.schema = schema
@@ -104,41 +120,108 @@ class RowGroupWorker(WorkerBase):
     def process(self, piece_index, fragment_path, row_group_id, partition_keys=None,
                 worker_predicate=None, shuffle_row_drop_partition=(0, 1), epoch_index=0):
         setup = self._setup
-        if setup.ngram is not None:
-            # Always published — a zero-window piece still carries its item_id so
-            # the reader's consumption accounting stays exact (same contract as the
-            # row path's empty ColumnarBatch below).
-            self.publish_func(self._process_ngram(
-                piece_index, fragment_path, row_group_id, partition_keys,
-                worker_predicate, shuffle_row_drop_partition, epoch_index))
-            return
-
-        predicate_token = _predicate_token(worker_predicate)
-        def load():
-            return self._load_and_decode(fragment_path, row_group_id, partition_keys,
-                                         worker_predicate, shuffle_row_drop_partition)
-        if predicate_token is None:
-            # Unpicklable predicate: no stable cache identity exists — bypass the cache
-            # rather than risk serving rows filtered by a different predicate.
-            columns = load()
-        else:
-            cache_key = '{}:{}:{}:{}:{}'.format(
-                setup.dataset_token, fragment_path, row_group_id,
-                shuffle_row_drop_partition, predicate_token)
-            columns = setup.cache.get(cache_key, load)
         # (absolute_epoch, piece, drop_partition): the epoch tag lets the reader attribute
         # this result to the right epoch even when completions interleave across an epoch
         # boundary (parallel pools keep up to workers+2 items in flight).
         item_id = (epoch_index, piece_index, shuffle_row_drop_partition[0])
-        num_rows = _columns_num_rows(columns)
+
+        # ------------------------------------------------------------- resilience
+        # The retry wrapper goes around the IO-heavy load closure only (transform and
+        # shuffle never touch the filesystem); the skip-with-quarantine catch covers the
+        # whole piece so any permanent failure — corrupt footer, decode bug — degrades
+        # to one ledger entry instead of aborting the epoch (docs/robustness.md).
+        retry_cell = [0]
+
+        def on_retry(attempt, exc, delay):
+            retry_cell[0] += 1
+            # Drop the cached filesystem: a broken connection must not be reused — the
+            # next attempt reconnects through the (retry-aware) factory.
+            self._filesystem = None
+            logger.warning('Transient IO failure on piece %s (%s rg %s), attempt %d: '
+                           '%s; retrying in %.3fs', piece_index, fragment_path,
+                           row_group_id, attempt, exc, delay)
+
+        def with_retry(load_fn):
+            if setup.retry_policy is None:
+                return load_fn()
+            from petastorm_tpu.resilience import run_with_retry
+            result, _ = run_with_retry(load_fn, setup.retry_policy, key=piece_index,
+                                       on_retry=on_retry)
+            return result
+
+        if setup.ngram is not None:
+            try:
+                payload = with_retry(lambda: self._process_ngram(
+                    piece_index, fragment_path, row_group_id, partition_keys,
+                    worker_predicate, shuffle_row_drop_partition, epoch_index))
+            except Exception as exc:  # noqa: BLE001 - on_error policy decides
+                if setup.on_error != 'skip':
+                    raise
+                self._publish_quarantined(exc, item_id, piece_index, fragment_path,
+                                          row_group_id, retry_cell[0])
+                return
+            # Always published — a zero-window piece still carries its item_id so
+            # the reader's consumption accounting stays exact (same contract as the
+            # row path's empty ColumnarBatch below).
+            payload.retries = retry_cell[0]
+            self.publish_func(payload)
+            return
+
+        try:
+            predicate_token = _predicate_token(worker_predicate)
+
+            def load():
+                return self._load_and_decode(fragment_path, row_group_id, partition_keys,
+                                             worker_predicate, shuffle_row_drop_partition)
+
+            if predicate_token is None:
+                # Unpicklable predicate: no stable cache identity exists — bypass the
+                # cache rather than risk serving rows filtered by a different predicate.
+                columns = with_retry(load)
+            else:
+                cache_key = '{}:{}:{}:{}:{}'.format(
+                    setup.dataset_token, fragment_path, row_group_id,
+                    shuffle_row_drop_partition, predicate_token)
+                columns = setup.cache.get(cache_key, lambda: with_retry(load))
+            num_rows = _columns_num_rows(columns)
+            if num_rows:
+                columns = self._shuffle(columns, num_rows, piece_index)
+                columns, num_rows = self._apply_transform(columns, num_rows)
+        except Exception as exc:  # noqa: BLE001 - on_error policy decides
+            if setup.on_error != 'skip':
+                raise
+            self._publish_quarantined(exc, item_id, piece_index, fragment_path,
+                                      row_group_id, retry_cell[0])
+            return
         if num_rows == 0:
             # Publish an empty batch anyway: every item must yield exactly one result so
             # the reader's consumption accounting (state_dict/resume) stays exact.
-            self.publish_func(ColumnarBatch({}, 0, item_id=item_id))
+            self.publish_func(ColumnarBatch({}, 0, item_id=item_id,
+                                            retries=retry_cell[0]))
             return
-        columns = self._shuffle(columns, num_rows, piece_index)
-        columns, num_rows = self._apply_transform(columns, num_rows)
-        self.publish_func(ColumnarBatch(columns, num_rows, item_id=item_id))
+        self.publish_func(ColumnarBatch(columns, num_rows, item_id=item_id,
+                                        retries=retry_cell[0]))
+
+    def _publish_quarantined(self, exc, item_id, piece_index, fragment_path,
+                             row_group_id, retries):
+        """Skip path: record the failure and publish an EMPTY result carrying the
+        quarantine record, so (a) consumption accounting still sees exactly one result
+        for the item — checkpoints exclude it via the consumed set — and (b) the record
+        reaches the reader-side ledger over the same channel on every pool."""
+        from petastorm_tpu.resilience import QuarantineRecord
+        record = QuarantineRecord.from_exception(
+            exc, piece_index=piece_index, fragment_path=fragment_path,
+            row_group_id=row_group_id, attempts=retries + 1, epoch=item_id[0])
+        logger.warning('Quarantining rowgroup piece %s (%s rg %s) after %d attempt(s): '
+                       '%s: %s', piece_index, fragment_path, row_group_id, retries + 1,
+                       type(exc).__name__, exc)
+        if self._setup.ngram is not None:
+            from petastorm_tpu.ngram_worker import NGramWindows
+            self.publish_func(NGramWindows({}, np.empty(0, np.int64), item_id=item_id,
+                                           retries=retries, quarantine=record))
+        else:
+            self.publish_func(ColumnarBatch({}, 0, item_id=item_id, retries=retries,
+                                            quarantine=record))
 
     # ------------------------------------------------------------------ load
 
@@ -177,7 +260,8 @@ class RowGroupWorker(WorkerBase):
         if len(selected) != table.num_rows:
             table = table.take(selected)
 
-        return self._decode_table(table, partition_keys, all_fields)
+        return self._decode_table(table, partition_keys, all_fields,
+                                  fragment_path=fragment_path)
 
     def _two_phase_load(self, fragment_path, row_group_id, partition_keys,
                         worker_predicate, all_fields):
@@ -192,7 +276,8 @@ class RowGroupWorker(WorkerBase):
         fragment = self._make_fragment(fragment_path, row_group_id)
         predicate_table = fragment.to_table(columns=self._storage_columns(predicate_fields))
         predicate_columns = self._decode_table(predicate_table, partition_keys,
-                                               predicate_fields)
+                                               predicate_fields,
+                                               fragment_path=fragment_path)
         mask = self._evaluate_predicate(worker_predicate, predicate_columns,
                                         predicate_table.num_rows)
         keep = np.nonzero(mask)[0]
@@ -227,8 +312,12 @@ class RowGroupWorker(WorkerBase):
 
     # ---------------------------------------------------------------- decode
 
-    def _decode_table(self, table, partition_keys, field_names):
-        """Arrow table -> {name: ndarray-or-list} of decoded values."""
+    def _decode_table(self, table, partition_keys, field_names, fragment_path=None):
+        """Arrow table -> {name: ndarray-or-list} of decoded values. Codec failures are
+        wrapped in :class:`DecodeFieldError` carrying the field name and fragment path as
+        structured attributes — a corrupt value names its store location, not just a
+        message."""
+        from petastorm_tpu.errors import DecodeFieldError
         setup = self._setup
         partition_keys = partition_keys or {}
         num_rows = table.num_rows
@@ -241,7 +330,13 @@ class RowGroupWorker(WorkerBase):
                 continue
             arrow_col = table.column(name)
             if field is not None and field.codec is not None and setup.decode:
-                decoded = field.codec.decode_arrow_column(field, arrow_col)
+                try:
+                    decoded = field.codec.decode_arrow_column(field, arrow_col)
+                except Exception as exc:
+                    raise DecodeFieldError(
+                        'Failed to decode field {!r} of fragment {!r}: {}'
+                        .format(name, fragment_path, exc),
+                        field_name=name, fragment_path=fragment_path) from exc
                 if isinstance(decoded, np.ndarray):
                     columns[name] = decoded  # codec returned a stacked fast-path column
                 else:

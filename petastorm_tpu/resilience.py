@@ -1,0 +1,244 @@
+"""Resilience primitives: retry/backoff, transient-error classification, and the
+quarantine ledger.
+
+The reference (SURVEY §5.3) only *detects* failures — a worker exception aborts the
+epoch. Production input pipelines treat transient faults as routine (tf.data service
+restarts workers and re-dispatches their splits, arXiv 2210.14826); this module supplies
+the policy objects the rest of the stack threads through:
+
+- :class:`RetryPolicy` — bounded attempts, exponential backoff with **deterministic
+  seeded jitter**, per-attempt and total deadline budgets. Applied around filesystem
+  resolution (:mod:`petastorm_tpu.fs_utils`) and rowgroup loads
+  (:mod:`petastorm_tpu.reader_worker`).
+- :func:`run_with_retry` — the retry loop itself, classifier-driven so only transient
+  failures burn attempts.
+- :class:`QuarantineRecord` / :class:`QuarantineLedger` — the skip-with-quarantine
+  bookkeeping for ``make_reader(..., on_error='skip')``: every skipped rowgroup is
+  recorded (piece, path, exception, attempts) and surfaced through
+  ``Reader.diagnostics``, ``LoaderStats``, and the doctor — degradation is always
+  visible, never silent.
+
+This is the repo's first strict-typed module (mypy.ini ``[mypy-petastorm_tpu.resilience]``).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from petastorm_tpu.errors import TransientIOError
+
+#: on_error modes accepted by make_reader / make_batch_reader
+ON_ERROR_MODES: Tuple[str, ...] = ('raise', 'retry', 'skip')
+
+
+def check_on_error(on_error: str) -> str:
+    """Validate an ``on_error`` mode (shared by both reader factories)."""
+    if on_error not in ON_ERROR_MODES:
+        raise ValueError('on_error must be one of {}, got {!r}'
+                         .format(ON_ERROR_MODES, on_error))
+    return on_error
+
+
+def resolve_retry_policy(on_error: str,
+                         retry_policy: Optional['RetryPolicy']) -> Optional['RetryPolicy']:
+    """The ONE normalization of the ``(on_error, retry_policy)`` pair, used by every
+    layer (reader factories, Reader, WorkerSetup): ``'raise'`` means no retry anywhere
+    (today's exact behavior — an explicitly passed policy is ignored), other modes get
+    the given policy or the default. Also validates ``on_error``."""
+    check_on_error(on_error)
+    if on_error == 'raise':
+        return None
+    return retry_policy if retry_policy is not None else RetryPolicy()
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """Default transient classifier: OS-level IO failures (connection resets, timeouts,
+    throttling surfaced as errno failures — pyarrow raises its ``ArrowIOError`` as an
+    ``OSError`` subclass) plus explicit :class:`TransientIOError`. Data corruption
+    (``ArrowInvalid``/``ValueError``), schema and decode bugs are permanent: retrying a
+    truncated footer re-reads the same bytes."""
+    if isinstance(exc, TransientIOError):
+        return True
+    if isinstance(exc, (FileNotFoundError, IsADirectoryError, NotADirectoryError,
+                        PermissionError)):
+        # Deterministic filesystem answers — retrying cannot change them.
+        return False
+    return isinstance(exc, (OSError, TimeoutError))
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with exponential backoff and deterministic seeded jitter.
+
+    :param max_attempts: total attempts including the first (1 = no retry).
+    :param backoff_base_s: sleep before the first retry.
+    :param backoff_multiplier: growth factor per subsequent retry.
+    :param max_backoff_s: backoff ceiling.
+    :param jitter_fraction: each sleep is scaled by a factor drawn uniformly from
+        ``[1 - jitter_fraction, 1 + jitter_fraction]``. The draw is a pure function of
+        ``(seed, key, attempt)`` — two runs with the same seed sleep identically, so
+        fault-injection tests and distributed workers are reproducible.
+    :param seed: jitter seed; None keeps jitter deterministic with seed 0.
+    :param per_attempt_deadline_s: if a *failed* attempt ran longer than this, the
+        budget is considered consumed and no further retry is made (Python cannot
+        preempt a blocked C call, so this bounds retries-after-slow-failures rather
+        than the attempt itself).
+    :param total_deadline_s: wall-clock budget across all attempts and backoffs;
+        exhausting it stops retrying even if attempts remain.
+    """
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_multiplier: float = 2.0
+    max_backoff_s: float = 2.0
+    jitter_fraction: float = 0.1
+    seed: Optional[int] = None
+    per_attempt_deadline_s: Optional[float] = None
+    total_deadline_s: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.max_attempts < 1:
+            raise ValueError('max_attempts must be >= 1, got {}'.format(self.max_attempts))
+        if self.backoff_base_s < 0 or self.max_backoff_s < 0:
+            raise ValueError('backoff durations must be non-negative')
+        if not 0 <= self.jitter_fraction <= 1:
+            raise ValueError('jitter_fraction must be in [0, 1], got {}'
+                             .format(self.jitter_fraction))
+
+    def backoff_s(self, attempt: int, key: int = 0) -> float:
+        """Deterministic sleep before retry number ``attempt`` (1-based): exponential
+        base schedule scaled by the seeded jitter draw for ``(seed, key, attempt)``."""
+        if attempt < 1:
+            raise ValueError('attempt is 1-based, got {}'.format(attempt))
+        base = min(self.max_backoff_s,
+                   self.backoff_base_s * self.backoff_multiplier ** (attempt - 1))
+        if not self.jitter_fraction:
+            return base
+        # hash of an int tuple is deterministic across processes (PYTHONHASHSEED only
+        # salts str/bytes), so workers with the same (seed, key, attempt) draw the
+        # same jitter.
+        draw = random.Random(hash((self.seed or 0, key, attempt))).uniform(
+            1.0 - self.jitter_fraction, 1.0 + self.jitter_fraction)
+        return base * draw
+
+
+#: retry-notification callback: (attempt_number, exception, sleep_seconds)
+OnRetry = Callable[[int, BaseException, float], None]
+
+
+def run_with_retry(fn: Callable[[], Any],
+                   policy: RetryPolicy,
+                   key: int = 0,
+                   is_transient: Callable[[BaseException], bool] = is_transient_error,
+                   sleep: Callable[[float], None] = time.sleep,
+                   clock: Callable[[], float] = time.monotonic,
+                   on_retry: Optional[OnRetry] = None) -> Tuple[Any, int]:
+    """Call ``fn`` under ``policy``; returns ``(result, retries_used)``.
+
+    Only exceptions classified transient by ``is_transient`` are retried; anything else
+    re-raises immediately (attempt 1 semantics). When the attempt/deadline budget is
+    exhausted the LAST exception re-raises unchanged — callers decide whether that means
+    abort (``on_error='retry'``) or quarantine (``on_error='skip'``).
+
+    ``key`` decorrelates the jitter streams of concurrent workers retrying different
+    rowgroups under the same seed (pass e.g. the piece index)."""
+    start = clock()
+    attempt = 0
+    while True:
+        attempt += 1
+        attempt_start = clock()
+        try:
+            return fn(), attempt - 1
+        except BaseException as exc:
+            attempt_elapsed = clock() - attempt_start
+            if not is_transient(exc):
+                raise
+            if attempt >= policy.max_attempts:
+                raise
+            if (policy.per_attempt_deadline_s is not None
+                    and attempt_elapsed > policy.per_attempt_deadline_s):
+                raise
+            delay = policy.backoff_s(attempt, key=key)
+            if (policy.total_deadline_s is not None
+                    and clock() - start + delay > policy.total_deadline_s):
+                raise
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            if delay > 0:
+                sleep(delay)
+
+
+@dataclass(frozen=True)
+class QuarantineRecord:
+    """One skipped rowgroup: where it was, what killed it, how hard we tried."""
+
+    piece_index: int
+    fragment_path: str
+    row_group_id: Optional[int]
+    error_type: str
+    error: str
+    attempts: int
+    epoch: int = 0
+
+    @classmethod
+    def from_exception(cls, exc: BaseException, piece_index: int, fragment_path: str,
+                       row_group_id: Optional[int], attempts: int,
+                       epoch: int = 0) -> 'QuarantineRecord':
+        return cls(piece_index=piece_index, fragment_path=fragment_path,
+                   row_group_id=row_group_id, error_type=type(exc).__name__,
+                   error=str(exc)[:500], attempts=attempts, epoch=epoch)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {'piece_index': self.piece_index, 'fragment_path': self.fragment_path,
+                'row_group_id': self.row_group_id, 'error_type': self.error_type,
+                'error': self.error, 'attempts': self.attempts, 'epoch': self.epoch}
+
+
+class QuarantineLedger:
+    """Thread-safe collection of :class:`QuarantineRecord`; the reader appends as
+    quarantined pieces surface on the results channel, observability consumers
+    (``Reader.diagnostics``, ``LoaderStats``, doctor) read it at any time."""
+
+    def __init__(self) -> None:
+        self._records: List[QuarantineRecord] = []
+        self._lock = threading.Lock()
+
+    def add(self, record: QuarantineRecord) -> None:
+        with self._lock:
+            self._records.append(record)
+
+    def records(self) -> List[QuarantineRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def as_dicts(self) -> List[Dict[str, Any]]:
+        return [record.as_dict() for record in self.records()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def raise_if_any(self) -> None:
+        """Strict post-epoch validation: convert a non-empty ledger into a
+        :class:`~petastorm_tpu.errors.QuarantinedRowGroupError` naming the first
+        skipped rowgroup (and how many more there are). For jobs that tolerate
+        degradation mid-epoch but must not silently train on a partial dataset."""
+        from petastorm_tpu.errors import QuarantinedRowGroupError
+        records = self.records()
+        if not records:
+            return
+        first = records[0]
+        raise QuarantinedRowGroupError(
+            '{} rowgroup(s) were quarantined this run; first: piece {} of {!r} '
+            '(rowgroup {}) failed after {} attempt(s) with {}: {}'.format(
+                len(records), first.piece_index, first.fragment_path,
+                first.row_group_id, first.attempts, first.error_type, first.error),
+            piece_index=first.piece_index, fragment_path=first.fragment_path,
+            row_group_id=first.row_group_id, attempts=first.attempts)

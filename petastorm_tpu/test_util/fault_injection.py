@@ -1,0 +1,213 @@
+"""Deterministic fault injection for resilience tests: a pyarrow-FS wrapper that fails,
+delays, or kills the calling worker on a schedule.
+
+Every recovery behavior in docs/robustness.md (retry, skip-with-quarantine, worker
+respawn) is tested against this filesystem rather than against real network flakiness:
+the schedule is explicit and the trigger state lives in ``state_dir`` as atomically
+created marker files, so "fail the first N opens of path X" means the first N opens
+**globally** — across every thread pool worker, every spawned process-pool worker, and
+every respawned replacement — regardless of interleaving. That is what makes
+fail-once-then-succeed deterministic on all three pools.
+
+Usage::
+
+    schedule = FaultSchedule(state_dir, [
+        FaultRule('part_0', times=1, kind='fail'),          # first open of part_0 fails
+        FaultRule('part_1', kind='latency', latency_s=0.2), # every open is slow
+        FaultRule('part_2', kind='kill'),                   # SIGKILL the opening process
+    ])
+    fs = fault_injecting_filesystem(schedule)               # wraps LocalFileSystem
+    make_reader('file:///data', filesystem=fs, on_error='retry', ...)
+
+The wrapper is picklable (ships to process-pool workers through the dill bootstrap) and
+rebuilds its wrapped filesystem on unpickle.
+"""
+
+import os
+import time
+
+import pyarrow.fs as pafs
+
+from petastorm_tpu.errors import TransientIOError
+
+_FAULT_KINDS = ('fail', 'latency', 'kill')
+
+
+class FaultRule(object):
+    """One injection rule, matched against the path of every intercepted open.
+
+    :param path_substring: rule applies to paths containing this substring.
+    :param kind: ``'fail'`` raises ``exception_type``; ``'latency'`` sleeps
+        ``latency_s`` then proceeds; ``'kill'`` SIGKILLs the calling process (worker
+        respawn tests — only ever schedule this against process-pool workers).
+    :param times: trigger at most this many times globally (None = every time).
+    :param after: skip the first ``after`` matching opens before triggering
+        (``after=n-1, times=1`` = classic fail-Nth-open).
+    :param latency_s: sleep duration for ``'latency'``.
+    :param exception_type: exception class for ``'fail'`` — default
+        :class:`TransientIOError` (retryable); pass e.g. ``ValueError`` to model a
+        permanent fault.
+    """
+
+    def __init__(self, path_substring, kind='fail', times=None, after=0,
+                 latency_s=0.0, exception_type=TransientIOError):
+        if kind not in _FAULT_KINDS:
+            raise ValueError('kind must be one of {}, got {!r}'.format(_FAULT_KINDS, kind))
+        if times is not None and times < 1:
+            raise ValueError('times must be >= 1 or None')
+        if after < 0:
+            raise ValueError('after must be >= 0')
+        self.path_substring = path_substring
+        self.kind = kind
+        self.times = times
+        self.after = after
+        self.latency_s = latency_s
+        self.exception_type = exception_type
+
+    def matches(self, path):
+        return self.path_substring in path
+
+
+class FaultSchedule(object):
+    """Ordered rules plus the shared trigger state. ``state_dir`` must be a local
+    directory reachable by every worker process; marker files created with
+    ``O_CREAT|O_EXCL`` make each trigger decision an atomic, once-only global event."""
+
+    def __init__(self, state_dir, rules):
+        self.state_dir = str(state_dir)
+        self.rules = list(rules)
+        os.makedirs(self.state_dir, exist_ok=True)
+
+    def _claim(self, prefix):
+        """Atomically claim the next slot for ``prefix``; returns the 0-based global
+        sequence number this caller won (creation races retry on the next slot)."""
+        index = 0
+        while True:
+            marker = os.path.join(self.state_dir, '{}.{}'.format(prefix, index))
+            try:
+                fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                index += 1
+                continue
+            os.close(fd)
+            return index
+
+    def on_open(self, path):
+        """Run every matching rule for one open call; raises / sleeps / kills per the
+        schedule. Called by the wrapper before delegating to the real filesystem."""
+        for rule_index, rule in enumerate(self.rules):
+            if not rule.matches(path):
+                continue
+            seq = self._claim('calls-{}'.format(rule_index))
+            if seq < rule.after:
+                continue
+            if rule.times is not None and seq >= rule.after + rule.times:
+                continue
+            if rule.kind == 'latency':
+                time.sleep(rule.latency_s)
+            elif rule.kind == 'kill':
+                import signal
+                os.kill(os.getpid(), signal.SIGKILL)
+            else:
+                raise rule.exception_type(
+                    'injected fault #{} for {!r} (rule {}: open of {})'
+                    .format(seq + 1, rule.path_substring, rule_index, path))
+
+    def trigger_count(self, rule_index=None):
+        """Opens observed so far (for a single rule, or summed) — lets tests assert the
+        schedule actually fired."""
+        counts = []
+        for index in range(len(self.rules)):
+            count = 0
+            while os.path.exists(os.path.join(self.state_dir,
+                                              'calls-{}.{}'.format(index, count))):
+                count += 1
+            counts.append(count)
+        return counts[rule_index] if rule_index is not None else sum(counts)
+
+
+class FaultInjectingHandler(pafs.FileSystemHandler):
+    """pyarrow FileSystemHandler delegating everything to a wrapped C++ filesystem,
+    with the schedule's faults injected on input opens (the calls Parquet reads make)."""
+
+    def __init__(self, schedule, base_filesystem=None):
+        self._schedule = schedule
+        self._base = base_filesystem if base_filesystem is not None \
+            else pafs.LocalFileSystem()
+
+    # -------------------------------------------------------------- intercepted
+    def open_input_file(self, path):
+        self._schedule.on_open(path)
+        return self._base.open_input_file(path)
+
+    def open_input_stream(self, path):
+        self._schedule.on_open(path)
+        return self._base.open_input_stream(path)
+
+    # -------------------------------------------------------------- delegation
+    def get_type_name(self):
+        return 'fault-injecting+{}'.format(self._base.type_name)
+
+    def get_file_info(self, paths):
+        return self._base.get_file_info(paths)
+
+    def get_file_info_selector(self, selector):
+        return self._base.get_file_info(selector)
+
+    def create_dir(self, path, recursive):
+        self._base.create_dir(path, recursive=recursive)
+
+    def delete_dir(self, path):
+        self._base.delete_dir(path)
+
+    def delete_dir_contents(self, path, missing_dir_ok=False):
+        self._base.delete_dir_contents(path, missing_dir_ok=missing_dir_ok)
+
+    def delete_root_dir_contents(self):
+        self._base.delete_dir_contents('/', accept_root_dir=True)
+
+    def delete_file(self, path):
+        self._base.delete_file(path)
+
+    def move(self, src, dest):
+        self._base.move(src, dest)
+
+    def copy_file(self, src, dest):
+        self._base.copy_file(src, dest)
+
+    def open_output_stream(self, path, metadata):
+        return self._base.open_output_stream(path, metadata=metadata)
+
+    def open_append_stream(self, path, metadata):
+        return self._base.open_append_stream(path, metadata=metadata)
+
+    def normalize_path(self, path):
+        return self._base.normalize_path(path)
+
+    def __eq__(self, other):
+        return isinstance(other, FaultInjectingHandler) and \
+            self._schedule is other._schedule
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+
+def fault_injecting_filesystem(schedule, base_filesystem=None):
+    """A ``pyarrow.fs.FileSystem`` (PyFileSystem-wrapped) that injects ``schedule``'s
+    faults in front of ``base_filesystem`` (default: LocalFileSystem). Feed it to
+    ``make_reader(..., filesystem=...)``."""
+    return pafs.PyFileSystem(FaultInjectingHandler(schedule, base_filesystem))
+
+
+class FaultInjectingFilesystemFactory(object):
+    """Picklable zero-arg factory (the shape worker processes ship, mirroring
+    ``fs_utils.FilesystemFactory``): rebuilds the fault-injecting filesystem from the
+    schedule inside each worker. The schedule's file-based state keeps trigger counts
+    global across the processes that rebuild it."""
+
+    def __init__(self, schedule, base_filesystem=None):
+        self._schedule = schedule
+        self._base = base_filesystem
+
+    def __call__(self):
+        return fault_injecting_filesystem(self._schedule, self._base)

@@ -193,19 +193,27 @@ def check_store_roundtrip(rows=200, workers=2):
                    rowgroup_size_mb=1)
         start = time.perf_counter()
         seen = []
-        with make_reader(url, workers_count=workers, num_epochs=1) as reader:
+        # on_error='retry': the roundtrip doubles as a probe of the resilience path —
+        # a flaky local disk shows up as a non-zero retry count in the report rather
+        # than an opaque failure (docs/robustness.md).
+        with make_reader(url, workers_count=workers, num_epochs=1,
+                         on_error='retry') as reader:
             for row in reader:
                 seen.append(int(row.idx))
                 if row.vec[0] != row.idx:
                     return {'status': 'fail',
                             'detail': 'row {} decoded wrong vec'.format(row.idx)}
+            diag = reader.diagnostics
         elapsed = time.perf_counter() - start
     if sorted(seen) != list(range(rows)):
         return {'status': 'fail',
                 'detail': 'expected {} distinct rows, got {}'.format(
                     rows, len(set(seen)))}
     return {'status': 'ok', 'rows': rows,
-            'rows_per_sec': round(rows / elapsed, 1)}
+            'rows_per_sec': round(rows / elapsed, 1),
+            'io_retries': diag.get('io_retries', 0),
+            'rowgroups_quarantined': diag.get('rowgroups_quarantined', 0),
+            'quarantine': diag.get('quarantine', [])}
 
 
 def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
@@ -256,6 +264,10 @@ def _print_human(report):
     if s.get('status') == 'ok':
         print('  store roundtrip: OK — {} rows at {} rows/s'.format(
             s['rows'], s['rows_per_sec']))
+        if s.get('io_retries') or s.get('rowgroups_quarantined'):
+            print('  resilience: {} transient-IO retries, {} rowgroups quarantined '
+                  '— local reads should never need these; check the disk'.format(
+                      s.get('io_retries', 0), s.get('rowgroups_quarantined', 0)))
     else:
         print('  store roundtrip: FAIL — {}'.format(s.get('detail')))
     print('  verdict: {}'.format('healthy' if report['healthy'] else 'BROKEN'))

@@ -409,7 +409,8 @@ def decode_row(row_dict, schema):
             else:
                 decoded[name] = value
         except Exception as exc:
-            raise DecodeFieldError('Failed to decode field {!r}: {}'.format(name, exc))
+            raise DecodeFieldError('Failed to decode field {!r}: {}'.format(name, exc),
+                                   field_name=name) from exc
     return decoded
 
 

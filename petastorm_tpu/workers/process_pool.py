@@ -1,10 +1,22 @@
 """Process worker pool over ZeroMQ (reference: petastorm/workers_pool/process_pool.py:114-424).
 
-Socket topology (mirrors the reference's ASCII diagram, process_pool.py:52-74):
+Socket topology (evolved from the reference's PUSH ventilation, process_pool.py:52-74):
 
-    main PUSH (ventilation) ──> worker PULL
-    main PUB  (control)     ──> worker SUB      ('stop' broadcast)
-    main PULL (results)     <── worker PUSH     (handshake / result / done / error)
+    main ROUTER (dispatch)  <─> worker DEALER    ('ready' requests up, work items down)
+    main PUB    (control)   ──> worker SUB       ('stop' broadcast)
+    main PULL   (results)   <── worker PUSH      (handshake / result / done / error)
+
+Dispatch is **pull-based**: a worker asks for work ('ready') and the pool assigns the
+next pending item to that specific worker. Unlike PUSH round-robin, nothing ever sits in
+a dead worker's socket buffer, and the pool knows exactly which items each worker holds —
+that attribution is what makes worker **respawn** sound: when a worker dies mid-epoch
+(OOM-kill, segfault in a native decoder), the pool respawns it (bounded by
+``max_worker_respawns``) and re-ventilates its un-acked in-flight items instead of
+aborting the epoch (docs/robustness.md; the tf.data-service recovery model,
+arXiv 2210.14826). Items are acked per-token ('done'), and a duplicate result from an
+item that was re-ventilated after its first result already reached the consumer is
+dropped (``results_dropped`` in diagnostics) — re-ventilation assumes the petastorm_tpu
+worker contract of exactly one published result per item.
 
 Workers are spawned (never forked — fork breaks JVM/libhdfs state, reference
 exec_in_new_process.py:15-17) as fresh interpreters running
@@ -12,12 +24,14 @@ exec_in_new_process.py:15-17) as fresh interpreters running
 Each worker runs a parent-watchdog thread and exits if the main process dies
 (reference: process_pool.py:320-327)."""
 
+import collections
 import logging
 import os
 import pickle
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 from petastorm_tpu.workers import EmptyResultError, TimeoutWaitingForResultError
@@ -27,6 +41,9 @@ logger = logging.getLogger(__name__)
 _WORKER_STARTUP_TIMEOUT_S = 30
 #: message kinds on the results channel
 MSG_STARTED, MSG_RESULT, MSG_DONE, MSG_ERROR = b'started', b'result', b'done', b'error'
+#: default total respawn budget — one bad rowgroup killing the same worker repeatedly
+#: must exhaust the budget and fail loudly, not respawn forever
+DEFAULT_MAX_WORKER_RESPAWNS = 3
 
 
 class WorkerTerminationError(Exception):
@@ -34,18 +51,20 @@ class WorkerTerminationError(Exception):
 
 
 class ProcessPool(object):
-    """Spawned-process worker pool over a ZMQ ventilator/sink pair (reference:
+    """Spawned-process worker pool over a ZMQ dispatcher/sink pair (reference:
     workers_pool/process_pool.py): dill-bootstrapped spawn (never fork), Arrow-IPC
-    or pickle wire, orphan watchdog, exception propagation."""
+    or pickle wire, orphan watchdog, exception propagation, bounded worker respawn."""
 
     def __init__(self, workers_count, results_queue_size=50, zmq_copy_buffers=False,
-                 payload_serializer=None):
+                 payload_serializer=None, max_worker_respawns=DEFAULT_MAX_WORKER_RESPAWNS):
         """``payload_serializer`` picks the wire format for worker results (reference:
         process_pool.py:251-270 pluggable serializers): default
         :class:`~petastorm_tpu.workers.serializers.ArrowIpcSerializer` (columnar
         zero-copy receive); pass :class:`PickleSerializer` to force plain pickle.
         ``zmq_copy_buffers=False`` (default) receives result frames without copying —
-        deserialized arrays then alias ZMQ frame memory."""
+        deserialized arrays then alias ZMQ frame memory. ``max_worker_respawns`` is the
+        pool-wide budget of worker restarts after unexpected deaths; 0 restores the
+        seed's die-loudly-on-first-death behavior."""
         from petastorm_tpu.workers.serializers import ArrowIpcSerializer
         self._workers_count = workers_count
         self.workers_count = workers_count
@@ -53,21 +72,43 @@ class ProcessPool(object):
         self._zmq_copy = zmq_copy_buffers
         self._serializer = (payload_serializer if payload_serializer is not None
                             else ArrowIpcSerializer())
+        self._max_worker_respawns = max_worker_respawns
         self._context = None
         self._ventilator = None
         self._processes = []
         self._stopped = False
-        self._in_flight_done = 0
         # Instance state, not a get_results local: a typical call returns after one
         # result, so a per-call throttle would still run the liveness probe (ventilator
         # lock + per-worker poll) once per result.
         self._next_liveness_check = 0.0
 
+        # ---------------------------------------------------- dispatch bookkeeping
+        # All mutated under _state_lock: ventilate() runs on the ventilator thread,
+        # dispatch/ack/requeue on the consumer thread.
+        self._state_lock = threading.Lock()
+        self._next_token = 0
+        self._items = {}                      # token -> dilled kwargs (until done-acked)
+        self._pending = collections.deque()   # tokens awaiting assignment
+        self._assigned = {}                   # token -> worker identity holding it
+        self._ready = collections.deque()     # worker identities awaiting work
+        self._identity_slot = {}              # identity -> (slot, generation)
+        self._slot_generation = []            # slot -> current generation
+        # Tokens whose result reached the consumer but whose 'done' has not (cleared on
+        # done). Any further result for such a token is a duplicate from a
+        # re-ventilated attempt — the worker contract is one result per item — and is
+        # dropped, regardless of whether the first result arrived before or after the
+        # producing worker died.
+        self._delivered = set()
+        self._workers_respawned = 0
+        self._results_dropped = 0
+
+    # ------------------------------------------------------------------ lifecycle
+
     def start(self, worker_class, worker_args=None, ventilator=None):
         import zmq
         self._context = zmq.Context()
-        self._vent_socket = self._context.socket(zmq.PUSH)
-        vent_port = self._vent_socket.bind_to_random_port('tcp://127.0.0.1')
+        self._dispatch_socket = self._context.socket(zmq.ROUTER)
+        dispatch_port = self._dispatch_socket.bind_to_random_port('tcp://127.0.0.1')
         self._control_socket = self._context.socket(zmq.PUB)
         control_port = self._control_socket.bind_to_random_port('tcp://127.0.0.1')
         self._results_socket = self._context.socket(zmq.PULL)
@@ -78,29 +119,25 @@ class ProcessPool(object):
         # Spawned interpreters must resolve petastorm_tpu itself (python -m resolves it at
         # interpreter startup) AND user modules (transform fns, predicates) exactly like
         # the parent: propagate the parent's sys.path via PYTHONPATH.
-        child_env = dict(os.environ)
+        self._child_env = dict(os.environ)
         parent_paths = [p for p in sys.path if p]
-        existing = child_env.get('PYTHONPATH')
-        child_env['PYTHONPATH'] = os.pathsep.join(
+        existing = self._child_env.get('PYTHONPATH')
+        self._child_env['PYTHONPATH'] = os.pathsep.join(
             parent_paths + ([existing] if existing else []))
-        bootstrap = {
+        # Kept for the lifetime of the pool: respawns re-materialize the bootstrap file
+        # (workers unlink it at startup).
+        self._bootstrap_template = {
             'worker_class': dill.dumps(worker_class),
             'worker_args': dill.dumps(worker_args),
             'serializer': dill.dumps(self._serializer),
-            'vent_addr': 'tcp://127.0.0.1:{}'.format(vent_port),
+            'dispatch_addr': 'tcp://127.0.0.1:{}'.format(dispatch_port),
             'control_addr': 'tcp://127.0.0.1:{}'.format(control_port),
             'results_addr': 'tcp://127.0.0.1:{}'.format(results_port),
             'parent_pid': os.getpid(),
         }
+        self._slot_generation = [0] * self._workers_count
         for worker_id in range(self._workers_count):
-            bootstrap['worker_id'] = worker_id
-            fd, path = tempfile.mkstemp(suffix='.petastorm-tpu-worker')
-            with os.fdopen(fd, 'wb') as f:
-                pickle.dump(bootstrap, f)
-            process = subprocess.Popen(
-                [sys.executable, '-m', 'petastorm_tpu.workers.process_worker_main', path],
-                env=child_env)
-            self._processes.append(process)
+            self._processes.append(self._spawn_worker(worker_id, generation=0))
 
         # Startup handshake (reference: process_pool.py:200-213).
         deadline = time.time() + _WORKER_STARTUP_TIMEOUT_S
@@ -122,6 +159,19 @@ class ProcessPool(object):
             self._ventilator = ventilator
             self._ventilator.start()
 
+    def _spawn_worker(self, slot, generation):
+        bootstrap = dict(self._bootstrap_template)
+        bootstrap['worker_id'] = slot
+        bootstrap['generation'] = generation
+        fd, path = tempfile.mkstemp(suffix='.petastorm-tpu-worker')
+        with os.fdopen(fd, 'wb') as f:
+            pickle.dump(bootstrap, f)
+        return subprocess.Popen(
+            [sys.executable, '-m', 'petastorm_tpu.workers.process_worker_main', path],
+            env=self._child_env)
+
+    # ------------------------------------------------------------------ messaging
+
     def _recv(self):
         parts = self._results_socket.recv_multipart(copy=self._zmq_copy)
         if not self._zmq_copy:
@@ -131,56 +181,127 @@ class ProcessPool(object):
         return kind, payload
 
     def ventilate(self, **kwargs):
-        import zmq
         if self._stopped:
             raise WorkerTerminationError('Pool is stopped')
-        # Non-blocking with retries so a dead pool raises instead of hanging
-        # (reference: process_pool.py:215-224).
-        deadline = time.time() + 60
+        # dill, not pickle: ventilated items carry user callables (lambda predicates,
+        # per-item transform state) that plain pickle rejects — the same reason the
+        # worker bootstrap ships via dill. Items are only enqueued here; the consumer
+        # thread assigns them to workers in response to 'ready' requests (pull-based
+        # dispatch — see module docstring).
+        import dill
+        blob = dill.dumps(kwargs)
+        with self._state_lock:
+            token = self._next_token
+            self._next_token += 1
+            self._items[token] = blob
+            self._pending.append(token)
+
+    def _handle_ready(self, frames):
+        """A worker announced itself idle on the dispatch ROUTER: remember its route and
+        slot so pending work can be assigned to it specifically."""
+        identity, slot, generation = frames[0], int(frames[2]), int(frames[3])
+        with self._state_lock:
+            self._identity_slot[identity] = (slot, generation)
+            self._ready.append(identity)
+
+    def _dispatch_pending(self):
+        """Assign pending items to ready workers (consumer thread only — ROUTER sends
+        must stay single-threaded)."""
         while True:
-            try:
-                # dill, not pickle: ventilated items carry user callables (lambda
-                # predicates, per-item transform state) that plain pickle rejects —
-                # the same reason the worker bootstrap ships via dill.
-                import dill
-                self._vent_socket.send(dill.dumps(kwargs), flags=zmq.NOBLOCK)
-                return
-            except zmq.Again:
-                if self._stopped or time.time() > deadline:
-                    raise WorkerTerminationError('Could not ventilate: workers not '
-                                                 'consuming (stopped or dead)')
-                if any(p.poll() is not None for p in self._processes):
-                    raise WorkerTerminationError('A worker process died unexpectedly')
-                time.sleep(0.05)
+            with self._state_lock:
+                while self._pending and self._pending[0] not in self._items:
+                    # Superseded token: its original attempt completed after the token
+                    # was re-ventilated (crash-after-done race) — nothing left to do.
+                    self._pending.popleft()
+                if not self._pending or not self._ready:
+                    return
+                identity = self._ready.popleft()
+                slot, generation = self._identity_slot.get(identity, (None, None))
+                if slot is None or self._slot_generation[slot] != generation:
+                    continue  # stale 'ready' from a dead/replaced worker
+                token = self._pending.popleft()
+                blob = self._items[token]
+                self._assigned[token] = identity
+            self._dispatch_socket.send_multipart(
+                [identity, b'%d' % token, blob])
+
+    def _handle_done(self, token):
+        with self._state_lock:
+            if token not in self._items:
+                return  # duplicate 'done' from a superseded attempt
+            del self._items[token]
+            self._assigned.pop(token, None)
+            self._delivered.discard(token)
+        if self._ventilator is not None:
+            self._ventilator.processed_item()
+
+    def _check_liveness(self):
+        """Consumer-thread probe: respawn dead workers while work remains (bounded
+        budget), or raise once the budget is exhausted. A death after all work finished
+        must not turn a successful read into an error."""
+        all_work_done = self._ventilator is not None and self._ventilator.completed()
+        for slot, process in enumerate(self._processes):
+            if process.poll() is None:
+                continue
+            if all_work_done:
+                continue
+            if self._workers_respawned >= self._max_worker_respawns:
+                self.stop()
+                raise WorkerTerminationError(
+                    'Worker {} (pid {}) exited with code {} while results were still '
+                    'expected, and the respawn budget ({}) is exhausted'
+                    .format(slot, process.pid, process.returncode,
+                            self._max_worker_respawns))
+            self._respawn(slot, process)
+
+    def _respawn(self, slot, dead_process):
+        """Replace the dead worker at ``slot`` and re-ventilate every item it held:
+        requeued items go to the FRONT of the pending queue (they are the oldest
+        work — consumers may be blocked on exactly these rowgroups)."""
+        requeued = []
+        with self._state_lock:
+            for token, identity in list(self._assigned.items()):
+                slot_gen = self._identity_slot.get(identity)
+                if slot_gen is None or slot_gen[0] != slot:
+                    continue
+                del self._assigned[token]
+                # _delivered intentionally untouched: whether the dead worker's result
+                # already reached the consumer or is still in the PULL buffer, the
+                # FIRST result to be delivered marks the token and every later one is
+                # dropped as a duplicate.
+                self._pending.appendleft(token)
+                requeued.append(token)
+            self._slot_generation[slot] += 1
+            generation = self._slot_generation[slot]
+            self._workers_respawned += 1
+        logger.warning(
+            'Worker %d (pid %d) died with exit code %s mid-epoch; respawning '
+            '(%d/%d respawns used) and re-ventilating %d in-flight item(s)',
+            slot, dead_process.pid, dead_process.returncode, self._workers_respawned,
+            self._max_worker_respawns, len(requeued))
+        self._processes[slot] = self._spawn_worker(slot, generation)
 
     def get_results(self, timeout=None):
         import zmq
         poller = zmq.Poller()
         poller.register(self._results_socket, zmq.POLLIN)
+        poller.register(self._dispatch_socket, zmq.POLLIN)
         deadline = None if timeout is None else time.time() + timeout
         while True:
             # Liveness on the hot path too — not only when results stop: with several
             # workers, survivors keep producing after one dies, but the dead worker's
-            # in-flight items are gone, so continuing would silently drop rowgroups.
-            # A dead worker while more results are expected is a loud failure
-            # (reference failure-detection contract, SURVEY.md §5.3). Throttled to
-            # ~10Hz (detection latency is bounded by the 100ms poller timeout anyway)
-            # and skipped once the ventilator reports completion — a worker dying
-            # AFTER all work finished must not turn a successful read into an error.
+            # in-flight items would otherwise silently vanish. Throttled to ~10Hz
+            # (detection latency is bounded by the 100ms poller timeout anyway);
             # ventilator.completed() acquires the ventilator lock (shared with the
             # backpressure condition), so it is only evaluated inside this throttled
             # window and on poll timeout — never per-result on the hot path.
             now = time.time()
             if not self._stopped and now >= self._next_liveness_check:
                 self._next_liveness_check = now + 0.1
-                all_work_done = (self._ventilator is not None
-                                 and self._ventilator.completed())
-                if (not all_work_done
-                        and any(p.poll() is not None for p in self._processes)):
-                    self.stop()
-                    raise WorkerTerminationError('A worker process exited while '
-                                                 'results were still expected')
-            if not poller.poll(100):
+                self._check_liveness()
+            self._dispatch_pending()
+            events = dict(poller.poll(100))
+            if not events:
                 if self._ventilator is not None and getattr(self._ventilator, 'error', None):
                     self.stop()
                     raise self._ventilator.error
@@ -189,19 +310,34 @@ class ProcessPool(object):
                 if deadline is not None and time.time() > deadline:
                     raise TimeoutWaitingForResultError()
                 continue
+            if self._dispatch_socket in events:
+                frames = self._dispatch_socket.recv_multipart()
+                if len(frames) >= 4 and bytes(frames[1]) == b'ready':
+                    self._handle_ready(frames)
+                self._dispatch_pending()
+            if self._results_socket not in events:
+                continue
             kind, payload = self._recv()
             if kind == MSG_DONE:
-                if self._ventilator is not None:
-                    self._ventilator.processed_item()
+                self._handle_done(int(bytes(memoryview(payload[0]))))
                 continue
             if kind == MSG_ERROR:
-                exc, tb = pickle.loads(bytes(memoryview(payload[0])))
+                exc, tb = pickle.loads(bytes(memoryview(payload[1])))
                 logger.error('Worker failure re-raised in consumer:\n%s', tb)
                 self.stop()
                 raise exc
             if kind == MSG_RESULT:
-                return self._serializer.deserialize(payload)
-            if kind == MSG_STARTED:  # late joiner after restart — ignore
+                token = int(bytes(memoryview(payload[0])))
+                with self._state_lock:
+                    if token not in self._items or token in self._delivered:
+                        # Duplicate from a re-ventilated item whose first result was
+                        # already delivered (retired token, or delivered-but-not-yet-
+                        # acked) — count it, never deliver it twice.
+                        self._results_dropped += 1
+                        continue
+                    self._delivered.add(token)
+                return self._serializer.deserialize(payload[1:])
+            if kind == MSG_STARTED:  # respawned worker joining — expected
                 continue
 
     def stop(self):
@@ -213,22 +349,48 @@ class ProcessPool(object):
         try:
             self._control_socket.send(b'stop')
         except Exception:
-            pass
+            logger.warning('Failed to broadcast stop to workers; relying on the '
+                           'parent-watchdog exit path', exc_info=True)
 
     def join(self):
         deadline = time.time() + 10
-        for process in self._processes:
-            remaining = max(0.1, deadline - time.time())
-            try:
-                process.wait(timeout=remaining)
-            except subprocess.TimeoutExpired:
-                process.kill()
+        for slot, process in enumerate(self._processes):
+            while process.poll() is None:
+                if time.time() >= deadline:
+                    # Loud fallback + reap: a silent kill() left both an unexplained
+                    # SIGKILL in the logs' absence AND a zombie (kill without wait).
+                    logger.warning('Worker %d (pid %d) did not exit within 10s of '
+                                   'stop(); sending SIGKILL', slot, process.pid)
+                    process.kill()
+                    try:
+                        process.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        logger.error('Worker %d (pid %d) is unreaped after SIGKILL; '
+                                     'abandoning it as a zombie', slot, process.pid)
+                    break
+                try:
+                    process.wait(timeout=1.0)
+                except subprocess.TimeoutExpired:
+                    # Re-broadcast stop: a worker respawned moments before stop() may
+                    # still have been starting up — its SUB socket missed the original
+                    # broadcast (PUB drops messages for unjoined subscribers).
+                    try:
+                        self._control_socket.send(b'stop')
+                    except Exception:  # noqa: BLE001 - socket may already be closed
+                        pass
         if self._context is not None:
-            for sock in (self._vent_socket, self._control_socket, self._results_socket):
+            for sock in (self._dispatch_socket, self._control_socket,
+                         self._results_socket):
                 sock.close(linger=0)
             self._context.term()
             self._context = None
 
     @property
     def diagnostics(self):
-        return {'workers_alive': sum(1 for p in self._processes if p.poll() is None)}
+        with self._state_lock:
+            return {
+                'workers_alive': sum(1 for p in self._processes if p.poll() is None),
+                'workers_respawned': self._workers_respawned,
+                'results_dropped': self._results_dropped,
+                'in_flight_items': len(self._items),
+            }

@@ -1,6 +1,12 @@
 """Entry point executed inside each spawned pool worker process (reference:
 petastorm/workers_pool/process_pool.py:330-413 _worker_bootstrap +
-exec_in_new_process.py/_entrypoint.py)."""
+exec_in_new_process.py/_entrypoint.py).
+
+Dispatch is pull-based (see process_pool.py module docstring): the worker announces
+itself idle with a 'ready' on its DEALER socket and receives exactly the items the pool
+assigned to it; every result and the final 'done' ack carry the item's dispatch token so
+the pool can re-ventilate un-acked items if this process dies and drop duplicate results
+after a respawn."""
 
 import os
 import pickle
@@ -22,7 +28,7 @@ def _watch_parent(parent_pid):
 
 def main(bootstrap_path):
     """Spawned worker-process entry: load the dill bootstrap file, connect the ZMQ
-    sockets, loop ventilated items until the stop message."""
+    sockets, request/process ventilated items until the stop message."""
     with open(bootstrap_path, 'rb') as f:
         bootstrap = pickle.load(f)
     try:
@@ -37,43 +43,53 @@ def main(bootstrap_path):
     worker_args = dill.loads(bootstrap['worker_args'])
     serializer = dill.loads(bootstrap['serializer'])
     worker_id = bootstrap['worker_id']
+    generation = bootstrap.get('generation', 0)
 
     threading.Thread(target=_watch_parent, args=(bootstrap['parent_pid'],),
                      daemon=True).start()
 
     context = zmq.Context()
-    vent_socket = context.socket(zmq.PULL)
-    vent_socket.connect(bootstrap['vent_addr'])
+    dispatch_socket = context.socket(zmq.DEALER)
+    dispatch_socket.connect(bootstrap['dispatch_addr'])
     control_socket = context.socket(zmq.SUB)
     control_socket.connect(bootstrap['control_addr'])
     control_socket.setsockopt(zmq.SUBSCRIBE, b'')
     results_socket = context.socket(zmq.PUSH)
     results_socket.connect(bootstrap['results_addr'])
 
+    current_token = [b'']
+
     def publish(result):
-        results_socket.send_multipart([b'result'] + serializer.serialize(result))
+        results_socket.send_multipart(
+            [b'result', current_token[0]] + serializer.serialize(result))
 
     worker = worker_class(worker_id, publish, worker_args)
     results_socket.send_multipart([b'started'])
 
     poller = zmq.Poller()
-    poller.register(vent_socket, zmq.POLLIN)
+    poller.register(dispatch_socket, zmq.POLLIN)
     poller.register(control_socket, zmq.POLLIN)
+    ready_msg = [b'ready', b'%d' % worker_id, b'%d' % generation]
+    dispatch_socket.send_multipart(ready_msg)
     while True:
         events = dict(poller.poll(1000))
         if control_socket in events:
             if control_socket.recv() == b'stop':
                 break
-        if vent_socket in events:
-            kwargs = dill.loads(vent_socket.recv())
+        if dispatch_socket in events:
+            token, blob = dispatch_socket.recv_multipart()
+            kwargs = dill.loads(blob)
+            current_token[0] = token
             try:
                 worker.process(**kwargs)
-                results_socket.send_multipart([b'done'])
+                results_socket.send_multipart([b'done', token])
             except Exception as exc:  # noqa: BLE001 - ship to consumer
                 blob = pickle.dumps((exc, traceback.format_exc()))
-                results_socket.send_multipart([b'error', blob])
+                results_socket.send_multipart([b'error', token, blob])
+            current_token[0] = b''
+            dispatch_socket.send_multipart(ready_msg)
     worker.shutdown()
-    for sock in (vent_socket, control_socket, results_socket):
+    for sock in (dispatch_socket, control_socket, results_socket):
         sock.close(linger=1000)
     context.term()
 

@@ -88,7 +88,14 @@ class ArrowIpcSerializer(object):
         meta = {'num_rows': int(obj.num_rows),
                 'item_id': ([int(part) for part in obj.item_id]
                             if obj.item_id is not None else None),
-                'columns': col_meta}
+                'columns': col_meta,
+                # resilience sidecar (docs/robustness.md): plain-JSON fields, so the
+                # quarantine ledger and retry counters cross the process boundary
+                # without pickling framework types
+                'retries': int(getattr(obj, 'retries', 0) or 0),
+                'quarantine': (obj.quarantine.as_dict()
+                               if getattr(obj, 'quarantine', None) is not None
+                               else None)}
         schema = pa.schema([pa.field(n, a.type) for n, a in zip(arrow_names, arrow_arrays)],
                            metadata={_META_KEY: json.dumps(meta).encode('utf-8')})
         batch = pa.record_batch(arrow_arrays, schema=schema)
@@ -124,8 +131,13 @@ class ArrowIpcSerializer(object):
                 values = values.copy()
             columns[field.name] = values
         item_id = meta['item_id']
+        quarantine = meta.get('quarantine')
+        if quarantine is not None:
+            from petastorm_tpu.resilience import QuarantineRecord
+            quarantine = QuarantineRecord(**quarantine)
         return ColumnarBatch(columns, meta['num_rows'],
-                             item_id=tuple(item_id) if item_id is not None else None)
+                             item_id=tuple(item_id) if item_id is not None else None,
+                             retries=meta.get('retries', 0), quarantine=quarantine)
 
 
 def _as_bytes(frame):

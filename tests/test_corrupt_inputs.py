@@ -132,6 +132,56 @@ def test_corrupt_unischema_metadata_value_fails_loudly(tmp_path):
     assert not isinstance(excinfo.value, StopIteration)
 
 
+@pytest.mark.faultinject
+@pytest.mark.parametrize('pool', POOLS)
+def test_truncated_part_skipped_with_quarantine(tmp_path, pool):
+    """With ``on_error='skip'`` a truncated part-file yields the REMAINING rows plus a
+    populated quarantine ledger — degradation is visible, never silent
+    (docs/robustness.md). All three pools."""
+    store = tmp_path / 'store'
+    url = _write_store(store, num_rows=48, n_files=4)
+    parts = _part_files(store)
+    # not the first part: dataset construction reads that one for schema inference
+    _truncate(parts[-1])
+    with make_reader(url, reader_pool_type=pool, workers_count=2, num_epochs=1,
+                     on_error='skip') as reader:
+        ids = sorted(int(row.id) for row in reader)
+        diag = reader.diagnostics
+    assert len(ids) == 36 and len(set(ids)) == 36
+    assert diag['rowgroups_quarantined'] == 1
+    (entry,) = diag['quarantine']
+    assert os.path.basename(parts[-1]) in entry['fragment_path']
+    # a truncated footer is permanent corruption — the (default) retry budget must
+    # have been spent on it before quarantining only if the error was transient;
+    # corruption is classified permanent, so exactly one attempt was made
+    assert entry['attempts'] == 1
+
+
+@pytest.mark.faultinject
+@pytest.mark.parametrize('pool', POOLS)
+def test_truncated_part_with_on_error_raise_matches_default(tmp_path, pool):
+    """``on_error='raise'`` must behave byte-identically to today's default: the
+    corruption aborts the read with the same exception type the default path raises,
+    and nothing lands in the quarantine ledger."""
+    store_default = tmp_path / 'store-default'
+    url_default = _write_store(store_default, num_rows=48, n_files=4)
+    _truncate(_part_files(store_default)[-1])
+    store_explicit = tmp_path / 'store-explicit'
+    url_explicit = _write_store(store_explicit, num_rows=48, n_files=4)
+    _truncate(_part_files(store_explicit)[-1])
+
+    def consume(url, **kwargs):
+        def iterate():
+            with make_reader(url, reader_pool_type=pool, workers_count=2,
+                             num_epochs=1, **kwargs) as reader:
+                list(reader)
+        return _consume_expect_error(iterate)
+
+    exc_default = consume(url_default)
+    exc_explicit = consume(url_explicit, on_error='raise')
+    assert type(exc_explicit) is type(exc_default)
+
+
 def test_truncated_parquet_raises_through_jax_loader(tmp_path):
     """The device-loader path must latch the worker failure too: consuming
     through JaxDataLoader raises instead of hanging on an empty queue."""

@@ -31,10 +31,12 @@ def test_process_pool_worker_exception_propagates(synthetic_dataset):
 
 
 @pytest.mark.slow
-def test_worker_hard_kill_raises_instead_of_hanging(synthetic_dataset):
-    """SIGKILL-ing a worker mid-read must surface WorkerTerminationError promptly
-    (reference failure-detection contract, SURVEY.md §5.3) — never hang the consumer,
-    never keep silently serving from the survivors."""
+def test_worker_hard_kill_raises_when_respawn_disabled(synthetic_dataset):
+    """With ``max_worker_respawns=0`` a SIGKILL-ed worker mid-read must surface
+    WorkerTerminationError promptly (reference failure-detection contract,
+    SURVEY.md §5.3) — never hang the consumer, never keep silently serving from the
+    survivors. (The default pool instead respawns: see the respawn tests here and in
+    test_resilience.py.)"""
     import os
     import signal
     import time
@@ -42,7 +44,7 @@ def test_worker_hard_kill_raises_instead_of_hanging(synthetic_dataset):
     from petastorm_tpu.workers.process_pool import (ProcessPool,
                                                     WorkerTerminationError)
 
-    pool = ProcessPool(2)
+    pool = ProcessPool(2, max_worker_respawns=0)
     with pytest.raises(WorkerTerminationError):
         with make_reader(synthetic_dataset.url, reader_pool=pool,
                          schema_fields=['id'], num_epochs=None,
@@ -54,3 +56,52 @@ def test_worker_hard_kill_raises_instead_of_hanging(synthetic_dataset):
             while time.time() < deadline:
                 next(reader)
             pytest.fail('reader kept serving for 30s with a killed worker')
+
+
+@pytest.mark.slow
+def test_worker_hard_kill_respawns_and_completes(synthetic_dataset):
+    """Default pool: a killed worker is respawned within the budget, its in-flight
+    items are re-ventilated, and the epoch completes with every row served exactly
+    once (docs/robustness.md)."""
+    import os
+    import signal
+
+    from petastorm_tpu.workers.process_pool import ProcessPool
+
+    pool = ProcessPool(2)
+    with make_reader(synthetic_dataset.url, reader_pool=pool,
+                     schema_fields=['id'], num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        ids = [next(reader).id]  # pool is up and serving
+        os.kill(pool._processes[0].pid, signal.SIGKILL)
+        ids.extend(row.id for row in reader)
+        diag = pool.diagnostics
+    assert sorted(ids) == sorted(r['id'] for r in synthetic_dataset.rows)
+    assert diag['workers_respawned'] == 1
+    assert diag['workers_alive'] == 2
+
+
+@pytest.mark.slow
+def test_respawn_budget_exhaustion_raises(synthetic_dataset):
+    """Repeated deaths beyond the budget must fail loudly, not respawn forever."""
+    import os
+    import signal
+    import time
+
+    from petastorm_tpu.workers.process_pool import (ProcessPool,
+                                                    WorkerTerminationError)
+
+    pool = ProcessPool(2, max_worker_respawns=1)
+    with pytest.raises(WorkerTerminationError, match='respawn budget'):
+        with make_reader(synthetic_dataset.url, reader_pool=pool,
+                         schema_fields=['id'], num_epochs=None,
+                         shuffle_row_groups=False) as reader:
+            next(reader)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                for process in pool._processes:
+                    if process.poll() is None:
+                        os.kill(process.pid, signal.SIGKILL)
+                        break
+                next(reader)
+            pytest.fail('reader kept serving past the respawn budget')
