@@ -154,7 +154,7 @@ _HEADLINE_FALLBACKS = (
 
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
-                 'flash', 'moe')
+                 'flash', 'moe', 'wire_bench')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -163,8 +163,8 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'mnist_scan_stream', 'flash', 'moe',
-                     'imagenet_scan', 'imagenet_stream', 'decode_delta',
+SECTION_RUN_ORDER = ('mnist_inmem', 'wire_bench', 'mnist_scan_stream', 'flash',
+                     'moe', 'imagenet_scan', 'imagenet_stream', 'decode_delta',
                      'bare_reader', 'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
@@ -500,7 +500,8 @@ def orchestrate():
                 'BENCH_MOE_T': '256', 'BENCH_MOE_BATCH': '2', 'BENCH_MOE_EMBED': '64',
                 'BENCH_MOE_HEADS': '2', 'BENCH_MOE_EXPERTS': '4',
                 'BENCH_MOE_LAYERS': '1', 'BENCH_MOE_STEPS': '2',
-                'BENCH_MOE_ROWS': '8'})
+                'BENCH_MOE_ROWS': '8',
+                'BENCH_WIRE_BATCHES': '12', 'BENCH_WIRE_CACHE_ROWS': '800'})
         if result is None:
             result = partial  # even a partial CPU run beats exiting empty
         if result is not None:
@@ -1399,6 +1400,20 @@ def child_main():
             'estimator': 'median_of_{}_epochs'.format(len(inmem_rates)),
         })
 
+    def run_wire_bench():
+        """Zero-copy data-plane microbench (host-only, fast): pickle vs arrow-ipc
+        vs shm transport MB/s + bytes-copied-per-batch, and the cold-fill vs
+        warm-mmap cache epoch ratio — the ISSUE-2 acceptance numbers
+        (wire_arrow_shm_bytes_copied_per_batch >= 2x below the pickle path,
+        wire_cache_warm_speedup >= 3)."""
+        from petastorm_tpu.benchmark.wire_bench import run_wire_bench as wire_bench
+        fields = wire_bench(
+            rows=int(os.environ.get('BENCH_WIRE_ROWS', 2048)),
+            batches=int(os.environ.get('BENCH_WIRE_BATCHES', 24)),
+            workers=int(os.environ.get('BENCH_WIRE_WORKERS', 2)),
+            cache_rows=int(os.environ.get('BENCH_WIRE_CACHE_ROWS', 1500)))
+        results.update({'wire_' + key: value for key, value in fields.items()})
+
     def run_decode():
         decode_host, decode_onchip = run_decode_delta()
         results.update({
@@ -1418,6 +1433,7 @@ def child_main():
         'decode_delta': run_decode,
         'flash': run_flash,
         'moe': run_moe,
+        'wire_bench': run_wire_bench,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

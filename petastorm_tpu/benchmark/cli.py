@@ -1,5 +1,9 @@
 """Throughput CLI: ``python -m petastorm_tpu.benchmark.cli <dataset_url>`` (reference:
-petastorm/benchmark/cli.py / petastorm-throughput.py console script)."""
+petastorm/benchmark/cli.py / petastorm-throughput.py console script).
+
+Subcommands: a first positional of ``wire-bench`` dispatches to
+:mod:`petastorm_tpu.benchmark.wire_bench` (zero-copy data-plane microbench, JSON
+output); anything else is the legacy dataset-throughput measurement."""
 
 import argparse
 import logging
@@ -9,8 +13,15 @@ from petastorm_tpu.benchmark.throughput import READ_JAX, READ_PYTHON, reader_thr
 
 
 def main(argv=None):
-    """``petastorm-tpu-throughput`` console entry: parse args, run
-    :func:`petastorm_tpu.benchmark.throughput.reader_throughput`, print the report."""
+    """``petastorm-tpu-throughput`` console entry: dispatch the ``wire-bench``
+    subcommand, else parse args and run
+    :func:`petastorm_tpu.benchmark.throughput.reader_throughput`, printing the
+    report."""
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == 'wire-bench':
+        from petastorm_tpu.benchmark.wire_bench import main as wire_bench_main
+        return wire_bench_main(argv[1:])
     parser = argparse.ArgumentParser(
         description='Measure petastorm_tpu reader throughput on a dataset')
     parser.add_argument('dataset_url')

@@ -3,15 +3,42 @@
 The reference delegates to the ``diskcache`` package; this is a self-contained sharded
 disk cache with atomic writes and size-capped LRU eviction (by file mtime), so repeated
 epochs over remote storage hit local disk.
+
+Two on-disk value formats:
+
+- :class:`LocalDiskCache` — whole-value pickle (the reference's semantics): every hit
+  pays a full unpickle round trip (read + object-graph materialization).
+- :class:`ArrowIpcDiskCache` — the zero-copy format of the decoded-rowgroup data
+  plane: columnar values are written as one Arrow IPC stream (the exact byte layout
+  of the process-pool wire, ``workers/serializers.py``) plus a pickled sidecar for
+  non-Arrow columns, in a single atomically-renamed file. A hit MEMORY-MAPS the file
+  and serves the numeric columns as read-only zero-copy views straight into the
+  consumer (e.g. ``JaxDataLoader``'s coalesced-upload path) — no Parquet read, no
+  decode, no unpickle, no copy. Non-columnar values degrade to an embedded pickle
+  record transparently (``stats['pickle_hits']`` makes the degradation visible).
+
+Both keep a ``stats`` dict (hits/misses/bytes); process-pool workers hold their own
+unpickled copy, so for that pool the numbers are per-worker (the per-batch
+``cache_hit`` sidecar on the results channel is the cross-process aggregate —
+see ``Reader.diagnostics``).
 """
 
 import hashlib
+import logging
 import os
 import pickle
+import struct
 import tempfile
 import threading
 
+logger = logging.getLogger(__name__)
+
 MB = 1 << 20
+
+#: Arrow-IPC cache file header: magic + mode byte ('A' columnar / 'P' pickle) +
+#: uint64-LE length of the IPC stream region (0 in pickle mode)
+_ARROW_MAGIC = b'PTUAC001'
+_HEADER = struct.Struct('<8scQ')
 
 
 class CacheBase(object):
@@ -34,6 +61,15 @@ class NullCache(CacheBase):
         return fill_cache_func()
 
 
+def _new_cache_stats():
+    """Fresh cache counters: ``hits``/``misses``, ``arrow_hits`` (zero-copy mmap
+    hits) vs ``pickle_hits`` (unpickle-path hits — the fallback to copy-mode),
+    ``bytes_mmapped`` (bytes served as views over the mapped file) and
+    ``bytes_written``."""
+    return {'hits': 0, 'misses': 0, 'arrow_hits': 0, 'pickle_hits': 0,
+            'bytes_mmapped': 0, 'bytes_written': 0}
+
+
 class LocalDiskCache(CacheBase):
     """File-per-key cache under ``path``, sharded into 256 subdirectories, bounded by
     ``size_limit_bytes`` with mtime-LRU eviction (reference: local_disk_cache.py:23-66).
@@ -44,6 +80,11 @@ class LocalDiskCache(CacheBase):
     :param cleanup: remove the whole cache directory on ``cleanup()``
     """
 
+    #: per-key file suffix; eviction scans every known suffix so differently-
+    #: formatted caches sharing one directory stay bounded together
+    _SUFFIX = '.pkl'
+    _ALL_SUFFIXES = ('.pkl', '.arrow')
+
     def __init__(self, path, size_limit_bytes, expected_row_size_bytes=0, cleanup=False,
                  shards=None):
         if expected_row_size_bytes and size_limit_bytes < 100 * expected_row_size_bytes:
@@ -53,6 +94,8 @@ class LocalDiskCache(CacheBase):
         self._size_limit_bytes = size_limit_bytes
         self._cleanup = cleanup
         self._lock = threading.Lock()
+        self.stats = _new_cache_stats()
+        self._decode_failure_logged = False
         os.makedirs(path, exist_ok=True)
         # Approximate running byte total: seeded from one scan, bumped per store; the
         # expensive full rescan happens only when this crosses the limit.
@@ -70,27 +113,61 @@ class LocalDiskCache(CacheBase):
 
     def _key_path(self, key):
         digest = hashlib.sha1(str(key).encode('utf-8')).hexdigest()
-        return os.path.join(self._path, digest[:2], digest + '.pkl')
+        return os.path.join(self._path, digest[:2], digest + self._SUFFIX)
+
+    # ------------------------------------------------------------- value codec
+
+    def _encode_value(self, value):
+        """Value -> file bytes (pickle format)."""
+        return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def _decode_file(self, file_path):
+        """File -> value; raising (corrupt/truncated entry) counts as a miss."""
+        with open(file_path, 'rb') as f:
+            value = pickle.load(f)
+        with self._lock:
+            self.stats['pickle_hits'] += 1
+        return value
+
+    # ------------------------------------------------------------------- get
 
     def get(self, key, fill_cache_func):
         file_path = self._key_path(key)
         try:
-            with open(file_path, 'rb') as f:
-                value = pickle.load(f)
+            value = self._decode_file(file_path)
             # touch for LRU
             os.utime(file_path, None)
+            with self._lock:
+                self.stats['hits'] += 1
             return value
-        except (OSError, pickle.UnpicklingError, EOFError):
-            pass
+        except FileNotFoundError:
+            pass  # plain miss
+        except Exception:  # noqa: BLE001 - any unreadable entry degrades to a miss
+            # Corrupt/truncated entries are expected (crash mid-eviction), but a
+            # SYSTEMATIC decode failure (env/codec bug) would otherwise silently
+            # turn every epoch cold — log the first one loudly, the rest quietly.
+            if not self._decode_failure_logged:
+                self._decode_failure_logged = True
+                logger.warning('cache entry %s is unreadable; serving a miss '
+                               '(further decode failures logged at DEBUG)',
+                               file_path, exc_info=True)
+            else:
+                logger.debug('cache entry %s is unreadable; serving a miss',
+                             file_path, exc_info=True)
+        with self._lock:
+            self.stats['misses'] += 1
         value = fill_cache_func()
         self._store(file_path, value)
         return value
 
     def _store(self, file_path, value):
         os.makedirs(os.path.dirname(file_path), exist_ok=True)
-        blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        blob = self._encode_value(value)
         if len(blob) > self._size_limit_bytes:
             return  # single value larger than the cache: do not thrash
+        # mkstemp + os.replace: concurrent fillers of the same key each write a
+        # private temp file and atomically publish it — readers only ever see a
+        # complete entry (last writer wins; both writers hold equivalent values).
         fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(file_path))
         try:
             with os.fdopen(fd, 'wb') as f:
@@ -103,6 +180,7 @@ class LocalDiskCache(CacheBase):
                 pass
             raise
         with self._lock:
+            self.stats['bytes_written'] += len(blob)
             if self._approx_bytes is None:
                 self._approx_bytes = sum(size for _, size, _ in self._iter_entries())
             else:
@@ -117,7 +195,7 @@ class LocalDiskCache(CacheBase):
             if not os.path.isdir(shard_path):
                 continue
             for name in os.listdir(shard_path):
-                if not name.endswith('.pkl'):
+                if not name.endswith(self._ALL_SUFFIXES):
                     continue  # skip other writers' in-progress mkstemp files
                 full = os.path.join(shard_path, name)
                 try:
@@ -152,3 +230,67 @@ class LocalDiskCache(CacheBase):
         if self._cleanup:
             import shutil
             shutil.rmtree(self._path, ignore_errors=True)
+
+
+class ArrowIpcDiskCache(LocalDiskCache):
+    """Decoded-rowgroup cache with mmap zero-copy hits (see module docstring).
+
+    Columnar values (``{name: ndarray-or-list}`` — what the rowgroup worker caches)
+    are stored as ``[header][arrow ipc stream][pickled sidecar]``; a hit memory-maps
+    the file and returns numeric columns as READ-ONLY views over the map (in-place
+    mutation of a warm-hit column raises numpy's read-only error — pass
+    ``writable_hits=True``, or let ``make_reader`` set it when a ``transform_spec``
+    is present, to receive writable copies instead: still no Parquet read, decode
+    or unpickle, just one memcpy per column). Anything else (NGram payloads,
+    arbitrary objects) is stored as an embedded pickle record with identical
+    atomicity/eviction semantics. Constructor = :class:`LocalDiskCache` plus
+    ``writable_hits`` (default False = zero-copy).
+    """
+
+    _SUFFIX = '.arrow'
+
+    def __init__(self, path, size_limit_bytes, expected_row_size_bytes=0,
+                 cleanup=False, shards=None, writable_hits=False):
+        super().__init__(path, size_limit_bytes, expected_row_size_bytes,
+                         cleanup=cleanup, shards=shards)
+        self._writable_hits = writable_hits
+
+    def _encode_value(self, value):
+        from petastorm_tpu.workers.serializers import (_columns_num_rows,
+                                                       encode_columnar)
+        if isinstance(value, dict):
+            try:
+                num_rows = _columns_num_rows(value)
+                ipc_buf, sidecar_blob, _ = encode_columnar(value, num_rows)
+                return b''.join([_HEADER.pack(_ARROW_MAGIC, b'A', len(ipc_buf)),
+                                 ipc_buf.to_pybytes(), sidecar_blob])
+            except Exception:  # noqa: BLE001 - non-columnar dict: pickle record
+                logger.debug('value for arrow cache is not columnar; storing as '
+                             'pickle record', exc_info=True)
+        return b''.join([_HEADER.pack(_ARROW_MAGIC, b'P', 0),
+                         pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)])
+
+    def _decode_file(self, file_path):
+        import pyarrow as pa
+        from petastorm_tpu.workers.serializers import decode_columnar
+        mm = pa.memory_map(file_path, 'r')
+        buf = mm.read_buffer()
+        magic, mode, ipc_len = _HEADER.unpack_from(memoryview(buf)[:_HEADER.size])
+        if magic != _ARROW_MAGIC:
+            raise ValueError('not an ArrowIpcDiskCache entry: {!r}'.format(magic))
+        body = buf.slice(_HEADER.size)
+        if mode == b'P':
+            value = pickle.loads(memoryview(body))
+            with self._lock:
+                self.stats['pickle_hits'] += 1
+            return value
+        # Zero-copy decode: numeric columns are read-only views whose base buffers
+        # keep the memory map alive; sidecar columns (ragged/object) unpickle.
+        # writable_hits copies each column out of the map instead (mutating
+        # consumers, e.g. in-place transform_specs).
+        columns, _ = decode_columnar(body.slice(0, ipc_len), body.slice(ipc_len),
+                                     writable=self._writable_hits)
+        with self._lock:
+            self.stats['arrow_hits'] += 1
+            self.stats['bytes_mmapped'] += len(buf)
+        return columns

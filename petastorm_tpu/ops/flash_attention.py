@@ -24,6 +24,15 @@ _NEG_INF = -1e30
 _LANE = 128
 
 
+def _tpu_compiler_params(pltpu, dimension_semantics):
+    """jax API-drift shim: pallas TPU compiler params were named
+    ``TPUCompilerParams`` before jax 0.4.34-era releases renamed the class to
+    ``CompilerParams``. Resolve whichever this jax ships so the kernels work (and
+    the 13 flash tests stay green) across the drift."""
+    cls = getattr(pltpu, 'CompilerParams', None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=dimension_semantics)
+
+
 def _block_segment_mask(qseg, kseg):
     """[Bq], [Bk] int32 -> [Bq, Bk] bool: same packed segment, both non-padding
     (``ops.packing`` convention: 0 = padding)."""
@@ -153,8 +162,8 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret, segments=None,
             pltpu.VMEM((block_q, _LANE), jnp.float32),   # running denominator
             pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        compiler_params=_tpu_compiler_params(
+            pltpu, ('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(*operands)
 
@@ -305,8 +314,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
         in_specs=dq_in_specs,
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        compiler_params=_tpu_compiler_params(
+            pltpu, ('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(*dq_operands)
 
@@ -331,8 +340,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
         out_specs=[kspec_o, kspec_o],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        compiler_params=_tpu_compiler_params(
+            pltpu, ('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(*dkv_operands)
     return dq, dk, dv

@@ -62,7 +62,14 @@ class LoaderStats(object):
     ``io_retries`` / ``rowgroups_quarantined`` mirror the reader's resilience
     counters (docs/robustness.md) into the loader's own stats surface: a training
     job that only watches ``LoaderStats`` still sees degradation — a non-zero
-    quarantine count means the epoch silently served fewer rowgroups."""
+    quarantine count means the epoch silently served fewer rowgroups.
+
+    The zero-copy data-plane counters mirror the same way (docs/performance.md):
+    ``cache_hits``/``cache_misses`` (decoded-rowgroup cache; a warm epoch should be
+    all hits), ``shm_batches``/``shm_fallback_batches`` (which transport the process
+    pool's results actually took) and ``wire_bytes_copied_per_batch`` (bytes
+    materialized into new host memory per result batch — the number the shm ring
+    exists to shrink)."""
 
     def __init__(self):
         self.batches = 0
@@ -73,6 +80,11 @@ class LoaderStats(object):
         self.per_field_uploads = 0
         self.io_retries = 0
         self.rowgroups_quarantined = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.shm_batches = 0
+        self.shm_fallback_batches = 0
+        self.wire_bytes_copied_per_batch = 0.0
 
     @property
     def input_stall_fraction(self):
@@ -88,7 +100,12 @@ class LoaderStats(object):
                 'coalesced_uploads': self.coalesced_uploads,
                 'per_field_uploads': self.per_field_uploads,
                 'io_retries': self.io_retries,
-                'rowgroups_quarantined': self.rowgroups_quarantined}
+                'rowgroups_quarantined': self.rowgroups_quarantined,
+                'cache_hits': self.cache_hits,
+                'cache_misses': self.cache_misses,
+                'shm_batches': self.shm_batches,
+                'shm_fallback_batches': self.shm_fallback_batches,
+                'wire_bytes_copied_per_batch': self.wire_bytes_copied_per_batch}
 
 
 class JaxDataLoader(object):
@@ -291,9 +308,8 @@ class JaxDataLoader(object):
 
     @staticmethod
     def _batch_cols_rows(columns):
-        for col in columns.values():
-            return len(col)
-        return 0
+        from petastorm_tpu.workers.serializers import _columns_num_rows
+        return _columns_num_rows(columns)
 
     def _reader_chunks(self):
         """Yield sanitized columnar chunks from the reader, tracking delivery when the
@@ -313,15 +329,26 @@ class JaxDataLoader(object):
             self._sync_resilience_stats()
 
     def _sync_resilience_stats(self):
-        """Mirror the reader's retry/quarantine counters into LoaderStats so training
-        jobs watching only the loader still see input degradation
-        (docs/robustness.md)."""
+        """Mirror the reader's retry/quarantine counters — and the zero-copy
+        data-plane counters (cache hits, shm transport, wire bytes copied) — into
+        LoaderStats so training jobs watching only the loader still see input
+        degradation (docs/robustness.md, docs/performance.md)."""
         retries = getattr(self.reader, 'io_retries', None)
         if retries is not None:
             self.stats.io_retries = retries
         ledger = getattr(self.reader, 'quarantine', None)
         if ledger is not None:
             self.stats.rowgroups_quarantined = len(ledger)
+        try:
+            diag = getattr(self.reader, 'diagnostics', None)
+        except Exception:  # noqa: BLE001 - wrapper readers may not expose it
+            return
+        if not isinstance(diag, dict):
+            return
+        for key in ('cache_hits', 'cache_misses', 'shm_batches',
+                    'shm_fallback_batches', 'wire_bytes_copied_per_batch'):
+            if key in diag:
+                setattr(self.stats, key, diag[key])
 
     def _sanitize(self, columns):
         return sanitize_columns(columns, self._pad_ragged, self._device_put)

@@ -12,7 +12,7 @@ import warnings
 
 import numpy as np
 
-from petastorm_tpu.cache import LocalDiskCache, NullCache
+from petastorm_tpu.cache import ArrowIpcDiskCache, LocalDiskCache, NullCache
 from petastorm_tpu.errors import MetadataError, NoDataAvailableError
 from petastorm_tpu.etl import dataset_metadata
 from petastorm_tpu.fs_utils import (as_arrow_filesystem, check_hdfs_driver,
@@ -37,12 +37,14 @@ _DEFAULT_WORKERS_COUNT = 10
 _DEFAULT_RESULTS_QUEUE_SIZE = 50
 
 
-def _make_pool(reader_pool_type, workers_count, results_queue_size):
+def _make_pool(reader_pool_type, workers_count, results_queue_size,
+               shm_transport=None):
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size)
     if reader_pool_type == 'process':
         from petastorm_tpu.workers.process_pool import ProcessPool
-        return ProcessPool(workers_count, results_queue_size)
+        return ProcessPool(workers_count, results_queue_size,
+                           shm_transport=shm_transport)
     if reader_pool_type == 'dummy':
         return DummyPool()
     raise ValueError('Unknown reader_pool_type {!r} (expected thread/process/dummy)'
@@ -68,12 +70,27 @@ def _retrying(fn, retry_policy, counter=None):
 
 
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
-                cache_extra_settings):
+                cache_extra_settings, cache_format='arrow-ipc', has_transform=False):
     if cache_type in (None, 'null'):
         return NullCache()
     if cache_type == 'local-disk':
-        return LocalDiskCache(cache_location, cache_size_limit, cache_row_size_estimate or 0,
-                              **(cache_extra_settings or {}))
+        extra = dict(cache_extra_settings or {})
+        if cache_format == 'arrow-ipc':
+            cache_cls = ArrowIpcDiskCache
+            # A transform_spec may mutate columns/rows in place; zero-copy mmap
+            # hits are read-only and would crash it on the warm epoch only. Decode
+            # hits writable in that case (one memcpy per column — still no Parquet
+            # read/decode/unpickle); cache_extra_settings={'writable_hits': ...}
+            # overrides either way.
+            if has_transform:
+                extra.setdefault('writable_hits', True)
+        elif cache_format == 'pickle':
+            cache_cls = LocalDiskCache
+        else:
+            raise ValueError('Unknown cache_format {!r} (expected arrow-ipc/pickle)'
+                             .format(cache_format))
+        return cache_cls(cache_location, cache_size_limit, cache_row_size_estimate or 0,
+                         **extra)
     raise ValueError('Unknown cache_type {!r} (expected null/local-disk)'.format(cache_type))
 
 
@@ -85,10 +102,11 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 rowgroup_selector=None, num_epochs=1, cur_shard=None, shard_count=None,
                 shard_seed=None, cache_type='null', cache_location=None,
                 cache_size_limit=None, cache_row_size_estimate=None,
-                cache_extra_settings=None, transform_spec=None, storage_options=None,
+                cache_extra_settings=None, cache_format='arrow-ipc',
+                transform_spec=None, storage_options=None,
                 filesystem=None, resume_state=None, reader_pool=None,
                 field_overrides=None, hdfs_driver='libhdfs', on_error='raise',
-                retry_policy=None):
+                retry_policy=None, shm_transport=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -106,7 +124,18 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     ``'skip'`` (after retries, the failing rowgroup is excluded and recorded in the
     quarantine ledger, visible via ``Reader.diagnostics['quarantine']``). ``retry_policy``
     is a :class:`~petastorm_tpu.resilience.RetryPolicy` (default: 3 attempts,
-    exponential backoff with seeded jitter)."""
+    exponential backoff with seeded jitter).
+
+    Zero-copy data plane (docs/performance.md): ``cache_format`` picks the
+    ``cache_type='local-disk'`` value format — ``'arrow-ipc'`` (default; decoded
+    rowgroups stored as Arrow IPC files, hits are memory-mapped READ-ONLY zero-copy
+    views — with a ``transform_spec`` present, hits are decoded writable instead so
+    in-place mutation keeps working; ``cache_extra_settings={'writable_hits': ...}``
+    overrides) or ``'pickle'`` (the reference's format; every hit pays a full
+    unpickle and returns writable arrays).
+    ``shm_transport`` controls the process pool's shared-memory result transport —
+    None (auto-on when available), True (require), False (ZMQ frames only); ignored
+    by thread/dummy pools, which never cross a process boundary."""
     from petastorm_tpu.resilience import resolve_retry_policy
     check_hdfs_driver(hdfs_driver)
     retry_policy = resolve_retry_policy(on_error, retry_policy)
@@ -126,19 +155,21 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     if field_overrides:
         schema = _apply_field_overrides(schema, field_overrides)
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
-                        cache_row_size_estimate, cache_extra_settings)
+                        cache_row_size_estimate, cache_extra_settings, cache_format,
+                        has_transform=transform_spec is not None)
     if reader_pool is not None:
         # Pool-shape kwargs describe a pool this call is NOT building (ADVICE.md r1).
         ignored = [name for name, value, default in [
             ('workers_count', workers_count, _DEFAULT_WORKERS_COUNT),
             ('results_queue_size', results_queue_size, _DEFAULT_RESULTS_QUEUE_SIZE),
-            ('reader_pool_type', reader_pool_type, _DEFAULT_POOL_TYPE)]
+            ('reader_pool_type', reader_pool_type, _DEFAULT_POOL_TYPE),
+            ('shm_transport', shm_transport, None)]
             if value != default]
         if ignored:
             warnings.warn('reader_pool was supplied; ignoring pool-shape arguments {} '
                           '(the pre-built pool defines its own shape)'.format(ignored))
     pool = reader_pool if reader_pool is not None else _make_pool(
-        reader_pool_type, workers_count, results_queue_size)
+        reader_pool_type, workers_count, results_queue_size, shm_transport)
     return Reader(dataset_url_or_urls, handle=handle, schema=schema,
                   schema_fields=schema_fields,
                   reader_pool=pool, seed=seed, shuffle_rows=shuffle_rows,
@@ -161,12 +192,14 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cur_shard=None, shard_count=None, shard_seed=None, cache_type='null',
                       cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, cache_extra_settings=None,
-                      transform_spec=None, storage_options=None, filesystem=None,
+                      cache_format='arrow-ipc', transform_spec=None,
+                      storage_options=None, filesystem=None,
                       resume_state=None, hdfs_driver='libhdfs', on_error='raise',
-                      retry_policy=None):
+                      retry_policy=None, shm_transport=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
-    ``on_error`` / ``retry_policy`` behave exactly as in :func:`make_reader`.
+    ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` behave
+    exactly as in :func:`make_reader`.
     """
     from petastorm_tpu.resilience import resolve_retry_policy
     check_hdfs_driver(hdfs_driver)
@@ -185,8 +218,10 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     except MetadataError:
         pass
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
-                        cache_row_size_estimate, cache_extra_settings)
-    pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+                        cache_row_size_estimate, cache_extra_settings, cache_format,
+                        has_transform=transform_spec is not None)
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      shm_transport)
     return Reader(dataset_url_or_urls, handle=handle, schema=None,
                   schema_fields=schema_fields,
                   reader_pool=pool, seed=seed, shuffle_rows=shuffle_rows,
@@ -225,6 +260,11 @@ class Reader(object):
         #: to the empty stand-in batches of skipped rowgroups (docs/robustness.md)
         self.quarantine = QuarantineLedger()
         self._io_retries = 0
+        # Cache observability: per-batch cache_hit sidecar flags accumulate here
+        # (works across all pools — the flag rides the results channel).
+        self._cache = cache
+        self._cache_hits = 0
+        self._cache_misses = 0
 
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
@@ -533,6 +573,13 @@ class Reader(object):
         if retries:
             with self._accounting_lock:
                 self._io_retries += retries
+        cache_hit = getattr(batch, 'cache_hit', None)
+        if cache_hit is not None:
+            with self._accounting_lock:
+                if cache_hit:
+                    self._cache_hits += 1
+                else:
+                    self._cache_misses += 1
         item_id = getattr(batch, 'item_id', None)
         if item_id is None:
             return
@@ -647,6 +694,14 @@ class Reader(object):
         diag = dict(self._pool.diagnostics)
         with self._accounting_lock:
             diag['io_retries'] = self._io_retries
+            diag['cache_hits'] = self._cache_hits
+            diag['cache_misses'] = self._cache_misses
+        # In-process cache counters (exact for thread/dummy pools; for the process
+        # pool each worker keeps its own copy, so the per-batch cache_hits/misses
+        # above are the cross-process aggregate).
+        cache_stats = getattr(self._cache, 'stats', None)
+        if cache_stats is not None:
+            diag['cache'] = dict(cache_stats)
         diag['rowgroups_quarantined'] = len(self.quarantine)
         diag['quarantine'] = self.quarantine.as_dicts()
         return diag

@@ -21,6 +21,7 @@ import pyarrow.dataset as pads
 
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.transform import transform_schema
+from petastorm_tpu.workers.serializers import _columns_num_rows
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 logger = logging.getLogger(__name__)
@@ -37,16 +38,25 @@ class ColumnarBatch(object):
     worker spent producing this batch (zero on the fault-free path); ``quarantine`` is a
     :class:`~petastorm_tpu.resilience.QuarantineRecord` when this batch stands in for a
     rowgroup skipped under ``on_error='skip'`` (such batches are empty — the record rides
-    the results channel so the ledger works identically across all pools)."""
+    the results channel so the ledger works identically across all pools).
 
-    __slots__ = ('columns', 'num_rows', 'item_id', 'retries', 'quarantine')
+    ``cache_hit`` is the cache-observability sidecar: True when this batch was served
+    from the rowgroup cache, False on a miss that filled it, None when no cache applied
+    (NullCache, unpicklable predicate bypass, quarantined/ngram stand-ins). It rides
+    the results channel like ``retries`` so ``Reader.diagnostics`` counts hits/misses
+    identically across all pools."""
 
-    def __init__(self, columns, num_rows, item_id=None, retries=0, quarantine=None):
+    __slots__ = ('columns', 'num_rows', 'item_id', 'retries', 'quarantine',
+                 'cache_hit')
+
+    def __init__(self, columns, num_rows, item_id=None, retries=0, quarantine=None,
+                 cache_hit=None):
         self.columns = columns
         self.num_rows = num_rows
         self.item_id = item_id
         self.retries = retries
         self.quarantine = quarantine
+        self.cache_hit = cache_hit
 
 
 class WorkerSetup(object):
@@ -174,6 +184,7 @@ class RowGroupWorker(WorkerBase):
                 return self._load_and_decode(fragment_path, row_group_id, partition_keys,
                                              worker_predicate, shuffle_row_drop_partition)
 
+            cache_hit = None
             if predicate_token is None:
                 # Unpicklable predicate: no stable cache identity exists — bypass the
                 # cache rather than risk serving rows filtered by a different predicate.
@@ -182,7 +193,15 @@ class RowGroupWorker(WorkerBase):
                 cache_key = '{}:{}:{}:{}:{}'.format(
                     setup.dataset_token, fragment_path, row_group_id,
                     shuffle_row_drop_partition, predicate_token)
-                columns = setup.cache.get(cache_key, lambda: with_retry(load))
+                filled = [False]
+
+                def fill():
+                    filled[0] = True
+                    return with_retry(load)
+
+                columns = setup.cache.get(cache_key, fill)
+                if not isinstance(setup.cache, NullCache):
+                    cache_hit = not filled[0]
             num_rows = _columns_num_rows(columns)
             if num_rows:
                 columns = self._shuffle(columns, num_rows, piece_index)
@@ -197,10 +216,11 @@ class RowGroupWorker(WorkerBase):
             # Publish an empty batch anyway: every item must yield exactly one result so
             # the reader's consumption accounting (state_dict/resume) stays exact.
             self.publish_func(ColumnarBatch({}, 0, item_id=item_id,
-                                            retries=retry_cell[0]))
+                                            retries=retry_cell[0],
+                                            cache_hit=cache_hit))
             return
         self.publish_func(ColumnarBatch(columns, num_rows, item_id=item_id,
-                                        retries=retry_cell[0]))
+                                        retries=retry_cell[0], cache_hit=cache_hit))
 
     def _publish_quarantined(self, exc, item_id, piece_index, fragment_path,
                              row_group_id, retries):
@@ -420,12 +440,6 @@ def _predicate_token(worker_predicate):
         return hashlib.md5(pickle.dumps(worker_predicate)).hexdigest()[:12]
     except Exception:
         return None
-
-
-def _columns_num_rows(columns):
-    for col in columns.values():
-        return len(col)
-    return 0
 
 
 def _take(col, indices):

@@ -22,7 +22,20 @@ Workers are spawned (never forked — fork breaks JVM/libhdfs state, reference
 exec_in_new_process.py:15-17) as fresh interpreters running
 ``petastorm_tpu.workers.process_worker_main`` with a dill-serialized bootstrap file.
 Each worker runs a parent-watchdog thread and exits if the main process dies
-(reference: process_pool.py:320-327)."""
+(reference: process_pool.py:320-327).
+
+**Shared-memory transport** (``shm_transport``, default auto-on): result payloads are
+written into a ``workers/shm_ring.py`` slot ring owned by this pool and only a tiny
+slot descriptor crosses ZMQ as a ``result_shm`` message; the consumer maps the slot
+zero-copy, deserializes, then acks the slot back to the producing worker with a
+``release`` on the dispatch ROUTER. Payloads that exceed the slot size (or arrive
+while no slot is free past the backpressure window, or when shm is unavailable) fall
+back transparently to the original ZMQ ``result`` frames — counted in
+``diagnostics['shm_fallback_batches']``. Descriptors carry the producing worker's
+generation, so results written by a worker that died and was respawned are dropped
+(``shm_stale_drops``) instead of read while the replacement overwrites the slot; the
+ring is closed AND unlinked in ``join()`` regardless of worker deaths, so no
+``/dev/shm`` segment outlives the pool."""
 
 import collections
 import logging
@@ -39,8 +52,10 @@ from petastorm_tpu.workers import EmptyResultError, TimeoutWaitingForResultError
 logger = logging.getLogger(__name__)
 
 _WORKER_STARTUP_TIMEOUT_S = 30
-#: message kinds on the results channel
+#: message kinds on the results channel; ``result_shm`` carries a shm-slot
+#: descriptor instead of the payload frames
 MSG_STARTED, MSG_RESULT, MSG_DONE, MSG_ERROR = b'started', b'result', b'done', b'error'
+MSG_RESULT_SHM = b'result_shm'
 #: default total respawn budget — one bad rowgroup killing the same worker repeatedly
 #: must exhaust the budget and fail loudly, not respawn forever
 DEFAULT_MAX_WORKER_RESPAWNS = 3
@@ -56,7 +71,8 @@ class ProcessPool(object):
     or pickle wire, orphan watchdog, exception propagation, bounded worker respawn."""
 
     def __init__(self, workers_count, results_queue_size=50, zmq_copy_buffers=False,
-                 payload_serializer=None, max_worker_respawns=DEFAULT_MAX_WORKER_RESPAWNS):
+                 payload_serializer=None, max_worker_respawns=DEFAULT_MAX_WORKER_RESPAWNS,
+                 shm_transport=None, shm_slot_bytes=None, shm_slots_per_worker=None):
         """``payload_serializer`` picks the wire format for worker results (reference:
         process_pool.py:251-270 pluggable serializers): default
         :class:`~petastorm_tpu.workers.serializers.ArrowIpcSerializer` (columnar
@@ -64,7 +80,15 @@ class ProcessPool(object):
         ``zmq_copy_buffers=False`` (default) receives result frames without copying —
         deserialized arrays then alias ZMQ frame memory. ``max_worker_respawns`` is the
         pool-wide budget of worker restarts after unexpected deaths; 0 restores the
-        seed's die-loudly-on-first-death behavior."""
+        seed's die-loudly-on-first-death behavior.
+
+        ``shm_transport``: None (auto — enable when ``multiprocessing.shared_memory``
+        works and the serializer receives writable copies), True (require; raises if
+        unavailable), False (ZMQ frames only, the seed behavior). ``shm_slot_bytes`` /
+        ``shm_slots_per_worker`` size the ring (defaults in ``workers/shm_ring.py``);
+        slot count bounds the transport's in-flight payloads per worker
+        (backpressure)."""
+        from petastorm_tpu.workers import shm_ring
         from petastorm_tpu.workers.serializers import ArrowIpcSerializer
         self._workers_count = workers_count
         self.workers_count = workers_count
@@ -73,6 +97,20 @@ class ProcessPool(object):
         self._serializer = (payload_serializer if payload_serializer is not None
                             else ArrowIpcSerializer())
         self._max_worker_respawns = max_worker_respawns
+        self._shm_transport = shm_transport
+        self._shm_slot_bytes = shm_slot_bytes or shm_ring.DEFAULT_SLOT_BYTES
+        self._shm_slots_per_worker = (shm_slots_per_worker
+                                      or shm_ring.DEFAULT_SLOTS_PER_WORKER)
+        self._ring = None
+        if shm_transport is not False \
+                and getattr(self._serializer, 'writable', True) is False:
+            # Slot memory is handed back to the worker the moment deserialize
+            # returns; zero-copy receives would alias reclaimed slots.
+            if shm_transport:
+                raise ValueError('shm_transport requires a writable-receive '
+                                 'serializer (slot memory is reclaimed after '
+                                 'deserialize); use ArrowIpcSerializer(writable=True)')
+            self._shm_transport = False
         self._context = None
         self._ventilator = None
         self._processes = []
@@ -92,6 +130,7 @@ class ProcessPool(object):
         self._assigned = {}                   # token -> worker identity holding it
         self._ready = collections.deque()     # worker identities awaiting work
         self._identity_slot = {}              # identity -> (slot, generation)
+        self._slot_identity = {}              # slot -> current identity (for releases)
         self._slot_generation = []            # slot -> current generation
         # Tokens whose result reached the consumer but whose 'done' has not (cleared on
         # done). Any further result for such a token is a duplicate from a
@@ -101,6 +140,15 @@ class ProcessPool(object):
         self._delivered = set()
         self._workers_respawned = 0
         self._results_dropped = 0
+        # ------------------------------------------------------ wire counters
+        # All consumer-thread-only except where noted; read under _state_lock in
+        # diagnostics for a consistent snapshot.
+        self._wire_batches = 0          # result payloads delivered or dropped
+        self._shm_batches = 0           # payloads that arrived via the shm ring
+        self._shm_fallback_batches = 0  # ZMQ-frame results while shm was enabled
+        self._shm_stale_drops = 0       # descriptors from a pre-respawn generation
+        self._shm_bytes_mapped = 0      # payload bytes served zero-copy from slots
+        self._zmq_result_bytes = 0      # payload bytes copied off the ZMQ wire
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -114,6 +162,19 @@ class ProcessPool(object):
         self._results_socket = self._context.socket(zmq.PULL)
         self._results_socket.set_hwm(self._results_queue_size)
         results_port = self._results_socket.bind_to_random_port('tcp://127.0.0.1')
+
+        if self._shm_transport is not False and self._ring is None:
+            from petastorm_tpu.workers.shm_ring import ShmRing
+            try:
+                self._ring = ShmRing(self._workers_count,
+                                     slots_per_worker=self._shm_slots_per_worker,
+                                     slot_bytes=self._shm_slot_bytes)
+            except Exception as exc:  # noqa: BLE001 - auto mode degrades to ZMQ
+                if self._shm_transport:
+                    raise
+                logger.warning('shared-memory transport unavailable (%r); falling '
+                               'back to ZMQ result frames', exc)
+                self._ring = None
 
         import dill
         # Spawned interpreters must resolve petastorm_tpu itself (python -m resolves it at
@@ -134,6 +195,8 @@ class ProcessPool(object):
             'control_addr': 'tcp://127.0.0.1:{}'.format(control_port),
             'results_addr': 'tcp://127.0.0.1:{}'.format(results_port),
             'parent_pid': os.getpid(),
+            'shm': (dict(self._ring.worker_spec(), name=self._ring.name)
+                    if self._ring is not None else None),
         }
         self._slot_generation = [0] * self._workers_count
         for worker_id in range(self._workers_count):
@@ -147,6 +210,7 @@ class ProcessPool(object):
         while started < self._workers_count:
             if time.time() > deadline:
                 self.stop()
+                self._release_ring()
                 raise WorkerTerminationError(
                     'Only {} of {} workers started within {}s'
                     .format(started, self._workers_count, _WORKER_STARTUP_TIMEOUT_S))
@@ -198,10 +262,13 @@ class ProcessPool(object):
 
     def _handle_ready(self, frames):
         """A worker announced itself idle on the dispatch ROUTER: remember its route and
-        slot so pending work can be assigned to it specifically."""
+        slot so pending work (and shm slot releases) can be routed to it
+        specifically."""
         identity, slot, generation = frames[0], int(frames[2]), int(frames[3])
         with self._state_lock:
             self._identity_slot[identity] = (slot, generation)
+            if self._slot_generation[slot] == generation:
+                self._slot_identity[slot] = identity
             self._ready.append(identity)
 
     def _dispatch_pending(self):
@@ -223,7 +290,21 @@ class ProcessPool(object):
                 blob = self._items[token]
                 self._assigned[token] = identity
             self._dispatch_socket.send_multipart(
-                [identity, b'%d' % token, blob])
+                [identity, b'work', b'%d' % token, blob])
+
+    def _release_slot(self, descriptor):
+        """Ack a consumed (or duplicate-dropped) shm slot back to the worker that
+        owns it, so the slot re-enters the worker's free set. Consumer thread only
+        (ROUTER sends are single-threaded). A vanished identity (worker died after
+        publishing) is fine: ROUTER drops unroutable messages and the replacement
+        worker starts with every slot free."""
+        with self._state_lock:
+            identity = self._slot_identity.get(descriptor.worker_slot)
+            current = self._slot_generation[descriptor.worker_slot]
+        if identity is None or current != descriptor.generation:
+            return
+        self._dispatch_socket.send_multipart(
+            [identity, b'release', b'%d' % descriptor.ring_slot])
 
     def _handle_done(self, token):
         with self._state_lock:
@@ -328,7 +409,12 @@ class ProcessPool(object):
                 raise exc
             if kind == MSG_RESULT:
                 token = int(bytes(memoryview(payload[0])))
+                payload_bytes = sum(memoryview(frame).nbytes for frame in payload[1:])
                 with self._state_lock:
+                    self._wire_batches += 1
+                    self._zmq_result_bytes += payload_bytes
+                    if self._ring is not None:
+                        self._shm_fallback_batches += 1
                     if token not in self._items or token in self._delivered:
                         # Duplicate from a re-ventilated item whose first result was
                         # already delivered (retired token, or delivered-but-not-yet-
@@ -337,8 +423,56 @@ class ProcessPool(object):
                         continue
                     self._delivered.add(token)
                 return self._serializer.deserialize(payload[1:])
+            if kind == MSG_RESULT_SHM:
+                result = self._handle_shm_result(payload)
+                if result is not None:
+                    return result[0]
+                continue
             if kind == MSG_STARTED:  # respawned worker joining — expected
                 continue
+
+    def _handle_shm_result(self, payload):
+        """One ``result_shm`` message: validate the descriptor's generation, dedup the
+        token, deserialize zero-copy from the slot, ack the slot. Returns
+        ``(payload_obj,)`` to deliver or None to keep polling."""
+        from petastorm_tpu.workers.shm_ring import ShmSlotDescriptor
+        token = int(bytes(memoryview(payload[0])))
+        descriptor = ShmSlotDescriptor.from_bytes(bytes(memoryview(payload[1])))
+        with self._state_lock:
+            self._wire_batches += 1
+            self._zmq_result_bytes += memoryview(payload[1]).nbytes
+            if self._slot_generation[descriptor.worker_slot] != descriptor.generation:
+                # Written by a worker that has since died and been respawned: the
+                # replacement owns (and may be overwriting) the slot — never read
+                # it. The item was re-ventilated, so a fresh result is coming.
+                self._shm_stale_drops += 1
+                return None
+            duplicate = token not in self._items or token in self._delivered
+            if duplicate:
+                self._results_dropped += 1
+            else:
+                self._delivered.add(token)
+                self._shm_batches += 1
+                self._shm_bytes_mapped += descriptor.total_bytes
+        if duplicate:
+            self._release_slot(descriptor)  # still owed: the slot holds real bytes
+            return None
+        if self._ring is None:  # defensive: descriptor without a ring
+            self._release_slot(descriptor)
+            return None
+        views = self._ring.view(descriptor)
+        try:
+            return (self._serializer.deserialize(views),)
+        finally:
+            # Frames never outlive this call (writable-receive contract enforced in
+            # __init__): drop the slot views so join()'s unlink can't hit exported
+            # buffers, then hand the slot back.
+            for view in views:
+                try:
+                    view.release()
+                except BufferError:  # pragma: no cover - a consumer kept a ref
+                    pass
+            self._release_slot(descriptor)
 
     def stop(self):
         if self._stopped:
@@ -384,13 +518,46 @@ class ProcessPool(object):
                 sock.close(linger=0)
             self._context.term()
             self._context = None
+        # After every worker is reaped: close AND unlink the ring so no /dev/shm
+        # segment survives the pool, however the workers died.
+        self._release_ring()
+
+    def _release_ring(self):
+        if self._ring is not None:
+            try:
+                self._ring.close_and_unlink()
+            except Exception:  # noqa: BLE001 - cleanup must not mask the exit path
+                logger.warning('failed to unlink the shm ring', exc_info=True)
+            self._ring = None
 
     @property
     def diagnostics(self):
+        serializer_stats = dict(getattr(self._serializer, 'stats', None) or {})
         with self._state_lock:
-            return {
+            wire_batches = self._wire_batches
+            bytes_copied = (self._zmq_result_bytes
+                            + serializer_stats.get('bytes_copied', 0))
+            diag = {
                 'workers_alive': sum(1 for p in self._processes if p.poll() is None),
                 'workers_respawned': self._workers_respawned,
                 'results_dropped': self._results_dropped,
                 'in_flight_items': len(self._items),
+                # ------------------------- zero-copy data plane observability
+                'shm_enabled': self._ring is not None,
+                'shm_batches': self._shm_batches,
+                'shm_fallback_batches': self._shm_fallback_batches,
+                'shm_stale_drops': self._shm_stale_drops,
+                'shm_bytes_mapped': self._shm_bytes_mapped,
+                'zmq_result_bytes': self._zmq_result_bytes,
+                'wire_batches': wire_batches,
+                # bytes materialized into new host memory per delivered batch:
+                # ZMQ-frame bytes copied off the wire + the serializer's receive-
+                # side copies (unpickle payloads, writable column copies)
+                'wire_bytes_copied': bytes_copied,
+                'wire_bytes_copied_per_batch':
+                    round(bytes_copied / wire_batches, 1) if wire_batches else 0.0,
+                'sidecar_columns': serializer_stats.get('sidecar_columns', 0),
+                'sidecar_column_names':
+                    list(serializer_stats.get('sidecar_column_names', [])),
             }
+        return diag

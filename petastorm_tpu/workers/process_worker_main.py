@@ -6,7 +6,14 @@ Dispatch is pull-based (see process_pool.py module docstring): the worker announ
 itself idle with a 'ready' on its DEALER socket and receives exactly the items the pool
 assigned to it; every result and the final 'done' ack carry the item's dispatch token so
 the pool can re-ventilate un-acked items if this process dies and drop duplicate results
-after a respawn."""
+after a respawn. Dispatch messages are kind-prefixed: ``work`` carries an item,
+``release`` acks a shared-memory slot back into this worker's free set.
+
+With the shm transport (bootstrap ``shm`` spec), each serialized result is written
+into one of this worker's ring slots and only the descriptor is sent
+(``result_shm``). No free slot = backpressure: the worker polls its dispatch socket
+for release acks up to a bounded wait, then falls back to plain ZMQ ``result``
+frames — results are never lost to slot exhaustion."""
 
 import os
 import pickle
@@ -14,6 +21,11 @@ import sys
 import threading
 import time
 import traceback
+
+#: bounded wait for a slot release before a payload falls back to ZMQ frames; the
+#: consumer releases every slot it reads, so a healthy pool frees one well within
+#: this window — the timeout only fires when the consumer stalls or dies
+_SLOT_WAIT_S = 10.0
 
 
 def _watch_parent(parent_pid):
@@ -28,7 +40,8 @@ def _watch_parent(parent_pid):
 
 def main(bootstrap_path):
     """Spawned worker-process entry: load the dill bootstrap file, connect the ZMQ
-    sockets, request/process ventilated items until the stop message."""
+    sockets, attach the shm ring writer when configured, and request/process
+    ventilated items until the stop message."""
     with open(bootstrap_path, 'rb') as f:
         bootstrap = pickle.load(f)
     try:
@@ -57,11 +70,54 @@ def main(bootstrap_path):
     results_socket = context.socket(zmq.PUSH)
     results_socket.connect(bootstrap['results_addr'])
 
+    ring_writer = None
+    shm_spec = bootstrap.get('shm')
+    if shm_spec is not None:
+        from petastorm_tpu.workers.shm_ring import ShmRingWriter
+        try:
+            ring_writer = ShmRingWriter(shm_spec['name'], worker_id, generation,
+                                        shm_spec['slots_per_worker'],
+                                        shm_spec['slot_bytes'])
+        except Exception:  # noqa: BLE001 - transport optional; ZMQ still works
+            import logging
+            logging.getLogger(__name__).warning(
+                'worker %d could not attach the shm ring; using ZMQ frames',
+                worker_id, exc_info=True)
+
     current_token = [b'']
 
+    def drain_releases(timeout_ms=0):
+        """Process queued ``release`` acks on the dispatch socket; returns any
+        out-of-band ``work`` frames that arrived interleaved (deferred by the
+        caller, never dropped)."""
+        deferred = []
+        while dispatch_socket.poll(timeout_ms, zmq.POLLIN):
+            timeout_ms = 0
+            frames = dispatch_socket.recv_multipart()
+            if frames and frames[0] == b'release' and ring_writer is not None:
+                ring_writer.release(int(frames[1]))
+            else:
+                deferred.append(frames)
+        return deferred
+
+    deferred_work = []
+
     def publish(result):
-        results_socket.send_multipart(
-            [b'result', current_token[0]] + serializer.serialize(result))
+        frames = serializer.serialize(result)
+        if ring_writer is not None and ring_writer.fits(frames):
+            descriptor = ring_writer.try_write(frames)
+            if descriptor is None:
+                # Backpressure: all our slots are in flight — wait (bounded) for
+                # the consumer's release acks before falling back to the wire.
+                deadline = time.monotonic() + _SLOT_WAIT_S
+                while descriptor is None and time.monotonic() < deadline:
+                    deferred_work.extend(drain_releases(timeout_ms=100))
+                    descriptor = ring_writer.try_write(frames)
+            if descriptor is not None:
+                results_socket.send_multipart(
+                    [b'result_shm', current_token[0], descriptor.to_bytes()])
+                return
+        results_socket.send_multipart([b'result', current_token[0]] + frames)
 
     worker = worker_class(worker_id, publish, worker_args)
     results_socket.send_multipart([b'started'])
@@ -76,8 +132,19 @@ def main(bootstrap_path):
         if control_socket in events:
             if control_socket.recv() == b'stop':
                 break
-        if dispatch_socket in events:
-            token, blob = dispatch_socket.recv_multipart()
+        if dispatch_socket in events or deferred_work:
+            if deferred_work:
+                frames = deferred_work.pop(0)
+            else:
+                frames = dispatch_socket.recv_multipart()
+            kind = frames[0]
+            if kind == b'release':
+                if ring_writer is not None:
+                    ring_writer.release(int(frames[1]))
+                continue
+            if kind != b'work':
+                continue  # unknown kind from a newer pool: ignore
+            token, blob = frames[1], frames[2]
             kwargs = dill.loads(blob)
             current_token[0] = token
             try:
@@ -89,6 +156,8 @@ def main(bootstrap_path):
             current_token[0] = b''
             dispatch_socket.send_multipart(ready_msg)
     worker.shutdown()
+    if ring_writer is not None:
+        ring_writer.close()
     for sock in (dispatch_socket, control_socket, results_socket):
         sock.close(linger=1000)
     context.term()

@@ -7,16 +7,26 @@ columns), not a ``pa.Table`` as in the reference — decode happens worker-side,
 serializer must move numpy, not Arrow-native, columns. :class:`ArrowIpcSerializer`
 re-encodes the uniform numeric columns into ONE Arrow record batch shipped as a single
 IPC-stream frame: the receive side maps it back with ``to_numpy(zero_copy_only=True)``
-over the incoming ZMQ frame's memory — no per-column copy, no pickle of array data.
-Columns Arrow can't hold zero-copy (ragged lists, object/string arrays, bit-packed
-bools) ride a pickled sidecar frame. Any non-ColumnarBatch payload (e.g. NGram window
-lists) falls back to plain pickle transparently.
+over the incoming frame's memory (a ZMQ frame, or a shared-memory ring slot — see
+``workers/shm_ring.py``) — no per-column copy, no pickle of array data. Columns Arrow
+can't hold zero-copy (ragged lists, object/string arrays, bit-packed bools) ride a
+pickled sidecar frame. Any non-ColumnarBatch payload (e.g. NGram window lists) falls
+back to plain pickle transparently.
+
+The columnar encode/decode pair is exposed as module functions
+(:func:`encode_columnar`, :func:`decode_columnar`) because the mmap rowgroup cache
+(``petastorm_tpu.cache.ArrowIpcDiskCache``) stores exactly the same byte layout on
+disk: one wire format, two transports (socket/shm ring and mmap file).
 
 A serializer turns a payload into a list of byte frames and back:
 
     serialize(obj) -> [frame, ...]      deserialize([frame, ...]) -> obj
 
 Frames are whatever ZMQ ``send_multipart`` accepts (bytes / memoryview / pa.Buffer).
+Each serializer keeps a ``stats`` dict updated on the DESERIALIZE (consumer) side —
+for the process pool that is the main process, so degradation to copy-mode (columns
+falling off the Arrow zero-copy path into the pickled sidecar) is visible in
+``ProcessPool.diagnostics`` / ``Reader.diagnostics`` without any extra channel.
 """
 
 import json
@@ -28,16 +38,132 @@ _MARKER_PICKLE = b'P'
 _MARKER_ARROW = b'A'
 _META_KEY = b'petastorm_tpu.columnar.v1'
 
+#: cap on distinct column names remembered in stats['sidecar_column_names'] — the
+#: counter must stay O(schema), not O(stream)
+_SIDECAR_NAMES_CAP = 64
+
+
+def _new_wire_stats():
+    """Fresh consumer-side wire counters (see module docstring): ``batches`` received,
+    ``bytes_copied`` (bytes materialized into new host memory on receive: pickle
+    payloads, writable column copies, sidecar bytes), ``bytes_zero_copy`` (bytes served
+    as views over the incoming frame), ``sidecar_columns`` (column instances that fell
+    off the Arrow path into the pickled sidecar) and the distinct
+    ``sidecar_column_names`` (capped)."""
+    return {'batches': 0, 'bytes_copied': 0, 'bytes_zero_copy': 0,
+            'sidecar_columns': 0, 'sidecar_column_names': []}
+
+
+def _columns_num_rows(columns):
+    """The columnar row-count convention shared by the wire codec, the rowgroup
+    worker and the cache: the first column's length (0 for an empty dict)."""
+    for col in columns.values():
+        return len(col)
+    return 0
+
+
+def encode_columnar(columns, num_rows, meta_extra=None):
+    """Encode ``{name: ndarray-or-list}`` into ``(ipc_bytes, sidecar_bytes,
+    sidecar_names)``: uniform numeric ndarrays become ONE Arrow record batch
+    (multi-dim columns flattened to FixedSizeList, original shapes/dtypes in schema
+    metadata), everything else ships in a pickled sidecar dict. ``meta_extra`` is a
+    JSON-safe dict merged into the schema metadata (the wire's resilience/cache
+    sidecar fields ride here)."""
+    import pyarrow as pa
+
+    arrow_arrays, arrow_names, col_meta = [], [], {}
+    sidecar_cols = {}
+    for name, col in columns.items():
+        if (isinstance(col, np.ndarray) and col.ndim >= 1
+                and col.dtype.kind in 'iuf' and len(col) == num_rows):
+            arr = np.ascontiguousarray(col)
+            # explicit inner size: reshape(n, -1) cannot infer an axis when n == 0
+            inner = int(np.prod(arr.shape[1:], dtype=np.int64)) if arr.ndim > 1 else 1
+            flat = arr.reshape(len(arr), inner) if arr.ndim > 1 else arr
+            pa_arr = pa.array(flat.ravel())
+            if arr.ndim > 1:
+                pa_arr = pa.FixedSizeListArray.from_arrays(pa_arr, flat.shape[1])
+            arrow_arrays.append(pa_arr)
+            arrow_names.append(name)
+            col_meta[name] = {'dtype': arr.dtype.str, 'shape': list(arr.shape[1:])}
+        else:
+            sidecar_cols[name] = col
+
+    meta = {'num_rows': int(num_rows), 'columns': col_meta}
+    if meta_extra:
+        meta.update(meta_extra)
+    schema = pa.schema([pa.field(n, a.type) for n, a in zip(arrow_names, arrow_arrays)],
+                       metadata={_META_KEY: json.dumps(meta).encode('utf-8')})
+    batch = pa.record_batch(arrow_arrays, schema=schema)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, schema) as writer:
+        writer.write_batch(batch)
+    return (sink.getvalue(), pickle.dumps(sidecar_cols, protocol=5),
+            sorted(sidecar_cols))
+
+
+def decode_columnar(ipc_frame, sidecar_frame, writable=True, stats=None):
+    """Decode the :func:`encode_columnar` pair back into ``(columns, meta)``.
+
+    ``ipc_frame``/``sidecar_frame`` may be bytes, memoryviews (ZMQ frame or shm slot)
+    or ``pa.Buffer`` (mmap region). With ``writable=False`` numeric columns are
+    READ-ONLY zero-copy views aliasing ``ipc_frame``'s memory — the caller owns that
+    memory's lifetime. ``stats`` (a :func:`_new_wire_stats` dict) is updated in place
+    when given."""
+    import pyarrow as pa
+
+    buf = ipc_frame if isinstance(ipc_frame, pa.Buffer) \
+        else pa.py_buffer(_as_memory(ipc_frame))
+    with pa.ipc.open_stream(buf) as reader:
+        batch = reader.read_next_batch()
+        meta = json.loads(batch.schema.metadata[_META_KEY].decode('utf-8'))
+    sidecar_blob = _as_bytes(sidecar_frame)
+    columns = pickle.loads(sidecar_blob)
+    if stats is not None:
+        stats['batches'] += 1
+        stats['bytes_copied'] += len(sidecar_blob)
+        stats['sidecar_columns'] += len(columns)
+        names = stats['sidecar_column_names']
+        for name in columns:
+            if name not in names and len(names) < _SIDECAR_NAMES_CAP:
+                names.append(name)
+    for i, field in enumerate(batch.schema):
+        col = batch.column(i)
+        spec = meta['columns'][field.name]
+        shape = tuple(spec['shape'])
+        if shape:
+            values = col.flatten().to_numpy(zero_copy_only=(len(col) > 0))
+            values = values.reshape((len(col),) + shape)
+        else:
+            values = col.to_numpy(zero_copy_only=(len(col) > 0))
+        # astype(copy=False) is a no-op when dtypes already match (the usual case)
+        values = values.astype(spec['dtype'], copy=False)
+        if writable and not values.flags.writeable:
+            values = values.copy()
+            if stats is not None:
+                stats['bytes_copied'] += values.nbytes
+        elif stats is not None:
+            stats['bytes_zero_copy'] += values.nbytes
+        columns[field.name] = values
+    return columns, meta
+
 
 class PickleSerializer(object):
     """Whole-object pickle — always correct, copies everything (reference:
     reader_impl/pickle_serializer.py:17-23)."""
 
+    def __init__(self):
+        self.stats = _new_wire_stats()
+
     def serialize(self, obj):
         return [_MARKER_PICKLE, pickle.dumps(obj, protocol=5)]
 
     def deserialize(self, frames):
-        return pickle.loads(_as_bytes(frames[1]))
+        blob = _as_bytes(frames[1])
+        self.stats['batches'] += 1
+        # unpickling re-materializes the whole object graph: count the payload once
+        self.stats['bytes_copied'] += len(blob)
+        return pickle.loads(blob)
 
 
 class ArrowIpcSerializer(object):
@@ -56,80 +182,50 @@ class ArrowIpcSerializer(object):
     alias the single incoming IPC frame and are READ-ONLY — and because all numeric
     columns share that frame, retaining any row/column view pins the whole batch's frame
     memory. Use it when the consumer is a device loader that only reads
-    (e.g. JaxDataLoader assembling device arrays)."""
+    (e.g. JaxDataLoader assembling device arrays). The shm-ring transport requires
+    ``writable=True``: its slot memory is handed back to the producing worker the
+    moment ``deserialize`` returns, so nothing may keep aliasing it."""
 
     def __init__(self, writable=True):
         self._writable = writable
+        self.stats = _new_wire_stats()
+
+    @property
+    def writable(self):
+        """True when receive copies columns into ordinary writable arrays."""
+        return self._writable
 
     def serialize(self, obj):
         from petastorm_tpu.reader_worker import ColumnarBatch
         if not isinstance(obj, ColumnarBatch):
             return PickleSerializer().serialize(obj)
-        import pyarrow as pa
-
-        arrow_arrays, arrow_names, col_meta = [], [], {}
-        sidecar_cols = {}
-        for name, col in obj.columns.items():
-            if (isinstance(col, np.ndarray) and col.ndim >= 1
-                    and col.dtype.kind in 'iuf' and len(col) == obj.num_rows):
-                arr = np.ascontiguousarray(col)
-                # explicit inner size: reshape(n, -1) cannot infer an axis when n == 0
-                inner = int(np.prod(arr.shape[1:], dtype=np.int64)) if arr.ndim > 1 else 1
-                flat = arr.reshape(len(arr), inner) if arr.ndim > 1 else arr
-                pa_arr = pa.array(flat.ravel())
-                if arr.ndim > 1:
-                    pa_arr = pa.FixedSizeListArray.from_arrays(pa_arr, flat.shape[1])
-                arrow_arrays.append(pa_arr)
-                arrow_names.append(name)
-                col_meta[name] = {'dtype': arr.dtype.str, 'shape': list(arr.shape[1:])}
-            else:
-                sidecar_cols[name] = col
-
-        meta = {'num_rows': int(obj.num_rows),
-                'item_id': ([int(part) for part in obj.item_id]
-                            if obj.item_id is not None else None),
-                'columns': col_meta,
-                # resilience sidecar (docs/robustness.md): plain-JSON fields, so the
-                # quarantine ledger and retry counters cross the process boundary
-                # without pickling framework types
-                'retries': int(getattr(obj, 'retries', 0) or 0),
-                'quarantine': (obj.quarantine.as_dict()
-                               if getattr(obj, 'quarantine', None) is not None
-                               else None)}
-        schema = pa.schema([pa.field(n, a.type) for n, a in zip(arrow_names, arrow_arrays)],
-                           metadata={_META_KEY: json.dumps(meta).encode('utf-8')})
-        batch = pa.record_batch(arrow_arrays, schema=schema)
-        sink = pa.BufferOutputStream()
-        with pa.ipc.new_stream(sink, schema) as writer:
-            writer.write_batch(batch)
-        return [_MARKER_ARROW, sink.getvalue(), pickle.dumps(sidecar_cols, protocol=5)]
+        meta_extra = {
+            'item_id': ([int(part) for part in obj.item_id]
+                        if obj.item_id is not None else None),
+            # resilience sidecar (docs/robustness.md): plain-JSON fields, so the
+            # quarantine ledger and retry counters cross the process boundary
+            # without pickling framework types
+            'retries': int(getattr(obj, 'retries', 0) or 0),
+            'quarantine': (obj.quarantine.as_dict()
+                           if getattr(obj, 'quarantine', None) is not None
+                           else None),
+            # cache-observability sidecar: None = cache bypassed/not applicable
+            'cache_hit': getattr(obj, 'cache_hit', None),
+        }
+        ipc_buf, sidecar_blob, _ = encode_columnar(obj.columns, obj.num_rows,
+                                                   meta_extra)
+        return [_MARKER_ARROW, ipc_buf, sidecar_blob]
 
     def deserialize(self, frames):
         marker = _as_bytes(frames[0])
         if marker == _MARKER_PICKLE:
-            return PickleSerializer().deserialize(frames)
-        import pyarrow as pa
+            self.stats['batches'] += 1
+            self.stats['bytes_copied'] += len(_as_memory(frames[1]))
+            return pickle.loads(_as_bytes(frames[1]))
         from petastorm_tpu.reader_worker import ColumnarBatch
 
-        buf = pa.py_buffer(_as_memory(frames[1]))
-        with pa.ipc.open_stream(buf) as reader:
-            batch = reader.read_next_batch()
-            meta = json.loads(batch.schema.metadata[_META_KEY].decode('utf-8'))
-        columns = pickle.loads(_as_bytes(frames[2]))
-        for i, field in enumerate(batch.schema):
-            col = batch.column(i)
-            spec = meta['columns'][field.name]
-            shape = tuple(spec['shape'])
-            if shape:
-                values = col.flatten().to_numpy(zero_copy_only=(len(col) > 0))
-                values = values.reshape((len(col),) + shape)
-            else:
-                values = col.to_numpy(zero_copy_only=(len(col) > 0))
-            # astype(copy=False) is a no-op when dtypes already match (the usual case)
-            values = values.astype(spec['dtype'], copy=False)
-            if self._writable and not values.flags.writeable:
-                values = values.copy()
-            columns[field.name] = values
+        columns, meta = decode_columnar(frames[1], frames[2],
+                                        writable=self._writable, stats=self.stats)
         item_id = meta['item_id']
         quarantine = meta.get('quarantine')
         if quarantine is not None:
@@ -137,7 +233,8 @@ class ArrowIpcSerializer(object):
             quarantine = QuarantineRecord(**quarantine)
         return ColumnarBatch(columns, meta['num_rows'],
                              item_id=tuple(item_id) if item_id is not None else None,
-                             retries=meta.get('retries', 0), quarantine=quarantine)
+                             retries=meta.get('retries', 0), quarantine=quarantine,
+                             cache_hit=meta.get('cache_hit'))
 
 
 def _as_bytes(frame):

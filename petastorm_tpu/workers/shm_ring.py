@@ -1,0 +1,212 @@
+"""Shared-memory slot ring: the zero-copy transport under the process pool.
+
+The pool (consumer side) owns ONE ``multiprocessing.shared_memory`` segment divided
+into fixed-size slots, statically partitioned among the worker slots: worker ``w`` of
+``n`` owns slots ``[w * k, (w + 1) * k)`` for ``k = slots_per_worker``. A worker
+serializes each result (Arrow IPC stream + pickled sidecar — the same frames the ZMQ
+wire carries) into one of ITS free slots and ships only a ~100-byte JSON descriptor
+``{w, g, s, lens}`` over the existing results channel; the consumer maps the slot
+zero-copy (``memoryview`` slices handed to the payload serializer, which reads them
+through ``pa.BufferReader`` / ``to_numpy(zero_copy_only=True)``) and acks the slot back
+to the producing worker with a ``release`` message on the dispatch ROUTER.
+
+Correctness properties this layout buys:
+
+- **Backpressure**: a worker with no free slot blocks (polling for release acks)
+  before falling back to the ZMQ frames — the bounded slot count is the transport's
+  flow control, mirroring the results queue HWM.
+- **Leak-proof reclamation**: the segment has exactly one owner (the pool). Workers
+  attach without registering with their resource tracker, so a SIGKILL-ed worker
+  cannot unlink the segment behind the pool's back, and ``ProcessPool.join()`` always
+  closes AND unlinks it — no ``/dev/shm`` residue regardless of worker deaths.
+- **Respawn safety**: descriptors carry the producing worker's generation. After a
+  respawn the pool bumps the slot generation, so a stale descriptor (written by the
+  dead worker, still sitting in the results buffer) is dropped instead of read while
+  the replacement worker may already be overwriting the slot; the replacement starts
+  with its whole slot range free.
+
+Static partitioning (vs a shared free list) is what makes worker death trivial to
+reason about: no cross-process allocator state can be corrupted mid-crash.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import secrets
+from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: default payload capacity of one slot; a decoded rowgroup batch beyond this falls
+#: back to the ZMQ frames (see the fallback matrix in docs/performance.md)
+DEFAULT_SLOT_BYTES: int = 32 << 20
+#: default slots owned by each worker — the transport's in-flight bound per worker
+DEFAULT_SLOTS_PER_WORKER: int = 4
+
+
+def _shared_memory_module():  # type: ignore[no-untyped-def]
+    """Import hook kept separate so environments without ``multiprocessing.
+    shared_memory`` (or with it disabled) degrade to the ZMQ wire, never crash."""
+    from multiprocessing import shared_memory
+    return shared_memory
+
+
+class ShmSlotDescriptor:
+    """Parsed wire descriptor of one shm-resident payload: producing worker slot,
+    its generation, the ring slot index, and the byte length of each serialized
+    frame laid out back-to-back in the slot."""
+
+    __slots__ = ('worker_slot', 'generation', 'ring_slot', 'frame_lengths')
+
+    def __init__(self, worker_slot: int, generation: int, ring_slot: int,
+                 frame_lengths: Sequence[int]) -> None:
+        self.worker_slot = worker_slot
+        self.generation = generation
+        self.ring_slot = ring_slot
+        self.frame_lengths = list(frame_lengths)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.frame_lengths)
+
+    def to_bytes(self) -> bytes:
+        return json.dumps({'w': self.worker_slot, 'g': self.generation,
+                           's': self.ring_slot,
+                           'lens': self.frame_lengths}).encode('utf-8')
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> 'ShmSlotDescriptor':
+        spec = json.loads(bytes(blob).decode('utf-8'))
+        return cls(int(spec['w']), int(spec['g']), int(spec['s']),
+                   [int(n) for n in spec['lens']])
+
+
+class ShmRing:
+    """Consumer-side owner of the shared-memory segment (create + unlink)."""
+
+    def __init__(self, workers_count: int,
+                 slots_per_worker: int = DEFAULT_SLOTS_PER_WORKER,
+                 slot_bytes: int = DEFAULT_SLOT_BYTES) -> None:
+        if workers_count < 1 or slots_per_worker < 1 or slot_bytes < 1024:
+            raise ValueError('ShmRing needs >=1 worker, >=1 slot/worker and '
+                             '>=1KiB slots')
+        shared_memory = _shared_memory_module()
+        self.workers_count = workers_count
+        self.slots_per_worker = slots_per_worker
+        self.slot_bytes = slot_bytes
+        total = workers_count * slots_per_worker * slot_bytes
+        # Explicit name (not the psm_ default): tests and operators can find (and
+        # assert the absence of) our segments in /dev/shm by prefix.
+        self.name = 'ptpu-ring-' + secrets.token_hex(8)
+        self._shm = shared_memory.SharedMemory(name=self.name, create=True,
+                                               size=total)
+        self._closed = False
+
+    def view(self, descriptor: ShmSlotDescriptor) -> List[memoryview]:
+        """Zero-copy memoryviews over the descriptor's frames, in frame order."""
+        if descriptor.ring_slot >= self.workers_count * self.slots_per_worker:
+            raise ValueError('descriptor names slot {} outside the ring'
+                             .format(descriptor.ring_slot))
+        if descriptor.total_bytes > self.slot_bytes:
+            raise ValueError('descriptor claims {} bytes > slot size {}'
+                             .format(descriptor.total_bytes, self.slot_bytes))
+        base = descriptor.ring_slot * self.slot_bytes
+        views: List[memoryview] = []
+        offset = base
+        for length in descriptor.frame_lengths:
+            views.append(self._shm.buf[offset:offset + length])
+            offset += length
+        return views
+
+    def close_and_unlink(self) -> None:
+        """Release the mapping and remove the segment from /dev/shm (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._shm.close()
+        finally:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:  # already gone (double-unlink race)
+                pass
+
+    def worker_spec(self) -> Dict[str, int]:
+        """The bootstrap fields a worker needs to attach its writer."""
+        return {'slots_per_worker': self.slots_per_worker,
+                'slot_bytes': self.slot_bytes}
+
+
+class ShmRingWriter:
+    """Worker-side attachment: writes serialized frames into this worker's slot
+    range and tracks which of its slots are awaiting a release ack."""
+
+    def __init__(self, name: str, worker_slot: int, generation: int,
+                 slots_per_worker: int, slot_bytes: int) -> None:
+        shared_memory = _shared_memory_module()
+        self.worker_slot = worker_slot
+        self.generation = generation
+        self.slot_bytes = slot_bytes
+        self._first_slot = worker_slot * slots_per_worker
+        self._slots_per_worker = slots_per_worker
+        self._free = list(range(self._first_slot,
+                                self._first_slot + slots_per_worker))
+        try:
+            self._shm = shared_memory.SharedMemory(name=name, track=False)  # type: ignore[call-arg]
+        except TypeError:
+            # Python < 3.13: attaching registers with THIS process's resource
+            # tracker, which would unlink the pool's segment when the worker
+            # exits. Undo the registration — the pool is the sole owner.
+            self._shm = shared_memory.SharedMemory(name=name)
+            try:
+                from multiprocessing import resource_tracker
+                resource_tracker.unregister(self._shm._name, 'shared_memory')  # type: ignore[attr-defined]
+            except Exception:  # pragma: no cover - tracker internals shifted
+                logger.warning('could not unregister shm segment from the '
+                               'resource tracker; pool-side unlink still wins',
+                               exc_info=True)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def fits(self, frames: Sequence[bytes]) -> bool:
+        return sum(len(memoryview(f)) for f in frames) <= self.slot_bytes
+
+    def try_write(self, frames: Sequence[bytes]) -> Optional[ShmSlotDescriptor]:
+        """Copy ``frames`` back-to-back into a free slot; None when no slot is
+        free or the payload exceeds the slot size (caller falls back to ZMQ)."""
+        if not self._free or not self.fits(frames):
+            return None
+        ring_slot = self._free.pop()
+        base = ring_slot * self.slot_bytes
+        offset = base
+        lengths: List[int] = []
+        for frame in frames:
+            view = memoryview(frame).cast('B')
+            self._shm.buf[offset:offset + view.nbytes] = view
+            offset += view.nbytes
+            lengths.append(view.nbytes)
+        return ShmSlotDescriptor(self.worker_slot, self.generation, ring_slot,
+                                 lengths)
+
+    def release(self, ring_slot: int) -> None:
+        """Consumer ack arrived: the slot may be reused. Acks outside this
+        writer's static partition (stale routing after a respawn) are ignored."""
+        if not (self._first_slot <= ring_slot
+                < self._first_slot + self._slots_per_worker):
+            return
+        if ring_slot not in self._free:
+            self._free.append(ring_slot)
+
+    def slot_range(self) -> Tuple[int, int]:
+        """(first_slot, slots_per_worker) of this writer's static partition."""
+        return self._first_slot, self._slots_per_worker
+
+    def close(self) -> None:
+        """Detach the mapping (the pool owns the unlink)."""
+        try:
+            self._shm.close()
+        except Exception:  # pragma: no cover - already closed
+            pass
