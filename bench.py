@@ -154,7 +154,7 @@ _HEADLINE_FALLBACKS = (
 
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
-                 'flash', 'moe', 'wire_bench')
+                 'flash', 'moe', 'wire_bench', 'telemetry')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -163,9 +163,10 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'wire_bench', 'mnist_scan_stream', 'flash',
-                     'moe', 'imagenet_scan', 'imagenet_stream', 'decode_delta',
-                     'bare_reader', 'mnist_stream')
+SECTION_RUN_ORDER = ('mnist_inmem', 'wire_bench', 'telemetry',
+                     'mnist_scan_stream', 'flash', 'moe', 'imagenet_scan',
+                     'imagenet_stream', 'decode_delta', 'bare_reader',
+                     'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
 
@@ -1414,6 +1415,41 @@ def child_main():
             cache_rows=int(os.environ.get('BENCH_WIRE_CACHE_ROWS', 1500)))
         results.update({'wire_' + key: value for key, value in fields.items()})
 
+    def run_telemetry():
+        """Stage-time-share breakdown (fast, host-only): one instrumented epoch
+        over the MNIST store through a spawned process pool (shm transport
+        auto), then the bottleneck attribution — so the perf trajectory records
+        WHERE the pipeline spends its time, not just how fast it went
+        (docs/observability.md)."""
+        from petastorm_tpu.telemetry.analyze import attribute_bottleneck
+        reader = make_reader(url, reader_pool_type='process',
+                             workers_count=min(WORKERS, 2), num_epochs=1,
+                             shuffle_row_groups=False)
+        rows = 0
+        start = time.perf_counter()
+        for batch in reader.iter_columnar():
+            rows += batch.num_rows
+        elapsed = time.perf_counter() - start
+        snapshot = reader.telemetry_snapshot()
+        diag = reader.diagnostics
+        reader.stop()
+        reader.join()
+        report = attribute_bottleneck(snapshot)
+        log('telemetry: {} rows in {:.2f}s; top stage {} ({:.0%}) -> {}'.format(
+            rows, elapsed, report['top_stage'], report['top_share'],
+            report['recommendation']))
+        fields = {
+            'telemetry_rows_per_sec': round(rows / elapsed, 1),
+            'telemetry_total_stage_seconds': report['total_stage_seconds'],
+            'telemetry_top_stage': report['top_stage'],
+            'telemetry_top_share': report['top_share'],
+            'telemetry_recommendation': report['recommendation'],
+            'telemetry_shm_batches': diag.get('shm_batches', 0),
+        }
+        for entry in report['ranked']:
+            fields['telemetry_stage_share_' + entry['stage']] = entry['share']
+        results.update(fields)
+
     def run_decode():
         decode_host, decode_onchip = run_decode_delta()
         results.update({
@@ -1434,6 +1470,7 @@ def child_main():
         'flash': run_flash,
         'moe': run_moe,
         'wire_bench': run_wire_bench,
+        'telemetry': run_telemetry,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

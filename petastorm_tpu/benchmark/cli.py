@@ -3,7 +3,10 @@ petastorm/benchmark/cli.py / petastorm-throughput.py console script).
 
 Subcommands: a first positional of ``wire-bench`` dispatches to
 :mod:`petastorm_tpu.benchmark.wire_bench` (zero-copy data-plane microbench, JSON
-output); anything else is the legacy dataset-throughput measurement."""
+output); ``analyze`` dispatches to :mod:`petastorm_tpu.telemetry.analyze` (stage
+time-share ranking + bottleneck-to-knob mapping over a telemetry snapshot /
+JSONL event log — docs/observability.md); anything else is the legacy
+dataset-throughput measurement."""
 
 import argparse
 import logging
@@ -22,6 +25,9 @@ def main(argv=None):
     if argv and argv[0] == 'wire-bench':
         from petastorm_tpu.benchmark.wire_bench import main as wire_bench_main
         return wire_bench_main(argv[1:])
+    if argv and argv[0] == 'analyze':
+        from petastorm_tpu.telemetry.analyze import main as analyze_main
+        return analyze_main(argv[1:])
     parser = argparse.ArgumentParser(
         description='Measure petastorm_tpu reader throughput on a dataset')
     parser.add_argument('dataset_url')

@@ -31,6 +31,8 @@ import struct
 import tempfile
 import threading
 
+from petastorm_tpu.telemetry.spans import stage_span
+
 logger = logging.getLogger(__name__)
 
 MB = 1 << 20
@@ -161,24 +163,28 @@ class LocalDiskCache(CacheBase):
         return value
 
     def _store(self, file_path, value):
-        os.makedirs(os.path.dirname(file_path), exist_ok=True)
-        blob = self._encode_value(value)
-        if len(blob) > self._size_limit_bytes:
-            return  # single value larger than the cache: do not thrash
-        # mkstemp + os.replace: concurrent fillers of the same key each write a
-        # private temp file and atomically publish it — readers only ever see a
-        # complete entry (last writer wins; both writers hold equivalent values).
-        fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(file_path))
-        try:
-            with os.fdopen(fd, 'wb') as f:
-                f.write(blob)
-            os.replace(tmp_path, file_path)
-        except OSError:
+        # cache_store stage span (docs/observability.md): encode + write + publish
+        # — first-epoch-only cost unless eviction churns
+        with stage_span('cache_store'):
+            os.makedirs(os.path.dirname(file_path), exist_ok=True)
+            blob = self._encode_value(value)
+            if len(blob) > self._size_limit_bytes:
+                return  # single value larger than the cache: do not thrash
+            # mkstemp + os.replace: concurrent fillers of the same key each write a
+            # private temp file and atomically publish it — readers only ever see a
+            # complete entry (last writer wins; both writers hold equivalent
+            # values).
+            fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(file_path))
             try:
-                os.unlink(tmp_path)
+                with os.fdopen(fd, 'wb') as f:
+                    f.write(blob)
+                os.replace(tmp_path, file_path)
             except OSError:
-                pass
-            raise
+                try:
+                    os.unlink(tmp_path)
+                except OSError:
+                    pass
+                raise
         with self._lock:
             self.stats['bytes_written'] += len(blob)
             if self._approx_bytes is None:

@@ -54,7 +54,12 @@ def _trace_span(name):
 class LoaderStats(object):
     """Thread-safe loader counters (batches/rows, wait vs total time); the input
     stall fraction ``wait_time_s / total_time_s`` is the bench's efficiency
-    metric. The upload-mode counters make the H2D path observable in captured
+    metric. Mutation happens through :meth:`add` (deltas) and :meth:`mirror`
+    (absolute values) under one internal lock — the loader writes from BOTH its
+    consumer thread (per-batch accounting) and its producer thread (reader-stat
+    mirroring), so bare ``stats.field += 1`` would lose updates under the race.
+    ``as_dict`` snapshots every field under the same lock (one consistent view).
+    The upload-mode counters make the H2D path observable in captured
     bench lines: a hardware capture can PROVE whether the coalesced
     single-transfer path engaged (``coalesced_uploads``) or each field shipped
     separately (``per_field_uploads`` — also counts mesh-path uploads).
@@ -69,9 +74,18 @@ class LoaderStats(object):
     all hits), ``shm_batches``/``shm_fallback_batches`` (which transport the process
     pool's results actually took) and ``wire_bytes_copied_per_batch`` (bytes
     materialized into new host memory per result batch — the number the shm ring
-    exists to shrink)."""
+    exists to shrink; a true running mean from the pool's ``wire_bytes_copied``
+    histogram, so multi-pool and mixed-transport runs report the stream-wide
+    mean, not the last pool's last value)."""
+
+    _FIELDS = ('batches', 'rows', 'wait_time_s', 'total_time_s',
+               'coalesced_uploads', 'per_field_uploads', 'io_retries',
+               'rowgroups_quarantined', 'cache_hits', 'cache_misses',
+               'shm_batches', 'shm_fallback_batches',
+               'wire_bytes_copied_per_batch')
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.batches = 0
         self.rows = 0
         self.wait_time_s = 0.0
@@ -86,26 +100,41 @@ class LoaderStats(object):
         self.shm_fallback_batches = 0
         self.wire_bytes_copied_per_batch = 0.0
 
+    def add(self, **deltas):
+        """Add keyword deltas to counter fields atomically (one lock hold)."""
+        with self._lock:
+            for name, delta in deltas.items():
+                if name not in self._FIELDS:
+                    raise AttributeError('unknown LoaderStats field {!r}'
+                                         .format(name))
+                setattr(self, name, getattr(self, name) + delta)
+
+    def mirror(self, **values):
+        """Set absolute values for mirrored counters atomically (reader/pool
+        counters copied into the loader surface)."""
+        with self._lock:
+            for name, value in values.items():
+                if name not in self._FIELDS:
+                    raise AttributeError('unknown LoaderStats field {!r}'
+                                         .format(name))
+                setattr(self, name, value)
+
     @property
     def input_stall_fraction(self):
-        if self.total_time_s <= 0:
-            return 0.0
-        return min(1.0, self.wait_time_s / self.total_time_s)
+        with self._lock:
+            if self.total_time_s <= 0:
+                return 0.0
+            return min(1.0, self.wait_time_s / self.total_time_s)
 
     def as_dict(self):
-        return {'batches': self.batches, 'rows': self.rows,
-                'wait_time_s': round(self.wait_time_s, 4),
-                'total_time_s': round(self.total_time_s, 4),
-                'input_stall_fraction': round(self.input_stall_fraction, 4),
-                'coalesced_uploads': self.coalesced_uploads,
-                'per_field_uploads': self.per_field_uploads,
-                'io_retries': self.io_retries,
-                'rowgroups_quarantined': self.rowgroups_quarantined,
-                'cache_hits': self.cache_hits,
-                'cache_misses': self.cache_misses,
-                'shm_batches': self.shm_batches,
-                'shm_fallback_batches': self.shm_fallback_batches,
-                'wire_bytes_copied_per_batch': self.wire_bytes_copied_per_batch}
+        with self._lock:
+            snapshot = {name: getattr(self, name) for name in self._FIELDS}
+        stall = (min(1.0, snapshot['wait_time_s'] / snapshot['total_time_s'])
+                 if snapshot['total_time_s'] > 0 else 0.0)
+        snapshot['wait_time_s'] = round(snapshot['wait_time_s'], 4)
+        snapshot['total_time_s'] = round(snapshot['total_time_s'], 4)
+        snapshot['input_stall_fraction'] = round(stall, 4)
+        return snapshot
 
 
 class JaxDataLoader(object):
@@ -159,6 +188,14 @@ class JaxDataLoader(object):
         self.reader = reader
         self.batch_size = batch_size
         self.stats = LoaderStats()
+        # Loader-side stage telemetry (docs/observability.md): shuffle_wait /
+        # collate / h2d histograms; telemetry_snapshot() merges in the reader's
+        # cross-process view. PETASTORM_TPU_TELEMETRY_JSONL streams periodic
+        # snapshots from the consumer loop.
+        from petastorm_tpu.telemetry import MetricsRegistry
+        from petastorm_tpu.telemetry.export import logger_from_env
+        self.telemetry = MetricsRegistry()
+        self._telemetry_jsonl = logger_from_env()
         self._mesh = mesh
         self._partition_spec = partition_spec
         self._pad_ragged = dict(pad_ragged or {})
@@ -243,11 +280,16 @@ class JaxDataLoader(object):
                     self._mark_delivered(None)  # drop_last / buffer-drain leftovers
                     return
                 batch, local_rows = item
-                self.stats.wait_time_s += now - wait_start
-                self.stats.total_time_s += now - last_emit
+                self.stats.add(wait_time_s=now - wait_start,
+                               total_time_s=now - last_emit,
+                               batches=1, rows=local_rows)
+                # shuffle_wait: time the training loop sat blocked on the input
+                # pipeline for this batch — the stage the stall fraction sums
+                self.telemetry.observe('shuffle_wait', now - wait_start)
+                if self._telemetry_jsonl is not None and self._telemetry_jsonl.due():
+                    self._telemetry_jsonl.emit(self.telemetry_snapshot(),
+                                               event='loader_interval')
                 last_emit = now
-                self.stats.batches += 1
-                self.stats.rows += local_rows
                 self._mark_delivered(local_rows)
                 yield batch
         finally:
@@ -333,25 +375,43 @@ class JaxDataLoader(object):
         data-plane counters (cache hits, shm transport, wire bytes copied) — into
         LoaderStats so training jobs watching only the loader still see input
         degradation (docs/robustness.md, docs/performance.md)."""
+        mirrored = {}
         retries = getattr(self.reader, 'io_retries', None)
         if retries is not None:
-            self.stats.io_retries = retries
+            mirrored['io_retries'] = retries
         ledger = getattr(self.reader, 'quarantine', None)
         if ledger is not None:
-            self.stats.rowgroups_quarantined = len(ledger)
+            mirrored['rowgroups_quarantined'] = len(ledger)
         try:
             diag = getattr(self.reader, 'diagnostics', None)
         except Exception:  # noqa: BLE001 - wrapper readers may not expose it
-            return
-        if not isinstance(diag, dict):
-            return
-        for key in ('cache_hits', 'cache_misses', 'shm_batches',
-                    'shm_fallback_batches', 'wire_bytes_copied_per_batch'):
-            if key in diag:
-                setattr(self.stats, key, diag[key])
+            diag = None
+        if isinstance(diag, dict):
+            for key in ('cache_hits', 'cache_misses', 'shm_batches',
+                        'shm_fallback_batches'):
+                if key in diag:
+                    mirrored[key] = diag[key]
+            # wire_bytes_copied_per_batch: a TRUE running mean over the whole
+            # stream, from the pool's wire_bytes_copied histogram (sum/count) —
+            # the diagnostics scalar is a last-writer value that misreports
+            # multi-pool / mixed-transport runs.
+            hist = (diag.get('telemetry', {}).get('histograms', {})
+                    .get('wire_bytes_copied'))
+            if hist and hist.get('count'):
+                mirrored['wire_bytes_copied_per_batch'] = round(
+                    float(hist['sum']) / int(hist['count']), 1)
+            elif 'wire_bytes_copied_per_batch' in diag:
+                mirrored['wire_bytes_copied_per_batch'] = \
+                    diag['wire_bytes_copied_per_batch']
+        if mirrored:
+            self.stats.mirror(**mirrored)
 
     def _sanitize(self, columns):
-        return sanitize_columns(columns, self._pad_ragged, self._device_put)
+        # collate stage: host batch assembly — dtype sanitization + ragged padding
+        collate_start = time.perf_counter()
+        out = sanitize_columns(columns, self._pad_ragged, self._device_put)
+        self.telemetry.observe('collate', time.perf_counter() - collate_start)
+        return out
 
     def _emit(self, columns, out_queue, stop_event):
         local_rows = self._batch_cols_rows(columns)
@@ -361,19 +421,21 @@ class JaxDataLoader(object):
             if isinstance(sharding, FieldShardings) and not self._spec_keys_checked:
                 self._spec_keys_checked = True
                 sharding.check_unused(columns.keys())
+            h2d_start = time.perf_counter()
             with _trace_span('petastorm_tpu.loader.h2d'):
                 if self._mesh is not None:
                     batch = {name: jax.make_array_from_process_local_data(
                                  sharding_for_field(sharding, name), col)
                              for name, col in columns.items()}
-                    self.stats.per_field_uploads += 1
+                    self.stats.add(per_field_uploads=1)
                 elif (self._coalesce_enabled()
                       and (layout := coalescible_layout(columns)) is not None):
                     batch = self._put_coalesced(columns, sharding, layout)
-                    self.stats.coalesced_uploads += 1
+                    self.stats.add(coalesced_uploads=1)
                 else:
                     batch = jax.device_put(columns, sharding)
-                    self.stats.per_field_uploads += 1
+                    self.stats.add(per_field_uploads=1)
+            self.telemetry.observe('h2d', time.perf_counter() - h2d_start)
         else:
             batch = columns
         # Host-local row count travels alongside: with a multi-process mesh the device
@@ -517,6 +579,7 @@ class JaxDataLoader(object):
             chunk = {name: np.ascontiguousarray(
                          col.reshape((n_batches, batch_size) + col.shape[1:]))
                      for name, col in columns.items()}
+            h2d_start = time.perf_counter()
             with _trace_span('petastorm_tpu.loader.scan_stream.h2d'):
                 if self._mesh is not None:
                     # Same upload contract as __iter__'s mesh path: host-local
@@ -531,6 +594,7 @@ class JaxDataLoader(object):
                     chunk = self._put_coalesced(chunk, sharding, layout)
                 else:
                     chunk = jax.device_put(chunk, sharding)
+            self.telemetry.observe('h2d', time.perf_counter() - h2d_start)
             key = (step_fn, n_batches)
             if key not in programs:
                 @jax.jit
@@ -651,6 +715,20 @@ class JaxDataLoader(object):
                 epoch - self._epochs_delivered: sorted(ids)
                 for epoch, ids in self._delivered_by_epoch.items()},
         }
+
+    # ------------------------------------------------------------------ telemetry
+
+    def telemetry_snapshot(self):
+        """One JSON-safe telemetry snapshot covering the WHOLE pipeline: the
+        loader's own stages (shuffle_wait/collate/h2d) merged with the reader's
+        cross-process snapshot (worker stages + pool registry). Feed it to
+        ``petastorm_tpu.telemetry.analyze.attribute_bottleneck`` (or the
+        ``petastorm-tpu-throughput analyze`` CLI) for the bottleneck report."""
+        from petastorm_tpu.telemetry import merge_snapshots
+        reader_snapshot_fn = getattr(self.reader, 'telemetry_snapshot', None)
+        if reader_snapshot_fn is None:
+            return self.telemetry.snapshot()
+        return merge_snapshots(self.telemetry.snapshot(), reader_snapshot_fn())
 
     # ------------------------------------------------------------------ lifecycle
 

@@ -265,6 +265,12 @@ class Reader(object):
         self._cache = cache
         self._cache_hits = 0
         self._cache_misses = 0
+        # Pipeline telemetry (docs/observability.md): worker-process stage times
+        # arrive on each batch's telemetry sidecar and merge here; pool-level
+        # registries merge at snapshot time, so telemetry_snapshot() covers every
+        # process that touched this reader's rows.
+        from petastorm_tpu.telemetry import MetricsRegistry
+        self._telemetry = MetricsRegistry()
 
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
@@ -537,10 +543,16 @@ class Reader(object):
             if self.ngram is not None:
                 # NGramWindows payload (shared columns + gather starts) -> dense
                 # window-major arrays, one vectorized gather per column. item_id
-                # rides along so delivery accounting / resume see the piece.
+                # rides along so delivery accounting / resume see the piece —
+                # and so do the resilience/cache/telemetry sidecars, which
+                # _note_item_consumed below accounts from this rebuilt batch.
                 batch = ColumnarBatch(
                     self.ngram.windows_as_arrays(batch.columns, batch.starts),
-                    len(batch.starts), item_id=batch.item_id)
+                    len(batch.starts), item_id=batch.item_id,
+                    retries=getattr(batch, 'retries', 0),
+                    quarantine=getattr(batch, 'quarantine', None),
+                    cache_hit=getattr(batch, 'cache_hit', None),
+                    telemetry=getattr(batch, 'telemetry', None))
             self._note_item_consumed(batch)
             if self._resume_fast_forward and batch.item_id is not None:
                 # Honor a row_cursor from a row-path checkpoint: skip the rows that
@@ -580,6 +592,11 @@ class Reader(object):
                     self._cache_hits += 1
                 else:
                     self._cache_misses += 1
+        stage_times = getattr(batch, 'telemetry', None)
+        if stage_times:
+            # cross-process span merge: the sidecar is a {stage: hist_snapshot}
+            # dict (additive, so respawned workers merge like any other)
+            self._telemetry.merge_stage_times(stage_times)
         item_id = getattr(batch, 'item_id', None)
         if item_id is None:
             return
@@ -674,6 +691,27 @@ class Reader(object):
         with self._accounting_lock:
             return self._io_retries
 
+    @property
+    def telemetry(self):
+        """The reader's consumer-side :class:`~petastorm_tpu.telemetry.MetricsRegistry`
+        (worker sidecar merges land here); prefer :meth:`telemetry_snapshot` for
+        the pool-inclusive view."""
+        return self._telemetry
+
+    def telemetry_snapshot(self):
+        """One JSON-safe telemetry snapshot covering every process: the reader's
+        registry (which absorbed the worker-sidecar stage times) merged with the
+        pool's consumer-side registry (shm_map/shm_release/pool_wait,
+        wire_bytes_copied). Feed it to
+        :func:`petastorm_tpu.telemetry.analyze.attribute_bottleneck` or
+        :func:`petastorm_tpu.telemetry.export.to_prometheus_text`."""
+        from petastorm_tpu.telemetry import merge_snapshots
+        pool_registry = getattr(self._pool, 'telemetry', None)
+        if pool_registry is None:
+            return self._telemetry.snapshot()
+        return merge_snapshots(self._telemetry.snapshot(),
+                               pool_registry.snapshot())
+
     # ------------------------------------------------------------- lifecycle
 
     def stop(self):
@@ -704,6 +742,9 @@ class Reader(object):
             diag['cache'] = dict(cache_stats)
         diag['rowgroups_quarantined'] = len(self.quarantine)
         diag['quarantine'] = self.quarantine.as_dicts()
+        # One cross-process telemetry snapshot (docs/observability.md): per-stage
+        # latency histograms merged from every worker sidecar + the pool registry.
+        diag['telemetry'] = self.telemetry_snapshot()
         return diag
 
     def __enter__(self):

@@ -15,11 +15,14 @@ Pipeline per rowgroup (reference call stack: SURVEY.md §3.2):
 import hashlib
 import logging
 import re
+import time
 
 import numpy as np
 import pyarrow.dataset as pads
 
 from petastorm_tpu.cache import NullCache
+from petastorm_tpu.telemetry.spans import (drain_stage_times, record_stage,
+                                           stage_span)
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.workers.serializers import _columns_num_rows
 from petastorm_tpu.workers.worker_base import WorkerBase
@@ -44,19 +47,27 @@ class ColumnarBatch(object):
     from the rowgroup cache, False on a miss that filled it, None when no cache applied
     (NullCache, unpicklable predicate bypass, quarantined/ngram stand-ins). It rides
     the results channel like ``retries`` so ``Reader.diagnostics`` counts hits/misses
-    identically across all pools."""
+    identically across all pools.
+
+    ``telemetry`` is the stage-span sidecar (docs/observability.md): a JSON-safe
+    ``{stage: histogram_snapshot}`` of the time this worker spent per pipeline
+    stage since its previous publish, drained from the process-local
+    :class:`~petastorm_tpu.telemetry.spans.StageRecorder`. It rides the results
+    channel like ``cache_hit`` and merges into the consumer-side registry — one
+    ``Reader.telemetry_snapshot()`` covers all processes."""
 
     __slots__ = ('columns', 'num_rows', 'item_id', 'retries', 'quarantine',
-                 'cache_hit')
+                 'cache_hit', 'telemetry')
 
     def __init__(self, columns, num_rows, item_id=None, retries=0, quarantine=None,
-                 cache_hit=None):
+                 cache_hit=None, telemetry=None):
         self.columns = columns
         self.num_rows = num_rows
         self.item_id = item_id
         self.retries = retries
         self.quarantine = quarantine
         self.cache_hit = cache_hit
+        self.telemetry = telemetry
 
 
 class WorkerSetup(object):
@@ -124,8 +135,16 @@ class RowGroupWorker(WorkerBase):
 
     def _fs(self):
         if self._filesystem is None:
-            self._filesystem = self._setup.filesystem_factory()
+            with stage_span('fs_open'):
+                self._filesystem = self._setup.filesystem_factory()
         return self._filesystem
+
+    def _publish(self, payload):
+        """Single publish funnel: attach the stage-span telemetry sidecar (this
+        thread's accumulation since its previous publish — docs/observability.md)
+        and hand the payload to the pool's results channel."""
+        payload.telemetry = drain_stage_times()
+        self.publish_func(payload)
 
     def process(self, piece_index, fragment_path, row_group_id, partition_keys=None,
                 worker_predicate=None, shuffle_row_drop_partition=(0, 1), epoch_index=0):
@@ -174,7 +193,7 @@ class RowGroupWorker(WorkerBase):
             # the reader's consumption accounting stays exact (same contract as the
             # row path's empty ColumnarBatch below).
             payload.retries = retry_cell[0]
-            self.publish_func(payload)
+            self._publish(payload)
             return
 
         try:
@@ -199,9 +218,17 @@ class RowGroupWorker(WorkerBase):
                     filled[0] = True
                     return with_retry(load)
 
+                cache_applies = not isinstance(setup.cache, NullCache)
+                cache_start = time.perf_counter()
                 columns = setup.cache.get(cache_key, fill)
-                if not isinstance(setup.cache, NullCache):
+                if cache_applies:
                     cache_hit = not filled[0]
+                    # cache_hit times serving from the cache; cache_miss is an
+                    # ENVELOPE span (it wraps the rowgroup_read/decode of the
+                    # fill) — attribution uses the leaf stages (telemetry/
+                    # analyze.py).
+                    record_stage('cache_hit' if cache_hit else 'cache_miss',
+                                 time.perf_counter() - cache_start)
             num_rows = _columns_num_rows(columns)
             if num_rows:
                 columns = self._shuffle(columns, num_rows, piece_index)
@@ -215,12 +242,12 @@ class RowGroupWorker(WorkerBase):
         if num_rows == 0:
             # Publish an empty batch anyway: every item must yield exactly one result so
             # the reader's consumption accounting (state_dict/resume) stays exact.
-            self.publish_func(ColumnarBatch({}, 0, item_id=item_id,
-                                            retries=retry_cell[0],
-                                            cache_hit=cache_hit))
+            self._publish(ColumnarBatch({}, 0, item_id=item_id,
+                                        retries=retry_cell[0],
+                                        cache_hit=cache_hit))
             return
-        self.publish_func(ColumnarBatch(columns, num_rows, item_id=item_id,
-                                        retries=retry_cell[0], cache_hit=cache_hit))
+        self._publish(ColumnarBatch(columns, num_rows, item_id=item_id,
+                                    retries=retry_cell[0], cache_hit=cache_hit))
 
     def _publish_quarantined(self, exc, item_id, piece_index, fragment_path,
                              row_group_id, retries):
@@ -237,11 +264,11 @@ class RowGroupWorker(WorkerBase):
                        type(exc).__name__, exc)
         if self._setup.ngram is not None:
             from petastorm_tpu.ngram_worker import NGramWindows
-            self.publish_func(NGramWindows({}, np.empty(0, np.int64), item_id=item_id,
-                                           retries=retries, quarantine=record))
+            self._publish(NGramWindows({}, np.empty(0, np.int64), item_id=item_id,
+                                       retries=retries, quarantine=record))
         else:
-            self.publish_func(ColumnarBatch({}, 0, item_id=item_id, retries=retries,
-                                            quarantine=record))
+            self._publish(ColumnarBatch({}, 0, item_id=item_id, retries=retries,
+                                        quarantine=record))
 
     # ------------------------------------------------------------------ load
 
@@ -264,7 +291,8 @@ class RowGroupWorker(WorkerBase):
                                                        all_fields)
         else:
             fragment = self._make_fragment(fragment_path, row_group_id)
-            table = fragment.to_table(columns=self._storage_columns(all_fields))
+            with stage_span('rowgroup_read'):
+                table = fragment.to_table(columns=self._storage_columns(all_fields))
             keep_indices = None
         num_rows = table.num_rows if keep_indices is None else len(keep_indices)
 
@@ -294,7 +322,9 @@ class RowGroupWorker(WorkerBase):
         if unknown:
             raise ValueError('Predicate references unknown fields {}'.format(unknown))
         fragment = self._make_fragment(fragment_path, row_group_id)
-        predicate_table = fragment.to_table(columns=self._storage_columns(predicate_fields))
+        with stage_span('rowgroup_read'):
+            predicate_table = fragment.to_table(
+                columns=self._storage_columns(predicate_fields))
         predicate_columns = self._decode_table(predicate_table, partition_keys,
                                                predicate_fields,
                                                fragment_path=fragment_path)
@@ -311,7 +341,8 @@ class RowGroupWorker(WorkerBase):
             return empty, np.array([], dtype=np.int64)
         # Re-read all needed columns (predicate columns included, so downstream sees one
         # consistent table) and filter by surviving indices.
-        full_table = fragment.to_table(columns=self._storage_columns(all_fields))
+        with stage_span('rowgroup_read'):
+            full_table = fragment.to_table(columns=self._storage_columns(all_fields))
         return full_table, keep
 
     def _evaluate_predicate(self, worker_predicate, predicate_columns, num_rows):
@@ -342,32 +373,35 @@ class RowGroupWorker(WorkerBase):
         partition_keys = partition_keys or {}
         num_rows = table.num_rows
         columns = {}
-        for name in field_names:
-            field = setup.schema.fields.get(name)
-            if name in setup.partition_field_names:
-                value = partition_keys.get(name)
-                columns[name] = self._partition_column(field, value, num_rows)
-                continue
-            arrow_col = table.column(name)
-            if field is not None and field.codec is not None and setup.decode:
-                try:
-                    decoded = field.codec.decode_arrow_column(field, arrow_col)
-                except Exception as exc:
-                    raise DecodeFieldError(
-                        'Failed to decode field {!r} of fragment {!r}: {}'
-                        .format(name, fragment_path, exc),
-                        field_name=name, fragment_path=fragment_path) from exc
-                if isinstance(decoded, np.ndarray):
-                    columns[name] = decoded  # codec returned a stacked fast-path column
-                else:
+        with stage_span('decode'):
+            for name in field_names:
+                field = setup.schema.fields.get(name)
+                if name in setup.partition_field_names:
+                    value = partition_keys.get(name)
+                    columns[name] = self._partition_column(field, value, num_rows)
+                    continue
+                arrow_col = table.column(name)
+                if field is not None and field.codec is not None and setup.decode:
+                    try:
+                        decoded = field.codec.decode_arrow_column(field, arrow_col)
+                    except Exception as exc:
+                        raise DecodeFieldError(
+                            'Failed to decode field {!r} of fragment {!r}: {}'
+                            .format(name, fragment_path, exc),
+                            field_name=name, fragment_path=fragment_path) from exc
+                    if isinstance(decoded, np.ndarray):
+                        # codec returned a stacked fast-path column
+                        columns[name] = decoded
+                    else:
+                        columns[name] = _stack_if_uniform(decoded, field)
+                elif field is not None and field.shape != () and setup.decode:
+                    values = arrow_col.to_pylist()
+                    decoded = [None if v is None
+                               else np.asarray(v, dtype=field.numpy_dtype)
+                               for v in values]
                     columns[name] = _stack_if_uniform(decoded, field)
-            elif field is not None and field.shape != () and setup.decode:
-                values = arrow_col.to_pylist()
-                decoded = [None if v is None else np.asarray(v, dtype=field.numpy_dtype)
-                           for v in values]
-                columns[name] = _stack_if_uniform(decoded, field)
-            else:
-                columns[name] = _arrow_to_numpy(arrow_col)
+                else:
+                    columns[name] = _arrow_to_numpy(arrow_col)
         return columns
 
     @staticmethod
@@ -383,9 +417,10 @@ class RowGroupWorker(WorkerBase):
         setup = self._setup
         if not setup.shuffle_rows:
             return columns
-        seed = None if setup.seed is None else (setup.seed + piece_index) % (2 ** 31)
-        permutation = np.random.RandomState(seed).permutation(num_rows)
-        return {name: _take(col, permutation) for name, col in columns.items()}
+        with stage_span('shuffle'):
+            seed = None if setup.seed is None else (setup.seed + piece_index) % (2 ** 31)
+            permutation = np.random.RandomState(seed).permutation(num_rows)
+            return {name: _take(col, permutation) for name, col in columns.items()}
 
     # ------------------------------------------------------------- transform
 
@@ -394,29 +429,32 @@ class RowGroupWorker(WorkerBase):
         spec = setup.transform_spec
         if spec is None:
             return columns, num_rows
-        if setup.batched_output:
-            import pandas as pd
-            frame = pd.DataFrame({name: list(col) if not isinstance(col, list) else col
-                                  for name, col in columns.items()})
+        with stage_span('transform'):
+            if setup.batched_output:
+                import pandas as pd
+                frame = pd.DataFrame({name: list(col) if not isinstance(col, list)
+                                      else col
+                                      for name, col in columns.items()})
+                if spec.func is not None:
+                    frame = spec.func(frame)
+                out = {}
+                for name in setup.result_schema.fields:
+                    field = setup.result_schema.fields[name]
+                    values = list(frame[name])
+                    out[name] = _stack_if_uniform(values, field)
+                return out, len(frame)
+            # Row path: func operates on one row dict at a time (reference:
+            # py_dict_reader_worker.py:40-54).
+            rows = [{name: col[i] for name, col in columns.items()}
+                    for i in range(num_rows)]
             if spec.func is not None:
-                frame = spec.func(frame)
+                rows = [spec.func(row) for row in rows]
             out = {}
             for name in setup.result_schema.fields:
                 field = setup.result_schema.fields[name]
-                values = list(frame[name])
+                values = [row[name] for row in rows]
                 out[name] = _stack_if_uniform(values, field)
-            return out, len(frame)
-        # Row path: func operates on one row dict at a time (reference:
-        # py_dict_reader_worker.py:40-54).
-        rows = [{name: col[i] for name, col in columns.items()} for i in range(num_rows)]
-        if spec.func is not None:
-            rows = [spec.func(row) for row in rows]
-        out = {}
-        for name in setup.result_schema.fields:
-            field = setup.result_schema.fields[name]
-            values = [row[name] for row in rows]
-            out[name] = _stack_if_uniform(values, field)
-        return out, len(rows)
+            return out, len(rows)
 
     # ----------------------------------------------------------------- ngram
 

@@ -1,0 +1,34 @@
+"""Pipeline telemetry: per-stage latency histograms, cross-process span merging,
+exportable snapshots, and bottleneck attribution (docs/observability.md).
+
+The subsystem has four layers:
+
+- :mod:`~petastorm_tpu.telemetry.registry` — the metric primitives: counters,
+  gauges, power-of-two-bucket histograms with lock-free per-thread write shards
+  merged on ``snapshot()``, and snapshot-level merge (the cross-process
+  primitive).
+- :mod:`~petastorm_tpu.telemetry.spans` — stage spans over the data plane
+  (``fs_open`` .. ``h2d``); worker-process spans ride each published batch's
+  ``telemetry`` sidecar on the results channel (like ``cache_hit``) and merge
+  into the consumer-side registry, so ONE snapshot covers every process.
+- :mod:`~petastorm_tpu.telemetry.export` — Prometheus text exposition and a
+  periodic JSONL event log.
+- :mod:`~petastorm_tpu.telemetry.analyze` — bottleneck attribution: rank stages
+  by time share, map the top stage to the knob that moves it
+  (``petastorm-tpu-throughput analyze``).
+
+Entry points on the pipeline objects: ``Reader.telemetry_snapshot()`` /
+``Reader.diagnostics['telemetry']`` and ``JaxDataLoader.telemetry_snapshot()``.
+``PETASTORM_TPU_TELEMETRY=0`` disables all instrumentation;
+``PETASTORM_TPU_TELEMETRY_JSONL=<path>`` streams periodic snapshots from the
+device loader.
+"""
+
+from petastorm_tpu.telemetry.registry import (Counter, Gauge,  # noqa: F401
+                                              Histogram, MetricsRegistry,
+                                              merge_snapshots,
+                                              set_telemetry_enabled,
+                                              telemetry_enabled)
+from petastorm_tpu.telemetry.spans import (STAGES, StageRecorder,  # noqa: F401
+                                           drain_stage_times, record_stage,
+                                           stage_span)

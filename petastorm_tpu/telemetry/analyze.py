@@ -1,0 +1,199 @@
+"""Bottleneck attribution: rank pipeline stages by time share and map the top
+stage to the knob that moves it (docs/observability.md "Reading an analyze
+report"; the analysis-tooling spirit of tf.data, arXiv 2101.12127).
+
+The input is any telemetry snapshot (``Reader.diagnostics['telemetry']``, a
+JSONL event log, a doctor ``--json`` report). Shares are computed over the LEAF
+latency stages only — envelope stages like ``cache_miss`` (which wraps
+``rowgroup_read`` + ``decode``) are reported but excluded from the denominator,
+so the shares of independent work sum sensibly. Stage seconds are summed across
+every process and thread that contributed, so a share is "fraction of all
+pipeline CPU/IO time", not wall-clock — with N parallel workers a 0.9 share can
+still hide behind prefetch, which is why the report pairs the ranking with the
+consumer-side ``shuffle_wait``/``pool_wait`` stages: those measure time the
+TRAINING side actually sat idle.
+
+CLI: ``petastorm-tpu-throughput analyze <snapshot.json|events.jsonl>`` (also
+``python -m petastorm_tpu.telemetry.analyze``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from petastorm_tpu.telemetry.registry import SECONDS_UNIT
+from petastorm_tpu.telemetry.spans import ENVELOPE_STAGES
+
+#: knob advice per dominant stage: (headline, detail) — the tuning map the
+#: tentpole exists to make mechanical (docs/observability.md)
+_KNOBS: Dict[str, Any] = {
+    'fs_open': ('check storage connectivity / keep filesystems warm',
+                'Filesystem construction dominates: remote stores with flaky '
+                'connections reconnect per retry — check on_error/retry_policy '
+                'counters and the storage backend before touching pool knobs.'),
+    'rowgroup_read': ('raise workers_count (IO-bound read)',
+                      'Parquet rowgroup IO dominates: more parallel readers '
+                      'overlap more IO (workers_count), and a local-disk cache '
+                      '(cache_type="local-disk", cache_format="arrow-ipc") '
+                      'removes the re-read on warm epochs entirely.'),
+    'decode': ('raise workers_count or cache decoded rowgroups',
+               'Codec decode dominates: decode parallelizes across workers '
+               '(workers_count; reader_pool_type="process" escapes the GIL for '
+               'pure-python codecs), and cache_format="arrow-ipc" makes warm '
+               'epochs skip decode via zero-copy mmap hits.'),
+    'shuffle': ('lower shuffle cost (shuffle_rows=False or smaller rowgroups)',
+                'In-rowgroup shuffling dominates — unusual; consider '
+                'shuffle_rows=False plus a loader shuffling buffer.'),
+    'transform': ('vectorize the TransformSpec or move it on-device',
+                  'TransformSpec dominates: batched (make_batch_reader) '
+                  'transforms amortize per-row Python cost; device-side ops '
+                  '(petastorm_tpu.ops) remove it from the host entirely.'),
+    'cache_hit': ('cache serving dominates — use cache_format="arrow-ipc"',
+                  'Cache hits dominate and are slow: the pickle cache format '
+                  'pays a full unpickle per hit; arrow-ipc serves zero-copy '
+                  'mmap views.'),
+    'cache_store': ('cache writes dominate — put cache_location on faster disk',
+                    'Filling the rowgroup cache dominates: first-epoch-only '
+                    'cost; if it persists, the cache disk is too slow or the '
+                    'size limit is forcing eviction churn.'),
+    'serialize': ('shrink the wire payload (arrow-ipc serializer, fewer fields)',
+                  'Worker-side result serialization dominates: ensure the '
+                  'ArrowIpcSerializer is in use (sidecar_column_names shows '
+                  'columns falling off the Arrow path) and trim schema_fields.'),
+    'shm_slot_wait': ('raise shm_slot_bytes / shm_slots_per_worker',
+                      'Workers block waiting for free shm ring slots: the '
+                      'consumer is not releasing slots fast enough for the '
+                      'configured ring — more/bigger slots '
+                      '(shm_slots_per_worker, shm_slot_bytes) or a faster '
+                      'consumer loop.'),
+    'shm_map': ('payload deserialize dominates — check sidecar columns',
+                'Mapping shm results dominates consumer time: columns falling '
+                'into the pickled sidecar (ragged/object dtypes) copy on every '
+                'batch; keep columns numeric/uniform for zero-copy receive.'),
+    'shm_release': ('slot release dominates — raise shm_slots_per_worker',
+                    'Releasing shm slots dominates — ROUTER backpressure; more '
+                    'slots per worker decouple the ack path.'),
+    'pool_wait': ('raise workers_count (consumer starved)',
+                  'The consumer sits idle in pool.get_results: the worker pool '
+                  'cannot keep up — raise workers_count, or remove the '
+                  'bottleneck the worker-side ranking names.'),
+    'shuffle_wait': ('raise workers_count / prefetch (input-bound training)',
+                     'The training loop blocks on the input pipeline: raise '
+                     'workers_count and loader prefetch; if worker stages are '
+                     'cheap, the host->device link is the limit (see h2d).'),
+    'collate': ('batch assembly dominates — larger batches / fewer ragged pads',
+                'Host batch assembly (sanitize/pad) dominates: bigger '
+                'batch_size amortizes per-batch cost; pad_ragged fields copy '
+                'every row — pack or pre-pad in the store.'),
+    'h2d': ('coalesce uploads / raise batch size (link-bound)',
+            'Host->device transfer dominates: coalesce_fields=True collapses '
+            'per-field transfers to one; a larger batch_size amortizes '
+            'per-transfer dispatch RTT; scan_stream uploads whole chunks.'),
+    'cache_miss': ('first-epoch fills — see rowgroup_read/decode',
+                   'cache_miss envelopes the fill work; the leaf ranking names '
+                   'the actual cost.'),
+}
+
+_DEFAULT_ADVICE = ('inspect the stage histogram',
+                   'No canned knob for this stage; inspect its histogram in the '
+                   'snapshot and docs/observability.md.')
+
+
+def attribute_bottleneck(snapshot: Dict[str, Any],
+                         top_n: int = 5) -> Dict[str, Any]:
+    """Rank leaf stages by total-time share and name the knob for the top one.
+
+    Returns ``{'total_stage_seconds', 'ranked': [{'stage', 'seconds', 'share',
+    'count', 'mean_s'}], 'top_stage', 'top_share', 'recommendation', 'detail',
+    'envelopes': {stage: seconds}}`` — all JSON-safe. An empty snapshot yields
+    ``top_stage=None`` with a no-data recommendation (never raises)."""
+    histograms = snapshot.get('histograms') or {}
+    leaves = []
+    envelopes = {}
+    for name, hist in histograms.items():
+        if float(hist.get('unit', SECONDS_UNIT)) != SECONDS_UNIT:
+            continue  # size histograms (bytes) are not time shares
+        total = float(hist.get('sum', 0.0))
+        if total <= 0:
+            continue
+        if name in ENVELOPE_STAGES:
+            envelopes[name] = round(total, 6)
+        else:
+            leaves.append((name, total, int(hist.get('count', 0))))
+    leaves.sort(key=lambda item: item[1], reverse=True)
+    total_s = sum(total for _, total, _ in leaves)
+    ranked = [{'stage': name,
+               'seconds': round(total, 6),
+               'share': round(total / total_s, 4) if total_s else 0.0,
+               'count': count,
+               'mean_s': round(total / count, 6) if count else 0.0}
+              for name, total, count in leaves[:max(top_n, 1)]]
+    if not ranked:
+        return {'total_stage_seconds': 0.0, 'ranked': [], 'envelopes': envelopes,
+                'top_stage': None, 'top_share': 0.0,
+                'recommendation': 'no stage timings recorded',
+                'detail': 'The snapshot holds no latency histograms — run an '
+                          'instrumented read first (telemetry is on by default; '
+                          'PETASTORM_TPU_TELEMETRY=0 disables it).'}
+    top = ranked[0]
+    headline, detail = _KNOBS.get(top['stage'], _DEFAULT_ADVICE)
+    return {'total_stage_seconds': round(total_s, 6),
+            'ranked': ranked,
+            'envelopes': envelopes,
+            'top_stage': top['stage'],
+            'top_share': top['share'],
+            'recommendation': headline,
+            'detail': detail}
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Human-readable rendering of an :func:`attribute_bottleneck` report."""
+    lines = ['pipeline stage attribution '
+             '(total {:.3f}s of stage time across all processes)'.format(
+                 report.get('total_stage_seconds', 0.0))]
+    for entry in report.get('ranked', []):
+        lines.append('  {:>6.1%}  {:<14} {:>10.3f}s  ({} spans, mean {:.3f}ms)'
+                     .format(entry['share'], entry['stage'], entry['seconds'],
+                             entry['count'], entry['mean_s'] * 1e3))
+    for stage, seconds in sorted((report.get('envelopes') or {}).items()):
+        lines.append('  [envelope] {:<14} {:>7.3f}s (wraps leaf stages above)'
+                     .format(stage, seconds))
+    if report.get('top_stage'):
+        lines.append('  bottleneck: {} ({:.1%}) -> {}'.format(
+            report['top_stage'], report['top_share'],
+            report['recommendation']))
+        lines.append('  {}'.format(report.get('detail', '')))
+    else:
+        lines.append('  ' + report.get('recommendation', 'no data'))
+    return '\n'.join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``analyze`` CLI entry: load a snapshot file, print the attribution report
+    (or ``--json`` one machine-readable line)."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        description='Rank petastorm_tpu pipeline stages by time share and name '
+                    'the knob that moves the top one')
+    parser.add_argument('snapshot_path',
+                        help='telemetry snapshot: a JSON snapshot/report file or '
+                             'a JSONL event log (last line wins)')
+    parser.add_argument('--json', action='store_true',
+                        help='print one machine-readable JSON line instead')
+    parser.add_argument('--top', type=int, default=5,
+                        help='stages to rank (default 5)')
+    args = parser.parse_args(argv)
+    from petastorm_tpu.telemetry.export import load_snapshot
+    snapshot = load_snapshot(args.snapshot_path)
+    report = attribute_bottleneck(snapshot, top_n=args.top)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

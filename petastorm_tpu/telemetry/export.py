@@ -1,0 +1,169 @@
+"""Export surfaces for telemetry snapshots: Prometheus text exposition and a
+periodic JSONL event log (docs/observability.md "Export formats").
+
+Both operate on the plain-dict snapshots produced by
+:meth:`~petastorm_tpu.telemetry.registry.MetricsRegistry.snapshot` (also found
+under ``Reader.diagnostics['telemetry']`` and
+``JaxDataLoader.telemetry_snapshot()``), so exporting never holds any pipeline
+lock — take a snapshot, hand it to an exporter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from petastorm_tpu.telemetry.registry import (DEFAULT_NUM_BUCKETS,
+                                              bucket_upper_bound)
+
+_NAME_SANITIZE = re.compile(r'[^a-zA-Z0-9_:]')
+
+
+def _metric_name(prefix: str, name: str) -> str:
+    return _NAME_SANITIZE.sub('_', '{}_{}'.format(prefix, name))
+
+
+def _format_value(value: float) -> str:
+    if value == float('inf'):
+        return '+Inf'
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+def to_prometheus_text(snapshot: Dict[str, Any],
+                       prefix: str = 'petastorm_tpu') -> str:
+    """Render a registry snapshot in the Prometheus text exposition format.
+
+    Histograms emit the conventional cumulative ``_bucket{le=...}`` series plus
+    ``_sum`` and ``_count``; bucket boundaries come from the histogram's
+    power-of-two layout (``le`` values are in the histogram's base unit — seconds
+    for latency stages). Counters map to ``counter``, gauges to ``gauge``."""
+    lines = []
+    for name, value in sorted((snapshot.get('counters') or {}).items()):
+        metric = _metric_name(prefix, name)
+        lines.append('# TYPE {} counter'.format(metric))
+        lines.append('{} {}'.format(metric, _format_value(value)))
+    for name, value in sorted((snapshot.get('gauges') or {}).items()):
+        metric = _metric_name(prefix, name)
+        lines.append('# TYPE {} gauge'.format(metric))
+        lines.append('{} {}'.format(metric, _format_value(value)))
+    for name, hist in sorted((snapshot.get('histograms') or {}).items()):
+        metric = _metric_name(prefix, name)
+        unit = float(hist.get('unit', 1e-6))
+        lines.append('# TYPE {} histogram'.format(metric))
+        buckets = {int(k): int(v) for k, v in (hist.get('buckets') or {}).items()}
+        cumulative = 0
+        top = max(buckets) if buckets else -1
+        # finite buckets only — the histogram's last bucket IS +Inf, which the
+        # unconditional line below emits exactly once (duplicate le="+Inf"
+        # series make scrapers reject the whole exposition)
+        for idx in range(min(top + 1, DEFAULT_NUM_BUCKETS - 1)):
+            cumulative += buckets.get(idx, 0)
+            le = bucket_upper_bound(idx, unit)
+            lines.append('{}_bucket{{le="{}"}} {}'.format(
+                metric, _format_value(le), cumulative))
+        lines.append('{}_bucket{{le="+Inf"}} {}'.format(
+            metric, int(hist.get('count', cumulative))))
+        lines.append('{}_sum {}'.format(metric,
+                                        _format_value(float(hist.get('sum', 0.0)))))
+        lines.append('{}_count {}'.format(metric, int(hist.get('count', 0))))
+    return '\n'.join(lines) + '\n'
+
+
+class JsonlEventLogger(object):
+    """Append-only JSONL telemetry log: one ``{"ts", "event", "telemetry", ...}``
+    object per line.
+
+    ``maybe_emit`` is the periodic entry point — call it from any hot-ish loop
+    (the device loader calls it once per yielded batch when
+    ``PETASTORM_TPU_TELEMETRY_JSONL`` names a path); it writes at most once per
+    ``interval_s`` and costs one monotonic-clock read otherwise. ``emit`` writes
+    unconditionally (final flush, epoch boundary). Thread-safe; write failures
+    disable the logger after one warning rather than breaking the pipeline."""
+
+    def __init__(self, path: str, interval_s: float = 10.0) -> None:
+        self._path = path
+        self._interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._next_emit = 0.0
+        self._failed = False
+
+    @property
+    def path(self) -> str:
+        """Destination file path."""
+        return self._path
+
+    def due(self) -> bool:
+        """Cheap periodicity check (one clock read): True when the next
+        ``maybe_emit`` would write. Lets hot loops skip building the snapshot
+        entirely between intervals."""
+        return not self._failed and time.monotonic() >= self._next_emit
+
+    def maybe_emit(self, snapshot: Dict[str, Any], event: str = 'interval',
+                   **extra: Any) -> bool:
+        """Emit if at least ``interval_s`` elapsed since the last write; returns
+        whether a line was written."""
+        now = time.monotonic()
+        if now < self._next_emit:
+            return False
+        return self.emit(snapshot, event=event, **extra)
+
+    def emit(self, snapshot: Dict[str, Any], event: str = 'snapshot',
+             **extra: Any) -> bool:
+        """Append one JSONL record unconditionally; returns success."""
+        if self._failed:
+            return False
+        record = {'ts': time.time(), 'event': event, 'pid': os.getpid(),
+                  'telemetry': snapshot}
+        record.update(extra)
+        line = json.dumps(record) + '\n'
+        with self._lock:
+            self._next_emit = time.monotonic() + self._interval_s
+            try:
+                with open(self._path, 'a') as f:
+                    f.write(line)
+            except OSError:
+                import logging
+                logging.getLogger(__name__).warning(
+                    'telemetry JSONL log %s is unwritable; disabling the logger',
+                    self._path, exc_info=True)
+                self._failed = True
+                return False
+        return True
+
+
+def logger_from_env(interval_s: float = 10.0) -> Optional[JsonlEventLogger]:
+    """A :class:`JsonlEventLogger` targeting ``$PETASTORM_TPU_TELEMETRY_JSONL``,
+    or None when the variable is unset/empty."""
+    path = os.environ.get('PETASTORM_TPU_TELEMETRY_JSONL')
+    if not path:
+        return None
+    return JsonlEventLogger(path, interval_s=interval_s)
+
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    """Read a telemetry snapshot from ``path``: either a bare snapshot JSON file,
+    a doctor/bench JSON report containing a ``telemetry`` key, or a JSONL event
+    log (the LAST line's ``telemetry`` field wins — the cumulative view)."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text:
+        raise ValueError('{} is empty'.format(path))
+    lines = text.splitlines()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = json.loads(lines[-1])  # JSONL: last (cumulative) record
+    if isinstance(obj, dict) and 'telemetry' in obj:
+        obj = obj['telemetry']
+    if isinstance(obj, dict) and 'snapshot' in obj and 'histograms' not in obj:
+        obj = obj['snapshot']  # doctor --json nests under telemetry.snapshot
+    if not isinstance(obj, dict) or 'histograms' not in obj:
+        raise ValueError('{} does not contain a telemetry snapshot '
+                         '(expected a "histograms" key)'.format(path))
+    return obj

@@ -1,0 +1,148 @@
+"""Stage spans: named timing scopes over the data-plane pipeline stages.
+
+A *stage* is one step a row batch passes through on its way to the device —
+``fs_open``, ``rowgroup_read``, ``decode``, ``transform``, ``shuffle``,
+``cache_hit`` / ``cache_miss`` / ``cache_store``, ``serialize``,
+``shm_slot_wait`` / ``shm_map`` / ``shm_release``, ``shuffle_wait``, ``collate``,
+``h2d`` (the catalog with semantics: docs/observability.md). Worker-side stages
+execute in whatever process the pool runs them in, so their timings cannot be
+written into the consumer's registry directly; instead each worker thread
+accumulates them in a process-local :class:`StageRecorder` and the rowgroup
+worker **drains** the accumulation into the published batch's ``telemetry``
+sidecar — the same results-channel ride ``cache_hit`` takes — where
+``Reader._note_item_consumed`` merges it into the consumer-side registry. One
+snapshot therefore covers every process, and a respawned worker's fresh recorder
+merges additively like any other (no double counting, no loss beyond the
+unpublished in-flight item).
+
+The recorder is sharded per THREAD (``threading.local``): a drain returns only
+the calling thread's accumulation, so thread-pool workers never race each other,
+and the serialize/slot-wait stages recorded by the process-pool worker main land
+on the same thread that publishes the next batch (they ride one item late —
+still the same process total).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import TracebackType
+from typing import Any, Dict, List, Optional, Type
+
+from petastorm_tpu.telemetry import registry as _registry
+from petastorm_tpu.telemetry.registry import (DEFAULT_NUM_BUCKETS, SECONDS_UNIT,
+                                              bucket_index)
+
+#: canonical stage names, pipeline order (docs/observability.md metric catalog)
+STAGES = (
+    'fs_open',        # filesystem construction / reconnect (worker)
+    'rowgroup_read',  # Parquet rowgroup -> Arrow table (worker)
+    'decode',         # codec decode, Arrow -> numpy columns (worker)
+    'shuffle',        # in-rowgroup seeded permutation (worker)
+    'transform',      # TransformSpec application (worker)
+    'cache_hit',      # serving a decoded rowgroup from the cache (worker)
+    'cache_miss',     # the full fill of a missed key — ENVELOPES read+decode
+    'cache_store',    # writing a filled value to the cache (worker)
+    'serialize',      # result -> wire frames (process-pool worker main)
+    'shm_slot_wait',  # backpressure wait for a free ring slot (worker main)
+    'shm_map',        # slot view + deserialize on the consumer (pool)
+    'shm_release',    # slot ack back to the producing worker (pool)
+    'pool_wait',      # consumer blocked in pool.get_results (pool)
+    'shuffle_wait',   # consumer blocked on the loader's prefetch queue (loader)
+    'collate',        # host batch assembly / sanitize (loader)
+    'h2d',            # host->device upload (loader)
+)
+
+#: stages whose span ENVELOPES other recorded stages (cache_miss wraps
+#: rowgroup_read+decode) — excluded from time-share attribution so shares of the
+#: leaf stages sum sensibly (telemetry/analyze.py)
+ENVELOPE_STAGES = frozenset({'cache_miss'})
+
+
+class StageRecorder(object):
+    """Per-thread accumulation of stage timings, drained into batch sidecars.
+
+    Each thread owns a private ``{stage: [count, sum, max, {bucket: n}]}`` dict;
+    ``record`` appends to it without locks and ``drain`` atomically (per thread)
+    hands it off as a JSON-safe ``{stage: histogram_snapshot}`` mapping that
+    :meth:`MetricsRegistry.merge_stage_times` understands."""
+
+    __slots__ = ('_local',)
+
+    def __init__(self) -> None:
+        self._local = threading.local()
+
+    def _cells(self) -> Dict[str, List[Any]]:
+        cells = getattr(self._local, 'cells', None)
+        if cells is None:
+            cells = {}
+            self._local.cells = cells
+        return cells
+
+    def record(self, stage: str, seconds: float) -> None:
+        """Accumulate one observation of ``stage`` for the calling thread."""
+        if not _registry.telemetry_enabled():
+            return
+        cells = self._cells()
+        cell = cells.get(stage)
+        if cell is None:
+            cell = [0, 0.0, 0.0, {}]
+            cells[stage] = cell
+        cell[0] += 1
+        cell[1] += seconds
+        if seconds > cell[2]:
+            cell[2] = seconds
+        idx = bucket_index(seconds, SECONDS_UNIT, DEFAULT_NUM_BUCKETS)
+        cell[3][idx] = cell[3].get(idx, 0) + 1
+
+    def drain(self) -> Optional[Dict[str, Dict[str, Any]]]:
+        """Hand off and clear the calling thread's accumulation (None if empty)."""
+        cells = getattr(self._local, 'cells', None)
+        if not cells:
+            return None
+        self._local.cells = {}
+        return {stage: {'unit': SECONDS_UNIT, 'count': cell[0], 'sum': cell[1],
+                        'max': cell[2],
+                        'buckets': {str(i): n for i, n in cell[3].items()}}
+                for stage, cell in cells.items()}
+
+
+#: the process-wide recorder every data-plane stage writes to (worker side)
+_process_recorder = StageRecorder()
+
+
+def record_stage(stage: str, seconds: float) -> None:
+    """Record one observation into the process-wide stage recorder."""
+    _process_recorder.record(stage, seconds)
+
+
+def drain_stage_times() -> Optional[Dict[str, Dict[str, Any]]]:
+    """Drain the calling thread's accumulated stage times (for batch sidecars)."""
+    return _process_recorder.drain()
+
+
+class stage_span(object):
+    """Context manager timing one stage into the process recorder:
+    ``with stage_span('decode'): ...``. Near-zero cost when telemetry is
+    disabled (one enabled check, no clock reads). Exceptions propagate; the
+    partial duration is still recorded (a stage that died slow is exactly the
+    signal the bottleneck report wants)."""
+
+    __slots__ = ('_stage', '_start')
+
+    def __init__(self, stage: str) -> None:
+        self._stage = stage
+        self._start = 0.0
+
+    def __enter__(self) -> 'stage_span':
+        if _registry.telemetry_enabled():
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type: Optional[Type[BaseException]],
+                 exc: Optional[BaseException],
+                 tb: Optional[TracebackType]) -> None:
+        if self._start:
+            _process_recorder.record(self._stage,
+                                     time.perf_counter() - self._start)
+            self._start = 0.0

@@ -204,6 +204,7 @@ def check_store_roundtrip(rows=200, workers=2):
                     return {'status': 'fail',
                             'detail': 'row {} decoded wrong vec'.format(row.idx)}
             diag = reader.diagnostics
+            telemetry = reader.telemetry_snapshot()
         elapsed = time.perf_counter() - start
     if sorted(seen) != list(range(rows)):
         return {'status': 'fail',
@@ -213,7 +214,8 @@ def check_store_roundtrip(rows=200, workers=2):
             'rows_per_sec': round(rows / elapsed, 1),
             'io_retries': diag.get('io_retries', 0),
             'rowgroups_quarantined': diag.get('rowgroups_quarantined', 0),
-            'quarantine': diag.get('quarantine', [])}
+            'quarantine': diag.get('quarantine', []),
+            'telemetry': telemetry}
 
 
 def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
@@ -229,6 +231,15 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
         report['store_roundtrip'] = check_store_roundtrip()
     except Exception as exc:  # noqa: BLE001 - the report must always complete
         report['store_roundtrip'] = {'status': 'fail', 'detail': repr(exc)}
+    # Pipeline telemetry (docs/observability.md): the roundtrip reader's
+    # cross-process stage snapshot + the bottleneck attribution it implies —
+    # the doctor's answer to "what will this install's input pipeline be slow
+    # at". Lifted to report level so --json consumers find one stable key.
+    snapshot = report['store_roundtrip'].pop('telemetry', None)
+    if snapshot is not None:
+        from petastorm_tpu.telemetry.analyze import attribute_bottleneck
+        report['telemetry'] = {'snapshot': snapshot,
+                               'bottleneck': attribute_bottleneck(snapshot)}
     report['healthy'] = report['store_roundtrip'].get('status') == 'ok'
     return report
 
@@ -270,6 +281,12 @@ def _print_human(report):
                       s.get('io_retries', 0), s.get('rowgroups_quarantined', 0)))
     else:
         print('  store roundtrip: FAIL — {}'.format(s.get('detail')))
+    telemetry = report.get('telemetry')
+    if telemetry and telemetry['bottleneck'].get('top_stage'):
+        b = telemetry['bottleneck']
+        print('  telemetry: top stage {} ({:.0%} of {:.3f}s stage time) -> {}'
+              .format(b['top_stage'], b['top_share'],
+                      b.get('total_stage_seconds', 0.0), b['recommendation']))
     print('  verdict: {}'.format('healthy' if report['healthy'] else 'BROKEN'))
 
 

@@ -3,6 +3,7 @@ thread inside ``get_results`` (reference: petastorm/workers_pool/dummy_pool.py:2
 
 from collections import deque
 
+from petastorm_tpu.telemetry.registry import MetricsRegistry
 from petastorm_tpu.workers import EmptyResultError, VentilatedItemProcessedMessage
 
 
@@ -17,6 +18,10 @@ class DummyPool(object):
         self._worker = None
         self._ventilator = None
         self.workers_count = 1
+        #: uniform pool-telemetry surface (docs/observability.md); worker stages
+        #: still ride each batch's sidecar — inline execution means there is no
+        #: consumer wait worth measuring here
+        self.telemetry = MetricsRegistry()
 
     def start(self, worker_class, worker_args=None, ventilator=None):
         self._worker = worker_class(0, self._results.append, worker_args)

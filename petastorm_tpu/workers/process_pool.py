@@ -47,6 +47,8 @@ import tempfile
 import threading
 import time
 
+from petastorm_tpu.telemetry.registry import (BYTES_UNIT, MetricsRegistry,
+                                              telemetry_enabled)
 from petastorm_tpu.workers import EmptyResultError, TimeoutWaitingForResultError
 
 logger = logging.getLogger(__name__)
@@ -115,6 +117,11 @@ class ProcessPool(object):
         self._ventilator = None
         self._processes = []
         self._stopped = False
+        #: consumer-side telemetry (docs/observability.md): shm_map/shm_release/
+        #: pool_wait latency stages plus the per-batch wire_bytes_copied size
+        #: histogram (the running-mean source for wire_bytes_copied_per_batch);
+        #: merged into Reader.telemetry_snapshot()
+        self.telemetry = MetricsRegistry()
         # Instance state, not a get_results local: a typical call returns after one
         # result, so a per-call throttle would still run the liveness probe (ventilator
         # lock + per-worker poll) once per result.
@@ -185,6 +192,11 @@ class ProcessPool(object):
         existing = self._child_env.get('PYTHONPATH')
         self._child_env['PYTHONPATH'] = os.pathsep.join(
             parent_paths + ([existing] if existing else []))
+        # Propagate the telemetry kill switch: set_telemetry_enabled(False) in
+        # the parent must also silence SPAWNED workers (captured at pool start;
+        # an explicit PETASTORM_TPU_TELEMETRY in the env wins).
+        self._child_env.setdefault('PETASTORM_TPU_TELEMETRY',
+                                   '1' if telemetry_enabled() else '0')
         # Kept for the lifetime of the pool: respawns re-materialize the bootstrap file
         # (workers unlink it at startup).
         self._bootstrap_template = {
@@ -303,8 +315,12 @@ class ProcessPool(object):
             current = self._slot_generation[descriptor.worker_slot]
         if identity is None or current != descriptor.generation:
             return
+        release_start = time.perf_counter()
         self._dispatch_socket.send_multipart(
             [identity, b'release', b'%d' % descriptor.ring_slot])
+        if telemetry_enabled():
+            self.telemetry.observe('shm_release',
+                                   time.perf_counter() - release_start)
 
     def _handle_done(self, token):
         with self._state_lock:
@@ -368,6 +384,7 @@ class ProcessPool(object):
         poller.register(self._results_socket, zmq.POLLIN)
         poller.register(self._dispatch_socket, zmq.POLLIN)
         deadline = None if timeout is None else time.time() + timeout
+        wait_start = time.perf_counter()
         while True:
             # Liveness on the hot path too — not only when results stop: with several
             # workers, survivors keep producing after one dies, but the dead worker's
@@ -422,10 +439,24 @@ class ProcessPool(object):
                         self._results_dropped += 1
                         continue
                     self._delivered.add(token)
-                return self._serializer.deserialize(payload[1:])
+                copy_before = self._serializer_bytes_copied()
+                result = self._serializer.deserialize(payload[1:])
+                if telemetry_enabled():
+                    # true per-batch copied bytes: ZMQ frame bytes + the
+                    # serializer's receive-side copies for THIS batch
+                    self.telemetry.observe(
+                        'wire_bytes_copied',
+                        payload_bytes + self._serializer_bytes_copied()
+                        - copy_before, unit=BYTES_UNIT)
+                    self.telemetry.observe('pool_wait',
+                                           time.perf_counter() - wait_start)
+                return result
             if kind == MSG_RESULT_SHM:
                 result = self._handle_shm_result(payload)
                 if result is not None:
+                    if telemetry_enabled():
+                        self.telemetry.observe('pool_wait',
+                                               time.perf_counter() - wait_start)
                     return result[0]
                 continue
             if kind == MSG_STARTED:  # respawned worker joining — expected
@@ -460,9 +491,22 @@ class ProcessPool(object):
         if self._ring is None:  # defensive: descriptor without a ring
             self._release_slot(descriptor)
             return None
+        map_start = time.perf_counter()
+        copy_before = self._serializer_bytes_copied()
         views = self._ring.view(descriptor)
         try:
-            return (self._serializer.deserialize(views),)
+            result = self._serializer.deserialize(views)
+            if telemetry_enabled():
+                # shm_map: slot view + deserialize; copied bytes = descriptor
+                # frame + the serializer's receive-side copies for this batch
+                self.telemetry.observe('shm_map',
+                                       time.perf_counter() - map_start)
+                self.telemetry.observe(
+                    'wire_bytes_copied',
+                    memoryview(payload[1]).nbytes
+                    + self._serializer_bytes_copied() - copy_before,
+                    unit=BYTES_UNIT)
+            return (result,)
         finally:
             # Frames never outlive this call (writable-receive contract enforced in
             # __init__): drop the slot views so join()'s unlink can't hit exported
@@ -473,6 +517,13 @@ class ProcessPool(object):
                 except BufferError:  # pragma: no cover - a consumer kept a ref
                     pass
             self._release_slot(descriptor)
+
+    def _serializer_bytes_copied(self):
+        """Cumulative receive-side copied bytes from the serializer's stats (0 when
+        the serializer keeps none) — deltas around one deserialize give the
+        per-batch copy cost for the wire_bytes_copied histogram."""
+        stats = getattr(self._serializer, 'stats', None)
+        return stats.get('bytes_copied', 0) if stats else 0
 
     def stop(self):
         if self._stopped:

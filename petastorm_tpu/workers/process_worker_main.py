@@ -103,16 +103,22 @@ def main(bootstrap_path):
     deferred_work = []
 
     def publish(result):
-        frames = serializer.serialize(result)
+        # Stage spans land in the process-local recorder and ride the NEXT
+        # published batch's telemetry sidecar (this one is already serialized) —
+        # one item late, same process total (docs/observability.md).
+        from petastorm_tpu.telemetry.spans import stage_span
+        with stage_span('serialize'):
+            frames = serializer.serialize(result)
         if ring_writer is not None and ring_writer.fits(frames):
             descriptor = ring_writer.try_write(frames)
             if descriptor is None:
                 # Backpressure: all our slots are in flight — wait (bounded) for
                 # the consumer's release acks before falling back to the wire.
                 deadline = time.monotonic() + _SLOT_WAIT_S
-                while descriptor is None and time.monotonic() < deadline:
-                    deferred_work.extend(drain_releases(timeout_ms=100))
-                    descriptor = ring_writer.try_write(frames)
+                with stage_span('shm_slot_wait'):
+                    while descriptor is None and time.monotonic() < deadline:
+                        deferred_work.extend(drain_releases(timeout_ms=100))
+                        descriptor = ring_writer.try_write(frames)
             if descriptor is not None:
                 results_socket.send_multipart(
                     [b'result_shm', current_token[0], descriptor.to_bytes()])

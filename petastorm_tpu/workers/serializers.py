@@ -211,6 +211,10 @@ class ArrowIpcSerializer(object):
                            else None),
             # cache-observability sidecar: None = cache bypassed/not applicable
             'cache_hit': getattr(obj, 'cache_hit', None),
+            # stage-span telemetry sidecar (docs/observability.md): a JSON-safe
+            # {stage: histogram_snapshot} dict the consumer merges into its
+            # registry — how worker-process timings reach one global snapshot
+            'telemetry': getattr(obj, 'telemetry', None),
         }
         ipc_buf, sidecar_blob, _ = encode_columnar(obj.columns, obj.num_rows,
                                                    meta_extra)
@@ -234,7 +238,8 @@ class ArrowIpcSerializer(object):
         return ColumnarBatch(columns, meta['num_rows'],
                              item_id=tuple(item_id) if item_id is not None else None,
                              retries=meta.get('retries', 0), quarantine=quarantine,
-                             cache_hit=meta.get('cache_hit'))
+                             cache_hit=meta.get('cache_hit'),
+                             telemetry=meta.get('telemetry'))
 
 
 def _as_bytes(frame):

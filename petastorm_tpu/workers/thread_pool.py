@@ -10,6 +10,7 @@ import queue
 import threading
 import time
 
+from petastorm_tpu.telemetry.registry import MetricsRegistry, telemetry_enabled
 from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
                                    VentilatedItemProcessedMessage)
 
@@ -89,6 +90,10 @@ class ThreadPool(object):
         self._profiles = []
         self._profiles_lock = threading.Lock()
         self._profiler_slot = threading.Lock()
+        #: consumer-side telemetry: ``pool_wait`` (time the consumer spent inside
+        #: get_results per result) — worker-side stages ride each batch's
+        #: telemetry sidecar instead (docs/observability.md)
+        self.telemetry = MetricsRegistry()
 
     def start(self, worker_class, worker_args=None, ventilator=None):
         if self._threads:
@@ -123,6 +128,7 @@ class ThreadPool(object):
         and the queue drained; re-raises worker exceptions (reference:
         thread_pool.py:139-172)."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        wait_start = time.perf_counter()
         while True:
             try:
                 result = self._results_queue.get_nowait()
@@ -146,6 +152,9 @@ class ThreadPool(object):
                 self.stop()
                 logger.error('Worker failure re-raised in consumer:\n%s', result.tb)
                 raise result.exc
+            if telemetry_enabled():
+                self.telemetry.observe('pool_wait',
+                                       time.perf_counter() - wait_start)
             return result
 
     def stop(self):
