@@ -154,7 +154,7 @@ _HEADLINE_FALLBACKS = (
 
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
-                 'flash', 'moe', 'wire_bench', 'telemetry')
+                 'flash', 'moe', 'wire_bench', 'telemetry', 'resilience')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -163,7 +163,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'wire_bench', 'telemetry',
+SECTION_RUN_ORDER = ('mnist_inmem', 'wire_bench', 'telemetry', 'resilience',
                      'mnist_scan_stream', 'flash', 'moe', 'imagenet_scan',
                      'imagenet_stream', 'decode_delta', 'bare_reader',
                      'mnist_stream')
@@ -1450,6 +1450,49 @@ def child_main():
             fields['telemetry_stage_share_' + entry['stage']] = entry['share']
         results.update(fields)
 
+    def run_resilience():
+        """Watchdog + CRC clean-path overhead (host-only, fast): the same
+        process-pool epoch with every robustness guard off (no heartbeats, no
+        hang timeout, no shm checksum) vs the shipping defaults; the overhead
+        percentage is the BENCH-history guard for the ISSUE-4 acceptance
+        (<= 3% on the clean path — docs/robustness.md)."""
+        from petastorm_tpu.workers.process_pool import ProcessPool
+
+        def epoch_rows_per_sec(guarded):
+            if guarded:
+                pool = ProcessPool(min(WORKERS, 2))
+            else:
+                pool = ProcessPool(min(WORKERS, 2), heartbeat_interval_s=0,
+                                   hang_timeout_s=None, shm_checksum=False)
+            reader = make_reader(url, reader_pool=pool, num_epochs=1,
+                                 shuffle_row_groups=False)
+            rows = 0
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            diag = reader.diagnostics
+            reader.stop()
+            reader.join()
+            return rows / elapsed, diag
+
+        baseline_rate, _ = epoch_rows_per_sec(guarded=False)
+        guarded_rate, diag = epoch_rows_per_sec(guarded=True)
+        overhead_pct = (baseline_rate - guarded_rate) / baseline_rate * 100.0
+        log('resilience: guarded {:.1f} rows/s vs bare {:.1f} rows/s '
+            '({:+.2f}% watchdog+CRC overhead); {} shm batches CRC-verified'
+            .format(guarded_rate, baseline_rate, overhead_pct,
+                    diag.get('shm_batches', 0)))
+        results.update({
+            'resilience_guarded_rows_per_sec': round(guarded_rate, 1),
+            'resilience_baseline_rows_per_sec': round(baseline_rate, 1),
+            'resilience_overhead_pct': round(overhead_pct, 2),
+            'resilience_crc_verified_batches': diag.get('shm_batches', 0),
+            'resilience_breaker_state':
+                diag.get('breakers', {}).get('shm_transport',
+                                             {}).get('state', 'closed'),
+        })
+
     def run_decode():
         decode_host, decode_onchip = run_decode_delta()
         results.update({
@@ -1471,6 +1514,7 @@ def child_main():
         'moe': run_moe,
         'wire_bench': run_wire_bench,
         'telemetry': run_telemetry,
+        'resilience': run_resilience,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

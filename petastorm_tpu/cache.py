@@ -30,8 +30,11 @@ import pickle
 import struct
 import tempfile
 import threading
+import time
+import zlib
 
-from petastorm_tpu.telemetry.spans import stage_span
+from petastorm_tpu.errors import CacheCorruptionError
+from petastorm_tpu.telemetry.spans import record_stage, stage_span
 
 logger = logging.getLogger(__name__)
 
@@ -41,6 +44,19 @@ MB = 1 << 20
 #: uint64-LE length of the IPC stream region (0 in pickle mode)
 _ARROW_MAGIC = b'PTUAC001'
 _HEADER = struct.Struct('<8scQ')
+#: Arrow-IPC cache file footer: magic + CRC-32 of the body (everything between
+#: header and footer) + uint64-LE body length. Verified on every hit BEFORE any
+#: byte of the body is interpreted; entries written before the footer existed
+#: fail the magic check and self-heal like any other corrupt entry
+#: (docs/robustness.md "Hang detection & circuit breakers").
+_FOOTER_MAGIC = b'PTUCRC01'
+_FOOTER = struct.Struct('<8sIQ')
+
+#: cache-breaker defaults: consecutive read/store failures before ``get``
+#: bypasses the cache entirely (direct fills), and the cooldown before a
+#: half-open probe tries the cache again
+DEFAULT_CACHE_BREAKER_THRESHOLD = 5
+DEFAULT_CACHE_BREAKER_RECOVERY_S = 60.0
 
 
 class CacheBase(object):
@@ -66,10 +82,13 @@ class NullCache(CacheBase):
 def _new_cache_stats():
     """Fresh cache counters: ``hits``/``misses``, ``arrow_hits`` (zero-copy mmap
     hits) vs ``pickle_hits`` (unpickle-path hits — the fallback to copy-mode),
-    ``bytes_mmapped`` (bytes served as views over the mapped file) and
-    ``bytes_written``."""
+    ``bytes_mmapped`` (bytes served as views over the mapped file),
+    ``bytes_written``, ``corrupt_entries`` (unreadable entries deleted by the
+    self-heal path) and ``bypass_reads`` (fills served while the cache circuit
+    breaker was open)."""
     return {'hits': 0, 'misses': 0, 'arrow_hits': 0, 'pickle_hits': 0,
-            'bytes_mmapped': 0, 'bytes_written': 0}
+            'bytes_mmapped': 0, 'bytes_written': 0, 'corrupt_entries': 0,
+            'bypass_reads': 0}
 
 
 class LocalDiskCache(CacheBase):
@@ -88,7 +107,7 @@ class LocalDiskCache(CacheBase):
     _ALL_SUFFIXES = ('.pkl', '.arrow')
 
     def __init__(self, path, size_limit_bytes, expected_row_size_bytes=0, cleanup=False,
-                 shards=None):
+                 shards=None, breaker=None):
         if expected_row_size_bytes and size_limit_bytes < 100 * expected_row_size_bytes:
             raise ValueError('Cache size_limit_bytes={} is too small for rows of ~{} bytes'
                              .format(size_limit_bytes, expected_row_size_bytes))
@@ -99,19 +118,37 @@ class LocalDiskCache(CacheBase):
         self.stats = _new_cache_stats()
         self._decode_failure_logged = False
         os.makedirs(path, exist_ok=True)
+        # Circuit breaker (docs/robustness.md): repeated corrupt entries or IO
+        # failures open it, and get() then BYPASSES the cache (direct fills, no
+        # reads, no stores) until the cooldown's half-open probe succeeds — a
+        # sick disk degrades throughput, not correctness. Registered on the
+        # process-local default board so its state rides the results-channel
+        # breaker sidecar into Reader.diagnostics; injectable for tests.
+        self._breaker = breaker if breaker is not None else self._default_breaker()
         # Approximate running byte total: seeded from one scan, bumped per store; the
         # expensive full rescan happens only when this crosses the limit.
         self._approx_bytes = None
 
+    def _default_breaker(self):
+        from petastorm_tpu.resilience import default_board
+        return default_board().breaker(
+            'cache:{}'.format(self._path),
+            failure_threshold=DEFAULT_CACHE_BREAKER_THRESHOLD,
+            recovery_timeout_s=DEFAULT_CACHE_BREAKER_RECOVERY_S)
+
     def __getstate__(self):
-        # Shipped to process-pool workers; the lock is per-process state.
+        # Shipped to process-pool workers; the lock is per-process state, and so
+        # is the breaker (each worker re-registers on ITS default board — states
+        # reach the consumer via the results-channel sidecar, not via pickle).
         state = self.__dict__.copy()
         del state['_lock']
+        del state['_breaker']
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.Lock()
+        self._breaker = self._default_breaker()
 
     def _key_path(self, key):
         digest = hashlib.sha1(str(key).encode('utf-8')).hexdigest()
@@ -134,6 +171,13 @@ class LocalDiskCache(CacheBase):
     # ------------------------------------------------------------------- get
 
     def get(self, key, fill_cache_func):
+        if not self._breaker.allow():
+            # Breaker open: the disk under this cache keeps corrupting or
+            # erroring — bypass it entirely (no read, no store) until the
+            # cooldown's half-open probe passes. Degradation, never silence.
+            with self._lock:
+                self.stats['bypass_reads'] += 1
+            return fill_cache_func()
         file_path = self._key_path(key)
         try:
             value = self._decode_file(file_path)
@@ -141,6 +185,7 @@ class LocalDiskCache(CacheBase):
             os.utime(file_path, None)
             with self._lock:
                 self.stats['hits'] += 1
+            self._breaker.record_success()
             return value
         except FileNotFoundError:
             pass  # plain miss
@@ -150,17 +195,46 @@ class LocalDiskCache(CacheBase):
             # turn every epoch cold — log the first one loudly, the rest quietly.
             if not self._decode_failure_logged:
                 self._decode_failure_logged = True
-                logger.warning('cache entry %s is unreadable; serving a miss '
-                               '(further decode failures logged at DEBUG)',
-                               file_path, exc_info=True)
+                logger.warning('cache entry %s is unreadable; deleting it and '
+                               'serving a miss (further decode failures logged '
+                               'at DEBUG)', file_path, exc_info=True)
             else:
-                logger.debug('cache entry %s is unreadable; serving a miss',
-                             file_path, exc_info=True)
+                logger.debug('cache entry %s is unreadable; deleting it and '
+                             'serving a miss', file_path, exc_info=True)
+            self._delete_corrupt_entry(file_path)
         with self._lock:
             self.stats['misses'] += 1
         value = fill_cache_func()
-        self._store(file_path, value)
+        try:
+            self._store(file_path, value)
+            # A successful store is breaker-neutral while closed (it must not
+            # reset a corrupt-READ streak — a disk that stores fine but corrupts
+            # everything it returns still needs to trip); it only counts as the
+            # recovery probe's success when the breaker is half-open.
+            if self._breaker.state == 'half_open':
+                self._breaker.record_success()
+        except OSError:
+            # A failed store must not fail the read — the value is in hand. It
+            # does feed the breaker: a disk that cannot store will not serve.
+            self._breaker.record_failure()
+            logger.warning('failed to store cache entry %s; serving the value '
+                           'uncached', file_path, exc_info=True)
         return value
+
+    def _delete_corrupt_entry(self, file_path):
+        """Self-heal: a poisoned entry left on disk would re-pay the decode
+        failure every warm epoch — delete it so the refill's store replaces it,
+        and count it (``corrupt_entries`` stat, ``cache_corrupt`` stage — the
+        latter rides the telemetry sidecar across process boundaries)."""
+        delete_start = time.perf_counter()
+        try:
+            os.unlink(file_path)
+        except OSError:
+            pass  # a concurrent reader may have healed it already
+        with self._lock:
+            self.stats['corrupt_entries'] += 1
+        self._breaker.record_failure()
+        record_stage('cache_corrupt', time.perf_counter() - delete_start)
 
     def _store(self, file_path, value):
         # cache_store stage span (docs/observability.md): encode + write + publish
@@ -256,35 +330,64 @@ class ArrowIpcDiskCache(LocalDiskCache):
     _SUFFIX = '.arrow'
 
     def __init__(self, path, size_limit_bytes, expected_row_size_bytes=0,
-                 cleanup=False, shards=None, writable_hits=False):
+                 cleanup=False, shards=None, writable_hits=False, breaker=None):
         super().__init__(path, size_limit_bytes, expected_row_size_bytes,
-                         cleanup=cleanup, shards=shards)
+                         cleanup=cleanup, shards=shards, breaker=breaker)
         self._writable_hits = writable_hits
 
     def _encode_value(self, value):
         from petastorm_tpu.workers.serializers import (_columns_num_rows,
                                                        encode_columnar)
+        body = None
         if isinstance(value, dict):
             try:
                 num_rows = _columns_num_rows(value)
                 ipc_buf, sidecar_blob, _ = encode_columnar(value, num_rows)
-                return b''.join([_HEADER.pack(_ARROW_MAGIC, b'A', len(ipc_buf)),
-                                 ipc_buf.to_pybytes(), sidecar_blob])
+                header = _HEADER.pack(_ARROW_MAGIC, b'A', len(ipc_buf))
+                body = ipc_buf.to_pybytes() + sidecar_blob
             except Exception:  # noqa: BLE001 - non-columnar dict: pickle record
                 logger.debug('value for arrow cache is not columnar; storing as '
                              'pickle record', exc_info=True)
-        return b''.join([_HEADER.pack(_ARROW_MAGIC, b'P', 0),
-                         pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)])
+        if body is None:
+            header = _HEADER.pack(_ARROW_MAGIC, b'P', 0)
+            body = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        footer = _FOOTER.pack(_FOOTER_MAGIC, zlib.crc32(body) & 0xFFFFFFFF,
+                              len(body))
+        return b''.join([header, body, footer])
 
     def _decode_file(self, file_path):
         import pyarrow as pa
         from petastorm_tpu.workers.serializers import decode_columnar
         mm = pa.memory_map(file_path, 'r')
         buf = mm.read_buffer()
+        total = len(buf)
+        if total < _HEADER.size + _FOOTER.size:
+            raise CacheCorruptionError(
+                'cache entry {} is {} bytes — shorter than header+footer'
+                .format(file_path, total))
         magic, mode, ipc_len = _HEADER.unpack_from(memoryview(buf)[:_HEADER.size])
         if magic != _ARROW_MAGIC:
             raise ValueError('not an ArrowIpcDiskCache entry: {!r}'.format(magic))
-        body = buf.slice(_HEADER.size)
+        # Footer verification BEFORE interpreting a single body byte: truncation
+        # shows as a length mismatch, a bit flip as a CRC mismatch, a
+        # pre-footer-format entry as a footer-magic mismatch — all three
+        # self-heal through get()'s delete-on-corrupt path.
+        footer_magic, crc, body_len = _FOOTER.unpack_from(
+            memoryview(buf)[total - _FOOTER.size:])
+        if footer_magic != _FOOTER_MAGIC:
+            raise CacheCorruptionError(
+                'cache entry {} has no integrity footer (truncated, or written '
+                'by a pre-footer version)'.format(file_path))
+        if body_len != total - _HEADER.size - _FOOTER.size or ipc_len > body_len:
+            raise CacheCorruptionError(
+                'cache entry {} length mismatch: footer claims {} body bytes, '
+                'file holds {}'.format(file_path, body_len,
+                                       total - _HEADER.size - _FOOTER.size))
+        body = buf.slice(_HEADER.size, body_len)
+        if zlib.crc32(memoryview(body)) & 0xFFFFFFFF != crc:
+            raise CacheCorruptionError(
+                'cache entry {} failed CRC verification (bit rot or torn write)'
+                .format(file_path))
         if mode == b'P':
             value = pickle.loads(memoryview(body))
             with self._lock:

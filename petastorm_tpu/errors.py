@@ -48,6 +48,22 @@ class TransientIOError(PetastormTpuError, OSError):
     error into the retry path explicitly."""
 
 
+class CacheCorruptionError(PetastormTpuError):
+    """A disk-cache entry failed its integrity check (missing/old footer, length
+    mismatch, CRC mismatch — ``petastorm_tpu.cache.ArrowIpcDiskCache``). Never
+    propagates out of the cache: ``get`` self-heals by deleting the entry and
+    serving the fill function (counted in ``stats['corrupt_entries']``); this
+    type exists so the self-heal path can be precise about what it catches."""
+
+
+class WorkerHangError(PetastormTpuError):
+    """A pool worker held an item past ``item_deadline_s`` without producing a
+    result and was reaped by the watchdog (docs/robustness.md). Under
+    ``on_error='skip'`` the item is quarantined with ``reason='hang'`` rather
+    than raised; this type names the failure in ledger entries and anywhere a
+    strict consumer converts them back into exceptions."""
+
+
 class QuarantinedRowGroupError(PetastormTpuError):
     """A rowgroup exhausted its error budget under ``on_error='skip'`` and was excluded
     from the stream. Not raised on the hot path (skip mode degrades silently-but-visibly

@@ -23,21 +23,22 @@ class NGramWindows(object):
     the unit of NGram checkpoint/resume accounting (VERDICT r3 item 4); zero-window
     pieces still publish (empty ``starts``) solely to carry it. ``retries`` /
     ``quarantine`` are the resilience sidecar, ``telemetry`` the stage-span
-    sidecar — same contracts as
+    sidecar, ``breakers`` the circuit-breaker sidecar — same contracts as
     :class:`~petastorm_tpu.reader_worker.ColumnarBatch` (docs/robustness.md,
     docs/observability.md)."""
 
     __slots__ = ('columns', 'starts', 'item_id', 'retries', 'quarantine',
-                 'telemetry')
+                 'telemetry', 'breakers')
 
     def __init__(self, columns, starts, item_id=None, retries=0, quarantine=None,
-                 telemetry=None):
+                 telemetry=None, breakers=None):
         self.columns = columns
         self.starts = starts
         self.item_id = item_id
         self.retries = retries
         self.quarantine = quarantine
         self.telemetry = telemetry
+        self.breakers = breakers
 
     def __len__(self):
         return len(self.starts)

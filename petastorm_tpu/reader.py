@@ -38,13 +38,17 @@ _DEFAULT_RESULTS_QUEUE_SIZE = 50
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size,
-               shm_transport=None):
+               shm_transport=None, item_deadline_s=None, heartbeat_interval_s=None):
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size)
     if reader_pool_type == 'process':
         from petastorm_tpu.workers.process_pool import ProcessPool
+        kwargs = {}
+        if heartbeat_interval_s is not None:
+            kwargs['heartbeat_interval_s'] = heartbeat_interval_s
         return ProcessPool(workers_count, results_queue_size,
-                           shm_transport=shm_transport)
+                           shm_transport=shm_transport,
+                           item_deadline_s=item_deadline_s, **kwargs)
     if reader_pool_type == 'dummy':
         return DummyPool()
     raise ValueError('Unknown reader_pool_type {!r} (expected thread/process/dummy)'
@@ -106,7 +110,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 transform_spec=None, storage_options=None,
                 filesystem=None, resume_state=None, reader_pool=None,
                 field_overrides=None, hdfs_driver='libhdfs', on_error='raise',
-                retry_policy=None, shm_transport=None):
+                retry_policy=None, shm_transport=None, item_deadline_s=None,
+                heartbeat_interval_s=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -135,7 +140,16 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     unpickle and returns writable arrays).
     ``shm_transport`` controls the process pool's shared-memory result transport —
     None (auto-on when available), True (require), False (ZMQ frames only); ignored
-    by thread/dummy pools, which never cross a process boundary."""
+    by thread/dummy pools, which never cross a process boundary.
+
+    Hang watchdog (docs/robustness.md "Hang detection & circuit breakers";
+    process pool only): ``item_deadline_s`` — a worker holding one rowgroup
+    longer than this without a result is reaped and respawned; under
+    ``on_error='skip'`` the offending rowgroup is quarantined with
+    ``reason='hang'`` instead of re-dispatched (None, the default, disables the
+    per-item deadline). ``heartbeat_interval_s`` — cadence of the workers'
+    liveness stamps (default 0.5s; a worker whose stamp stalls while it holds
+    work is reaped even without an item deadline; 0 disables stamping)."""
     from petastorm_tpu.resilience import resolve_retry_policy
     check_hdfs_driver(hdfs_driver)
     retry_policy = resolve_retry_policy(on_error, retry_policy)
@@ -163,13 +177,16 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
             ('workers_count', workers_count, _DEFAULT_WORKERS_COUNT),
             ('results_queue_size', results_queue_size, _DEFAULT_RESULTS_QUEUE_SIZE),
             ('reader_pool_type', reader_pool_type, _DEFAULT_POOL_TYPE),
-            ('shm_transport', shm_transport, None)]
+            ('shm_transport', shm_transport, None),
+            ('item_deadline_s', item_deadline_s, None),
+            ('heartbeat_interval_s', heartbeat_interval_s, None)]
             if value != default]
         if ignored:
             warnings.warn('reader_pool was supplied; ignoring pool-shape arguments {} '
                           '(the pre-built pool defines its own shape)'.format(ignored))
     pool = reader_pool if reader_pool is not None else _make_pool(
-        reader_pool_type, workers_count, results_queue_size, shm_transport)
+        reader_pool_type, workers_count, results_queue_size, shm_transport,
+        item_deadline_s, heartbeat_interval_s)
     return Reader(dataset_url_or_urls, handle=handle, schema=schema,
                   schema_fields=schema_fields,
                   reader_pool=pool, seed=seed, shuffle_rows=shuffle_rows,
@@ -195,11 +212,13 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cache_format='arrow-ipc', transform_spec=None,
                       storage_options=None, filesystem=None,
                       resume_state=None, hdfs_driver='libhdfs', on_error='raise',
-                      retry_policy=None, shm_transport=None):
+                      retry_policy=None, shm_transport=None, item_deadline_s=None,
+                      heartbeat_interval_s=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
-    ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` behave
-    exactly as in :func:`make_reader`.
+    ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
+    ``item_deadline_s`` / ``heartbeat_interval_s`` behave exactly as in
+    :func:`make_reader`.
     """
     from petastorm_tpu.resilience import resolve_retry_policy
     check_hdfs_driver(hdfs_driver)
@@ -221,7 +240,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                         cache_row_size_estimate, cache_extra_settings, cache_format,
                         has_transform=transform_spec is not None)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      shm_transport)
+                      shm_transport, item_deadline_s, heartbeat_interval_s)
     return Reader(dataset_url_or_urls, handle=handle, schema=None,
                   schema_fields=schema_fields,
                   reader_pool=pool, seed=seed, shuffle_rows=shuffle_rows,
@@ -260,6 +279,10 @@ class Reader(object):
         #: to the empty stand-in batches of skipped rowgroups (docs/robustness.md)
         self.quarantine = QuarantineLedger()
         self._io_retries = 0
+        # Circuit-breaker observability: worker-process breaker states arrive on
+        # each batch's 'breakers' sidecar (last writer wins per breaker name) and
+        # merge with this process's board in diagnostics['breakers'].
+        self._breaker_states = {}
         # Cache observability: per-batch cache_hit sidecar flags accumulate here
         # (works across all pools — the flag rides the results channel).
         self._cache = cache
@@ -464,6 +487,13 @@ class Reader(object):
             reset_iterations=num_epochs,
             tag_epoch=True)
         self._pool = reader_pool
+        if on_error == 'skip' and hasattr(reader_pool, 'set_hang_result_factory'):
+            # Per-item-deadline watchdog hook (docs/robustness.md): when the pool
+            # reaps a hung worker, the overdue rowgroup is quarantined — an empty
+            # stand-in batch carrying a QuarantineRecord(reason='hang') rides the
+            # normal delivery path, so consumption accounting stays exact.
+            reader_pool.set_hang_result_factory(
+                _make_hang_stand_in_factory(ngram))
         self._pool.start(RowGroupWorker, worker_setup, self._ventilator)
 
         if ngram is not None:
@@ -552,7 +582,8 @@ class Reader(object):
                     retries=getattr(batch, 'retries', 0),
                     quarantine=getattr(batch, 'quarantine', None),
                     cache_hit=getattr(batch, 'cache_hit', None),
-                    telemetry=getattr(batch, 'telemetry', None))
+                    telemetry=getattr(batch, 'telemetry', None),
+                    breakers=getattr(batch, 'breakers', None))
             self._note_item_consumed(batch)
             if self._resume_fast_forward and batch.item_id is not None:
                 # Honor a row_cursor from a row-path checkpoint: skip the rows that
@@ -597,6 +628,10 @@ class Reader(object):
             # cross-process span merge: the sidecar is a {stage: hist_snapshot}
             # dict (additive, so respawned workers merge like any other)
             self._telemetry.merge_stage_times(stage_times)
+        breakers = getattr(batch, 'breakers', None)
+        if breakers:
+            with self._accounting_lock:
+                self._breaker_states.update(breakers)
         item_id = getattr(batch, 'item_id', None)
         if item_id is None:
             return
@@ -742,6 +777,21 @@ class Reader(object):
             diag['cache'] = dict(cache_stats)
         diag['rowgroups_quarantined'] = len(self.quarantine)
         diag['quarantine'] = self.quarantine.as_dicts()
+        # Circuit-breaker states (docs/robustness.md): worker-process breakers
+        # (cache/filesystem, via the results-channel sidecar) + this process's
+        # board (exact for thread/dummy pools) + the process pool's shm breaker.
+        # Healthy (never-tripped, closed) breakers are omitted — an empty dict
+        # means everything is closed.
+        from petastorm_tpu.resilience import default_board
+        with self._accounting_lock:
+            breakers = dict(self._breaker_states)
+        breakers.update(default_board().snapshot(only_tripped=True))
+        shm_breaker = diag.get('shm_breaker')
+        if shm_breaker is not None and (
+                shm_breaker.get('failures') or shm_breaker.get('opened_count')
+                or shm_breaker.get('state') != 'closed'):
+            breakers['shm_transport'] = shm_breaker
+        diag['breakers'] = breakers
         # One cross-process telemetry snapshot (docs/observability.md): per-stage
         # latency histograms merged from every worker sidecar + the pool registry.
         diag['telemetry'] = self.telemetry_snapshot()
@@ -758,6 +808,32 @@ class Reader(object):
 def _item_id(item):
     """Stable identity of a ventilated work item for consumption accounting."""
     return (item['piece_index'], item['shuffle_row_drop_partition'][0])
+
+
+def _make_hang_stand_in_factory(ngram):
+    """Build the pool's hang-quarantine hook (docs/robustness.md): maps a
+    reaped item's ventilated kwargs to the empty stand-in batch (row or NGram
+    shape) carrying its ``QuarantineRecord(reason='hang')``."""
+    def factory(item_kwargs, elapsed_s):
+        from petastorm_tpu.resilience import QuarantineRecord
+        epoch = int(item_kwargs.get('epoch_index', 0))
+        piece_index = int(item_kwargs['piece_index'])
+        item_id = (epoch, piece_index,
+                   item_kwargs['shuffle_row_drop_partition'][0])
+        record = QuarantineRecord(
+            piece_index=piece_index,
+            fragment_path=item_kwargs.get('fragment_path', ''),
+            row_group_id=item_kwargs.get('row_group_id'),
+            error_type='WorkerHangError',
+            error='no result after {:.3g}s; the worker holding this rowgroup '
+                  'was reaped by the watchdog'.format(elapsed_s),
+            attempts=1, epoch=epoch, reason='hang')
+        if ngram is not None:
+            from petastorm_tpu.ngram_worker import NGramWindows
+            return NGramWindows({}, np.empty(0, np.int64), item_id=item_id,
+                                quarantine=record)
+        return ColumnarBatch({}, 0, item_id=item_id, quarantine=record)
+    return factory
 
 
 def _slice_batch(batch, start):

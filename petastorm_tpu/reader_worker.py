@@ -14,6 +14,7 @@ Pipeline per rowgroup (reference call stack: SURVEY.md §3.2):
 
 import hashlib
 import logging
+import os
 import re
 import time
 
@@ -28,6 +29,14 @@ from petastorm_tpu.workers.serializers import _columns_num_rows
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 logger = logging.getLogger(__name__)
+
+#: per-path-prefix filesystem breaker defaults (docs/robustness.md): the
+#: threshold sits well above one rowgroup's retry budget — a single poisoned
+#: file exhausting its attempts must not open the breaker for its whole
+#: directory; a *mount-wide* stall (every open failing) crosses it in one or
+#: two pieces
+FS_BREAKER_THRESHOLD = 10
+FS_BREAKER_RECOVERY_S = 30.0
 
 
 class ColumnarBatch(object):
@@ -54,13 +63,19 @@ class ColumnarBatch(object):
     stage since its previous publish, drained from the process-local
     :class:`~petastorm_tpu.telemetry.spans.StageRecorder`. It rides the results
     channel like ``cache_hit`` and merges into the consumer-side registry — one
-    ``Reader.telemetry_snapshot()`` covers all processes."""
+    ``Reader.telemetry_snapshot()`` covers all processes.
+
+    ``breakers`` is the circuit-breaker sidecar (docs/robustness.md): the
+    producing process's tripped-breaker states (``{name: state_dict}`` from its
+    :func:`~petastorm_tpu.resilience.default_board`), or None when every breaker
+    is healthy — how worker-process cache/filesystem breaker states reach
+    ``Reader.diagnostics['breakers']`` across the process boundary."""
 
     __slots__ = ('columns', 'num_rows', 'item_id', 'retries', 'quarantine',
-                 'cache_hit', 'telemetry')
+                 'cache_hit', 'telemetry', 'breakers')
 
     def __init__(self, columns, num_rows, item_id=None, retries=0, quarantine=None,
-                 cache_hit=None, telemetry=None):
+                 cache_hit=None, telemetry=None, breakers=None):
         self.columns = columns
         self.num_rows = num_rows
         self.item_id = item_id
@@ -68,6 +83,7 @@ class ColumnarBatch(object):
         self.quarantine = quarantine
         self.cache_hit = cache_hit
         self.telemetry = telemetry
+        self.breakers = breakers
 
 
 class WorkerSetup(object):
@@ -142,8 +158,11 @@ class RowGroupWorker(WorkerBase):
     def _publish(self, payload):
         """Single publish funnel: attach the stage-span telemetry sidecar (this
         thread's accumulation since its previous publish — docs/observability.md)
-        and hand the payload to the pool's results channel."""
+        and the tripped-breaker states of this process (docs/robustness.md), then
+        hand the payload to the pool's results channel."""
+        from petastorm_tpu.resilience import default_board
         payload.telemetry = drain_stage_times()
+        payload.breakers = default_board().snapshot(only_tripped=True) or None
         self.publish_func(payload)
 
     def process(self, piece_index, fragment_path, row_group_id, partition_keys=None,
@@ -173,9 +192,21 @@ class RowGroupWorker(WorkerBase):
         def with_retry(load_fn):
             if setup.retry_policy is None:
                 return load_fn()
-            from petastorm_tpu.resilience import run_with_retry
-            result, _ = run_with_retry(load_fn, setup.retry_policy, key=piece_index,
-                                       on_retry=on_retry)
+            from petastorm_tpu.resilience import (call_with_breaker, default_board,
+                                                  run_with_retry)
+            # Per-path-prefix filesystem breaker composing with the retry policy
+            # (docs/robustness.md): once a prefix (one store / one mount) keeps
+            # failing, attempts against it fail FAST — the remaining budget burns
+            # in milliseconds instead of hammering a stalled filesystem, and
+            # under 'skip' the piece quarantines promptly. Only under a retrying
+            # policy: on_error='raise' stays byte-identical to the seed.
+            breaker = default_board().breaker(
+                'fs:{}'.format(os.path.dirname(fragment_path) or fragment_path),
+                failure_threshold=FS_BREAKER_THRESHOLD,
+                recovery_timeout_s=FS_BREAKER_RECOVERY_S)
+            result, _ = run_with_retry(
+                lambda: call_with_breaker(load_fn, breaker),
+                setup.retry_policy, key=piece_index, on_retry=on_retry)
             return result
 
         if setup.ngram is not None:

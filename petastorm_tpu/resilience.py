@@ -1,5 +1,5 @@
-"""Resilience primitives: retry/backoff, transient-error classification, and the
-quarantine ledger.
+"""Resilience primitives: retry/backoff, transient-error classification, the
+quarantine ledger, and circuit breakers.
 
 The reference (SURVEY §5.3) only *detects* failures — a worker exception aborts the
 epoch. Production input pipelines treat transient faults as routine (tf.data service
@@ -14,9 +14,18 @@ the policy objects the rest of the stack threads through:
   failures burn attempts.
 - :class:`QuarantineRecord` / :class:`QuarantineLedger` — the skip-with-quarantine
   bookkeeping for ``make_reader(..., on_error='skip')``: every skipped rowgroup is
-  recorded (piece, path, exception, attempts) and surfaced through
-  ``Reader.diagnostics``, ``LoaderStats``, and the doctor — degradation is always
-  visible, never silent.
+  recorded (piece, path, exception, attempts, reason — ``'error'`` or ``'hang'``)
+  and surfaced through ``Reader.diagnostics``, ``LoaderStats``, and the doctor —
+  degradation is always visible, never silent.
+- :class:`CircuitBreaker` / :class:`BreakerBoard` — closed/open/half-open breakers
+  (injectable clock, so every transition is deterministic in tests) that wrap the
+  components retry alone cannot protect: a persistently failing dependency should be
+  *routed around* for a cooldown, not hammered. Deployed in front of the shm result
+  transport (repeated checksum failures → temporary ZMQ-wire fallback), the disk
+  cache (repeated corruption/IO errors → bypass to direct reads) and filesystem
+  opens (per-path-prefix, composing with :class:`RetryPolicy` via
+  :func:`call_with_breaker`). States surface in ``Reader.diagnostics['breakers']``
+  and the doctor report (docs/robustness.md "Hang detection & circuit breakers").
 
 This is the repo's first strict-typed module (mypy.ini ``[mypy-petastorm_tpu.resilience]``).
 """
@@ -174,7 +183,12 @@ def run_with_retry(fn: Callable[[], Any],
 
 @dataclass(frozen=True)
 class QuarantineRecord:
-    """One skipped rowgroup: where it was, what killed it, how hard we tried."""
+    """One skipped rowgroup: where it was, what killed it, how hard we tried.
+
+    ``reason`` distinguishes *how* the rowgroup left the stream: ``'error'`` (an
+    exception exhausted the retry budget — the PR-1 path) or ``'hang'`` (the worker
+    holding it blew ``item_deadline_s`` and was reaped by the watchdog;
+    docs/robustness.md "Hang detection & circuit breakers")."""
 
     piece_index: int
     fragment_path: str
@@ -183,6 +197,7 @@ class QuarantineRecord:
     error: str
     attempts: int
     epoch: int = 0
+    reason: str = 'error'
 
     @classmethod
     def from_exception(cls, exc: BaseException, piece_index: int, fragment_path: str,
@@ -195,7 +210,8 @@ class QuarantineRecord:
     def as_dict(self) -> Dict[str, Any]:
         return {'piece_index': self.piece_index, 'fragment_path': self.fragment_path,
                 'row_group_id': self.row_group_id, 'error_type': self.error_type,
-                'error': self.error, 'attempts': self.attempts, 'epoch': self.epoch}
+                'error': self.error, 'attempts': self.attempts, 'epoch': self.epoch,
+                'reason': self.reason}
 
 
 class QuarantineLedger:
@@ -242,3 +258,245 @@ class QuarantineLedger:
                 first.row_group_id, first.attempts, first.error_type, first.error),
             piece_index=first.piece_index, fragment_path=first.fragment_path,
             row_group_id=first.row_group_id, attempts=first.attempts)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breakers (docs/robustness.md "Hang detection & circuit breakers")
+# ---------------------------------------------------------------------------
+
+#: breaker state names (the classic three-state machine)
+BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = 'closed', 'open', 'half_open'
+
+#: transition-notification callback: (breaker_name, old_state, new_state)
+OnBreakerTransition = Callable[[str, str, str], None]
+
+
+class CircuitBreaker:
+    """Closed/open/half-open circuit breaker with an injectable clock.
+
+    Retry answers "this call failed, try again"; the breaker answers "this
+    *dependency* keeps failing, stop calling it for a while". State machine:
+
+    - **closed** (healthy): calls flow; ``failure_threshold`` *consecutive*
+      failures trip it open (any success resets the streak).
+    - **open**: :meth:`allow` returns False — callers fail fast / take their
+      fallback path without touching the broken dependency — until
+      ``recovery_timeout_s`` of ``clock`` time has passed, after which the next
+      :meth:`allow` moves to half-open.
+    - **half-open**: calls flow again as probes; the first success closes the
+      breaker, the first failure re-opens it (restarting the cooldown).
+
+    ``clock`` is injectable (default ``time.monotonic``) so every transition is
+    deterministic in tests; ``on_transition`` feeds telemetry counters
+    (``breaker_open``). Thread-safe; pickles by dropping the lock (each process
+    gets an independent breaker — states cross process boundaries via the
+    results-channel sidecar, not via shared memory)."""
+
+    def __init__(self, name: str, failure_threshold: int = 5,
+                 recovery_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[OnBreakerTransition] = None) -> None:
+        if failure_threshold < 1:
+            raise ValueError('failure_threshold must be >= 1, got {}'
+                             .format(failure_threshold))
+        if recovery_timeout_s < 0:
+            raise ValueError('recovery_timeout_s must be >= 0')
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.recovery_timeout_s = recovery_timeout_s
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._failures = 0
+        self._successes = 0
+        self._opened_count = 0
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = self.__dict__.copy()
+        del state['_lock']
+        state['_on_transition'] = None  # callbacks are process-local wiring
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def observe_transitions(self, callback: OnBreakerTransition) -> None:
+        """Attach an additional transition observer, chaining after any callback
+        already installed — the supported way for a component adopting an
+        injected breaker (e.g. a pool feeding its telemetry counters) to watch
+        it without clobbering the owner's wiring. Observers are process-local
+        (dropped on pickle, like ``on_transition``)."""
+        with self._lock:
+            existing = self._on_transition
+            if existing is None:
+                self._on_transition = callback
+                return
+
+            def chained(name: str, old_state: str, new_state: str,
+                        _first: OnBreakerTransition = existing,
+                        _second: OnBreakerTransition = callback) -> None:
+                _first(name, old_state, new_state)
+                _second(name, old_state, new_state)
+            self._on_transition = chained
+
+    def _transition(self, new_state: str) -> None:
+        # caller holds self._lock
+        old_state = self._state
+        if old_state == new_state:
+            return
+        self._state = new_state
+        if new_state == BREAKER_OPEN:
+            self._opened_at = self._clock()
+            self._opened_count += 1
+        callback = self._on_transition
+        if callback is not None:
+            callback(self.name, old_state, new_state)
+
+    def allow(self) -> bool:
+        """True when a call may proceed. In the open state this is where the
+        cooldown expires: once ``recovery_timeout_s`` has elapsed the breaker
+        moves to half-open and the call proceeds as a probe."""
+        with self._lock:
+            if self._state == BREAKER_OPEN:
+                if self._clock() - self._opened_at >= self.recovery_timeout_s:
+                    self._transition(BREAKER_HALF_OPEN)
+                    return True
+                return False
+            return True
+
+    def record_success(self) -> None:
+        """A guarded call succeeded: reset the failure streak; a half-open probe
+        success closes the breaker."""
+        with self._lock:
+            self._successes += 1
+            self._consecutive_failures = 0
+            if self._state == BREAKER_HALF_OPEN:
+                self._transition(BREAKER_CLOSED)
+
+    def record_failure(self) -> None:
+        """A guarded call failed: trip open after ``failure_threshold``
+        consecutive failures (immediately, when half-open)."""
+        with self._lock:
+            self._failures += 1
+            self._consecutive_failures += 1
+            if self._state == BREAKER_HALF_OPEN:
+                self._transition(BREAKER_OPEN)
+            elif (self._state == BREAKER_CLOSED
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._transition(BREAKER_OPEN)
+
+    @property
+    def state(self) -> str:
+        """Current state name; reading it applies the open→half-open cooldown
+        transition (state is a function of the clock, not only of events)."""
+        with self._lock:
+            if (self._state == BREAKER_OPEN
+                    and self._clock() - self._opened_at >= self.recovery_timeout_s):
+                self._transition(BREAKER_HALF_OPEN)
+            return self._state
+
+    @property
+    def tripped(self) -> bool:
+        """True when this breaker has ever recorded a failure or opened — the
+        'interesting enough to report' criterion used by snapshots."""
+        with self._lock:
+            return (self._failures > 0 or self._opened_count > 0
+                    or self._state != BREAKER_CLOSED)
+
+    def reset(self) -> None:
+        """Force back to a pristine closed state (tests, manual recovery)."""
+        with self._lock:
+            self._transition(BREAKER_CLOSED)
+            self._consecutive_failures = 0
+            self._failures = 0
+            self._successes = 0
+            self._opened_count = 0
+
+    def as_dict(self) -> Dict[str, Any]:
+        """JSON-safe state for diagnostics / the doctor report."""
+        state = self.state  # applies the cooldown transition first
+        with self._lock:
+            return {'state': state, 'failures': self._failures,
+                    'successes': self._successes,
+                    'consecutive_failures': self._consecutive_failures,
+                    'opened_count': self._opened_count,
+                    'failure_threshold': self.failure_threshold,
+                    'recovery_timeout_s': self.recovery_timeout_s}
+
+
+class BreakerBoard:
+    """Named registry of :class:`CircuitBreaker` instances (one per guarded
+    dependency: ``'fs:<path-prefix>'``, ``'cache:<location>'``, ...). Process
+    local: worker processes each hold their own board, and its snapshot rides
+    the results-channel ``breakers`` sidecar into ``Reader.diagnostics`` the
+    same way stage-span telemetry does."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def breaker(self, name: str, failure_threshold: int = 5,
+                recovery_timeout_s: float = 30.0,
+                clock: Callable[[], float] = time.monotonic,
+                on_transition: Optional[OnBreakerTransition] = None) -> CircuitBreaker:
+        """Get or create the breaker ``name`` (settings apply on creation)."""
+        existing = self._breakers.get(name)
+        if existing is not None:
+            return existing
+        with self._lock:
+            return self._breakers.setdefault(
+                name, CircuitBreaker(name, failure_threshold=failure_threshold,
+                                     recovery_timeout_s=recovery_timeout_s,
+                                     clock=clock, on_transition=on_transition))
+
+    def snapshot(self, only_tripped: bool = False) -> Dict[str, Dict[str, Any]]:
+        """``{name: breaker.as_dict()}``; ``only_tripped`` keeps the wire
+        sidecar small by omitting never-failed closed breakers."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {name: brk.as_dict() for name, brk in breakers.items()
+                if not only_tripped or brk.tripped}
+
+    def reset(self) -> None:
+        """Drop every registered breaker (test isolation)."""
+        with self._lock:
+            self._breakers.clear()
+
+
+#: the process-wide board every in-process breaker registers on
+_default_board = BreakerBoard()
+
+
+def default_board() -> BreakerBoard:
+    """The process-wide :class:`BreakerBoard` (cache + filesystem breakers live
+    here; the process pool's shm breaker is pool-owned and consumer-side)."""
+    return _default_board
+
+
+def call_with_breaker(
+        fn: Callable[[], Any], breaker: CircuitBreaker,
+        is_failure: Callable[[BaseException], bool] = is_transient_error) -> Any:
+    """Run ``fn`` under ``breaker``: an open breaker fails fast with
+    :class:`~petastorm_tpu.errors.TransientIOError` (classified transient, so a
+    wrapping :func:`run_with_retry` burns its remaining budget on cheap fast
+    failures instead of hammering a stalled dependency); outcomes feed the
+    breaker (only ``is_failure`` exceptions count — a ``KeyError`` in user code
+    must not trip an IO breaker)."""
+    if not breaker.allow():
+        raise TransientIOError(
+            'circuit breaker {!r} is open (cooling down for {:.3g}s after {} '
+            'consecutive failure(s)); failing fast instead of re-touching the '
+            'broken dependency'.format(breaker.name, breaker.recovery_timeout_s,
+                                       breaker.failure_threshold))
+    try:
+        result = fn()
+    except BaseException as exc:
+        if is_failure(exc):
+            breaker.record_failure()
+        raise
+    breaker.record_success()
+    return result

@@ -43,6 +43,7 @@ STAGES = (
     'cache_hit',      # serving a decoded rowgroup from the cache (worker)
     'cache_miss',     # the full fill of a missed key — ENVELOPES read+decode
     'cache_store',    # writing a filled value to the cache (worker)
+    'cache_corrupt',  # detecting+deleting a corrupt entry (worker; count = entries)
     'serialize',      # result -> wire frames (process-pool worker main)
     'shm_slot_wait',  # backpressure wait for a free ring slot (worker main)
     'shm_map',        # slot view + deserialize on the consumer (pool)

@@ -15,9 +15,20 @@ Usage::
         FaultRule('part_0', times=1, kind='fail'),          # first open of part_0 fails
         FaultRule('part_1', kind='latency', latency_s=0.2), # every open is slow
         FaultRule('part_2', kind='kill'),                   # SIGKILL the opening process
+        FaultRule('part_3', kind='hang', times=1),          # opener sleeps "forever"
+        FaultRule('part_4', kind='corrupt', times=1),       # bit-flip the file first
     ])
     fs = fault_injecting_filesystem(schedule)               # wraps LocalFileSystem
     make_reader('file:///data', filesystem=fs, on_error='retry', ...)
+
+``kind='hang'`` models the two real hang shapes the watchdog distinguishes
+(docs/robustness.md): ``hang_mode='sleep'`` blocks only the opening thread
+(GIL released — heartbeats keep flowing; only the per-item deadline catches
+it), ``hang_mode='stop'`` SIGSTOPs the whole process (heartbeats stall — the
+staleness reap catches it; the watchdog's SIGKILL terminates a stopped
+process). ``kind='corrupt'`` damages the target FILE in place before the open
+proceeds (``corrupt_mode='flip'`` bit-flips the middle byte,
+``'truncate'`` halves it) — deterministic bit-rot for self-heal tests.
 
 The wrapper is picklable (ships to process-pool workers through the dill bootstrap) and
 rebuilds its wrapped filesystem on unpickle.
@@ -30,7 +41,9 @@ import pyarrow.fs as pafs
 
 from petastorm_tpu.errors import TransientIOError
 
-_FAULT_KINDS = ('fail', 'latency', 'kill')
+_FAULT_KINDS = ('fail', 'latency', 'kill', 'hang', 'corrupt')
+_HANG_MODES = ('sleep', 'stop')
+_CORRUPT_MODES = ('flip', 'truncate')
 
 
 class FaultRule(object):
@@ -47,22 +60,40 @@ class FaultRule(object):
     :param exception_type: exception class for ``'fail'`` — default
         :class:`TransientIOError` (retryable); pass e.g. ``ValueError`` to model a
         permanent fault.
+    :param hang_mode: for ``'hang'``: ``'sleep'`` (block only the opening thread
+        for ``hang_s`` — a GIL-releasing stall, caught by the per-item deadline)
+        or ``'stop'`` (SIGSTOP the whole process — a process-wide wedge, caught
+        by heartbeat staleness).
+    :param hang_s: sleep duration for ``hang_mode='sleep'`` (default: effectively
+        forever relative to any test deadline).
+    :param corrupt_mode: for ``'corrupt'``: ``'flip'`` (XOR the middle byte of
+        the target file) or ``'truncate'`` (halve it) before the open proceeds.
     """
 
     def __init__(self, path_substring, kind='fail', times=None, after=0,
-                 latency_s=0.0, exception_type=TransientIOError):
+                 latency_s=0.0, exception_type=TransientIOError,
+                 hang_mode='sleep', hang_s=3600.0, corrupt_mode='flip'):
         if kind not in _FAULT_KINDS:
             raise ValueError('kind must be one of {}, got {!r}'.format(_FAULT_KINDS, kind))
         if times is not None and times < 1:
             raise ValueError('times must be >= 1 or None')
         if after < 0:
             raise ValueError('after must be >= 0')
+        if hang_mode not in _HANG_MODES:
+            raise ValueError('hang_mode must be one of {}, got {!r}'
+                             .format(_HANG_MODES, hang_mode))
+        if corrupt_mode not in _CORRUPT_MODES:
+            raise ValueError('corrupt_mode must be one of {}, got {!r}'
+                             .format(_CORRUPT_MODES, corrupt_mode))
         self.path_substring = path_substring
         self.kind = kind
         self.times = times
         self.after = after
         self.latency_s = latency_s
         self.exception_type = exception_type
+        self.hang_mode = hang_mode
+        self.hang_s = hang_s
+        self.corrupt_mode = corrupt_mode
 
     def matches(self, path):
         return self.path_substring in path
@@ -108,6 +139,16 @@ class FaultSchedule(object):
             elif rule.kind == 'kill':
                 import signal
                 os.kill(os.getpid(), signal.SIGKILL)
+            elif rule.kind == 'hang':
+                if rule.hang_mode == 'stop':
+                    import signal
+                    # process-wide wedge: every thread (heartbeat included)
+                    # freezes; only the watchdog's SIGKILL ends it
+                    os.kill(os.getpid(), signal.SIGSTOP)
+                else:
+                    time.sleep(rule.hang_s)
+            elif rule.kind == 'corrupt':
+                corrupt_file(path, rule.corrupt_mode)
             else:
                 raise rule.exception_type(
                     'injected fault #{} for {!r} (rule {}: open of {})'
@@ -124,6 +165,29 @@ class FaultSchedule(object):
                 count += 1
             counts.append(count)
         return counts[rule_index] if rule_index is not None else sum(counts)
+
+
+def corrupt_file(path, corrupt_mode='flip'):
+    """THE repo-wide file-damage model (rule ``kind='corrupt'``, and called
+    directly by corruption tests so every self-heal test exercises identical
+    damage): ``'flip'`` XORs the middle byte in place, ``'truncate'`` halves
+    the file but never below 24 bytes — a leading magic/header stays intact, so
+    the damage lands in the BODY that only a checksum can defend. Local paths
+    only (the wrapper normalizes them before the base open)."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return  # nothing to corrupt; let the real open report the miss
+    if size == 0:
+        return
+    with open(path, 'r+b') as f:
+        if corrupt_mode == 'truncate':
+            f.truncate(max(24, size // 2))
+        else:
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
 
 
 class FaultInjectingHandler(pafs.FileSystemHandler):

@@ -215,7 +215,17 @@ def check_store_roundtrip(rows=200, workers=2):
             'io_retries': diag.get('io_retries', 0),
             'rowgroups_quarantined': diag.get('rowgroups_quarantined', 0),
             'quarantine': diag.get('quarantine', []),
-            'telemetry': telemetry}
+            'telemetry': telemetry,
+            # lifted to report['resilience'] by collect_report — the hang/
+            # integrity/breaker view of docs/robustness.md
+            'resilience': {
+                'breakers': diag.get('breakers', {}),
+                'workers_hung_reaped': diag.get('workers_hung_reaped', 0),
+                'shm_crc_failures': diag.get('shm_crc_failures', 0),
+                'cache_corrupt_entries':
+                    diag.get('cache', {}).get('corrupt_entries', 0),
+                'rowgroups_quarantined': diag.get('rowgroups_quarantined', 0),
+            }}
 
 
 def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
@@ -240,6 +250,13 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
         from petastorm_tpu.telemetry.analyze import attribute_bottleneck
         report['telemetry'] = {'snapshot': snapshot,
                                'bottleneck': attribute_bottleneck(snapshot)}
+    # Resilience block (docs/robustness.md): breaker states + hung-reap/corrupt
+    # counts, lifted to report level so --json consumers find one stable key.
+    # Always present — dashboards alert on it without key-existence checks.
+    resilience = report['store_roundtrip'].pop('resilience', None)
+    report['resilience'] = resilience if resilience is not None else {
+        'breakers': {}, 'workers_hung_reaped': 0, 'shm_crc_failures': 0,
+        'cache_corrupt_entries': 0, 'rowgroups_quarantined': 0}
     report['healthy'] = report['store_roundtrip'].get('status') == 'ok'
     return report
 
@@ -287,6 +304,22 @@ def _print_human(report):
         print('  telemetry: top stage {} ({:.0%} of {:.3f}s stage time) -> {}'
               .format(b['top_stage'], b['top_share'],
                       b.get('total_stage_seconds', 0.0), b['recommendation']))
+    resilience = report.get('resilience') or {}
+    open_breakers = sorted(
+        name for name, state in (resilience.get('breakers') or {}).items()
+        if state.get('state') != 'closed')
+    if open_breakers:
+        print('  WARNING: circuit breaker(s) not closed: {} — a dependency is '
+              'being routed around; reads are degraded, not broken '
+              '(docs/robustness.md)'.format(', '.join(open_breakers)))
+    degraded = {key: resilience.get(key, 0)
+                for key in ('workers_hung_reaped', 'shm_crc_failures',
+                            'cache_corrupt_entries')
+                if resilience.get(key, 0)}
+    if degraded:
+        print('  resilience: {} — the roundtrip needed hang/corruption '
+              'recovery on a local disk; check the hardware'.format(
+                  ', '.join('{}={}'.format(k, v) for k, v in sorted(degraded.items()))))
     print('  verdict: {}'.format('healthy' if report['healthy'] else 'BROKEN'))
 
 

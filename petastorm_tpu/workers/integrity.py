@@ -1,0 +1,76 @@
+"""Payload integrity helpers for the zero-copy data plane (docs/robustness.md
+"Hang detection & circuit breakers").
+
+The shm slot ring and the Arrow-IPC disk cache both hand the consumer bytes
+that no kernel checksum protects end-to-end: a torn slot write (producer died
+mid-copy with a reused generation), a bit flip in page cache, or a truncated
+cache file would flow straight into training arrays. Every shm descriptor and
+every cache entry therefore carries a CRC of its payload, verified on the
+consuming side before a single byte is interpreted.
+
+The checksum is CRC-32 via :func:`zlib.crc32` (castagnoli-polynomial ``crc32c``
+would be preferable for hardware acceleration, but this image ships no crc32c
+binding and the data plane must not grow a dependency for it); the chained-
+update form lets multi-frame payloads be summed without concatenation. A
+deterministic test-only corruption hook (:func:`corrupt_for_test`) flips one
+byte of a freshly written slot when the ``PETASTORM_TPU_TEST_SHM_CORRUPT``
+env var names a marker-file state dir — the same global-atomic-claim scheme
+``test_util.fault_injection`` uses, so "corrupt the first N shm writes" is
+exact across every worker process.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Iterable, Union
+
+Frame = Union[bytes, bytearray, memoryview]
+
+#: env var enabling the deterministic shm-write corruption hook; value is
+#: ``<state_dir>:<times>`` (flip one byte in each of the first <times> slot
+#: writes, globally across worker processes)
+TEST_SHM_CORRUPT_ENV = 'PETASTORM_TPU_TEST_SHM_CORRUPT'
+
+
+def payload_checksum(frames: Iterable[Frame]) -> int:
+    """Chained CRC-32 over ``frames`` in order (equal to the CRC of their
+    concatenation); returns an unsigned 32-bit int."""
+    crc = 0
+    for frame in frames:
+        crc = zlib.crc32(frame, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _claim_marker(state_dir: str, prefix: str) -> int:
+    """Atomically claim the next global sequence number for ``prefix`` in
+    ``state_dir`` (``O_CREAT|O_EXCL`` marker files, exactly as
+    ``test_util.fault_injection.FaultSchedule`` does)."""
+    index = 0
+    while True:
+        marker = os.path.join(state_dir, '{}.{}'.format(prefix, index))
+        try:
+            fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            index += 1
+            continue
+        os.close(fd)
+        return index
+
+
+def corrupt_for_test(buf: memoryview, offset: int, length: int) -> bool:
+    """Test-only hook: when :data:`TEST_SHM_CORRUPT_ENV` is set to
+    ``<state_dir>:<times>``, flip one byte in the middle of
+    ``buf[offset:offset+length]`` for each of the first ``times`` calls
+    globally (across processes). Returns True when a byte was flipped. A no-op
+    (False) in production — one env lookup per slot write."""
+    spec = os.environ.get(TEST_SHM_CORRUPT_ENV)
+    if not spec or length <= 0:
+        return False
+    state_dir, _, times_str = spec.rpartition(':')
+    seq = _claim_marker(state_dir, 'shm-corrupt')
+    if seq >= int(times_str):
+        return False
+    target = offset + length // 2
+    buf[target] = buf[target] ^ 0xFF
+    return True

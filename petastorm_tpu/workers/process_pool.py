@@ -24,6 +24,42 @@ exec_in_new_process.py:15-17) as fresh interpreters running
 Each worker runs a parent-watchdog thread and exits if the main process dies
 (reference: process_pool.py:320-327).
 
+**Hang watchdog** (docs/robustness.md "Hang detection & circuit breakers"): respawn
+alone only fires on process *death* — a worker wedged in a native deadlock or an
+NFS stall would stall the epoch forever. Two complementary consumer-side detectors
+reap hung-but-alive workers through the same bounded-respawn path:
+
+- **heartbeat staleness**: each worker's heartbeat thread stamps a monotone counter
+  (shm heartbeat word when the ring is up, ``heartbeat`` results-channel messages
+  otherwise); a worker holding assigned items whose stamp has not changed for
+  ``hang_timeout_s`` is process-wide wedged (a GIL-releasing stall keeps stamping)
+  and is SIGKILLed — the existing death path then respawns it and re-ventilates its
+  items.
+- **per-item deadline** (``item_deadline_s``, off by default): an assigned item with
+  no result for that long marks its worker hung even though it keeps heartbeating
+  (GIL-released native stall). The worker is reaped; when a hang-result factory is
+  installed (``on_error='skip'``), the overdue items are *quarantined* — an empty
+  stand-in batch carrying a ``QuarantineRecord(reason='hang')`` is delivered instead
+  of re-dispatching a rowgroup that already demonstrated it hangs a worker.
+
+Both checks run only while ``get_results`` is idle-polling (results drained, consumer
+actually starved) — a consumer away in a long training step can neither observe
+staleness nor accrue false deadlines against queued-but-unread results. Reaps count
+into ``workers_hung_reaped`` and the ``watchdog_reap`` telemetry counter, and consume
+the same ``max_worker_respawns`` budget as deaths: a worker that hangs repeatedly
+fails loudly, exactly like one that crashes repeatedly.
+
+**Frame integrity + the shm circuit breaker**: every shm descriptor carries a CRC-32
+of its payload (``workers/shm_ring.py``) verified before deserialization. A mismatch
+(torn write / bit flip that the generation stamp cannot see) drops the frame unread,
+counts ``shm_crc_failures`` (+ ``shm_crc_fail`` telemetry), SIGKILLs the producing
+worker — its slot memory is no longer trusted, and the proven death path re-ventilates
+its in-flight items — and records a failure on the pool's shm
+:class:`~petastorm_tpu.resilience.CircuitBreaker`. While that breaker is open, work
+dispatches carry a ``b'0'`` transport flag telling workers to publish over plain ZMQ
+frames (the temporary wire fallback); after ``recovery_timeout_s`` a half-open probe
+item rides the ring again and a verified result re-closes the breaker.
+
 **Shared-memory transport** (``shm_transport``, default auto-on): result payloads are
 written into a ``workers/shm_ring.py`` slot ring owned by this pool and only a tiny
 slot descriptor crosses ZMQ as a ``result_shm`` message; the consumer maps the slot
@@ -55,12 +91,23 @@ logger = logging.getLogger(__name__)
 
 _WORKER_STARTUP_TIMEOUT_S = 30
 #: message kinds on the results channel; ``result_shm`` carries a shm-slot
-#: descriptor instead of the payload frames
+#: descriptor instead of the payload frames, ``heartbeat`` a liveness stamp
 MSG_STARTED, MSG_RESULT, MSG_DONE, MSG_ERROR = b'started', b'result', b'done', b'error'
 MSG_RESULT_SHM = b'result_shm'
+MSG_HEARTBEAT = b'heartbeat'
 #: default total respawn budget — one bad rowgroup killing the same worker repeatedly
 #: must exhaust the budget and fail loudly, not respawn forever
 DEFAULT_MAX_WORKER_RESPAWNS = 3
+#: watchdog defaults: stamp cadence, and how long a stamp may go unchanged (while
+#: the worker holds assigned items) before the worker counts as hung. The timeout
+#: is deliberately >> the interval: a worker briefly starved of the GIL by a big
+#: in-Python decode must not be reaped for being slow.
+DEFAULT_HEARTBEAT_INTERVAL_S = 0.5
+DEFAULT_HANG_TIMEOUT_S = 30.0
+#: shm breaker defaults: consecutive CRC failures before the wire fallback, and
+#: the cooldown before a half-open probe rides the ring again
+DEFAULT_SHM_BREAKER_THRESHOLD = 3
+DEFAULT_SHM_BREAKER_RECOVERY_S = 30.0
 
 
 class WorkerTerminationError(Exception):
@@ -74,7 +121,10 @@ class ProcessPool(object):
 
     def __init__(self, workers_count, results_queue_size=50, zmq_copy_buffers=False,
                  payload_serializer=None, max_worker_respawns=DEFAULT_MAX_WORKER_RESPAWNS,
-                 shm_transport=None, shm_slot_bytes=None, shm_slots_per_worker=None):
+                 shm_transport=None, shm_slot_bytes=None, shm_slots_per_worker=None,
+                 heartbeat_interval_s=DEFAULT_HEARTBEAT_INTERVAL_S,
+                 hang_timeout_s=DEFAULT_HANG_TIMEOUT_S, item_deadline_s=None,
+                 shm_checksum=True, shm_breaker=None):
         """``payload_serializer`` picks the wire format for worker results (reference:
         process_pool.py:251-270 pluggable serializers): default
         :class:`~petastorm_tpu.workers.serializers.ArrowIpcSerializer` (columnar
@@ -89,7 +139,18 @@ class ProcessPool(object):
         unavailable), False (ZMQ frames only, the seed behavior). ``shm_slot_bytes`` /
         ``shm_slots_per_worker`` size the ring (defaults in ``workers/shm_ring.py``);
         slot count bounds the transport's in-flight payloads per worker
-        (backpressure)."""
+        (backpressure).
+
+        Watchdog knobs (module docstring; docs/robustness.md): workers stamp
+        liveness every ``heartbeat_interval_s`` (0/None disables stamping); a worker
+        holding assigned items whose stamp stalls for ``hang_timeout_s`` (None
+        disables the staleness reap) or whose item exceeds ``item_deadline_s``
+        (None disables the per-item deadline) is SIGKILLed and respawned within
+        ``max_worker_respawns``. ``shm_checksum=False`` skips CRC verification of
+        shm frames (benchmark baseline; keep it on in production). ``shm_breaker``
+        overrides the shm transport's :class:`~petastorm_tpu.resilience.
+        CircuitBreaker` (tests inject one with a fake clock)."""
+        from petastorm_tpu.resilience import CircuitBreaker
         from petastorm_tpu.workers import shm_ring
         from petastorm_tpu.workers.serializers import ArrowIpcSerializer
         self._workers_count = workers_count
@@ -126,6 +187,46 @@ class ProcessPool(object):
         # result, so a per-call throttle would still run the liveness probe (ventilator
         # lock + per-worker poll) once per result.
         self._next_liveness_check = 0.0
+
+        # ------------------------------------------------------- hang watchdog
+        self._heartbeat_interval_s = heartbeat_interval_s or 0
+        self._hang_timeout_s = hang_timeout_s
+        if (self._hang_timeout_s is not None and self._heartbeat_interval_s
+                and self._hang_timeout_s < 4 * self._heartbeat_interval_s):
+            raise ValueError('hang_timeout_s ({}) must be >= 4x '
+                             'heartbeat_interval_s ({}) or staleness cannot be '
+                             'told from stamp jitter'
+                             .format(hang_timeout_s, heartbeat_interval_s))
+        self._item_deadline_s = item_deadline_s
+        #: worker slot -> [last_stamp_value, monotonic_time_of_last_change]
+        self._hb_state = {}
+        self._dispatch_time = {}              # token -> monotonic dispatch time
+        self._hang_results = collections.deque()  # synthesized quarantine batches
+        self._hang_result_factory = None
+        self._workers_hung_reaped = 0
+        self._next_hang_check = 0.0
+
+        # -------------------------------------------------------- shm integrity
+        self._shm_checksum = shm_checksum
+        self._shm_crc_failures = 0
+        # token -> current attempt number, bumped on every re-ventilation. The
+        # 'done' ack echoes the attempt it was dispatched with, so an ack from a
+        # SUPERSEDED attempt (e.g. the done a corrupt result's producer may or
+        # may not have flushed before its SIGKILL — ZMQ gives no guarantee
+        # either way) can never retire an item the redelivery attempt still
+        # owes, nor double-retire one the redelivery already acked.
+        self._attempt = {}
+
+        def _count_breaker_open(name, old_state, new_state):
+            if new_state == 'open' and telemetry_enabled():
+                self.telemetry.inc('breaker_open')
+        self._shm_breaker = shm_breaker if shm_breaker is not None else \
+            CircuitBreaker('shm_transport',
+                           failure_threshold=DEFAULT_SHM_BREAKER_THRESHOLD,
+                           recovery_timeout_s=DEFAULT_SHM_BREAKER_RECOVERY_S)
+        # injected breakers feed the breaker_open telemetry counter too;
+        # observe_transitions chains after (never clobbers) any caller wiring
+        self._shm_breaker.observe_transitions(_count_breaker_open)
 
         # ---------------------------------------------------- dispatch bookkeeping
         # All mutated under _state_lock: ventilate() runs on the ventilator thread,
@@ -207,12 +308,15 @@ class ProcessPool(object):
             'control_addr': 'tcp://127.0.0.1:{}'.format(control_port),
             'results_addr': 'tcp://127.0.0.1:{}'.format(results_port),
             'parent_pid': os.getpid(),
-            'shm': (dict(self._ring.worker_spec(), name=self._ring.name)
+            'shm': (dict(self._ring.worker_spec(), name=self._ring.name,
+                         checksum=self._shm_checksum)
                     if self._ring is not None else None),
+            'heartbeat_interval_s': self._heartbeat_interval_s,
         }
         self._slot_generation = [0] * self._workers_count
         for worker_id in range(self._workers_count):
             self._processes.append(self._spawn_worker(worker_id, generation=0))
+            self._hb_state[worker_id] = [0, time.monotonic()]
 
         # Startup handshake (reference: process_pool.py:200-213).
         deadline = time.time() + _WORKER_STARTUP_TIMEOUT_S
@@ -285,7 +389,10 @@ class ProcessPool(object):
 
     def _dispatch_pending(self):
         """Assign pending items to ready workers (consumer thread only — ROUTER sends
-        must stay single-threaded)."""
+        must stay single-threaded). The trailing transport flag tells the worker
+        whether its result may ride the shm ring — ``b'0'`` while the shm circuit
+        breaker is open (the temporary ZMQ-wire fallback after repeated CRC
+        failures)."""
         while True:
             with self._state_lock:
                 while self._pending and self._pending[0] not in self._items:
@@ -301,8 +408,13 @@ class ProcessPool(object):
                 token = self._pending.popleft()
                 blob = self._items[token]
                 self._assigned[token] = identity
+                self._dispatch_time[token] = time.monotonic()
+                attempt = self._attempt.setdefault(token, 0)
+            shm_flag = b'1' if (self._ring is not None
+                                and self._shm_breaker.allow()) else b'0'
             self._dispatch_socket.send_multipart(
-                [identity, b'work', b'%d' % token, blob])
+                [identity, b'work', b'%d' % token, blob, shm_flag,
+                 b'%d' % attempt])
 
     def _release_slot(self, descriptor):
         """Ack a consumed (or duplicate-dropped) shm slot back to the worker that
@@ -322,12 +434,21 @@ class ProcessPool(object):
             self.telemetry.observe('shm_release',
                                    time.perf_counter() - release_start)
 
-    def _handle_done(self, token):
+    def _handle_done(self, token, attempt=None):
         with self._state_lock:
             if token not in self._items:
                 return  # duplicate 'done' from a superseded attempt
+            if attempt is not None and attempt != self._attempt.get(token, 0):
+                # Ack from a superseded dispatch (e.g. the producer of a
+                # CRC-failed frame flushed its done before the reaping SIGKILL
+                # landed): the item was re-ventilated, and only the CURRENT
+                # attempt's ack may retire it — otherwise the redelivered
+                # result would be lost (retire-before-delivery).
+                return
             del self._items[token]
             self._assigned.pop(token, None)
+            self._dispatch_time.pop(token, None)
+            self._attempt.pop(token, None)
             self._delivered.discard(token)
         if self._ventilator is not None:
             self._ventilator.processed_item()
@@ -362,6 +483,10 @@ class ProcessPool(object):
                 if slot_gen is None or slot_gen[0] != slot:
                     continue
                 del self._assigned[token]
+                self._dispatch_time.pop(token, None)
+                # New attempt number: any done the dead worker managed to flush
+                # for this token is now a stale ack and cannot retire the item.
+                self._attempt[token] = self._attempt.get(token, 0) + 1
                 # _delivered intentionally untouched: whether the dead worker's result
                 # already reached the consumer or is still in the PULL buffer, the
                 # FIRST result to be delivered marks the token and every later one is
@@ -371,12 +496,141 @@ class ProcessPool(object):
             self._slot_generation[slot] += 1
             generation = self._slot_generation[slot]
             self._workers_respawned += 1
+            # fresh liveness clock for the replacement (it has not stamped yet)
+            self._hb_state[slot] = [0, time.monotonic()]
         logger.warning(
             'Worker %d (pid %d) died with exit code %s mid-epoch; respawning '
             '(%d/%d respawns used) and re-ventilating %d in-flight item(s)',
             slot, dead_process.pid, dead_process.returncode, self._workers_respawned,
             self._max_worker_respawns, len(requeued))
         self._processes[slot] = self._spawn_worker(slot, generation)
+
+    # ----------------------------------------------------------- hang watchdog
+
+    def set_hang_result_factory(self, factory):
+        """Install the per-item-deadline quarantine hook: ``factory(item_kwargs,
+        elapsed_s)`` must return a result object (an empty stand-in batch carrying
+        a ``QuarantineRecord(reason='hang')``) delivered in place of the overdue
+        item's real result. Installed by the reader under ``on_error='skip'``;
+        without it, overdue items are re-ventilated on the replacement worker (and
+        a rowgroup that hangs every worker exhausts the respawn budget loudly)."""
+        self._hang_result_factory = factory
+
+    def _note_heartbeat(self, payload):
+        """A ``heartbeat`` message arrived on the results channel (ring-less
+        transport): record the stamp for the producing worker slot."""
+        slot = int(bytes(memoryview(payload[0])))
+        generation = int(bytes(memoryview(payload[1])))
+        seq = int(bytes(memoryview(payload[2])))
+        with self._state_lock:
+            if self._slot_generation[slot] != generation:
+                return  # stale stamp from a reaped worker's dying breath
+            state = self._hb_state.get(slot)
+            if state is None or state[0] != seq:
+                self._hb_state[slot] = [seq, time.monotonic()]
+
+    def _heartbeat_stale_s(self, slot, now):
+        """Seconds since worker ``slot``'s heartbeat stamp last CHANGED (0.0 right
+        after a change), or None when stamping is disabled. Change detection is
+        consumer-side, so worker and pool clocks are never compared."""
+        if not self._heartbeat_interval_s:
+            return None
+        state = self._hb_state.get(slot)
+        if state is None:
+            state = [0, now]
+            self._hb_state[slot] = state
+        if self._ring is not None:
+            value = self._ring.heartbeat(slot)
+            if value != state[0]:
+                self._hb_state[slot] = [value, now]
+                return 0.0
+        return now - state[1]
+
+    def _check_hangs(self):
+        """Reap hung-but-alive workers (module docstring). Runs only from the
+        idle branch of ``get_results`` — every queued result/heartbeat has been
+        drained, so observed staleness is real, not a consumer that was away."""
+        if self._hang_timeout_s is None and self._item_deadline_s is None:
+            return
+        now = time.monotonic()
+        if now < self._next_hang_check:
+            return
+        self._next_hang_check = now + 0.5
+        with self._state_lock:
+            assigned_by_slot = {}
+            for token, identity in self._assigned.items():
+                slot_gen = self._identity_slot.get(identity)
+                if slot_gen is not None:
+                    assigned_by_slot.setdefault(slot_gen[0], []).append(token)
+            dispatch_time = dict(self._dispatch_time)
+        for slot, process in enumerate(self._processes):
+            if process.poll() is not None:
+                continue  # already dead: _check_liveness owns that path
+            tokens = assigned_by_slot.get(slot)
+            if not tokens:
+                # keep the change tracker fresh so idle stretches between items
+                # never accrue staleness
+                self._heartbeat_stale_s(slot, now)
+                continue
+            stale_s = self._heartbeat_stale_s(slot, now)
+            heartbeat_hung = (self._hang_timeout_s is not None
+                              and stale_s is not None
+                              and stale_s > self._hang_timeout_s)
+            overdue = []
+            if self._item_deadline_s is not None:
+                overdue = [token for token in tokens
+                           if now - dispatch_time.get(token, now)
+                           > self._item_deadline_s]
+            if heartbeat_hung or overdue:
+                self._reap_hung_worker(slot, process, overdue, stale_s, now,
+                                       dispatch_time)
+
+    def _reap_hung_worker(self, slot, process, overdue, stale_s, now,
+                          dispatch_time):
+        """SIGKILL a hung worker so the existing death path respawns it and
+        re-ventilates its items. Overdue items are quarantined first (when a
+        hang-result factory is installed): re-dispatching a rowgroup that just
+        demonstrated it hangs a worker would burn the whole respawn budget on
+        the same poison item."""
+        with self._state_lock:
+            self._workers_hung_reaped += 1
+            reap_count = self._workers_hung_reaped
+        if telemetry_enabled():
+            self.telemetry.inc('watchdog_reap')
+        logger.error(
+            'Worker %d (pid %d) is hung (heartbeat stale %.1fs, %d item(s) past '
+            'the %s item deadline); reaping it (hung-reap #%d — consumes the '
+            'respawn budget)',
+            slot, process.pid, stale_s if stale_s is not None else -1.0,
+            len(overdue), self._item_deadline_s, reap_count)
+        if self._hang_result_factory is not None and overdue:
+            import dill
+            for token in overdue:
+                with self._state_lock:
+                    blob = self._items.pop(token, None)
+                    self._assigned.pop(token, None)
+                    self._dispatch_time.pop(token, None)
+                    self._attempt.pop(token, None)
+                if blob is None:
+                    continue  # superseded meanwhile
+                elapsed = now - dispatch_time.get(token, now)
+                try:
+                    stand_in = self._hang_result_factory(dill.loads(blob), elapsed)
+                except Exception:  # noqa: BLE001 - never lose the reap to the hook
+                    logger.exception('hang-result factory failed for token %d; '
+                                     're-ventilating the item instead', token)
+                    with self._state_lock:
+                        self._items[token] = blob
+                        self._attempt[token] = self._attempt.get(token, 0) + 1
+                        self._pending.appendleft(token)
+                    continue
+                self._hang_results.append(stand_in)
+                # the item is retired exactly as a 'done' would retire it
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+        process.kill()
+        # The next liveness pass observes the death and respawns through the
+        # bounded budget; any still-assigned tokens re-ventilate there.
 
     def get_results(self, timeout=None):
         import zmq
@@ -386,6 +640,10 @@ class ProcessPool(object):
         deadline = None if timeout is None else time.time() + timeout
         wait_start = time.perf_counter()
         while True:
+            if self._hang_results:
+                # Stand-in batch synthesized for a hang-quarantined item: deliver
+                # it like any other result (the quarantine record rides it).
+                return self._hang_results.popleft()
             # Liveness on the hot path too — not only when results stop: with several
             # workers, survivors keep producing after one dies, but the dead worker's
             # in-flight items would otherwise silently vanish. Throttled to ~10Hz
@@ -400,6 +658,15 @@ class ProcessPool(object):
             self._dispatch_pending()
             events = dict(poller.poll(100))
             if not events:
+                # Hang detection belongs exactly here: the queues are drained and
+                # the consumer is genuinely starved, so heartbeat staleness and
+                # item deadlines measure the workers, not a busy consumer.
+                if not self._stopped:
+                    self._check_hangs()
+                    if self._hang_results:
+                        # a reap just quarantined item(s) — deliver the stand-in
+                        # BEFORE the completed() check can end the epoch
+                        continue
                 if self._ventilator is not None and getattr(self._ventilator, 'error', None):
                     self.stop()
                     raise self._ventilator.error
@@ -416,8 +683,14 @@ class ProcessPool(object):
             if self._results_socket not in events:
                 continue
             kind, payload = self._recv()
+            if kind == MSG_HEARTBEAT:
+                self._note_heartbeat(payload)
+                continue
             if kind == MSG_DONE:
-                self._handle_done(int(bytes(memoryview(payload[0]))))
+                self._handle_done(
+                    int(bytes(memoryview(payload[0]))),
+                    attempt=(int(bytes(memoryview(payload[1])))
+                             if len(payload) > 1 else None))
                 continue
             if kind == MSG_ERROR:
                 exc, tb = pickle.loads(bytes(memoryview(payload[1])))
@@ -464,8 +737,8 @@ class ProcessPool(object):
 
     def _handle_shm_result(self, payload):
         """One ``result_shm`` message: validate the descriptor's generation, dedup the
-        token, deserialize zero-copy from the slot, ack the slot. Returns
-        ``(payload_obj,)`` to deliver or None to keep polling."""
+        token, verify the payload CRC, deserialize zero-copy from the slot, ack the
+        slot. Returns ``(payload_obj,)`` to deliver or None to keep polling."""
         from petastorm_tpu.workers.shm_ring import ShmSlotDescriptor
         token = int(bytes(memoryview(payload[0])))
         descriptor = ShmSlotDescriptor.from_bytes(bytes(memoryview(payload[1])))
@@ -479,13 +752,9 @@ class ProcessPool(object):
                 self._shm_stale_drops += 1
                 return None
             duplicate = token not in self._items or token in self._delivered
-            if duplicate:
-                self._results_dropped += 1
-            else:
-                self._delivered.add(token)
-                self._shm_batches += 1
-                self._shm_bytes_mapped += descriptor.total_bytes
         if duplicate:
+            with self._state_lock:
+                self._results_dropped += 1
             self._release_slot(descriptor)  # still owed: the slot holds real bytes
             return None
         if self._ring is None:  # defensive: descriptor without a ring
@@ -494,11 +763,23 @@ class ProcessPool(object):
         map_start = time.perf_counter()
         copy_before = self._serializer_bytes_copied()
         views = self._ring.view(descriptor)
+        if self._shm_checksum and descriptor.crc is not None:
+            from petastorm_tpu.workers.integrity import payload_checksum
+            if payload_checksum(views) != descriptor.crc:
+                for view in views:
+                    view.release()
+                self._on_shm_corruption(descriptor, token)
+                return None
+        with self._state_lock:
+            self._delivered.add(token)
+            self._shm_batches += 1
+            self._shm_bytes_mapped += descriptor.total_bytes
         try:
             result = self._serializer.deserialize(views)
+            self._shm_breaker.record_success()
             if telemetry_enabled():
-                # shm_map: slot view + deserialize; copied bytes = descriptor
-                # frame + the serializer's receive-side copies for this batch
+                # shm_map: slot view + CRC verify + deserialize; copied bytes =
+                # descriptor frame + the serializer's receive-side copies
                 self.telemetry.observe('shm_map',
                                        time.perf_counter() - map_start)
                 self.telemetry.observe(
@@ -517,6 +798,37 @@ class ProcessPool(object):
                 except BufferError:  # pragma: no cover - a consumer kept a ref
                     pass
             self._release_slot(descriptor)
+
+    def _on_shm_corruption(self, descriptor, token):
+        """A shm frame failed its CRC — a torn write or bit flip the generation
+        stamp cannot see. The frame is dropped unread; the producing worker is
+        SIGKILLed (its slot memory is no longer trusted, and the proven death
+        path re-ventilates everything it held, this token included, with the
+        duplicate-drop guard intact); the shm breaker records the failure, so
+        repeated corruption opens it and routes results over the ZMQ wire until
+        the cooldown's half-open probe passes (docs/robustness.md)."""
+        with self._state_lock:
+            self._shm_crc_failures += 1
+            failures = self._shm_crc_failures
+            # Invalidate the producer's ack for this token RIGHT NOW: if its
+            # done(attempt) was flushed before the SIGKILL below lands, it is
+            # already queued behind this frame and would otherwise retire the
+            # item before the respawn path can redeliver it.
+            self._attempt[token] = self._attempt.get(token, 0) + 1
+        if telemetry_enabled():
+            self.telemetry.inc('shm_crc_fail')
+        self._shm_breaker.record_failure()
+        logger.error(
+            'shm frame from worker %d (ring slot %d, token %d) failed CRC '
+            'verification (corruption #%d); dropping it unread, reaping the '
+            'producing worker, and recording a shm-breaker failure (state now %r)',
+            descriptor.worker_slot, descriptor.ring_slot, token, failures,
+            self._shm_breaker.state)
+        process = self._processes[descriptor.worker_slot]
+        if process.poll() is None:
+            process.kill()
+        # No slot release: the replacement worker starts with its range free,
+        # and the death path re-ventilates everything the worker held.
 
     def _serializer_bytes_copied(self):
         """Cumulative receive-side copied bytes from the serializer's stats (0 when
@@ -539,30 +851,19 @@ class ProcessPool(object):
 
     def join(self):
         deadline = time.time() + 10
+        self._drain_until_exit(deadline)
         for slot, process in enumerate(self._processes):
-            while process.poll() is None:
-                if time.time() >= deadline:
-                    # Loud fallback + reap: a silent kill() left both an unexplained
-                    # SIGKILL in the logs' absence AND a zombie (kill without wait).
-                    logger.warning('Worker %d (pid %d) did not exit within 10s of '
-                                   'stop(); sending SIGKILL', slot, process.pid)
-                    process.kill()
-                    try:
-                        process.wait(timeout=5)
-                    except subprocess.TimeoutExpired:
-                        logger.error('Worker %d (pid %d) is unreaped after SIGKILL; '
-                                     'abandoning it as a zombie', slot, process.pid)
-                    break
+            if process.poll() is None:
+                # Loud fallback + reap: a silent kill() left both an unexplained
+                # SIGKILL in the logs' absence AND a zombie (kill without wait).
+                logger.warning('Worker %d (pid %d) did not exit within 10s of '
+                               'stop(); sending SIGKILL', slot, process.pid)
+                process.kill()
                 try:
-                    process.wait(timeout=1.0)
+                    process.wait(timeout=5)
                 except subprocess.TimeoutExpired:
-                    # Re-broadcast stop: a worker respawned moments before stop() may
-                    # still have been starting up — its SUB socket missed the original
-                    # broadcast (PUB drops messages for unjoined subscribers).
-                    try:
-                        self._control_socket.send(b'stop')
-                    except Exception:  # noqa: BLE001 - socket may already be closed
-                        pass
+                    logger.error('Worker %d (pid %d) is unreaped after SIGKILL; '
+                                 'abandoning it as a zombie', slot, process.pid)
         if self._context is not None:
             for sock in (self._dispatch_socket, self._control_socket,
                          self._results_socket):
@@ -572,6 +873,54 @@ class ProcessPool(object):
         # After every worker is reaped: close AND unlink the ring so no /dev/shm
         # segment survives the pool, however the workers died.
         self._release_ring()
+
+    def _drain_until_exit(self, deadline):
+        """Wait (to ``deadline``) for workers to exit, DRAINING both channels in
+        200ms polls. Discarding queued results/heartbeats and acking un-released
+        shm descriptors is what lets a worker blocked in its slot-wait
+        backpressure loop (e.g. publishing the items it held when a sibling was
+        hang-reaped) finish its publish, see the stop broadcast, and exit —
+        instead of riding the full slot-wait timeout into the SIGKILL fallback."""
+        if self._context is None:
+            while (time.time() < deadline
+                    and any(p.poll() is None for p in self._processes)):
+                time.sleep(0.2)
+            return
+        import zmq
+        from petastorm_tpu.workers.shm_ring import ShmSlotDescriptor
+        poller = zmq.Poller()
+        poller.register(self._results_socket, zmq.POLLIN)
+        poller.register(self._dispatch_socket, zmq.POLLIN)
+        next_stop_broadcast = 0.0
+        while any(p.poll() is None for p in self._processes):
+            now = time.time()
+            if now >= deadline:
+                return
+            if now >= next_stop_broadcast:
+                # Re-broadcast stop: a worker respawned moments before stop() may
+                # still have been starting up — its SUB socket missed the original
+                # broadcast (PUB drops messages for unjoined subscribers).
+                next_stop_broadcast = now + 1.0
+                try:
+                    self._control_socket.send(b'stop')
+                except Exception:  # noqa: BLE001 - socket may already be closed
+                    pass
+            events = dict(poller.poll(200))
+            if self._dispatch_socket in events:
+                frames = self._dispatch_socket.recv_multipart()
+                if len(frames) >= 4 and bytes(frames[1]) == b'ready':
+                    self._handle_ready(frames)  # keep release routing current
+            if self._results_socket in events:
+                kind, payload = self._recv()
+                if kind == MSG_RESULT_SHM:
+                    try:
+                        descriptor = ShmSlotDescriptor.from_bytes(
+                            bytes(memoryview(payload[1])))
+                    except Exception:  # noqa: BLE001 - shutdown drain is best-effort
+                        continue
+                    self._release_slot(descriptor)
+                # every other kind (result/done/heartbeat/started/error) is
+                # drained and dropped — the epoch is over
 
     def _release_ring(self):
         if self._ring is not None:
@@ -593,6 +942,10 @@ class ProcessPool(object):
                 'workers_respawned': self._workers_respawned,
                 'results_dropped': self._results_dropped,
                 'in_flight_items': len(self._items),
+                # --------------------------------- hang watchdog + integrity
+                'workers_hung_reaped': self._workers_hung_reaped,
+                'shm_crc_failures': self._shm_crc_failures,
+                'shm_breaker': self._shm_breaker.as_dict(),
                 # ------------------------- zero-copy data plane observability
                 'shm_enabled': self._ring is not None,
                 'shm_batches': self._shm_batches,

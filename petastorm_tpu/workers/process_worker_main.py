@@ -13,7 +13,18 @@ With the shm transport (bootstrap ``shm`` spec), each serialized result is writt
 into one of this worker's ring slots and only the descriptor is sent
 (``result_shm``). No free slot = backpressure: the worker polls its dispatch socket
 for release acks up to a bounded wait, then falls back to plain ZMQ ``result``
-frames — results are never lost to slot exhaustion."""
+frames — results are never lost to slot exhaustion. ``work`` messages may carry a
+transport flag (``b'0'`` = the pool's shm circuit breaker is open: publish this
+item's result over plain ZMQ frames — the temporary wire fallback after repeated
+checksum failures, docs/robustness.md).
+
+A daemon **heartbeat thread** stamps a monotone counter every
+``heartbeat_interval_s`` — into this worker's shm heartbeat word when the ring is
+attached, else as tiny ``heartbeat`` messages on a private PUSH socket to the
+results channel. The pool's watchdog reads the stamps to tell "hung" from "slow":
+a worker wedged process-wide (native deadlock holding the GIL, SIGSTOP) stops
+stamping and is reaped; a worker merely blocked in a GIL-releasing call keeps
+stamping and is instead bounded by the pool's per-item deadline."""
 
 import os
 import pickle
@@ -36,6 +47,39 @@ def _watch_parent(parent_pid):
         if not psutil.pid_exists(parent_pid):
             os._exit(0)
         time.sleep(1)
+
+
+def _heartbeat_loop(stop_event, ring_writer, context, results_addr, worker_id,
+                    generation, interval_s):
+    """Stamp liveness every ``interval_s`` until ``stop_event`` is set: the shm
+    heartbeat word when the ring is attached (no traffic, works even when the
+    results channel is saturated), else non-blocking ``heartbeat`` messages on a
+    PRIVATE push socket (ZMQ sockets are not thread-safe — the main thread owns
+    the results socket). Dropped sends (HWM) are fine: the watchdog only needs
+    *some* stamp to land within its (much longer) staleness window."""
+    import zmq
+    socket = None
+    if ring_writer is None:
+        socket = context.socket(zmq.PUSH)
+        socket.setsockopt(zmq.SNDHWM, 8)
+        socket.setsockopt(zmq.LINGER, 0)
+        socket.connect(results_addr)
+    seq = 0
+    try:
+        while not stop_event.wait(interval_s):
+            seq += 1
+            try:
+                if ring_writer is not None:
+                    ring_writer.stamp_heartbeat(seq)
+                elif socket is not None:
+                    socket.send_multipart(
+                        [b'heartbeat', b'%d' % worker_id, b'%d' % generation,
+                         b'%d' % seq], zmq.NOBLOCK)
+            except Exception:  # noqa: BLE001 - liveness must never kill a worker
+                pass
+    finally:
+        if socket is not None:
+            socket.close(linger=0)
 
 
 def main(bootstrap_path):
@@ -77,14 +121,30 @@ def main(bootstrap_path):
         try:
             ring_writer = ShmRingWriter(shm_spec['name'], worker_id, generation,
                                         shm_spec['slots_per_worker'],
-                                        shm_spec['slot_bytes'])
+                                        shm_spec['slot_bytes'],
+                                        data_offset=shm_spec.get('data_offset', 0),
+                                        checksum=shm_spec.get('checksum', True))
         except Exception:  # noqa: BLE001 - transport optional; ZMQ still works
             import logging
             logging.getLogger(__name__).warning(
                 'worker %d could not attach the shm ring; using ZMQ frames',
                 worker_id, exc_info=True)
 
+    heartbeat_stop = threading.Event()
+    heartbeat_thread = None
+    heartbeat_interval_s = bootstrap.get('heartbeat_interval_s', 0.5)
+    if heartbeat_interval_s and heartbeat_interval_s > 0:
+        heartbeat_thread = threading.Thread(
+            target=_heartbeat_loop,
+            args=(heartbeat_stop, ring_writer, context,
+                  bootstrap['results_addr'], worker_id, generation,
+                  heartbeat_interval_s),
+            daemon=True)
+        heartbeat_thread.start()
+
     current_token = [b'']
+    # b'0' when the pool's shm breaker routed this item to the ZMQ wire
+    current_shm_allowed = [True]
 
     def drain_releases(timeout_ms=0):
         """Process queued ``release`` acks on the dispatch socket; returns any
@@ -109,7 +169,8 @@ def main(bootstrap_path):
         from petastorm_tpu.telemetry.spans import stage_span
         with stage_span('serialize'):
             frames = serializer.serialize(result)
-        if ring_writer is not None and ring_writer.fits(frames):
+        if ring_writer is not None and current_shm_allowed[0] \
+                and ring_writer.fits(frames):
             descriptor = ring_writer.try_write(frames)
             if descriptor is None:
                 # Backpressure: all our slots are in flight — wait (bounded) for
@@ -153,15 +214,27 @@ def main(bootstrap_path):
             token, blob = frames[1], frames[2]
             kwargs = dill.loads(blob)
             current_token[0] = token
+            # optional 4th frame: shm transport flag (b'0' while the pool's shm
+            # circuit breaker is open — docs/robustness.md); optional 5th: the
+            # dispatch attempt number, echoed in 'done' so the pool can tell a
+            # current ack from one flushed by a since-reaped worker
+            current_shm_allowed[0] = len(frames) < 4 or frames[3] != b'0'
+            attempt = frames[4] if len(frames) >= 5 else b'0'
             try:
                 worker.process(**kwargs)
-                results_socket.send_multipart([b'done', token])
+                results_socket.send_multipart([b'done', token, attempt])
             except Exception as exc:  # noqa: BLE001 - ship to consumer
                 blob = pickle.dumps((exc, traceback.format_exc()))
                 results_socket.send_multipart([b'error', token, blob])
             current_token[0] = b''
+            current_shm_allowed[0] = True
             dispatch_socket.send_multipart(ready_msg)
     worker.shutdown()
+    # Stop the heartbeat thread BEFORE terminating the context: its private
+    # push socket must close, or context.term() blocks forever.
+    heartbeat_stop.set()
+    if heartbeat_thread is not None:
+        heartbeat_thread.join(timeout=2 * heartbeat_interval_s + 1)
     if ring_writer is not None:
         ring_writer.close()
     for sock in (dispatch_socket, control_socket, results_socket):

@@ -215,6 +215,10 @@ class ArrowIpcSerializer(object):
             # {stage: histogram_snapshot} dict the consumer merges into its
             # registry — how worker-process timings reach one global snapshot
             'telemetry': getattr(obj, 'telemetry', None),
+            # circuit-breaker sidecar (docs/robustness.md): this process's
+            # tripped-breaker states ({name: state_dict}, None when all healthy)
+            # merged into Reader.diagnostics['breakers']
+            'breakers': getattr(obj, 'breakers', None),
         }
         ipc_buf, sidecar_blob, _ = encode_columnar(obj.columns, obj.num_rows,
                                                    meta_extra)
@@ -239,7 +243,8 @@ class ArrowIpcSerializer(object):
                              item_id=tuple(item_id) if item_id is not None else None,
                              retries=meta.get('retries', 0), quarantine=quarantine,
                              cache_hit=meta.get('cache_hit'),
-                             telemetry=meta.get('telemetry'))
+                             telemetry=meta.get('telemetry'),
+                             breakers=meta.get('breakers'))
 
 
 def _as_bytes(frame):

@@ -24,6 +24,16 @@ Correctness properties this layout buys:
   dead worker, still sitting in the results buffer) is dropped instead of read while
   the replacement worker may already be overwriting the slot; the replacement starts
   with its whole slot range free.
+- **End-to-end integrity**: every descriptor carries a CRC-32 of the payload bytes
+  (computed over the SOURCE frames while copying into the slot), verified by the
+  pool before deserializing — a torn slot write or bit flip the generation stamp
+  cannot see is detected instead of flowing into training arrays
+  (docs/robustness.md).
+- **Liveness**: the segment is prefixed with one 8-byte heartbeat word per worker
+  slot; each worker's heartbeat thread stamps a monotone counter there, and the
+  pool's watchdog reads it without any message traffic — a hung-but-alive worker
+  (stalled heartbeat while holding assigned items) is reaped through the bounded
+  respawn path.
 
 Static partitioning (vs a shared free list) is what makes worker death trivial to
 reason about: no cross-process allocator state can be corrupted mid-crash.
@@ -34,7 +44,11 @@ from __future__ import annotations
 import json
 import logging
 import secrets
+import struct
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from petastorm_tpu.workers.integrity import corrupt_for_test
 
 logger = logging.getLogger(__name__)
 
@@ -43,6 +57,10 @@ logger = logging.getLogger(__name__)
 DEFAULT_SLOT_BYTES: int = 32 << 20
 #: default slots owned by each worker — the transport's in-flight bound per worker
 DEFAULT_SLOTS_PER_WORKER: int = 4
+#: bytes reserved per worker at the head of the segment for its heartbeat word
+#: (a cache line, so concurrent stamps by different workers never share one)
+HEARTBEAT_BYTES: int = 64
+_HEARTBEAT_WORD = struct.Struct('<q')
 
 
 def _shared_memory_module():  # type: ignore[no-untyped-def]
@@ -54,32 +72,38 @@ def _shared_memory_module():  # type: ignore[no-untyped-def]
 
 class ShmSlotDescriptor:
     """Parsed wire descriptor of one shm-resident payload: producing worker slot,
-    its generation, the ring slot index, and the byte length of each serialized
-    frame laid out back-to-back in the slot."""
+    its generation, the ring slot index, the byte length of each serialized
+    frame laid out back-to-back in the slot, and the CRC-32 of the payload
+    (``None`` only for descriptors from a pre-integrity writer)."""
 
-    __slots__ = ('worker_slot', 'generation', 'ring_slot', 'frame_lengths')
+    __slots__ = ('worker_slot', 'generation', 'ring_slot', 'frame_lengths', 'crc')
 
     def __init__(self, worker_slot: int, generation: int, ring_slot: int,
-                 frame_lengths: Sequence[int]) -> None:
+                 frame_lengths: Sequence[int], crc: Optional[int] = None) -> None:
         self.worker_slot = worker_slot
         self.generation = generation
         self.ring_slot = ring_slot
         self.frame_lengths = list(frame_lengths)
+        self.crc = crc
 
     @property
     def total_bytes(self) -> int:
         return sum(self.frame_lengths)
 
     def to_bytes(self) -> bytes:
-        return json.dumps({'w': self.worker_slot, 'g': self.generation,
-                           's': self.ring_slot,
-                           'lens': self.frame_lengths}).encode('utf-8')
+        spec = {'w': self.worker_slot, 'g': self.generation,
+                's': self.ring_slot, 'lens': self.frame_lengths}
+        if self.crc is not None:
+            spec['crc'] = self.crc
+        return json.dumps(spec).encode('utf-8')
 
     @classmethod
     def from_bytes(cls, blob: bytes) -> 'ShmSlotDescriptor':
         spec = json.loads(bytes(blob).decode('utf-8'))
+        crc = spec.get('crc')
         return cls(int(spec['w']), int(spec['g']), int(spec['s']),
-                   [int(n) for n in spec['lens']])
+                   [int(n) for n in spec['lens']],
+                   crc=int(crc) if crc is not None else None)
 
 
 class ShmRing:
@@ -95,13 +119,23 @@ class ShmRing:
         self.workers_count = workers_count
         self.slots_per_worker = slots_per_worker
         self.slot_bytes = slot_bytes
-        total = workers_count * slots_per_worker * slot_bytes
+        #: payload slots start after the per-worker heartbeat words
+        self.data_offset = workers_count * HEARTBEAT_BYTES
+        total = self.data_offset + workers_count * slots_per_worker * slot_bytes
         # Explicit name (not the psm_ default): tests and operators can find (and
         # assert the absence of) our segments in /dev/shm by prefix.
         self.name = 'ptpu-ring-' + secrets.token_hex(8)
         self._shm = shared_memory.SharedMemory(name=self.name, create=True,
                                                size=total)
         self._closed = False
+
+    def heartbeat(self, worker_slot: int) -> int:
+        """Current heartbeat counter stamped by worker ``worker_slot`` (0 until
+        its first stamp). The pool's watchdog polls this — change detection is
+        consumer-side, so no cross-process clock comparison is needed."""
+        value: int = _HEARTBEAT_WORD.unpack_from(
+            self._shm.buf, worker_slot * HEARTBEAT_BYTES)[0]
+        return value
 
     def view(self, descriptor: ShmSlotDescriptor) -> List[memoryview]:
         """Zero-copy memoryviews over the descriptor's frames, in frame order."""
@@ -111,7 +145,7 @@ class ShmRing:
         if descriptor.total_bytes > self.slot_bytes:
             raise ValueError('descriptor claims {} bytes > slot size {}'
                              .format(descriptor.total_bytes, self.slot_bytes))
-        base = descriptor.ring_slot * self.slot_bytes
+        base = self.data_offset + descriptor.ring_slot * self.slot_bytes
         views: List[memoryview] = []
         offset = base
         for length in descriptor.frame_lengths:
@@ -135,7 +169,8 @@ class ShmRing:
     def worker_spec(self) -> Dict[str, int]:
         """The bootstrap fields a worker needs to attach its writer."""
         return {'slots_per_worker': self.slots_per_worker,
-                'slot_bytes': self.slot_bytes}
+                'slot_bytes': self.slot_bytes,
+                'data_offset': self.data_offset}
 
 
 class ShmRingWriter:
@@ -143,11 +178,17 @@ class ShmRingWriter:
     range and tracks which of its slots are awaiting a release ack."""
 
     def __init__(self, name: str, worker_slot: int, generation: int,
-                 slots_per_worker: int, slot_bytes: int) -> None:
+                 slots_per_worker: int, slot_bytes: int,
+                 data_offset: int = 0, checksum: bool = True) -> None:
         shared_memory = _shared_memory_module()
         self.worker_slot = worker_slot
         self.generation = generation
         self.slot_bytes = slot_bytes
+        self._data_offset = data_offset
+        #: False skips the producer-side CRC entirely (descriptors carry
+        #: crc=None and the pool skips verification) — the benchmark baseline;
+        #: production keeps it on
+        self.checksum = checksum
         self._first_slot = worker_slot * slots_per_worker
         self._slots_per_worker = slots_per_worker
         self._free = list(range(self._first_slot,
@@ -174,22 +215,37 @@ class ShmRingWriter:
     def fits(self, frames: Sequence[bytes]) -> bool:
         return sum(len(memoryview(f)) for f in frames) <= self.slot_bytes
 
+    def stamp_heartbeat(self, value: int) -> None:
+        """Write this worker's liveness counter into its heartbeat word (called
+        by the worker's heartbeat thread; an aligned 8-byte store, so the pool
+        never observes a torn value)."""
+        _HEARTBEAT_WORD.pack_into(self._shm.buf,
+                                  self.worker_slot * HEARTBEAT_BYTES, value)
+
     def try_write(self, frames: Sequence[bytes]) -> Optional[ShmSlotDescriptor]:
         """Copy ``frames`` back-to-back into a free slot; None when no slot is
-        free or the payload exceeds the slot size (caller falls back to ZMQ)."""
+        free or the payload exceeds the slot size (caller falls back to ZMQ).
+        The returned descriptor carries the CRC-32 of the SOURCE frames — the
+        consumer recomputes it over the slot, so any divergence between what
+        was serialized and what gets mapped (torn write, bit flip, stale
+        overwrite) is caught before deserialization."""
         if not self._free or not self.fits(frames):
             return None
         ring_slot = self._free.pop()
-        base = ring_slot * self.slot_bytes
+        base = self._data_offset + ring_slot * self.slot_bytes
         offset = base
         lengths: List[int] = []
+        crc: Optional[int] = 0 if self.checksum else None
         for frame in frames:
             view = memoryview(frame).cast('B')
             self._shm.buf[offset:offset + view.nbytes] = view
+            if crc is not None:
+                crc = zlib.crc32(view, crc) & 0xFFFFFFFF
             offset += view.nbytes
             lengths.append(view.nbytes)
+        corrupt_for_test(self._shm.buf, base, offset - base)
         return ShmSlotDescriptor(self.worker_slot, self.generation, ring_slot,
-                                 lengths)
+                                 lengths, crc=crc)
 
     def release(self, ring_slot: int) -> None:
         """Consumer ack arrived: the slot may be reused. Acks outside this

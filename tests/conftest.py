@@ -30,6 +30,16 @@ def rng():
     return np.random.RandomState(42)
 
 
+@pytest.fixture(autouse=True)
+def _reset_breaker_board():
+    """Circuit-breaker isolation: the default BreakerBoard is process-global
+    (docs/robustness.md), so one test's tripped fs/cache breaker must not leak
+    failure streaks into the next test's reads."""
+    yield
+    from petastorm_tpu.resilience import default_board
+    default_board().reset()
+
+
 class SyntheticDataset(object):
     def __init__(self, url, rows):
         self.url = url

@@ -1,9 +1,14 @@
-"""Cache tests (model: petastorm/tests/test_disk_cache.py / test_cache.py)."""
+"""Cache tests (model: petastorm/tests/test_disk_cache.py / test_cache.py), plus
+the integrity/self-heal and circuit-breaker-bypass behavior of
+docs/robustness.md "Hang detection & circuit breakers"."""
+
+import glob
+import os
 
 import numpy as np
 import pytest
 
-from petastorm_tpu.cache import LocalDiskCache, NullCache
+from petastorm_tpu.cache import ArrowIpcDiskCache, LocalDiskCache, NullCache
 
 
 def test_null_cache_always_calls():
@@ -68,3 +73,95 @@ def test_disk_cache_survives_restart(tmp_path):
     path = str(tmp_path / 'c')
     LocalDiskCache(path, 1 << 20).get('k', lambda: 'value')
     assert LocalDiskCache(path, 1 << 20).get('k', lambda: 'OTHER') == 'value'
+
+
+# ---------------------------------------------------------------------------
+# Corruption self-heal + circuit-breaker bypass (docs/robustness.md)
+# ---------------------------------------------------------------------------
+
+def _entry_files(path, suffix):
+    return glob.glob(os.path.join(str(path), '*', '*' + suffix))
+
+
+def test_corrupt_entry_deleted_and_refilled(tmp_path):
+    """Regression (ISSUE 4 satellite): a raising entry used to count as a miss
+    but stay on disk, so every warm epoch re-paid the decode failure. Now the
+    poisoned file is deleted, the refill's store replaces it, and the next get
+    is a clean hit."""
+    cache = LocalDiskCache(str(tmp_path / 'c'), 10 << 20)
+    fills = []
+
+    def fill():
+        fills.append(1)
+        return {'a': np.arange(4)}
+
+    cache.get('k', fill)
+    (entry,) = _entry_files(tmp_path / 'c', '.pkl')
+    with open(entry, 'wb') as f:
+        f.write(b'not a pickle')
+    value = cache.get('k', fill)
+    np.testing.assert_array_equal(value['a'], np.arange(4))
+    assert len(fills) == 2
+    assert cache.stats['corrupt_entries'] == 1
+    # healed in place: same path, now a valid entry — served without a fill
+    assert os.path.exists(entry)
+    cache.get('k', fill)
+    assert len(fills) == 2 and cache.stats['hits'] == 1
+
+
+@pytest.mark.parametrize('damage', ['truncate', 'bitflip'])
+def test_arrow_cache_footer_catches_body_damage(tmp_path, damage):
+    """Magic intact, body damaged: the CRC footer must catch it BEFORE decode
+    (a bit flip inside the Arrow IPC stream is otherwise silently wrong data,
+    not an exception) and self-heal."""
+    cache = ArrowIpcDiskCache(str(tmp_path / 'c'), 10 << 20)
+    fills = []
+
+    def fill():
+        fills.append(1)
+        return {'x': np.arange(32, dtype=np.float32)}
+
+    cache.get('k', fill)
+    (entry,) = _entry_files(tmp_path / 'c', '.arrow')
+    # the one repo-wide damage model: header magic survives, body does not
+    from petastorm_tpu.test_util.fault_injection import corrupt_file
+    corrupt_file(entry, 'truncate' if damage == 'truncate' else 'flip')
+    value = cache.get('k', fill)
+    np.testing.assert_array_equal(value['x'], np.arange(32, dtype=np.float32))
+    assert len(fills) == 2
+    assert cache.stats['corrupt_entries'] == 1
+    # self-healed: warm again
+    cache.get('k', fill)
+    assert len(fills) == 2 and cache.stats['arrow_hits'] == 1
+
+
+def test_cache_breaker_opens_bypasses_and_recovers(tmp_path):
+    """Deterministic closed→open→half-open→closed walk under an injectable
+    clock: repeated corruption opens the breaker (gets bypass the cache), the
+    cooldown's half-open probe hits the healed entry and re-closes it."""
+    from petastorm_tpu.resilience import CircuitBreaker
+    clock = [0.0]
+    breaker = CircuitBreaker('cache:test', failure_threshold=2,
+                             recovery_timeout_s=30.0, clock=lambda: clock[0])
+    cache = LocalDiskCache(str(tmp_path / 'c'), 10 << 20, breaker=breaker)
+    fills = []
+
+    def fill():
+        fills.append(1)
+        return 'v'
+
+    cache.get('k', fill)
+    for _ in range(2):
+        (entry,) = _entry_files(tmp_path / 'c', '.pkl')
+        with open(entry, 'wb') as f:
+            f.write(b'garbage')
+        cache.get('k', fill)
+    assert breaker.state == 'open'
+    fills_before = len(fills)
+    cache.get('k', fill)  # bypassed: filled directly, no read, no store
+    assert cache.stats['bypass_reads'] == 1
+    assert len(fills) == fills_before + 1
+    clock[0] = 31.0  # cooldown elapsed: half-open probe hits the healed entry
+    assert cache.get('k', fill) == 'v'
+    assert breaker.state == 'closed'
+    assert len(fills) == fills_before + 1

@@ -225,3 +225,50 @@ def test_file_deleted_mid_epoch_raises_through_jax_loader(tmp_path):
             loader.join()
 
     _consume_expect_error(iterate)
+
+
+# ---------------------------------------------------------------------------
+# Corrupt cache entries (ISSUE 4): footer-verified, self-healing warm epochs
+# ---------------------------------------------------------------------------
+
+def _arrow_cache_entries(cache_dir):
+    entries = sorted(glob.glob(os.path.join(str(cache_dir), '*', '*.arrow')))
+    assert entries, 'no arrow cache entries under {}'.format(cache_dir)
+    return entries
+
+
+@pytest.mark.faultinject
+@pytest.mark.parametrize('damage', ['truncate', 'bitflip'])
+def test_corrupt_arrow_cache_entry_self_heals_through_reader(tmp_path, damage):
+    """A warm-epoch ArrowIpcDiskCache entry whose header magic survives but
+    whose BODY is damaged (truncated file / flipped byte) must be caught by the
+    footer CRC before decode, deleted, recounted as a miss, and refilled — the
+    epoch serves correct rows, never crashes, never silently serves damaged
+    columns (docs/robustness.md)."""
+    url = _write_store(tmp_path / 'store', num_rows=48, n_files=4)
+    cache_dir = tmp_path / 'cache'
+    reader_kwargs = dict(reader_pool_type='thread', workers_count=2,
+                         num_epochs=1, shuffle_row_groups=False,
+                         cache_type='local-disk', cache_location=str(cache_dir),
+                         cache_size_limit=64 << 20, cache_format='arrow-ipc')
+
+    def epoch_ids():
+        with make_reader(url, **reader_kwargs) as reader:
+            ids = sorted(int(row.id) for row in reader)
+            return ids, reader.diagnostics
+
+    ids, _ = epoch_ids()  # cold epoch fills the cache
+    assert ids == list(range(48))
+    entry = _arrow_cache_entries(cache_dir)[0]
+    # the one repo-wide damage model (header magic survives, body does not)
+    from petastorm_tpu.test_util.fault_injection import corrupt_file
+    corrupt_file(entry, 'truncate' if damage == 'truncate' else 'flip')
+    ids, diag = epoch_ids()  # warm epoch meets the damage
+    assert ids == list(range(48)), 'damaged cache entry changed served rows'
+    assert diag['cache']['corrupt_entries'] == 1
+    assert diag['cache_misses'] >= 1
+    # self-healed: a third epoch is fully warm again
+    ids, diag = epoch_ids()
+    assert ids == list(range(48))
+    assert diag['cache']['corrupt_entries'] == 0
+    assert diag['cache_hits'] == 4 and diag['cache_misses'] == 0

@@ -35,6 +35,31 @@ def test_collect_report_healthy_and_json_clean(capsys):
     assert report['backend']['status'] == 'up'
     assert 'link' not in report  # --no-link honored
     assert report['store_roundtrip']['status'] == 'ok'
+    # resilience block (docs/robustness.md): always present, healthy on a
+    # clean local roundtrip — no open breakers, no hung reaps, no corruption
+    resilience = report['resilience']
+    assert resilience['workers_hung_reaped'] == 0
+    assert resilience['shm_crc_failures'] == 0
+    assert resilience['cache_corrupt_entries'] == 0
+    assert all(state['state'] == 'closed'
+               for state in resilience['breakers'].values())
+
+
+def test_human_report_warns_on_open_breaker(capsys):
+    report = {
+        'versions': {'petastorm_tpu': 'x', 'python': 'x', 'jax': 'x',
+                     'pyarrow': 'x'},
+        'backend': {'status': 'down', 'detail': ''},
+        'store_roundtrip': {'status': 'ok', 'rows': 1, 'rows_per_sec': 1.0},
+        'resilience': {'breakers': {'cache:/tmp/c': {'state': 'open'}},
+                       'workers_hung_reaped': 2, 'shm_crc_failures': 1,
+                       'cache_corrupt_entries': 0},
+        'healthy': True,
+    }
+    doctor._print_human(report)
+    out = capsys.readouterr().out
+    assert 'WARNING: circuit breaker(s) not closed: cache:/tmp/c' in out
+    assert 'workers_hung_reaped=2' in out and 'shm_crc_failures=1' in out
 
 
 def test_human_report_prints_verdict(capsys):

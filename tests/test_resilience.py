@@ -501,3 +501,237 @@ def test_latency_rule_slows_but_serves(tmp_path):
         diag = reader.diagnostics
     assert ids == list(range(16))
     assert diag['io_retries'] == 0
+
+
+# ---------------------------------------------------------------------------
+# Circuit breakers (ISSUE 4: closed/open/half-open, injectable clock)
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker(object):
+    def _breaker(self, **kwargs):
+        from petastorm_tpu.resilience import CircuitBreaker
+        clock = [0.0]
+        defaults = dict(failure_threshold=3, recovery_timeout_s=10.0,
+                        clock=lambda: clock[0])
+        defaults.update(kwargs)
+        return CircuitBreaker('test', **defaults), clock
+
+    def test_full_state_walk_is_deterministic(self):
+        breaker, clock = self._breaker()
+        transitions = []
+        breaker._on_transition = lambda name, old, new: transitions.append((old, new))
+        assert breaker.state == 'closed' and breaker.allow()
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state == 'closed'  # under threshold
+        breaker.record_failure()
+        assert breaker.state == 'open' and not breaker.allow()
+        clock[0] = 9.999
+        assert not breaker.allow()  # cooldown not yet elapsed
+        clock[0] = 10.0
+        assert breaker.allow()  # half-open probe allowed
+        assert breaker.state == 'half_open'
+        breaker.record_failure()  # probe failed: re-open, cooldown restarts
+        assert breaker.state == 'open' and not breaker.allow()
+        clock[0] = 20.0
+        assert breaker.allow()
+        breaker.record_success()  # probe passed
+        assert breaker.state == 'closed'
+        assert transitions == [('closed', 'open'), ('open', 'half_open'),
+                               ('half_open', 'open'), ('open', 'half_open'),
+                               ('half_open', 'closed')]
+
+    def test_success_resets_the_failure_streak(self):
+        breaker, _ = self._breaker(failure_threshold=2)
+        breaker.record_failure()
+        breaker.record_success()
+        breaker.record_failure()
+        assert breaker.state == 'closed'  # never two CONSECUTIVE failures
+
+    def test_as_dict_reports_counts(self):
+        breaker, clock = self._breaker(failure_threshold=1)
+        breaker.record_failure()
+        clock[0] = 10.0
+        breaker.allow()
+        breaker.record_success()
+        state = breaker.as_dict()
+        assert state['state'] == 'closed'
+        assert state['failures'] == 1 and state['successes'] == 1
+        assert state['opened_count'] == 1
+
+    def test_call_with_breaker_fails_fast_while_open(self):
+        from petastorm_tpu.resilience import call_with_breaker
+        breaker, _ = self._breaker(failure_threshold=1)
+        breaker.record_failure()
+        calls = []
+        with pytest.raises(TransientIOError, match='circuit breaker'):
+            call_with_breaker(lambda: calls.append(1), breaker)
+        assert not calls, 'open breaker must not touch the dependency'
+
+    def test_call_with_breaker_only_counts_classified_failures(self):
+        from petastorm_tpu.resilience import call_with_breaker
+        breaker, _ = self._breaker(failure_threshold=1)
+        with pytest.raises(KeyError):
+            call_with_breaker(lambda: {}['missing'], breaker)
+        assert breaker.state == 'closed', 'user-code bugs must not trip IO breakers'
+        with pytest.raises(TransientIOError):
+            call_with_breaker(_raise_transient, breaker)
+        assert breaker.state == 'open'
+
+    def test_board_snapshot_only_tripped(self):
+        from petastorm_tpu.resilience import BreakerBoard
+        board = BreakerBoard()
+        board.breaker('healthy')
+        board.breaker('sick', failure_threshold=1).record_failure()
+        assert set(board.snapshot()) == {'healthy', 'sick'}
+        tripped = board.snapshot(only_tripped=True)
+        assert set(tripped) == {'sick'}
+        assert tripped['sick']['state'] == 'open'
+        board.reset()
+        assert board.snapshot() == {}
+
+    def test_breaker_pickles_without_callbacks(self):
+        # default clock (time.monotonic pickles by reference); the transition
+        # callback is process-local wiring and is dropped by __getstate__
+        import pickle
+        import time as time_module
+        from petastorm_tpu.resilience import CircuitBreaker
+        breaker = CircuitBreaker('test', failure_threshold=3,
+                                 on_transition=lambda *a: None)
+        breaker.record_failure()
+        clone = pickle.loads(pickle.dumps(breaker))
+        assert clone.as_dict()['failures'] == 1
+        assert clone._clock is time_module.monotonic
+
+
+def _raise_transient():
+    raise TransientIOError('down')
+
+
+# ---------------------------------------------------------------------------
+# Hang watchdog (ISSUE 4 acceptance: reap within deadline, epoch completes)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faultinject
+def test_hung_worker_sigstop_reaped_epoch_completes(tmp_path):
+    """Acceptance: a worker hung mid-epoch (process-wide wedge: SIGSTOP freezes
+    the heartbeat thread too) is reaped via heartbeat staleness within the
+    timeout, respawned through the bounded budget, and the epoch completes with
+    the correct deduplicated row set; workers_hung_reaped >= 1 in diagnostics."""
+    from petastorm_tpu.workers.process_pool import ProcessPool
+    url = _write_store(tmp_path / 'store', num_rows=64, n_files=8)
+    target = os.path.basename(_part_files(tmp_path / 'store')[3])
+    sched = FaultSchedule(tmp_path / 'faults',
+                          [FaultRule(target, kind='hang', hang_mode='stop',
+                                     times=1)])
+    pool = ProcessPool(2, heartbeat_interval_s=0.1, hang_timeout_s=2.0)
+    with make_reader(url, reader_pool=pool, num_epochs=1,
+                     shuffle_row_groups=False,
+                     filesystem=fault_injecting_filesystem(sched)) as reader:
+        ids = sorted(int(row.id) for row in reader)
+        diag = reader.diagnostics
+        counters = reader.telemetry_snapshot()['counters']
+    assert ids == list(range(64)), 'rows lost or duplicated across the hang reap'
+    assert diag['workers_hung_reaped'] == 1
+    assert diag['workers_respawned'] == 1
+    assert diag['workers_alive'] == 2
+    assert counters.get('watchdog_reap') == 1
+
+
+@pytest.mark.faultinject
+def test_item_deadline_quarantines_hung_rowgroup(tmp_path):
+    """A GIL-releasing hang (sleep — heartbeats keep flowing) is caught by the
+    per-item deadline; under on_error='skip' the offending rowgroup lands in the
+    quarantine ledger with reason='hang' (riding the process-pool wire) instead
+    of re-hanging the replacement worker, and the epoch serves the rest."""
+    url = _write_store(tmp_path / 'store', num_rows=64, n_files=8)
+    target = os.path.basename(_part_files(tmp_path / 'store')[3])
+    sched = FaultSchedule(tmp_path / 'faults',
+                          [FaultRule(target, kind='hang', times=1)])
+    with make_reader(url, reader_pool_type='process', workers_count=2,
+                     num_epochs=1, shuffle_row_groups=False, on_error='skip',
+                     item_deadline_s=2.0,
+                     filesystem=fault_injecting_filesystem(sched)) as reader:
+        ids = sorted(int(row.id) for row in reader)
+        diag = reader.diagnostics
+    assert len(ids) == 56 and len(set(ids)) == 56
+    assert diag['workers_hung_reaped'] == 1
+    assert diag['rowgroups_quarantined'] == 1
+    (entry,) = diag['quarantine']
+    assert entry['reason'] == 'hang'
+    assert entry['error_type'] == 'WorkerHangError'
+    assert target in entry['fragment_path']
+
+
+@pytest.mark.faultinject
+def test_bitflipped_shm_frame_served_via_wire_fallback(tmp_path, monkeypatch):
+    """Acceptance: a bit-flipped shm frame is detected by the descriptor CRC,
+    the item is redelivered through the respawn path, the shm breaker opens
+    (threshold 1 here) so later results ride the ZMQ wire, and the epoch
+    completes with correct data + matching telemetry counters."""
+    from petastorm_tpu.resilience import CircuitBreaker
+    from petastorm_tpu.workers.process_pool import ProcessPool
+    url = _write_store(tmp_path / 'store', num_rows=64, n_files=8)
+    monkeypatch.setenv('PETASTORM_TPU_TEST_SHM_CORRUPT',
+                       '{}:1'.format(tmp_path / 'faults'))
+    os.makedirs(str(tmp_path / 'faults'), exist_ok=True)
+    pool = ProcessPool(2, shm_breaker=CircuitBreaker(
+        'shm_transport', failure_threshold=1, recovery_timeout_s=300.0))
+    with make_reader(url, reader_pool=pool, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        ids = sorted(int(row.id) for row in reader)
+        diag = reader.diagnostics
+        counters = reader.telemetry_snapshot()['counters']
+    assert ids == list(range(64)), 'rows lost or duplicated across the CRC drop'
+    assert diag['shm_crc_failures'] == 1
+    assert diag['workers_respawned'] == 1
+    assert diag['breakers']['shm_transport']['state'] == 'open'
+    assert diag['shm_fallback_batches'] >= 1, 'wire fallback never engaged'
+    assert counters.get('shm_crc_fail') == 1
+    assert counters.get('breaker_open') == 1
+
+
+class DoublePublishWorker(object):
+    """Publishes two payloads per item — with a 1-slot ring the second publish
+    parks in the slot-wait backpressure loop whenever the consumer stops
+    reading (the join-drain satellite's deadlock shape)."""
+
+    def __init__(self, worker_id, publish_func, args):
+        self.worker_id = worker_id
+        self.publish_func = publish_func
+        self.args = args
+
+    def process(self, value):
+        self.publish_func(value)
+        self.publish_func(value + 1000)
+
+    def shutdown(self):
+        pass
+
+
+@pytest.mark.faultinject
+def test_join_drains_unacked_shm_slots(tmp_path):
+    """Satellite: join()'s drain loop must release un-acked shm slots so a
+    worker parked in its slot-wait loop finishes publishing, sees the stop
+    broadcast, and exits cleanly — not via the 10s slot-wait timeout into the
+    SIGKILL fallback."""
+    import time
+    from petastorm_tpu.workers.process_pool import ProcessPool
+    from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+    pool = ProcessPool(1, shm_slots_per_worker=1, shm_slot_bytes=4096)
+    ventilator = ConcurrentVentilator(pool.ventilate,
+                                      [{'value': i} for i in range(4)])
+    pool.start(DoublePublishWorker, None, ventilator)
+    first = pool.get_results()
+    assert first in range(4) or first >= 1000
+    time.sleep(1.0)  # let the worker park in slot-wait on its next publish
+    pool.stop()
+    join_start = time.time()
+    pool.join()
+    join_elapsed = time.time() - join_start
+    assert join_elapsed < 8.0, \
+        'join took {:.1f}s — slot-wait was not drained'.format(join_elapsed)
+    assert all(p.returncode == 0 for p in pool._processes), \
+        'worker needed the SIGKILL fallback: {}'.format(
+            [p.returncode for p in pool._processes])

@@ -39,7 +39,8 @@ class TestShmRing(object):
         ring = ShmRing(workers_count=2, slots_per_worker=2, slot_bytes=4096)
         try:
             writer = ShmRingWriter(ring.name, worker_slot=1, generation=0,
-                                   slots_per_worker=2, slot_bytes=4096)
+                                   slots_per_worker=2, slot_bytes=4096,
+                                   data_offset=ring.data_offset)
             frames = [b'A', b'x' * 1000, b'sidecar']
             descriptor = writer.try_write(frames)
             assert descriptor is not None
@@ -58,7 +59,8 @@ class TestShmRing(object):
     def test_slot_exhaustion_then_release(self):
         ring = ShmRing(workers_count=1, slots_per_worker=2, slot_bytes=4096)
         try:
-            writer = ShmRingWriter(ring.name, 0, 0, 2, 4096)
+            writer = ShmRingWriter(ring.name, 0, 0, 2, 4096,
+                                   data_offset=ring.data_offset)
             d1 = writer.try_write([b'one'])
             d2 = writer.try_write([b'two'])
             assert d1 is not None and d2 is not None
@@ -72,7 +74,8 @@ class TestShmRing(object):
     def test_oversized_payload_rejected(self):
         ring = ShmRing(workers_count=1, slots_per_worker=1, slot_bytes=2048)
         try:
-            writer = ShmRingWriter(ring.name, 0, 0, 1, 2048)
+            writer = ShmRingWriter(ring.name, 0, 0, 1, 2048,
+                                   data_offset=ring.data_offset)
             assert not writer.fits([b'x' * 4096])
             assert writer.try_write([b'x' * 4096]) is None
             writer.close()
@@ -82,7 +85,8 @@ class TestShmRing(object):
     def test_release_outside_partition_ignored(self):
         ring = ShmRing(workers_count=2, slots_per_worker=2, slot_bytes=2048)
         try:
-            writer = ShmRingWriter(ring.name, 0, 0, 2, 2048)
+            writer = ShmRingWriter(ring.name, 0, 0, 2, 2048,
+                                   data_offset=ring.data_offset)
             writer.release(3)  # worker 1's slot: not ours
             assert writer.free_slots == 2
             writer.close()
@@ -414,3 +418,43 @@ def test_wire_bench_transport_acceptance(tmp_path):
     result = transport_bench(rows=2048, cols=4, batches=12, workers=2)
     assert result['arrow_shm_shm_batches'] == 12
     assert result['copy_reduction_vs_pickle_zmq'] >= 2.0
+
+
+# ---------------------------------------------------------------------------
+# Frame integrity + heartbeat words (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+class TestRingIntegrity(object):
+    def test_descriptor_carries_verifiable_crc(self):
+        from petastorm_tpu.workers.integrity import payload_checksum
+        ring = ShmRing(workers_count=1, slots_per_worker=1, slot_bytes=4096)
+        try:
+            writer = ShmRingWriter(ring.name, 0, 0, 1, 4096,
+                                   data_offset=ring.data_offset)
+            descriptor = writer.try_write([b'A', b'payload' * 64, b'sidecar'])
+            descriptor = ShmSlotDescriptor.from_bytes(descriptor.to_bytes())
+            assert descriptor.crc is not None
+            views = ring.view(descriptor)
+            assert payload_checksum(views) == descriptor.crc
+            # a single flipped byte in the slot must break the match
+            views[1][10] = views[1][10] ^ 0xFF
+            assert payload_checksum(ring.view(descriptor)) != descriptor.crc
+            for v in views:
+                v.release()
+            writer.close()
+        finally:
+            ring.close_and_unlink()
+
+    def test_heartbeat_word_roundtrip_per_worker(self):
+        ring = ShmRing(workers_count=2, slots_per_worker=1, slot_bytes=4096)
+        try:
+            writer = ShmRingWriter(ring.name, 1, 0, 1, 4096,
+                                   data_offset=ring.data_offset)
+            assert ring.heartbeat(0) == 0 and ring.heartbeat(1) == 0
+            writer.stamp_heartbeat(41)
+            writer.stamp_heartbeat(42)
+            assert ring.heartbeat(1) == 42
+            assert ring.heartbeat(0) == 0, 'heartbeat words must not alias'
+            writer.close()
+        finally:
+            ring.close_and_unlink()
