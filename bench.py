@@ -154,7 +154,8 @@ _HEADLINE_FALLBACKS = (
 
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
-                 'flash', 'moe', 'wire_bench', 'telemetry', 'resilience')
+                 'flash', 'moe', 'wire_bench', 'telemetry', 'resilience',
+                 'pipecheck')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -163,10 +164,10 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'wire_bench', 'telemetry', 'resilience',
-                     'mnist_scan_stream', 'flash', 'moe', 'imagenet_scan',
-                     'imagenet_stream', 'decode_delta', 'bare_reader',
-                     'mnist_stream')
+SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'wire_bench', 'telemetry',
+                     'resilience', 'mnist_scan_stream', 'flash', 'moe',
+                     'imagenet_scan', 'imagenet_stream', 'decode_delta',
+                     'bare_reader', 'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
 
@@ -1493,6 +1494,30 @@ def child_main():
                                              {}).get('state', 'closed'),
         })
 
+    def run_pipecheck():
+        """Check phase (host-only, sub-second): the pipecheck static
+        data-plane invariant analysis + the mypy-strict ratchet over the
+        installed package (docs/static-analysis.md). A non-clean result is
+        recorded in the BENCH json — perf history that rides on code whose
+        producer/consumer protocol has drifted is not trustworthy perf
+        history."""
+        from petastorm_tpu.analysis import run_pipecheck as pipecheck
+        report = pipecheck()
+        by_rule = report.by_rule()
+        log('pipecheck: {} — {} file(s), {} finding(s), {} suppressed{}'
+            .format('clean' if report.clean else 'FINDINGS', report.files,
+                    len(report.findings), report.suppressed,
+                    '' if report.clean else '; first: ' +
+                    report.findings[0].format()))
+        results.update({
+            'pipecheck_clean': report.clean,
+            'pipecheck_findings': len(report.findings),
+            'pipecheck_suppressed': report.suppressed,
+            'pipecheck_files': report.files,
+            'pipecheck_mypy_ratchet_findings':
+                by_rule.get('mypy-ratchet', 0),
+        })
+
     def run_decode():
         decode_host, decode_onchip = run_decode_delta()
         results.update({
@@ -1515,6 +1540,7 @@ def child_main():
         'wire_bench': run_wire_bench,
         'telemetry': run_telemetry,
         'resilience': run_resilience,
+        'pipecheck': run_pipecheck,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

@@ -1,0 +1,28 @@
+"""pipecheck: AST-based invariant analyzer for the cross-process data plane.
+
+The multi-process pipeline's correctness rests on invariants no general tool
+checks: ZMQ message kinds and shm descriptor fields must match between
+``process_worker_main.py`` / ``shm_ring.py`` (producers) and
+``process_pool.py`` (consumer); results-channel sidecar keys written by
+``serializers.serialize`` must be read back by ``deserialize``; telemetry
+stage names must exist in the ``spans.py`` catalog; retry/breaker/deadline
+code must never read the wall clock directly; broad excepts in worker loops
+must justify themselves; the mypy-strict module set may only grow. Protocol
+drift between processes otherwise fails only at runtime, on the slow path,
+under load — pipecheck pins each invariant statically and runs as a tier-1
+test (self-application must stay clean).
+
+Entry points: ``python -m petastorm_tpu.analysis``,
+``petastorm-tpu-pipecheck``, ``petastorm-tpu-throughput pipecheck``, the
+doctor's ``report['pipecheck']`` block, and bench.py's ``pipecheck``
+section. Full rule catalog + suppression syntax: docs/static-analysis.md.
+"""
+
+from petastorm_tpu.analysis.cli import main, run_pipecheck
+from petastorm_tpu.analysis.config import AnalysisConfig, default_config
+from petastorm_tpu.analysis.core import Finding, Report, Rule, run_analysis
+from petastorm_tpu.analysis.rules import ALL_RULES, default_rules
+
+__all__ = ['AnalysisConfig', 'ALL_RULES', 'Finding', 'Report', 'Rule',
+           'default_config', 'default_rules', 'main', 'run_analysis',
+           'run_pipecheck']

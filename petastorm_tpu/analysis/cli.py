@@ -1,0 +1,98 @@
+"""pipecheck CLI: ``python -m petastorm_tpu.analysis [paths...]``.
+
+Also reachable as ``petastorm-tpu-throughput pipecheck`` and the
+``petastorm-tpu-pipecheck`` console script. With no paths, analyzes the
+installed ``petastorm_tpu`` package — the self-application mode the tier-1
+test keeps green. Exit codes: 0 clean, 1 findings, 2 usage error.
+
+    $ petastorm-tpu-pipecheck                        # self-check the package
+    $ petastorm-tpu-pipecheck path/to/tree --json    # machine-readable
+    $ petastorm-tpu-pipecheck --rules clock-discipline,mypy-ratchet src/
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from petastorm_tpu.analysis.config import AnalysisConfig, default_config
+from petastorm_tpu.analysis.core import Report, run_analysis
+from petastorm_tpu.analysis.rules import ALL_RULES, default_rules
+
+
+def package_root() -> Path:
+    """The installed ``petastorm_tpu`` package directory (the default
+    analysis target)."""
+    import petastorm_tpu
+    return Path(os.path.dirname(os.path.abspath(petastorm_tpu.__file__)))
+
+
+def run_pipecheck(paths: Optional[Sequence[str]] = None,
+                  rules: Optional[Sequence[str]] = None,
+                  mypy_ini: Optional[str] = None,
+                  manifest: Optional[str] = None) -> Report:
+    """Programmatic entry (doctor, bench, tests): analyze ``paths`` (default:
+    the installed package) with the shipped rules and return the
+    :class:`~petastorm_tpu.analysis.core.Report`."""
+    config = default_config()
+    if mypy_ini is not None or manifest is not None:
+        config = AnalysisConfig(mypy_ini_path=mypy_ini, manifest_path=manifest)
+    targets = [Path(p) for p in paths] if paths else [package_root()]
+    return run_analysis(targets, default_rules(rules), config)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The pipecheck argument parser (split out for doc/tests)."""
+    parser = argparse.ArgumentParser(
+        prog='pipecheck',
+        description='AST-based invariant analyzer for the petastorm_tpu '
+                    'cross-process data plane (docs/static-analysis.md)')
+    parser.add_argument('paths', nargs='*',
+                        help='files/directories to analyze (default: the '
+                             'installed petastorm_tpu package)')
+    parser.add_argument('--json', action='store_true',
+                        help='print one JSON document instead of the '
+                             'flake8-style listing')
+    parser.add_argument('--rules',
+                        help='comma-separated rule subset (see --list-rules)')
+    parser.add_argument('--list-rules', action='store_true',
+                        help='print the rule catalog and exit')
+    parser.add_argument('--mypy-ini',
+                        help='explicit mypy.ini path for the mypy-ratchet '
+                             'rule (default: walk up from the analyzed '
+                             'paths)')
+    parser.add_argument('--manifest',
+                        help='explicit strict-module manifest path (default: '
+                             'the packaged analysis/strict_modules.txt)')
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Console entry: parse args, run the analysis, print, return the exit
+    code (0 clean / 1 findings / 2 usage error)."""
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print('{:24s} {}'.format(cls.name, cls.description))
+        return 0
+    selected: Optional[List[str]] = None
+    if args.rules:
+        selected = [name.strip() for name in args.rules.split(',')
+                    if name.strip()]
+    try:
+        report = run_pipecheck(paths=args.paths or None, rules=selected,
+                               mypy_ini=args.mypy_ini,
+                               manifest=args.manifest)
+    except ValueError as exc:
+        print('pipecheck: {}'.format(exc), file=sys.stderr)
+        return 2
+    print(report.to_json() if args.json else report.format_human())
+    return 0 if report.clean else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""pipecheck configuration: which files play which role in each invariant.
+
+The rule *mechanisms* (set matching over produced/consumed wire literals,
+catalog membership, clock/lock/exception discipline — ``analysis/rules/``)
+are generic; this module pins them to the petastorm_tpu data plane: which
+basenames are the ZMQ protocol peers, which modules must never read the wall
+clock directly, where the telemetry catalog and the mypy ratchet manifest
+live. Matching is by **basename / path suffix**, not import path, so fixture
+trees (``tests/data/pipecheck/``) and mutated copies under a temp dir
+exercise exactly the shipped configuration.
+
+Override points (CLI flags map onto these): ``mypy_ini_path`` /
+``manifest_path`` for the ratchet rule; everything else via
+:func:`dataclasses.replace` from test code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+#: files forming the cross-process ZMQ peer set: every message kind one of
+#: them produces (``send`` / ``send_multipart``) must be dispatched on by one
+#: of them, and vice versa (docs/static-analysis.md, protocol-conformance)
+PROTOCOL_PEER_FILES: Tuple[str, ...] = ('process_pool.py',
+                                        'process_worker_main.py')
+
+#: modules under the injectable-clock discipline: direct ``time.time()`` /
+#: ``time.monotonic()`` / ``time.perf_counter()`` calls are findings — retry,
+#: backoff, deadline and breaker arithmetic must flow through the injected
+#: ``clock``/``sleep`` callables so tests stay deterministic (PR-4 discipline)
+CLOCK_DISCIPLINED_FILES: Tuple[str, ...] = ('resilience.py',)
+
+#: directory name marking worker/data-plane process code, where the
+#: exception-hygiene bar is highest: a broad except that can swallow needs an
+#: explicit reason comment even when it logs
+WORKER_DIR: str = 'workers'
+
+#: basenames of data-path modules where ``raise Exception(...)`` /
+#: ``raise BaseException(...)`` are findings (use the errors.py taxonomy)
+DATAPATH_FILES: Tuple[str, ...] = ('reader_worker.py', 'reader.py',
+                                   'cache.py', 'fs_utils.py',
+                                   'resilience.py')
+
+#: where the telemetry stage/counter catalog lives (path suffix); the rule
+#: falls back to the installed ``petastorm_tpu.telemetry.spans`` when the
+#: analyzed tree does not contain it
+STAGE_CATALOG_SUFFIX: str = 'telemetry/spans.py'
+
+#: where the declared quarantine-reason registry lives (path suffix)
+QUARANTINE_REGISTRY_SUFFIX: str = 'resilience.py'
+
+#: mypy option names a ratchet entry's section must set to True
+STRICT_FLAGS: Tuple[str, ...] = ('disallow_untyped_defs',
+                                 'disallow_incomplete_defs',
+                                 'no_implicit_optional',
+                                 'warn_return_any')
+
+
+@dataclass(frozen=True)
+class AnalysisConfig:
+    """Resolved configuration for one pipecheck run (defaults above)."""
+
+    protocol_peer_files: Tuple[str, ...] = PROTOCOL_PEER_FILES
+    clock_disciplined_files: Tuple[str, ...] = CLOCK_DISCIPLINED_FILES
+    worker_dir: str = WORKER_DIR
+    datapath_files: Tuple[str, ...] = DATAPATH_FILES
+    stage_catalog_suffix: str = STAGE_CATALOG_SUFFIX
+    quarantine_registry_suffix: str = QUARANTINE_REGISTRY_SUFFIX
+    strict_flags: Tuple[str, ...] = STRICT_FLAGS
+    #: explicit mypy.ini path; None = walk up from the analyzed roots
+    mypy_ini_path: Optional[str] = None
+    #: explicit ratchet manifest path; None = the packaged
+    #: ``analysis/strict_modules.txt``
+    manifest_path: Optional[str] = None
+
+
+def default_config() -> AnalysisConfig:
+    """The shipped configuration (what the CLI and tier-1 self-check use)."""
+    return AnalysisConfig()

@@ -1,0 +1,120 @@
+"""lock-discipline: no blocking calls while holding a lock.
+
+The data plane's locks (``_state_lock`` in the process pool, the registry
+shard locks, the loader accounting lock) guard *bookkeeping*, and the
+comments around them promise short critical sections. A blocking call inside
+``with lock:`` — a sleep, a socket receive, a thread join — turns every
+other participant's fast path into that call's wait, and under the consumer/
+ventilator thread split it is one step from deadlock (the PR-1 pool design
+notes say exactly this about ROUTER sends vs ``_state_lock``).
+
+Detection: a ``with`` item whose context expression's terminal name is
+lock-ish (``lock``, ``*_lock``, ``*lock``) opens a critical section; inside
+its body (not descending into nested ``def``/``lambda``) these calls are
+findings:
+
+- ``time.sleep(...)`` / bare ``sleep(...)``
+- socket receives: ``.recv(...)``, ``.recv_multipart(...)``,
+  ``.recv_string(...)``, ``.recv_pyobj(...)``, ``.recv_json(...)``,
+  ``.accept(...)``
+- thread/process joins: ``.join()`` with no arguments, a numeric-literal
+  timeout, or a ``timeout=`` keyword (the argument heuristic is what keeps
+  ``', '.join(parts)`` and ``os.path.join(a, b)`` out)
+- ``subprocess.run/call/check_call/check_output(...)`` and ``input()``
+
+``Condition.wait`` is deliberately NOT flagged: condition variables must be
+waited on with their lock held — that is their protocol, not a violation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from petastorm_tpu.analysis.core import (AnalysisContext, Finding, Rule,
+                                         SourceModule,
+                                         walk_skipping_functions)
+
+_RECV_ATTRS = frozenset({'recv', 'recv_multipart', 'recv_string',
+                         'recv_pyobj', 'recv_json', 'accept'})
+_SUBPROCESS_FUNCS = frozenset({'run', 'call', 'check_call', 'check_output'})
+
+
+def _terminal_name(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def is_lockish(node: ast.expr) -> bool:
+    """True when a ``with`` context expression names a lock (by the repo's
+    naming convention: ``lock``, ``_lock``, ``state_lock``, ...)."""
+    name = _terminal_name(node)
+    if name is None:
+        return False
+    lowered = name.lower()
+    return lowered == 'lock' or lowered.endswith('lock')
+
+
+def _blocking_call(node: ast.Call) -> Optional[str]:
+    """A human-readable description when ``node`` is a blocking call."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id == 'sleep':
+            return 'sleep()'
+        if func.id == 'input':
+            return 'input()'
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr == 'sleep':
+        return '{}.sleep()'.format(_terminal_name(func.value) or '?')
+    if func.attr in _RECV_ATTRS:
+        return '.{}()'.format(func.attr)
+    if (func.attr in _SUBPROCESS_FUNCS
+            and isinstance(func.value, ast.Name)
+            and func.value.id == 'subprocess'):
+        return 'subprocess.{}()'.format(func.attr)
+    if func.attr == 'join':
+        if not node.args and not node.keywords:
+            return '.join()'
+        if any(kw.arg == 'timeout' for kw in node.keywords):
+            return '.join(timeout=...)'
+        if (len(node.args) == 1 and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, (int, float))):
+            return '.join({})'.format(node.args[0].value)
+    return None
+
+
+class LockDisciplineRule(Rule):
+    """Flag blocking calls inside ``with lock:`` bodies (module doc)."""
+
+    name = 'lock-discipline'
+    description = ('no sleep / blocking recv / join inside a "with lock:" '
+                   'body — critical sections must stay bookkeeping-short')
+
+    def check_module(self, module: SourceModule,
+                     ctx: AnalysisContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_names = [
+                _terminal_name(item.context_expr) or 'lock'
+                for item in node.items if is_lockish(item.context_expr)]
+            if not lock_names:
+                continue
+            for inner in walk_skipping_functions(node.body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                blocked = _blocking_call(inner)
+                if blocked is not None:
+                    findings.append(Finding(
+                        self.name, module.display, inner.lineno,
+                        'blocking call {} while holding {!r} — move it '
+                        'outside the critical section (snapshot under the '
+                        'lock, block outside)'.format(
+                            blocked, lock_names[0])))
+        return findings

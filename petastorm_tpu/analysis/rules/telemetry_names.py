@@ -1,0 +1,130 @@
+"""telemetry-names: every metric name used must exist in the spans catalog.
+
+The telemetry subsystem (docs/observability.md) intentionally creates metrics
+on first use — ``registry.observe('decodee', ...)`` raises nothing, it mints
+a fresh histogram that no dashboard, no ``attribute_bottleneck`` knob-map
+entry, and no doc row knows about. This rule closes that hole statically:
+
+- ``stage_span('x')`` / ``record_stage('x', ...)`` → ``x`` must be in
+  ``STAGES`` (``telemetry/spans.py``);
+- ``<registry>.observe('x', ...)`` → ``x`` in ``STAGES`` or
+  ``SIZE_HISTOGRAMS``;
+- ``<registry>.inc('x')`` → ``x`` in ``COUNTERS``.
+
+Conditional names (``'cache_hit' if hit else 'cache_miss'``) check both
+branches; non-literal names are skipped (they are register-time plumbing, not
+call sites). The catalog is read from the analyzed tree's
+``telemetry/spans.py`` when present (so a mutated copy is judged against its
+own catalog), else from the installed ``petastorm_tpu.telemetry.spans``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from petastorm_tpu.analysis.core import (AnalysisContext, Finding, Rule,
+                                         SourceModule, extract_string_tuple,
+                                         literal_str_values)
+
+#: call forms checked: (function-name form, catalog group)
+_NAME_FUNCS = ('stage_span', 'record_stage')
+
+
+class _Catalog:
+    """The declared telemetry names, split by metric family."""
+
+    def __init__(self, stages: Tuple[str, ...], counters: Tuple[str, ...],
+                 size_histograms: Tuple[str, ...], origin: str) -> None:
+        self.stages = frozenset(stages)
+        self.counters = frozenset(counters)
+        self.size_histograms = frozenset(size_histograms)
+        self.origin = origin
+
+
+def _catalog_from_tree(tree: ast.Module, origin: str) -> Optional[_Catalog]:
+    stages = extract_string_tuple(tree, 'STAGES')
+    if stages is None:
+        return None
+    counters = extract_string_tuple(tree, 'COUNTERS') or []
+    size_histograms = extract_string_tuple(tree, 'SIZE_HISTOGRAMS') or []
+    return _Catalog(tuple(stages), tuple(counters), tuple(size_histograms),
+                    origin)
+
+
+def load_catalog(ctx: AnalysisContext) -> Optional[_Catalog]:
+    """Resolve the stage/counter catalog (analyzed tree first, then the
+    installed package source)."""
+    cached = ctx.rule_state(TelemetryNamesRule.name).get('catalog')
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    catalog: Optional[_Catalog] = None
+    module = ctx.find_module(ctx.config.stage_catalog_suffix)
+    if module is not None:
+        catalog = _catalog_from_tree(module.tree, module.display)
+    if catalog is None:
+        try:
+            import petastorm_tpu.telemetry.spans as spans_module
+            path = spans_module.__file__
+            if path is not None:
+                tree = ast.parse(open(path, encoding='utf-8').read())
+                catalog = _catalog_from_tree(tree, path)
+        except (ImportError, OSError, SyntaxError):
+            catalog = None
+    if catalog is not None:
+        ctx.rule_state(TelemetryNamesRule.name)['catalog'] = catalog
+    return catalog
+
+
+class TelemetryNamesRule(Rule):
+    """Flag telemetry names missing from the spans.py catalog (module doc)."""
+
+    name = 'telemetry-names'
+    description = ('stage_span/record_stage/observe/inc names must exist in '
+                   'the telemetry catalog (STAGES / COUNTERS / '
+                   'SIZE_HISTOGRAMS in telemetry/spans.py)')
+
+    def check_module(self, module: SourceModule,
+                     ctx: AnalysisContext) -> Iterable[Finding]:
+        if module.posix().endswith(ctx.config.stage_catalog_suffix):
+            return []  # the catalog itself
+        catalog = load_catalog(ctx)
+        if catalog is None:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            func_name: Optional[str] = None
+            attr_name: Optional[str] = None
+            if isinstance(func, ast.Name):
+                func_name = func.id
+            elif isinstance(func, ast.Attribute):
+                attr_name = func.attr
+            names: List[Tuple[str, int]] = []
+            allowed: Optional[frozenset] = None
+            family = ''
+            if func_name in _NAME_FUNCS or attr_name in _NAME_FUNCS:
+                names = literal_str_values(node.args[0])
+                allowed = catalog.stages
+                family = 'STAGES'
+            elif attr_name == 'observe':
+                names = literal_str_values(node.args[0])
+                allowed = catalog.stages | catalog.size_histograms
+                family = 'STAGES or SIZE_HISTOGRAMS'
+            elif attr_name == 'inc':
+                names = literal_str_values(node.args[0])
+                allowed = catalog.counters
+                family = 'COUNTERS'
+            if not names or allowed is None:
+                continue
+            for value, line in names:
+                if value not in allowed:
+                    findings.append(Finding(
+                        self.name, module.display, line,
+                        'telemetry name {!r} is not declared in {} '
+                        '(catalog: {}) — it would mint an orphan metric no '
+                        'dashboard or bottleneck map knows'.format(
+                            value, family, catalog.origin)))
+        return findings

@@ -5,8 +5,11 @@ Subcommands: a first positional of ``wire-bench`` dispatches to
 :mod:`petastorm_tpu.benchmark.wire_bench` (zero-copy data-plane microbench, JSON
 output); ``analyze`` dispatches to :mod:`petastorm_tpu.telemetry.analyze` (stage
 time-share ranking + bottleneck-to-knob mapping over a telemetry snapshot /
-JSONL event log — docs/observability.md); anything else is the legacy
-dataset-throughput measurement."""
+JSONL event log — docs/observability.md); ``pipecheck`` dispatches to
+:mod:`petastorm_tpu.analysis` (AST-based data-plane invariant analyzer —
+docs/static-analysis.md); ``doctor`` dispatches to
+:mod:`petastorm_tpu.tools.doctor` (environment health report); anything else
+is the legacy dataset-throughput measurement."""
 
 import argparse
 import logging
@@ -28,6 +31,12 @@ def main(argv=None):
     if argv and argv[0] == 'analyze':
         from petastorm_tpu.telemetry.analyze import main as analyze_main
         return analyze_main(argv[1:])
+    if argv and argv[0] == 'pipecheck':
+        from petastorm_tpu.analysis.cli import main as pipecheck_main
+        return pipecheck_main(argv[1:])
+    if argv and argv[0] == 'doctor':
+        from petastorm_tpu.tools.doctor import main as doctor_main
+        return doctor_main(argv[1:])
     parser = argparse.ArgumentParser(
         description='Measure petastorm_tpu reader throughput on a dataset')
     parser.add_argument('dataset_url')

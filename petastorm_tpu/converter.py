@@ -140,6 +140,11 @@ class DatasetConverter(object):
         except Exception:
             if not silent:
                 raise
+            # silent=True tolerates any deletion failure, but never silently:
+            # an undeletable store is a disk-quota leak worth a log line
+            logger.warning('Failed to delete converter store %s (silent=True); '
+                           'the materialized files may linger',
+                           self.cache_dir_url, exc_info=True)
         _active_converters.pop(self.cache_dir_url, None)
         # A deleted store must not be served to a later same-plan make_converter.
         for key, conv in list(_spark_plan_converters.items()):

@@ -6,7 +6,15 @@ failures into two classes: *transient* (retryable — network hiccups, throttled
 stores, flaky tunnels) and *permanent* (corrupt data, schema bugs). ``TransientIOError``
 marks the former explicitly; ``QuarantinedRowGroupError`` reports a rowgroup that was
 skipped under ``on_error='skip'`` and landed in the quarantine ledger.
+
+Strict-typed (mypy.ini ``[mypy-petastorm_tpu.errors]``): the taxonomy is the
+machine-readable contract the retry classifier, ledger and doctor key on, so
+its structured attributes carry full signatures.
 """
+
+from __future__ import annotations
+
+from typing import Optional
 
 
 class PetastormTpuError(Exception):
@@ -29,7 +37,8 @@ class DecodeFieldError(PetastormTpuError):
       (None when decoding outside a rowgroup read, e.g. ``decode_row``).
     """
 
-    def __init__(self, message, field_name=None, fragment_path=None):
+    def __init__(self, message: str, field_name: Optional[str] = None,
+                 fragment_path: Optional[str] = None) -> None:
         super().__init__(message)
         self.field_name = field_name
         self.fragment_path = fragment_path
@@ -73,8 +82,11 @@ class QuarantinedRowGroupError(PetastormTpuError):
     Structured attributes: ``piece_index``, ``fragment_path``, ``row_group_id``,
     ``attempts``, and ``cause`` (the final underlying exception, if available)."""
 
-    def __init__(self, message, piece_index=None, fragment_path=None, row_group_id=None,
-                 attempts=None, cause=None):
+    def __init__(self, message: str, piece_index: Optional[int] = None,
+                 fragment_path: Optional[str] = None,
+                 row_group_id: Optional[int] = None,
+                 attempts: Optional[int] = None,
+                 cause: Optional[BaseException] = None) -> None:
         super().__init__(message)
         self.piece_index = piece_index
         self.fragment_path = fragment_path

@@ -8,6 +8,7 @@ import logging
 import sys
 from pydoc import locate
 
+from petastorm_tpu.errors import MetadataError
 from petastorm_tpu.etl import dataset_metadata
 from petastorm_tpu.unischema import Unischema
 
@@ -39,7 +40,9 @@ def _has_embedded(handle):
     try:
         dataset_metadata.get_schema(handle)
         return True
-    except Exception:
+    except MetadataError:
+        # precisely the "no embedded unischema" answer this probe exists to
+        # give; anything else (IO failures, corrupt footers) should propagate
         return False
 
 

@@ -507,7 +507,13 @@ def _predicate_token(worker_predicate):
     try:
         import pickle
         return hashlib.md5(pickle.dumps(worker_predicate)).hexdigest()[:12]
-    except Exception:
+    except Exception:  # noqa: BLE001 - ANY pickling failure means "no stable identity"
+        # swallowing is the contract here: an unpicklable predicate just
+        # bypasses the rowgroup cache (caller checks for None) — but say so,
+        # or "cache never warms" is undebuggable
+        logger.debug('predicate %s has no stable cache token; bypassing the '
+                     'rowgroup cache for it', type(worker_predicate).__name__,
+                     exc_info=True)
         return None
 
 

@@ -43,6 +43,12 @@ from petastorm_tpu.errors import TransientIOError
 #: on_error modes accepted by make_reader / make_batch_reader
 ON_ERROR_MODES: Tuple[str, ...] = ('raise', 'retry', 'skip')
 
+#: declared ``QuarantineRecord.reason`` values — the registry every
+#: construction site must draw from (pipecheck protocol-conformance,
+#: docs/static-analysis.md): ledger consumers (doctor, dashboards) dispatch
+#: on these strings, so an undeclared reason is a silent new failure class
+QUARANTINE_REASONS: Tuple[str, ...] = ('error', 'hang')
+
 
 def check_on_error(on_error: str) -> str:
     """Validate an ``on_error`` mode (shared by both reader factories)."""
@@ -162,7 +168,7 @@ def run_with_retry(fn: Callable[[], Any],
         attempt_start = clock()
         try:
             return fn(), attempt - 1
-        except BaseException as exc:
+        except BaseException as exc:  # noqa: BLE001 - the retry loop must see every exception; is_transient decides, non-transient re-raises below
             attempt_elapsed = clock() - attempt_start
             if not is_transient(exc):
                 raise

@@ -59,6 +59,23 @@ STAGES = (
 #: leaf stages sum sensibly (telemetry/analyze.py)
 ENVELOPE_STAGES = frozenset({'cache_miss'})
 
+#: declared event counters (``registry.inc(name)`` call sites). Part of the
+#: telemetry name catalog alongside STAGES: pipecheck's telemetry-names rule
+#: (docs/static-analysis.md) rejects any ``inc`` of a name not listed here,
+#: so a typo'd counter fails the tier-1 self-check instead of silently
+#: minting an orphan metric.
+COUNTERS = (
+    'breaker_open',    # a circuit breaker tripped open (pool consumer side)
+    'watchdog_reap',   # a hung worker was SIGKILLed by the watchdog (pool)
+    'shm_crc_fail',    # a shm frame failed CRC verification (pool)
+)
+
+#: declared size histograms (``registry.observe(name, n, unit=BYTES_UNIT)``
+#: call sites) — same catalog contract as COUNTERS
+SIZE_HISTOGRAMS = (
+    'wire_bytes_copied',  # bytes materialized into new host memory per batch
+)
+
 
 class StageRecorder(object):
     """Per-thread accumulation of stage timings, drained into batch sidecars.

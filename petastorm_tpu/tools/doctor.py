@@ -17,6 +17,9 @@ in order:
 4. **Store roundtrip** — write a small dataset to a temp dir through the real
    codec/metadata path, read it back with ``make_reader`` across the thread
    pool, verify row integrity, report rows/s.
+5. **Pipecheck** — the static data-plane invariant analysis
+   (:mod:`petastorm_tpu.analysis`, docs/static-analysis.md) over the
+   installed package; findings print as a WARNING (``report['pipecheck']``).
 
 Prints a human-readable report; with ``--json``, one machine-readable JSON
 line (the same dict :func:`collect_report` returns). Exit code 0 iff the
@@ -228,6 +231,25 @@ def check_store_roundtrip(rows=200, workers=2):
             }}
 
 
+def check_pipecheck():
+    """Run the pipecheck static analysis over the installed package
+    (docs/static-analysis.md) and summarize: ``{'status': 'ok'|'findings',
+    'findings': N, 'suppressed': M, 'files': F, 'by_rule': {...}}``.
+
+    Static findings mean the *installed code* has drifted from its own
+    data-plane invariants (protocol kinds, telemetry names, the mypy
+    ratchet) — a WARNING in the human report, not an install-health failure:
+    reads still work, but the next refactor is flying blind."""
+    from petastorm_tpu.analysis import run_pipecheck
+    report = run_pipecheck()
+    return {'status': 'ok' if report.clean else 'findings',
+            'findings': len(report.findings),
+            'suppressed': report.suppressed,
+            'files': report.files,
+            'by_rule': report.by_rule(),
+            'first': report.findings[0].format() if report.findings else None}
+
+
 def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
     """Run every check; returns the full report dict (no printing)."""
     report = {'versions': check_versions()}
@@ -257,6 +279,14 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
     report['resilience'] = resilience if resilience is not None else {
         'breakers': {}, 'workers_hung_reaped': 0, 'shm_crc_failures': 0,
         'cache_corrupt_entries': 0, 'rowgroups_quarantined': 0}
+    # Static-analysis block (docs/static-analysis.md): does the installed
+    # package still satisfy its own data-plane invariants? Always present so
+    # --json consumers find one stable key; failures of the analyzer itself
+    # are reported, never fatal to the doctor.
+    try:
+        report['pipecheck'] = check_pipecheck()
+    except Exception as exc:  # noqa: BLE001 - the report must always complete
+        report['pipecheck'] = {'status': 'fail', 'detail': repr(exc)}
     report['healthy'] = report['store_roundtrip'].get('status') == 'ok'
     return report
 
@@ -320,6 +350,22 @@ def _print_human(report):
         print('  resilience: {} — the roundtrip needed hang/corruption '
               'recovery on a local disk; check the hardware'.format(
                   ', '.join('{}={}'.format(k, v) for k, v in sorted(degraded.items()))))
+    pipecheck = report.get('pipecheck') or {}
+    if pipecheck.get('status') == 'ok':
+        print('  pipecheck: clean — {} files, {} suppression(s) honored '
+              '(docs/static-analysis.md)'.format(
+                  pipecheck.get('files', 0), pipecheck.get('suppressed', 0)))
+    elif pipecheck.get('status') == 'findings':
+        print('  WARNING: pipecheck found {} data-plane invariant '
+              'violation(s) ({}); first: {} — run '
+              '`petastorm-tpu-pipecheck` for the full list'.format(
+                  pipecheck.get('findings', 0),
+                  ', '.join('{}={}'.format(rule, count) for rule, count
+                            in sorted(pipecheck.get('by_rule', {}).items())),
+                  pipecheck.get('first')))
+    elif pipecheck:
+        print('  pipecheck: FAIL ({}) — the analyzer itself errored'.format(
+            pipecheck.get('detail', 'unknown')))
     print('  verdict: {}'.format('healthy' if report['healthy'] else 'BROKEN'))
 
 

@@ -8,7 +8,7 @@ def _subprocess_entry(serialized, result_queue):
     try:
         func, args, kwargs = dill.loads(serialized)
         result_queue.put(('ok', pickle.dumps(func(*args, **kwargs))))
-    except Exception as exc:  # noqa: BLE001
+    except Exception as exc:  # noqa: BLE001 - every failure must ship to the parent via the queue, not kill the child silently
         import traceback
         result_queue.put(('error', pickle.dumps((exc, traceback.format_exc()))))
 

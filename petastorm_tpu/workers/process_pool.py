@@ -845,7 +845,7 @@ class ProcessPool(object):
             self._ventilator.stop()
         try:
             self._control_socket.send(b'stop')
-        except Exception:
+        except Exception:  # noqa: BLE001 - stop() is best-effort: a dead socket/context must not mask shutdown
             logger.warning('Failed to broadcast stop to workers; relying on the '
                            'parent-watchdog exit path', exc_info=True)
 

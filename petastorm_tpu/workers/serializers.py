@@ -29,10 +29,18 @@ falling off the Arrow zero-copy path into the pickled sidecar) is visible in
 ``ProcessPool.diagnostics`` / ``Reader.diagnostics`` without any extra channel.
 """
 
+from __future__ import annotations
+
 import json
 import pickle
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
+
+#: a wire frame: whatever ZMQ send_multipart accepts and recv hands back
+#: (bytes / memoryview / zmq.Frame / pa.Buffer) — structurally Any because the
+#: concrete types come from optional dependencies
+Frame = Any
 
 _MARKER_PICKLE = b'P'
 _MARKER_ARROW = b'A'
@@ -43,7 +51,7 @@ _META_KEY = b'petastorm_tpu.columnar.v1'
 _SIDECAR_NAMES_CAP = 64
 
 
-def _new_wire_stats():
+def _new_wire_stats() -> Dict[str, Any]:
     """Fresh consumer-side wire counters (see module docstring): ``batches`` received,
     ``bytes_copied`` (bytes materialized into new host memory on receive: pickle
     payloads, writable column copies, sidecar bytes), ``bytes_zero_copy`` (bytes served
@@ -54,7 +62,7 @@ def _new_wire_stats():
             'sidecar_columns': 0, 'sidecar_column_names': []}
 
 
-def _columns_num_rows(columns):
+def _columns_num_rows(columns: Mapping[str, Any]) -> int:
     """The columnar row-count convention shared by the wire codec, the rowgroup
     worker and the cache: the first column's length (0 for an empty dict)."""
     for col in columns.values():
@@ -62,7 +70,9 @@ def _columns_num_rows(columns):
     return 0
 
 
-def encode_columnar(columns, num_rows, meta_extra=None):
+def encode_columnar(columns: Mapping[str, Any], num_rows: int,
+                    meta_extra: Optional[Mapping[str, Any]] = None
+                    ) -> Tuple[Any, bytes, List[str]]:
     """Encode ``{name: ndarray-or-list}`` into ``(ipc_bytes, sidecar_bytes,
     sidecar_names)``: uniform numeric ndarrays become ONE Arrow record batch
     (multi-dim columns flattened to FixedSizeList, original shapes/dtypes in schema
@@ -71,8 +81,10 @@ def encode_columnar(columns, num_rows, meta_extra=None):
     sidecar fields ride here)."""
     import pyarrow as pa
 
-    arrow_arrays, arrow_names, col_meta = [], [], {}
-    sidecar_cols = {}
+    arrow_arrays: List[Any] = []
+    arrow_names: List[str] = []
+    col_meta: Dict[str, Any] = {}
+    sidecar_cols: Dict[str, Any] = {}
     for name, col in columns.items():
         if (isinstance(col, np.ndarray) and col.ndim >= 1
                 and col.dtype.kind in 'iuf' and len(col) == num_rows):
@@ -89,7 +101,7 @@ def encode_columnar(columns, num_rows, meta_extra=None):
         else:
             sidecar_cols[name] = col
 
-    meta = {'num_rows': int(num_rows), 'columns': col_meta}
+    meta: Dict[str, Any] = {'num_rows': int(num_rows), 'columns': col_meta}
     if meta_extra:
         meta.update(meta_extra)
     schema = pa.schema([pa.field(n, a.type) for n, a in zip(arrow_names, arrow_arrays)],
@@ -102,7 +114,10 @@ def encode_columnar(columns, num_rows, meta_extra=None):
             sorted(sidecar_cols))
 
 
-def decode_columnar(ipc_frame, sidecar_frame, writable=True, stats=None):
+def decode_columnar(ipc_frame: Frame, sidecar_frame: Frame,
+                    writable: bool = True,
+                    stats: Optional[Dict[str, Any]] = None
+                    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Decode the :func:`encode_columnar` pair back into ``(columns, meta)``.
 
     ``ipc_frame``/``sidecar_frame`` may be bytes, memoryviews (ZMQ frame or shm slot)
@@ -118,7 +133,7 @@ def decode_columnar(ipc_frame, sidecar_frame, writable=True, stats=None):
         batch = reader.read_next_batch()
         meta = json.loads(batch.schema.metadata[_META_KEY].decode('utf-8'))
     sidecar_blob = _as_bytes(sidecar_frame)
-    columns = pickle.loads(sidecar_blob)
+    columns: Dict[str, Any] = pickle.loads(sidecar_blob)
     if stats is not None:
         stats['batches'] += 1
         stats['bytes_copied'] += len(sidecar_blob)
@@ -152,13 +167,15 @@ class PickleSerializer(object):
     """Whole-object pickle — always correct, copies everything (reference:
     reader_impl/pickle_serializer.py:17-23)."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self.stats = _new_wire_stats()
 
-    def serialize(self, obj):
+    def serialize(self, obj: Any) -> List[Frame]:
+        """Whole-object pickle into one payload frame."""
         return [_MARKER_PICKLE, pickle.dumps(obj, protocol=5)]
 
-    def deserialize(self, frames):
+    def deserialize(self, frames: Sequence[Frame]) -> Any:
+        """Unpickle the payload frame, counting the copy in ``stats``."""
         blob = _as_bytes(frames[1])
         self.stats['batches'] += 1
         # unpickling re-materializes the whole object graph: count the payload once
@@ -186,20 +203,22 @@ class ArrowIpcSerializer(object):
     ``writable=True``: its slot memory is handed back to the producing worker the
     moment ``deserialize`` returns, so nothing may keep aliasing it."""
 
-    def __init__(self, writable=True):
+    def __init__(self, writable: bool = True) -> None:
         self._writable = writable
         self.stats = _new_wire_stats()
 
     @property
-    def writable(self):
+    def writable(self) -> bool:
         """True when receive copies columns into ordinary writable arrays."""
         return self._writable
 
-    def serialize(self, obj):
+    def serialize(self, obj: Any) -> List[Frame]:
+        """ColumnarBatch -> ``[marker, ipc_stream, pickled_sidecar]`` frames
+        (anything else falls back to whole-object pickle)."""
         from petastorm_tpu.reader_worker import ColumnarBatch
         if not isinstance(obj, ColumnarBatch):
             return PickleSerializer().serialize(obj)
-        meta_extra = {
+        meta_extra: Dict[str, Any] = {
             'item_id': ([int(part) for part in obj.item_id]
                         if obj.item_id is not None else None),
             # resilience sidecar (docs/robustness.md): plain-JSON fields, so the
@@ -224,7 +243,9 @@ class ArrowIpcSerializer(object):
                                                    meta_extra)
         return [_MARKER_ARROW, ipc_buf, sidecar_blob]
 
-    def deserialize(self, frames):
+    def deserialize(self, frames: Sequence[Frame]) -> Any:
+        """Frames -> ColumnarBatch (or the pickled fallback object), updating
+        the consumer-side ``stats``."""
         marker = _as_bytes(frames[0])
         if marker == _MARKER_PICKLE:
             self.stats['batches'] += 1
@@ -247,17 +268,17 @@ class ArrowIpcSerializer(object):
                              breakers=meta.get('breakers'))
 
 
-def _as_bytes(frame):
+def _as_bytes(frame: Frame) -> bytes:
     """bytes from a bytes / memoryview / zmq.Frame / pa.Buffer wire frame."""
     if isinstance(frame, bytes):
         return frame
     return bytes(_as_memory(frame))
 
 
-def _as_memory(frame):
+def _as_memory(frame: Frame) -> memoryview:
     if isinstance(frame, memoryview):
         return frame
     buffer = getattr(frame, 'buffer', None)  # zmq.Frame (copy=False receive)
     if buffer is not None:
-        return buffer
+        return memoryview(buffer)
     return memoryview(frame)

@@ -1,0 +1,6 @@
+"""Known-bad fixture: a clock-disciplined module reading the wall clock."""
+import time
+
+
+def deadline_exceeded(start, budget_s):
+    return time.monotonic() - start > budget_s  # must use the injected clock

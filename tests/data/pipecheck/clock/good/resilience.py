@@ -1,0 +1,6 @@
+"""Known-good fixture: the clock is injected; references are not calls."""
+import time
+
+
+def deadline_exceeded(start, budget_s, clock=time.monotonic):
+    return clock() - start > budget_s

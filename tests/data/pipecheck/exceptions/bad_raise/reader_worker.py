@@ -1,0 +1,7 @@
+"""Known-bad fixture: a data-path module raising bare Exception."""
+
+
+def decode(value):
+    if value is None:
+        raise Exception('decode failed')  # should be a petastorm_tpu.errors type
+    return value

@@ -1,0 +1,8 @@
+"""Known-bad fixture: a broad except that swallows silently."""
+
+
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:
+        return None

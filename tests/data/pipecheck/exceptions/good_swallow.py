@@ -1,0 +1,33 @@
+"""Known-good fixture: narrow catch; broad-but-logging; broad-but-reraising."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def narrow(path):
+    try:
+        return open(path).read()
+    except OSError:
+        return None
+
+
+def logged(path):
+    try:
+        return open(path).read()
+    except Exception:
+        logger.warning('failed to read %s', path, exc_info=True)
+        return None
+
+
+def reraises(path):
+    try:
+        return open(path).read()
+    except Exception as exc:
+        raise RuntimeError('read failed') from exc
+
+
+def commented(path):
+    try:
+        return open(path).read()
+    except Exception:  # noqa: BLE001 - any failure means "no config", the documented default
+        return None

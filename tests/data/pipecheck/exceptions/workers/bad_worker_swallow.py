@@ -1,0 +1,14 @@
+"""Known-bad fixture: in a workers/ module, logging alone is not enough —
+the reason must be written at the site."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def worker_loop(queue):
+    while True:
+        item = queue.get()
+        try:
+            item.process()
+        except Exception:
+            logger.warning('item failed', exc_info=True)

@@ -1,0 +1,21 @@
+"""Known-bad fixture: blocking calls inside lock-guarded critical sections."""
+import time
+
+
+class Pool:
+    def __init__(self, lock, socket, thread):
+        self._state_lock = lock
+        self._socket = socket
+        self._thread = thread
+
+    def drain(self):
+        with self._state_lock:
+            time.sleep(0.2)
+
+    def read(self):
+        with self._state_lock:
+            return self._socket.recv_multipart()
+
+    def reap(self):
+        with self._state_lock:
+            self._thread.join()

@@ -1,0 +1,31 @@
+"""Known-good fixture: snapshot under the lock, block outside; str/path joins
+and Condition.wait stay unflagged."""
+import os
+import time
+
+
+class Pool:
+    def __init__(self, lock, socket, thread, cond):
+        self._state_lock = lock
+        self._socket = socket
+        self._thread = thread
+        self._cond = cond
+
+    def drain(self):
+        with self._state_lock:
+            pending = list(range(3))
+        time.sleep(0.2)
+        return pending
+
+    def read(self):
+        frames = self._socket.recv_multipart()
+        with self._state_lock:
+            return frames
+
+    def label(self, parts):
+        with self._state_lock:
+            return ', '.join(parts) + os.path.join('a', 'b')
+
+    def wait_for_work(self):
+        with self._cond:
+            self._cond.wait()

@@ -1,0 +1,20 @@
+"""Known-bad fixture: descriptor writer/reader key drift (writes 's', reads
+'slot')."""
+import json
+
+
+class Descriptor:
+    def __init__(self, worker_slot, generation, ring_slot):
+        self.worker_slot = worker_slot
+        self.generation = generation
+        self.ring_slot = ring_slot
+
+    def to_bytes(self):
+        spec = {'w': self.worker_slot, 'g': self.generation,
+                's': self.ring_slot}
+        return json.dumps(spec).encode('utf-8')
+
+    @classmethod
+    def from_bytes(cls, blob):
+        spec = json.loads(bytes(blob).decode('utf-8'))
+        return cls(spec['w'], spec['g'], spec['slot'])

@@ -1,0 +1,18 @@
+"""Known-bad fixture (pool side): dispatches on b'result', which the worker
+fixture renamed to b'result_v2' without updating this side."""
+
+MSG_RESULT, MSG_DONE = b'result', b'done'
+
+
+def get_results(results_socket):
+    parts = results_socket.recv_multipart()
+    kind = bytes(parts[0])
+    if kind == MSG_RESULT:
+        return parts[1:]
+    if kind == MSG_DONE:
+        return None
+    return None
+
+
+def dispatch(dispatch_socket, identity, token, blob):
+    dispatch_socket.send_multipart([identity, b'work', token, blob])

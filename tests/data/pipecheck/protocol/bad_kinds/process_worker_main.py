@@ -1,0 +1,16 @@
+"""Known-bad fixture (worker side of the peer pair): publishes a message
+kind the pool never dispatches on, and never sends the kind the pool expects."""
+
+
+def publish(results_socket, token, frames):
+    # b'result_v2' is not dispatched on by the peer pool fixture
+    results_socket.send_multipart([b'result_v2', token] + frames)
+    results_socket.send_multipart([b'done', token])
+
+
+def loop(dispatch_socket):
+    frames = dispatch_socket.recv_multipart()
+    kind = frames[0]
+    if kind == b'work':
+        return frames[1:]
+    return None

@@ -1,0 +1,8 @@
+"""Known-bad fixture: an undeclared quarantine reason literal."""
+from petastorm_tpu.resilience import QuarantineRecord
+
+
+def quarantine(piece_index, path):
+    return QuarantineRecord(piece_index=piece_index, fragment_path=path,
+                            row_group_id=None, error_type='X', error='x',
+                            attempts=1, reason='cosmic-ray')

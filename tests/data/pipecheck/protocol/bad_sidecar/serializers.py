@@ -1,0 +1,14 @@
+"""Known-bad fixture: serialize writes a sidecar key deserialize never reads,
+and deserialize reads one that is never written."""
+import json
+import pickle
+
+
+class Serializer:
+    def serialize(self, obj):
+        meta_extra = {'item_id': obj.item_id, 'telemetry': obj.telemetry}
+        return [json.dumps(meta_extra).encode('utf-8'), pickle.dumps(obj)]
+
+    def deserialize(self, frames):
+        meta = json.loads(bytes(frames[0]).decode('utf-8'))
+        return meta['item_id'], meta.get('breakers')

@@ -1,0 +1,17 @@
+"""Known-good fixture (pool side): matches the worker fixture's kinds."""
+
+MSG_RESULT, MSG_DONE = b'result', b'done'
+
+
+def get_results(results_socket):
+    parts = results_socket.recv_multipart()
+    kind = bytes(parts[0])
+    if kind == MSG_RESULT:
+        return parts[1:]
+    if kind == MSG_DONE:
+        return None
+    return None
+
+
+def dispatch(dispatch_socket, identity, token, blob):
+    dispatch_socket.send_multipart([identity, b'work', token, blob])

@@ -1,0 +1,15 @@
+"""Known-good fixture (worker side): every kind sent is dispatched on by the
+pool fixture and vice versa."""
+
+
+def publish(results_socket, token, frames):
+    results_socket.send_multipart([b'result', token] + frames)
+    results_socket.send_multipart([b'done', token])
+
+
+def loop(dispatch_socket):
+    frames = dispatch_socket.recv_multipart()
+    kind = frames[0]
+    if kind == b'work':
+        return frames[1:]
+    return None

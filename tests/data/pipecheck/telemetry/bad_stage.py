@@ -1,0 +1,8 @@
+"""Known-bad fixture: a typo'd stage name (not in the STAGES catalog)."""
+from petastorm_tpu.telemetry.spans import stage_span
+
+
+def work(registry):
+    with stage_span('decodee'):  # typo: should be 'decode'
+        pass
+    registry.inc('watchdog_reep')  # typo: should be 'watchdog_reap'

@@ -1,0 +1,11 @@
+"""Known-good fixture: catalog names only, including the conditional form."""
+from petastorm_tpu.telemetry.spans import record_stage, stage_span
+
+
+def work(registry, hit, dt):
+    with stage_span('decode'):
+        pass
+    record_stage('cache_hit' if hit else 'cache_miss', dt)
+    registry.inc('watchdog_reap')
+    registry.observe('pool_wait', dt)
+    registry.observe('wire_bytes_copied', 123)

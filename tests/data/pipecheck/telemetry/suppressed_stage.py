@@ -1,0 +1,7 @@
+"""Suppression fixture: an off-catalog name, explicitly allowed with a reason."""
+from petastorm_tpu.telemetry.spans import stage_span
+
+
+def work():
+    with stage_span('experimental_stage'):  # pipecheck: disable=telemetry-names -- experiment-local stage, removed with the experiment
+        pass

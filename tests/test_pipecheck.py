@@ -1,0 +1,331 @@
+"""Tests for pipecheck, the AST-based data-plane invariant analyzer
+(petastorm_tpu/analysis/, docs/static-analysis.md).
+
+Three layers, mirroring how the tool is meant to hold the line:
+
+- **fixtures** (tests/data/pipecheck/): one known-bad and one known-good
+  snippet per rule family, plus suppression-comment cases — the rule
+  *mechanisms* work;
+- **self-application**: ``pipecheck`` over the real ``petastorm_tpu`` package
+  exits clean — the tier-1 gate every future PR inherits;
+- **seeded mutations**: copies of the real modules with exactly the drift
+  each rule exists to catch (a typo'd stage name in a worker span, a new ZMQ
+  kind sent but not dispatched, a wall-clock call in resilience.py, a strict
+  module dropped from mypy.ini) — the ISSUE-5 acceptance list.
+"""
+import configparser
+import os
+import shutil
+from pathlib import Path
+
+import pytest
+
+import petastorm_tpu
+from petastorm_tpu.analysis import run_pipecheck
+from petastorm_tpu.analysis.cli import main as pipecheck_main
+from petastorm_tpu.analysis.rules.ratchet import (DEFAULT_MANIFEST,
+                                                  read_manifest)
+
+FIXTURES = Path(__file__).parent / 'data' / 'pipecheck'
+PKG = Path(os.path.dirname(os.path.abspath(petastorm_tpu.__file__)))
+STRICT_FLAGS = ('disallow_untyped_defs', 'disallow_incomplete_defs',
+                'no_implicit_optional', 'warn_return_any')
+
+
+def run(paths, rules=None, **kwargs):
+    return run_pipecheck(paths=[str(p) for p in paths], rules=rules, **kwargs)
+
+
+def messages(report):
+    return [finding.format() for finding in report.findings]
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+BAD_FIXTURES = [
+    ('telemetry/bad_stage.py', ['telemetry-names'], 2,
+     ['decodee', 'watchdog_reep']),
+    ('clock/bad', ['clock-discipline'], 1, ['time.monotonic']),
+    ('exceptions/bad_swallow.py', ['exception-hygiene'], 1, ['swallows']),
+    ('exceptions/workers/bad_worker_swallow.py', ['exception-hygiene'], 1,
+     ['worker module']),
+    ('exceptions/bad_raise/reader_worker.py', ['exception-hygiene'], 1,
+     ['errors type']),
+    ('locks/bad_lock.py', ['lock-discipline'], 3,
+     ['sleep', 'recv_multipart', 'join']),
+    ('protocol/bad_kinds', ['protocol-conformance'], 2,
+     ["b'result_v2'", "b'result'"]),
+    ('protocol/bad_descriptor/shm_ring.py', ['protocol-conformance'], 2,
+     ["'s'", "'slot'"]),
+    ('protocol/bad_sidecar/serializers.py', ['protocol-conformance'], 2,
+     ["'telemetry'", "'breakers'"]),
+    ('protocol/bad_reason/quarantiner.py', ['protocol-conformance'], 1,
+     ['cosmic-ray']),
+]
+
+GOOD_FIXTURES = [
+    ('telemetry/good_stage.py', ['telemetry-names']),
+    ('clock/good', ['clock-discipline']),
+    ('exceptions/good_swallow.py', ['exception-hygiene']),
+    ('locks/good_lock.py', ['lock-discipline']),
+    ('protocol/good_kinds', ['protocol-conformance']),
+]
+
+
+@pytest.mark.parametrize('path,rules,min_findings,needles', BAD_FIXTURES)
+def test_known_bad_fixture_is_flagged(path, rules, min_findings, needles):
+    report = run([FIXTURES / path], rules=rules)
+    assert len(report.findings) >= min_findings, messages(report)
+    text = '\n'.join(messages(report))
+    for needle in needles:
+        assert needle in text, (needle, text)
+    # every finding carries the rule id it can be suppressed under
+    assert all(f.rule == rules[0] for f in report.findings), messages(report)
+
+
+@pytest.mark.parametrize('path,rules', GOOD_FIXTURES)
+def test_known_good_fixture_is_clean(path, rules):
+    report = run([FIXTURES / path], rules=rules)
+    assert report.clean, messages(report)
+
+
+@pytest.mark.parametrize('path,rules', [
+    ('telemetry/suppressed_stage.py', ['telemetry-names']),
+    ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
+])
+def test_suppression_comment_is_honored_and_counted(path, rules):
+    report = run([FIXTURES / path], rules=rules)
+    assert report.clean, messages(report)
+    assert report.suppressed == 1
+
+
+def test_suppression_without_reason_is_itself_a_finding(tmp_path):
+    bad = tmp_path / 'mod.py'
+    bad.write_text("from petastorm_tpu.telemetry.spans import stage_span\n"
+                   "def f():\n"
+                   "    with stage_span('bogus_stage'):  "
+                   "# pipecheck: disable=telemetry-names\n"
+                   "        pass\n")
+    report = run([tmp_path], rules=['telemetry-names'])
+    # the typo IS suppressed, but the reasonless directive is flagged
+    assert report.suppressed == 1
+    assert [f.rule for f in report.findings] == ['suppression-hygiene'], \
+        messages(report)
+
+
+def test_tree_under_dot_directory_is_still_analyzed(tmp_path):
+    """A .venv/site-packages install must not read as 'clean — 0 files':
+    the hidden-dir skip applies below the analyzed root, not above it."""
+    pkg = tmp_path / '.venv' / 'lib' / 'pkg'
+    pkg.mkdir(parents=True)
+    shutil.copy(FIXTURES / 'exceptions' / 'bad_swallow.py',
+                pkg / 'bad_swallow.py')
+    hidden_below = pkg / '.hidden'
+    hidden_below.mkdir()
+    shutil.copy(FIXTURES / 'exceptions' / 'bad_swallow.py',
+                hidden_below / 'also_bad.py')
+    report = run([pkg], rules=['exception-hygiene'])
+    assert report.files == 1  # .hidden/ below the root IS skipped
+    assert len(report.findings) == 1, messages(report)
+
+
+def test_ratchet_skip_without_mypy_ini_is_noted(tmp_path):
+    (tmp_path / 'mod.py').write_text('x = 1\n')
+    report = run([tmp_path], rules=['mypy-ratchet'])
+    assert report.clean
+    assert any('mypy-ratchet did NOT run' in note for note in report.notes)
+    assert 'did NOT run' in report.format_human()
+
+
+def test_marker_only_comment_is_not_a_broad_except_reason(tmp_path):
+    workers = tmp_path / 'workers'
+    workers.mkdir()
+    (workers / 'loop.py').write_text(
+        'def f(item):\n'
+        '    try:\n'
+        '        item.process()\n'
+        '    except Exception:  # TODO\n'
+        '        pass\n')
+    report = run([tmp_path], rules=['exception-hygiene'])
+    assert len(report.findings) == 1, messages(report)
+
+
+def test_parse_error_is_reported_not_skipped(tmp_path):
+    (tmp_path / 'broken.py').write_text('def f(:\n')
+    report = run([tmp_path], rules=['telemetry-names'])
+    assert [f.rule for f in report.findings] == ['parse-error']
+
+
+# --------------------------------------------------------- self-application
+
+
+def test_self_application_is_clean():
+    """The tier-1 gate: the shipped package satisfies its own invariants."""
+    report = run_pipecheck()
+    assert report.clean, '\n'.join(messages(report))
+    assert report.files > 60  # the walker found the real package
+    assert len(report.rules) == 6
+
+
+def test_cli_self_application_exit_code(capsys):
+    assert pipecheck_main([str(PKG)]) == 0
+    out = capsys.readouterr().out
+    assert 'pipecheck: clean' in out
+
+
+def test_cli_json_and_exit_codes(capsys):
+    import json
+    rc = pipecheck_main([str(FIXTURES / 'telemetry' / 'bad_stage.py'),
+                         '--rules', 'telemetry-names', '--json'])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['clean'] is False
+    assert doc['by_rule'] == {'telemetry-names': 2}
+    assert pipecheck_main(['--list-rules']) == 0
+    assert 'protocol-conformance' in capsys.readouterr().out
+    assert pipecheck_main(['--rules', 'no-such-rule', str(PKG)]) == 2
+
+
+def test_throughput_cli_dispatches_pipecheck(capsys):
+    from petastorm_tpu.benchmark.cli import main as throughput_main
+    assert throughput_main(['pipecheck', str(PKG)]) == 0
+    assert 'pipecheck: clean' in capsys.readouterr().out
+
+
+def test_doctor_pipecheck_block():
+    from petastorm_tpu.tools.doctor import check_pipecheck
+    block = check_pipecheck()
+    assert block['status'] == 'ok'
+    assert block['findings'] == 0
+    assert block['files'] > 60
+
+
+# -------------------------------------------------------- seeded mutations
+
+
+def _copy_mutated(src, dst, old, new):
+    text = src.read_text()
+    assert old in text, 'mutation anchor {!r} vanished from {}'.format(old, src)
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(text.replace(old, new))
+    return dst
+
+
+def test_mutation_typo_stage_name_in_worker_span(tmp_path):
+    _copy_mutated(PKG / 'workers' / 'process_worker_main.py',
+                  tmp_path / 'process_worker_main.py',
+                  "stage_span('serialize')", "stage_span('seralize')")
+    report = run([tmp_path], rules=['telemetry-names'])
+    assert len(report.findings) == 1, messages(report)
+    assert "'seralize'" in report.findings[0].message
+
+
+def test_mutation_new_zmq_kind_sent_but_not_dispatched(tmp_path):
+    _copy_mutated(PKG / 'workers' / 'process_worker_main.py',
+                  tmp_path / 'process_worker_main.py',
+                  "[b'result_shm', current_token[0]",
+                  "[b'result_v2', current_token[0]")
+    shutil.copy(PKG / 'workers' / 'process_pool.py',
+                tmp_path / 'process_pool.py')
+    report = run([tmp_path], rules=['protocol-conformance'])
+    text = '\n'.join(messages(report))
+    assert "b'result_v2'" in text and 'no protocol peer dispatches' in text
+    assert "b'result_shm'" in text and 'never sent' in text
+
+
+def test_mutation_sidecar_key_dropped_from_real_deserialize(tmp_path):
+    """Guards the real serializers.py pairing (incl. the annotated-assign
+    form of meta_extra): dropping the consumer-side read of a sidecar key
+    must surface as written-but-never-read."""
+    _copy_mutated(PKG / 'workers' / 'serializers.py',
+                  tmp_path / 'serializers.py',
+                  "breakers=meta.get('breakers')", 'breakers=None')
+    report = run([tmp_path], rules=['protocol-conformance'])
+    text = '\n'.join(messages(report))
+    assert "'breakers'" in text and 'never read back' in text, text
+
+
+def test_mutation_wall_clock_call_in_resilience(tmp_path):
+    src = PKG / 'resilience.py'
+    dst = tmp_path / 'resilience.py'
+    dst.write_text(src.read_text() + '\n_BOOTED_AT = time.time()\n')
+    report = run([tmp_path], rules=['clock-discipline'])
+    assert len(report.findings) == 1, messages(report)
+    assert 'time.time' in report.findings[0].message
+    # the unmutated module is clean (the baseline the mutation perturbs)
+    shutil.copy(src, dst)
+    assert run([tmp_path], rules=['clock-discipline']).clean
+
+
+def _write_strict_ini(path, entries, weaken=None):
+    lines = ['[mypy]', 'files = petastorm_tpu', '']
+    for entry in entries:
+        lines.append('[mypy-{}]'.format(entry))
+        for flag in STRICT_FLAGS:
+            if weaken and entry == weaken and flag == 'warn_return_any':
+                lines.append('{} = False'.format(flag))
+            else:
+                lines.append('{} = True'.format(flag))
+        lines.append('')
+    path.write_text('\n'.join(lines))
+
+
+def test_mutation_strict_module_dropped_from_mypy_ini(tmp_path):
+    entries = read_manifest(DEFAULT_MANIFEST)
+    assert 'petastorm_tpu.resilience' in entries
+    ini = tmp_path / 'mypy.ini'
+    _write_strict_ini(ini, [e for e in entries
+                            if e != 'petastorm_tpu.resilience'])
+    report = run([tmp_path], rules=['mypy-ratchet'], mypy_ini=str(ini))
+    assert len(report.findings) == 1, messages(report)
+    assert 'petastorm_tpu.resilience' in report.findings[0].message
+    assert 'only grow' in report.findings[0].message
+
+
+def test_mutation_strict_section_weakened(tmp_path):
+    entries = read_manifest(DEFAULT_MANIFEST)
+    ini = tmp_path / 'mypy.ini'
+    _write_strict_ini(ini, entries, weaken='petastorm_tpu.errors')
+    report = run([tmp_path], rules=['mypy-ratchet'], mypy_ini=str(ini))
+    assert len(report.findings) == 1, messages(report)
+    assert 'warn_return_any' in report.findings[0].message
+
+
+def test_ratchet_unlisted_strict_section_must_join_manifest(tmp_path):
+    entries = read_manifest(DEFAULT_MANIFEST) + ['petastorm_tpu.zzz_new']
+    ini = tmp_path / 'mypy.ini'
+    _write_strict_ini(ini, entries)
+    report = run([tmp_path], rules=['mypy-ratchet'], mypy_ini=str(ini))
+    assert len(report.findings) == 1, messages(report)
+    assert 'petastorm_tpu.zzz_new' in report.findings[0].message
+    assert 'strict_modules.txt' in report.findings[0].message
+
+
+def test_ratchet_manifest_matches_shipped_mypy_ini():
+    """The checked-in pair is consistent AND the manifest names all seven+
+    strict sections (ISSUE-5 satellite: serializers + errors promoted)."""
+    entries = read_manifest(DEFAULT_MANIFEST)
+    assert entries == sorted(entries)
+    for promoted in ('petastorm_tpu.workers.serializers',
+                     'petastorm_tpu.errors', 'petastorm_tpu.resilience',
+                     'petastorm_tpu.analysis.*'):
+        assert promoted in entries
+    parser = configparser.ConfigParser()
+    parser.read(Path(__file__).parent.parent / 'mypy.ini')
+    for entry in entries:
+        section = 'mypy-' + entry
+        assert parser.has_section(section), section
+        for flag in STRICT_FLAGS:
+            assert parser.getboolean(section, flag), (section, flag)
+
+
+def test_bench_declares_pipecheck_section():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'bench_for_pipecheck_test',
+        Path(__file__).parent.parent / 'bench.py')
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert 'pipecheck' in bench.SECTION_NAMES
+    assert 'pipecheck' in bench.SECTION_RUN_ORDER
