@@ -155,7 +155,7 @@ _HEADLINE_FALLBACKS = (
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
                  'flash', 'moe', 'wire_bench', 'telemetry', 'resilience',
-                 'pipecheck')
+                 'pipecheck', 'tracing')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -165,8 +165,8 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
 SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'wire_bench', 'telemetry',
-                     'resilience', 'mnist_scan_stream', 'flash', 'moe',
-                     'imagenet_scan', 'imagenet_stream', 'decode_delta',
+                     'tracing', 'resilience', 'mnist_scan_stream', 'flash',
+                     'moe', 'imagenet_scan', 'imagenet_stream', 'decode_delta',
                      'bare_reader', 'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
@@ -1451,6 +1451,56 @@ def child_main():
             fields['telemetry_stage_share_' + entry['stage']] = entry['share']
         results.update(fields)
 
+    def run_tracing():
+        """Flight-recorder overhead + capture validity (host-only, fast): the
+        same process-pool epoch with the trace ring armed vs disarmed; the
+        overhead percentage is the BENCH-history guard for the ISSUE-6
+        acceptance (<= 3% with tracing on — docs/observability.md "Flight
+        recorder"), and the captured trace's event/drop counts prove the
+        default ring size holds a full epoch without silent loss."""
+        from petastorm_tpu.telemetry import tracing as flight
+        from petastorm_tpu.telemetry.trace_export import summarize_trace
+
+        def epoch_rows_per_sec(traced):
+            flight.reset_tracing()
+            flight.set_trace_enabled(traced)
+            reader = make_reader(url, reader_pool_type='process',
+                                 workers_count=min(WORKERS, 2), num_epochs=1,
+                                 shuffle_row_groups=False)
+            rows = 0
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            summary = (summarize_trace(flight.trace_snapshot())
+                       if traced else None)
+            reader.stop()
+            reader.join()
+            return rows / elapsed, summary
+
+        try:
+            baseline_rate, _ = epoch_rows_per_sec(traced=False)
+            traced_rate, summary = epoch_rows_per_sec(traced=True)
+        finally:
+            flight.set_trace_enabled(False)
+            flight.reset_tracing()
+        overhead_pct = (baseline_rate - traced_rate) / baseline_rate * 100.0
+        log('tracing: traced {:.1f} rows/s vs off {:.1f} rows/s ({:+.2f}% '
+            'flight-recorder overhead); {} events over {} rowgroup traces '
+            'across {} processes, {} dropped'
+            .format(traced_rate, baseline_rate, overhead_pct,
+                    summary['events'], summary['rowgroups_traced'],
+                    len(summary['processes']), summary['dropped_events']))
+        results.update({
+            'tracing_traced_rows_per_sec': round(traced_rate, 1),
+            'tracing_baseline_rows_per_sec': round(baseline_rate, 1),
+            'tracing_overhead_pct': round(overhead_pct, 2),
+            'tracing_events': summary['events'],
+            'tracing_dropped_events': summary['dropped_events'],
+            'tracing_rowgroups_traced': summary['rowgroups_traced'],
+            'tracing_process_tracks': len(summary['processes']),
+        })
+
     def run_resilience():
         """Watchdog + CRC clean-path overhead (host-only, fast): the same
         process-pool epoch with every robustness guard off (no heartbeats, no
@@ -1539,6 +1589,7 @@ def child_main():
         'moe': run_moe,
         'wire_bench': run_wire_bench,
         'telemetry': run_telemetry,
+        'tracing': run_tracing,
         'resilience': run_resilience,
         'pipecheck': run_pipecheck,
     }

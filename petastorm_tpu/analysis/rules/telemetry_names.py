@@ -9,7 +9,11 @@ entry, and no doc row knows about. This rule closes that hole statically:
   ``STAGES`` (``telemetry/spans.py``);
 - ``<registry>.observe('x', ...)`` → ``x`` in ``STAGES`` or
   ``SIZE_HISTOGRAMS``;
-- ``<registry>.inc('x')`` → ``x`` in ``COUNTERS``.
+- ``<registry>.inc('x')`` → ``x`` in ``COUNTERS``;
+- ``trace_instant('x', ...)`` → ``x`` in ``TRACE_INSTANTS`` (the
+  flight-recorder anomaly catalog — docs/observability.md "Flight recorder");
+- ``trace_complete('x', ...)`` → ``x`` in ``STAGES`` (a traced span IS a
+  stage span, just on the timeline instead of a histogram).
 
 Conditional names (``'cache_hit' if hit else 'cache_miss'``) check both
 branches; non-literal names are skipped (they are register-time plumbing, not
@@ -27,18 +31,24 @@ from petastorm_tpu.analysis.core import (AnalysisContext, Finding, Rule,
                                          SourceModule, extract_string_tuple,
                                          literal_str_values)
 
-#: call forms checked: (function-name form, catalog group)
-_NAME_FUNCS = ('stage_span', 'record_stage')
+#: call forms checked against STAGES (observe_traced is the loader's
+#: histogram+timeline dual-emission helper)
+_NAME_FUNCS = ('stage_span', 'record_stage', 'trace_complete',
+               'observe_traced')
+#: call form checked against TRACE_INSTANTS (flight-recorder anomaly markers)
+_INSTANT_FUNCS = ('trace_instant',)
 
 
 class _Catalog:
     """The declared telemetry names, split by metric family."""
 
     def __init__(self, stages: Tuple[str, ...], counters: Tuple[str, ...],
-                 size_histograms: Tuple[str, ...], origin: str) -> None:
+                 size_histograms: Tuple[str, ...],
+                 trace_instants: Tuple[str, ...], origin: str) -> None:
         self.stages = frozenset(stages)
         self.counters = frozenset(counters)
         self.size_histograms = frozenset(size_histograms)
+        self.trace_instants = frozenset(trace_instants)
         self.origin = origin
 
 
@@ -48,8 +58,9 @@ def _catalog_from_tree(tree: ast.Module, origin: str) -> Optional[_Catalog]:
         return None
     counters = extract_string_tuple(tree, 'COUNTERS') or []
     size_histograms = extract_string_tuple(tree, 'SIZE_HISTOGRAMS') or []
+    trace_instants = extract_string_tuple(tree, 'TRACE_INSTANTS') or []
     return _Catalog(tuple(stages), tuple(counters), tuple(size_histograms),
-                    origin)
+                    tuple(trace_instants), origin)
 
 
 def load_catalog(ctx: AnalysisContext) -> Optional[_Catalog]:
@@ -80,9 +91,10 @@ class TelemetryNamesRule(Rule):
     """Flag telemetry names missing from the spans.py catalog (module doc)."""
 
     name = 'telemetry-names'
-    description = ('stage_span/record_stage/observe/inc names must exist in '
-                   'the telemetry catalog (STAGES / COUNTERS / '
-                   'SIZE_HISTOGRAMS in telemetry/spans.py)')
+    description = ('stage_span/record_stage/observe/inc/trace_complete/'
+                   'trace_instant names must exist in the telemetry catalog '
+                   '(STAGES / COUNTERS / SIZE_HISTOGRAMS / TRACE_INSTANTS in '
+                   'telemetry/spans.py)')
 
     def check_module(self, module: SourceModule,
                      ctx: AnalysisContext) -> Iterable[Finding]:
@@ -109,6 +121,10 @@ class TelemetryNamesRule(Rule):
                 names = literal_str_values(node.args[0])
                 allowed = catalog.stages
                 family = 'STAGES'
+            elif func_name in _INSTANT_FUNCS or attr_name in _INSTANT_FUNCS:
+                names = literal_str_values(node.args[0])
+                allowed = catalog.trace_instants
+                family = 'TRACE_INSTANTS'
             elif attr_name == 'observe':
                 names = literal_str_values(node.args[0])
                 allowed = catalog.stages | catalog.size_histograms

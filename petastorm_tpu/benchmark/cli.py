@@ -5,7 +5,10 @@ Subcommands: a first positional of ``wire-bench`` dispatches to
 :mod:`petastorm_tpu.benchmark.wire_bench` (zero-copy data-plane microbench, JSON
 output); ``analyze`` dispatches to :mod:`petastorm_tpu.telemetry.analyze` (stage
 time-share ranking + bottleneck-to-knob mapping over a telemetry snapshot /
-JSONL event log — docs/observability.md); ``pipecheck`` dispatches to
+JSONL event log — docs/observability.md); ``trace`` dispatches to
+:mod:`petastorm_tpu.telemetry.trace_export` (flight-recorder capture of a real
+read, exported as Chrome-trace/Perfetto JSON — docs/observability.md "Flight
+recorder"); ``pipecheck`` dispatches to
 :mod:`petastorm_tpu.analysis` (AST-based data-plane invariant analyzer —
 docs/static-analysis.md); ``doctor`` dispatches to
 :mod:`petastorm_tpu.tools.doctor` (environment health report); anything else
@@ -31,6 +34,9 @@ def main(argv=None):
     if argv and argv[0] == 'analyze':
         from petastorm_tpu.telemetry.analyze import main as analyze_main
         return analyze_main(argv[1:])
+    if argv and argv[0] == 'trace':
+        from petastorm_tpu.telemetry.trace_export import main as trace_main
+        return trace_main(argv[1:])
     if argv and argv[0] == 'pipecheck':
         from petastorm_tpu.analysis.cli import main as pipecheck_main
         return pipecheck_main(argv[1:])

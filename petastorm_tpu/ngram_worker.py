@@ -23,15 +23,16 @@ class NGramWindows(object):
     the unit of NGram checkpoint/resume accounting (VERDICT r3 item 4); zero-window
     pieces still publish (empty ``starts``) solely to carry it. ``retries`` /
     ``quarantine`` are the resilience sidecar, ``telemetry`` the stage-span
-    sidecar, ``breakers`` the circuit-breaker sidecar — same contracts as
+    sidecar, ``breakers`` the circuit-breaker sidecar, ``trace`` the
+    flight-recorder sidecar — same contracts as
     :class:`~petastorm_tpu.reader_worker.ColumnarBatch` (docs/robustness.md,
     docs/observability.md)."""
 
     __slots__ = ('columns', 'starts', 'item_id', 'retries', 'quarantine',
-                 'telemetry', 'breakers')
+                 'telemetry', 'breakers', 'trace')
 
     def __init__(self, columns, starts, item_id=None, retries=0, quarantine=None,
-                 telemetry=None, breakers=None):
+                 telemetry=None, breakers=None, trace=None):
         self.columns = columns
         self.starts = starts
         self.item_id = item_id
@@ -39,6 +40,7 @@ class NGramWindows(object):
         self.quarantine = quarantine
         self.telemetry = telemetry
         self.breakers = breakers
+        self.trace = trace
 
     def __len__(self):
         return len(self.starts)

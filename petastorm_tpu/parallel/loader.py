@@ -25,6 +25,7 @@ import time
 
 import numpy as np
 
+from petastorm_tpu.telemetry import tracing as _flight
 from petastorm_tpu.parallel.shuffling_buffer import (NoopShufflingBuffer,
                                                      RandomShufflingBuffer)
 
@@ -285,7 +286,8 @@ class JaxDataLoader(object):
                                batches=1, rows=local_rows)
                 # shuffle_wait: time the training loop sat blocked on the input
                 # pipeline for this batch — the stage the stall fraction sums
-                self.telemetry.observe('shuffle_wait', now - wait_start)
+                # (clocked on monotonic, so the timeline leg back-dates)
+                self.observe_traced('shuffle_wait', now - wait_start)
                 if self._telemetry_jsonl is not None and self._telemetry_jsonl.due():
                     self._telemetry_jsonl.emit(self.telemetry_snapshot(),
                                                event='loader_interval')
@@ -410,7 +412,8 @@ class JaxDataLoader(object):
         # collate stage: host batch assembly — dtype sanitization + ragged padding
         collate_start = time.perf_counter()
         out = sanitize_columns(columns, self._pad_ragged, self._device_put)
-        self.telemetry.observe('collate', time.perf_counter() - collate_start)
+        self.observe_traced('collate', time.perf_counter() - collate_start,
+                            start_pc=collate_start)
         return out
 
     def _emit(self, columns, out_queue, stop_event):
@@ -435,7 +438,8 @@ class JaxDataLoader(object):
                 else:
                     batch = jax.device_put(columns, sharding)
                     self.stats.add(per_field_uploads=1)
-            self.telemetry.observe('h2d', time.perf_counter() - h2d_start)
+            self.observe_traced('h2d', time.perf_counter() - h2d_start,
+                                start_pc=h2d_start)
         else:
             batch = columns
         # Host-local row count travels alongside: with a multi-process mesh the device
@@ -594,7 +598,8 @@ class JaxDataLoader(object):
                     chunk = self._put_coalesced(chunk, sharding, layout)
                 else:
                     chunk = jax.device_put(chunk, sharding)
-            self.telemetry.observe('h2d', time.perf_counter() - h2d_start)
+            self.observe_traced('h2d', time.perf_counter() - h2d_start,
+                                start_pc=h2d_start)
             key = (step_fn, n_batches)
             if key not in programs:
                 @jax.jit
@@ -717,6 +722,19 @@ class JaxDataLoader(object):
         }
 
     # ------------------------------------------------------------------ telemetry
+
+    def observe_traced(self, stage, dur_s, start_pc=None):
+        """One loader-stage measurement, both legs: the loader's registry
+        histogram and (when the flight recorder is armed) a timeline span.
+        ``start_pc`` is the ``perf_counter`` start; None back-dates by the
+        measured duration (for stages clocked on a different timebase, e.g.
+        the monotonic-clocked ``shuffle_wait``). The stage name is validated
+        against the spans.py catalog by pipecheck's telemetry-names rule."""
+        self.telemetry.observe(stage, dur_s)
+        if _flight.trace_enabled():
+            if start_pc is None:
+                start_pc = time.perf_counter() - dur_s
+            _flight.trace_complete(stage, start_pc, dur_s)
 
     def telemetry_snapshot(self):
         """One JSON-safe telemetry snapshot covering the WHOLE pipeline: the

@@ -19,6 +19,9 @@ from petastorm_tpu.fs_utils import (as_arrow_filesystem, check_hdfs_driver,
                                     make_filesystem_factory,
                                     normalize_dataset_url_or_urls)
 from petastorm_tpu.reader_worker import ColumnarBatch, RowGroupWorker, WorkerSetup
+from petastorm_tpu.telemetry.tracing import (merge_trace_events,
+                                             set_trace_enabled, trace_enabled,
+                                             trace_instant)
 from petastorm_tpu.unischema import Unischema
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.dummy_pool import DummyPool
@@ -111,7 +114,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 filesystem=None, resume_state=None, reader_pool=None,
                 field_overrides=None, hdfs_driver='libhdfs', on_error='raise',
                 retry_policy=None, shm_transport=None, item_deadline_s=None,
-                heartbeat_interval_s=None):
+                heartbeat_interval_s=None, trace=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -149,8 +152,17 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     ``reason='hang'`` instead of re-dispatched (None, the default, disables the
     per-item deadline). ``heartbeat_interval_s`` — cadence of the workers'
     liveness stamps (default 0.5s; a worker whose stamp stalls while it holds
-    work is reaped even without an item deadline; 0 disables stamping)."""
+    work is reaped even without an item deadline; 0 disables stamping).
+
+    Flight recorder (docs/observability.md "Flight recorder"): ``trace``
+    arms/disarms the per-process trace ring buffer — True/False call
+    :func:`~petastorm_tpu.telemetry.tracing.set_trace_enabled` (process-global,
+    like the telemetry switch; workers spawned by this reader's pool inherit
+    it), None (default) leaves the ``PETASTORM_TPU_TRACE`` env setting in
+    place. Export the capture with ``Reader.dump_trace()``."""
     from petastorm_tpu.resilience import resolve_retry_policy
+    if trace is not None:
+        set_trace_enabled(bool(trace))
     check_hdfs_driver(hdfs_driver)
     retry_policy = resolve_retry_policy(on_error, retry_policy)
     construction_retries = [0]
@@ -213,14 +225,16 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       storage_options=None, filesystem=None,
                       resume_state=None, hdfs_driver='libhdfs', on_error='raise',
                       retry_policy=None, shm_transport=None, item_deadline_s=None,
-                      heartbeat_interval_s=None):
+                      heartbeat_interval_s=None, trace=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
-    ``item_deadline_s`` / ``heartbeat_interval_s`` behave exactly as in
-    :func:`make_reader`.
+    ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` behave exactly
+    as in :func:`make_reader`.
     """
     from petastorm_tpu.resilience import resolve_retry_policy
+    if trace is not None:
+        set_trace_enabled(bool(trace))
     check_hdfs_driver(hdfs_driver)
     retry_policy = resolve_retry_policy(on_error, retry_policy)
     construction_retries = [0]
@@ -475,7 +489,7 @@ class Reader(object):
 
         max_in_flight = getattr(reader_pool, 'workers_count', 1) + _VENTILATE_EXTRA_ROWGROUPS
         self._ventilator = ConcurrentVentilator(
-            ventilate_fn=reader_pool.ventilate,
+            ventilate_fn=_traced_ventilate(reader_pool.ventilate),
             items_to_ventilate=items,
             iterations=iterations,
             max_ventilation_queue_size=max_in_flight,
@@ -583,7 +597,8 @@ class Reader(object):
                     quarantine=getattr(batch, 'quarantine', None),
                     cache_hit=getattr(batch, 'cache_hit', None),
                     telemetry=getattr(batch, 'telemetry', None),
-                    breakers=getattr(batch, 'breakers', None))
+                    breakers=getattr(batch, 'breakers', None),
+                    trace=getattr(batch, 'trace', None))
             self._note_item_consumed(batch)
             if self._resume_fast_forward and batch.item_id is not None:
                 # Honor a row_cursor from a row-path checkpoint: skip the rows that
@@ -632,10 +647,21 @@ class Reader(object):
         if breakers:
             with self._accounting_lock:
                 self._breaker_states.update(breakers)
+        trace_sidecar = getattr(batch, 'trace', None)
+        if trace_sidecar:
+            # flight-recorder merge: the producing process's drained timeline
+            # events land in this process's recorder, preserving their pid —
+            # one dump_trace() then spans every process
+            merge_trace_events(trace_sidecar)
         item_id = getattr(batch, 'item_id', None)
         if item_id is None:
             return
         epoch, piece, drop = item_id
+        if trace_enabled():
+            # consumer-side anchor of the rowgroup's trace: present on every
+            # pool/transport, so a trace always ends on the consumer track
+            trace_instant('rowgroup_consumed', ctx=(epoch, piece, 0),
+                          args={'rows': getattr(batch, 'num_rows', 0)})
         with self._accounting_lock:
             self._consumed_by_epoch.setdefault(epoch, set()).add((piece, drop))
             # Epochs complete strictly in order; results of later epochs accumulate in
@@ -747,6 +773,35 @@ class Reader(object):
         return merge_snapshots(self._telemetry.snapshot(),
                                pool_registry.snapshot())
 
+    # --------------------------------------------------------- flight recorder
+
+    def dump_trace(self, path=None):
+        """Export the flight recorder as Chrome-trace/Perfetto JSON
+        (docs/observability.md "Flight recorder"): every event this process
+        recorded plus the worker events merged off the ``trace`` batch
+        sidecars — per-process tracks, stage slices, anomaly instants, and
+        worker→consumer flow arrows per rowgroup. Writes to ``path`` when
+        given; returns the trace dict either way (load it at
+        https://ui.perfetto.dev). Requires tracing to have been armed for the
+        read (``trace=True`` / ``PETASTORM_TPU_TRACE=1``) — otherwise the
+        trace is empty."""
+        from petastorm_tpu.telemetry.trace_export import (to_chrome_trace,
+                                                          write_chrome_trace)
+        from petastorm_tpu.telemetry.tracing import trace_snapshot
+        snapshot = trace_snapshot()
+        if path is not None:
+            return write_chrome_trace(path, snapshot)
+        return to_chrome_trace(snapshot)
+
+    def trace_summary(self):
+        """The non-visual flight-recorder view (doctor/bench embed it): event
+        counts, dropped-event count, anomaly instants, and the top-5 longest
+        rowgroup traces — see
+        :func:`petastorm_tpu.telemetry.trace_export.summarize_trace`."""
+        from petastorm_tpu.telemetry.trace_export import summarize_trace
+        from petastorm_tpu.telemetry.tracing import trace_snapshot
+        return summarize_trace(trace_snapshot())
+
     # ------------------------------------------------------------- lifecycle
 
     def stop(self):
@@ -795,6 +850,10 @@ class Reader(object):
         # One cross-process telemetry snapshot (docs/observability.md): per-stage
         # latency histograms merged from every worker sidecar + the pool registry.
         diag['telemetry'] = self.telemetry_snapshot()
+        # Flight-recorder summary, only while tracing is armed (the summary of
+        # an empty recorder would just be noise in every dashboard).
+        if trace_enabled():
+            diag['trace'] = self.trace_summary()
         return diag
 
     def __enter__(self):
@@ -808,6 +867,23 @@ class Reader(object):
 def _item_id(item):
     """Stable identity of a ventilated work item for consumption accounting."""
     return (item['piece_index'], item['shuffle_row_drop_partition'][0])
+
+
+def _traced_ventilate(pool_ventilate):
+    """Wrap a pool's ``ventilate`` so each work item's birth lands on the
+    flight-recorder timeline (docs/observability.md "Flight recorder"): the
+    ``ventilate`` instant is the causal origin of a rowgroup's trace — the
+    ``(epoch, rowgroup)`` context every later span inherits starts here. One
+    enabled-check per item when tracing is off."""
+    def ventilate(**kwargs):
+        if trace_enabled():
+            piece = kwargs.get('piece_index')
+            if piece is not None:
+                trace_instant('ventilate',
+                              ctx=(int(kwargs.get('epoch_index', 0)),
+                                   int(piece), 0))
+        pool_ventilate(**kwargs)
+    return ventilate
 
 
 def _make_hang_stand_in_factory(ngram):
@@ -828,6 +904,10 @@ def _make_hang_stand_in_factory(ngram):
             error='no result after {:.3g}s; the worker holding this rowgroup '
                   'was reaped by the watchdog'.format(elapsed_s),
             attempts=1, epoch=epoch, reason='hang')
+        # anomaly marker (consumer side — the hung worker can't publish one)
+        trace_instant('quarantine', ctx=(epoch, piece_index, 0),
+                      args={'reason': 'hang',
+                            'elapsed_s': round(elapsed_s, 3)})
         if ngram is not None:
             from petastorm_tpu.ngram_worker import NGramWindows
             return NGramWindows({}, np.empty(0, np.int64), item_id=item_id,

@@ -24,6 +24,10 @@ import pyarrow.dataset as pads
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.telemetry.spans import (drain_stage_times, record_stage,
                                            stage_span)
+from petastorm_tpu.telemetry.tracing import (clear_trace_context,
+                                             current_dispatch_attempt,
+                                             drain_trace_events,
+                                             set_trace_context, trace_instant)
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.workers.serializers import _columns_num_rows
 from petastorm_tpu.workers.worker_base import WorkerBase
@@ -69,13 +73,20 @@ class ColumnarBatch(object):
     producing process's tripped-breaker states (``{name: state_dict}`` from its
     :func:`~petastorm_tpu.resilience.default_board`), or None when every breaker
     is healthy — how worker-process cache/filesystem breaker states reach
-    ``Reader.diagnostics['breakers']`` across the process boundary."""
+    ``Reader.diagnostics['breakers']`` across the process boundary.
+
+    ``trace`` is the flight-recorder sidecar (docs/observability.md "Flight
+    recorder"): the producing process's drained trace events
+    (``{'pid', 'events', 'dropped'}`` from
+    :func:`~petastorm_tpu.telemetry.tracing.drain_trace_events`), or None when
+    tracing is off — how worker-side timeline events reach the consumer's
+    recorder so one ``Reader.dump_trace()`` spans every process."""
 
     __slots__ = ('columns', 'num_rows', 'item_id', 'retries', 'quarantine',
-                 'cache_hit', 'telemetry', 'breakers')
+                 'cache_hit', 'telemetry', 'breakers', 'trace')
 
     def __init__(self, columns, num_rows, item_id=None, retries=0, quarantine=None,
-                 cache_hit=None, telemetry=None, breakers=None):
+                 cache_hit=None, telemetry=None, breakers=None, trace=None):
         self.columns = columns
         self.num_rows = num_rows
         self.item_id = item_id
@@ -84,6 +95,7 @@ class ColumnarBatch(object):
         self.cache_hit = cache_hit
         self.telemetry = telemetry
         self.breakers = breakers
+        self.trace = trace
 
 
 class WorkerSetup(object):
@@ -157,16 +169,35 @@ class RowGroupWorker(WorkerBase):
 
     def _publish(self, payload):
         """Single publish funnel: attach the stage-span telemetry sidecar (this
-        thread's accumulation since its previous publish — docs/observability.md)
-        and the tripped-breaker states of this process (docs/robustness.md), then
-        hand the payload to the pool's results channel."""
+        thread's accumulation since its previous publish — docs/observability.md),
+        the tripped-breaker states of this process (docs/robustness.md), and the
+        flight-recorder trace sidecar (this thread's drained timeline events),
+        then hand the payload to the pool's results channel."""
         from petastorm_tpu.resilience import default_board
         payload.telemetry = drain_stage_times()
         payload.breakers = default_board().snapshot(only_tripped=True) or None
+        payload.trace = drain_trace_events()
         self.publish_func(payload)
 
     def process(self, piece_index, fragment_path, row_group_id, partition_keys=None,
                 worker_predicate=None, shuffle_row_drop_partition=(0, 1), epoch_index=0):
+        # Causal trace context (docs/observability.md "Flight recorder"): every
+        # span/instant this thread records while the item is processed — publish
+        # and serialize included, they run inside this call — is tagged
+        # (epoch, rowgroup, dispatch attempt). The attempt was installed by
+        # process_worker_main from the pool's work frames (0 on thread/dummy
+        # pools), so a re-ventilated rowgroup's second life is a distinct
+        # attempt on the merged timeline.
+        set_trace_context(epoch_index, piece_index, current_dispatch_attempt())
+        try:
+            return self._process_item(piece_index, fragment_path, row_group_id,
+                                      partition_keys, worker_predicate,
+                                      shuffle_row_drop_partition, epoch_index)
+        finally:
+            clear_trace_context()
+
+    def _process_item(self, piece_index, fragment_path, row_group_id, partition_keys,
+                      worker_predicate, shuffle_row_drop_partition, epoch_index):
         setup = self._setup
         # (absolute_epoch, piece, drop_partition): the epoch tag lets the reader attribute
         # this result to the right epoch even when completions interleave across an epoch
@@ -290,6 +321,9 @@ class RowGroupWorker(WorkerBase):
         record = QuarantineRecord.from_exception(
             exc, piece_index=piece_index, fragment_path=fragment_path,
             row_group_id=row_group_id, attempts=retries + 1, epoch=item_id[0])
+        # anomaly marker on the flight-recorder timeline (ctx = this item)
+        trace_instant('quarantine', args={'reason': record.reason,
+                                          'error_type': record.error_type})
         logger.warning('Quarantining rowgroup piece %s (%s rg %s) after %d attempt(s): '
                        '%s: %s', piece_index, fragment_path, row_group_id, retries + 1,
                        type(exc).__name__, exc)

@@ -358,6 +358,15 @@ class CircuitBreaker:
         if new_state == BREAKER_OPEN:
             self._opened_at = self._clock()
             self._opened_count += 1
+        # Flight-recorder hook (docs/observability.md "Flight recorder"):
+        # every breaker transition in every process is an anomaly instant on
+        # the traced timeline (worker-side ones ride the trace batch sidecar).
+        # Local import: tracing is an observability layer above this module.
+        from petastorm_tpu.telemetry.tracing import trace_enabled, trace_instant
+        if trace_enabled():
+            trace_instant('breaker_transition',
+                          args={'breaker': self.name, 'from_state': old_state,
+                                'to_state': new_state})
         callback = self._on_transition
         if callback is not None:
             callback(self.name, old_state, new_state)

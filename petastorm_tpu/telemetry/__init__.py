@@ -13,6 +13,13 @@ The subsystem has four layers:
   into the consumer-side registry, so ONE snapshot covers every process.
 - :mod:`~petastorm_tpu.telemetry.export` — Prometheus text exposition and a
   periodic JSONL event log.
+- :mod:`~petastorm_tpu.telemetry.tracing` /
+  :mod:`~petastorm_tpu.telemetry.trace_export` — the flight recorder: a
+  bounded per-process ring buffer of span/instant events tagged with the
+  causal ``(epoch, rowgroup, attempt)`` context, exported as
+  Chrome-trace/Perfetto JSON with worker→consumer flow arrows
+  (``PETASTORM_TPU_TRACE=1`` / ``make_reader(..., trace=True)`` /
+  ``Reader.dump_trace()``).
 - :mod:`~petastorm_tpu.telemetry.analyze` — bottleneck attribution: rank stages
   by time share, map the top stage to the knob that moves it
   (``petastorm-tpu-throughput analyze``).
@@ -29,6 +36,10 @@ from petastorm_tpu.telemetry.registry import (Counter, Gauge,  # noqa: F401
                                               merge_snapshots,
                                               set_telemetry_enabled,
                                               telemetry_enabled)
-from petastorm_tpu.telemetry.spans import (STAGES, StageRecorder,  # noqa: F401
-                                           drain_stage_times, record_stage,
-                                           stage_span)
+from petastorm_tpu.telemetry.spans import (STAGES, TRACE_INSTANTS,  # noqa: F401
+                                           StageRecorder, drain_stage_times,
+                                           record_stage, stage_span)
+from petastorm_tpu.telemetry.tracing import (TraceRecorder,  # noqa: F401
+                                             reset_tracing, set_trace_enabled,
+                                             trace_complete, trace_enabled,
+                                             trace_instant, trace_snapshot)

@@ -35,26 +35,54 @@ def _format_value(value: float) -> str:
     return repr(float(value))
 
 
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the Prometheus text exposition format: inside
+    the double quotes of ``{label="..."}``, backslash, double-quote and newline
+    must appear as ``\\\\``, ``\\"`` and ``\\n`` — a raw newline splits the
+    series line and makes scrapers reject the whole exposition."""
+    return (str(value).replace('\\', '\\\\').replace('"', '\\"')
+            .replace('\n', '\\n'))
+
+
+def _escape_help(text: str) -> str:
+    # HELP text escaping differs from label values: only backslash and newline
+    # (quotes are legal in HELP text per the exposition format)
+    return str(text).replace('\\', '\\\\').replace('\n', '\\n')
+
+
+def _help_line(metric: str, kind: str, name: str) -> str:
+    return '# HELP {} petastorm_tpu {} {} (docs/observability.md)'.format(
+        metric, kind, _escape_help(name))
+
+
 def to_prometheus_text(snapshot: Dict[str, Any],
                        prefix: str = 'petastorm_tpu') -> str:
     """Render a registry snapshot in the Prometheus text exposition format.
 
-    Histograms emit the conventional cumulative ``_bucket{le=...}`` series plus
-    ``_sum`` and ``_count``; bucket boundaries come from the histogram's
-    power-of-two layout (``le`` values are in the histogram's base unit — seconds
-    for latency stages). Counters map to ``counter``, gauges to ``gauge``."""
+    Every metric emits a ``# HELP``/``# TYPE`` pair. Histograms emit the
+    conventional cumulative ``_bucket{le=...}`` series plus ``_sum`` and
+    ``_count``; bucket boundaries come from the histogram's power-of-two layout
+    (``le`` values are in the histogram's base unit — seconds for latency
+    stages). Counters map to ``counter``, gauges to ``gauge``. Metric names are
+    sanitized to the legal charset and label values / HELP text escaped per the
+    exposition format (backslash, quote, newline — :func:`escape_label_value`),
+    so a pathological stage name degrades to an ugly series, never to an
+    exposition the scraper rejects."""
     lines = []
     for name, value in sorted((snapshot.get('counters') or {}).items()):
         metric = _metric_name(prefix, name)
+        lines.append(_help_line(metric, 'counter', name))
         lines.append('# TYPE {} counter'.format(metric))
         lines.append('{} {}'.format(metric, _format_value(value)))
     for name, value in sorted((snapshot.get('gauges') or {}).items()):
         metric = _metric_name(prefix, name)
+        lines.append(_help_line(metric, 'gauge', name))
         lines.append('# TYPE {} gauge'.format(metric))
         lines.append('{} {}'.format(metric, _format_value(value)))
     for name, hist in sorted((snapshot.get('histograms') or {}).items()):
         metric = _metric_name(prefix, name)
         unit = float(hist.get('unit', 1e-6))
+        lines.append(_help_line(metric, 'histogram', name))
         lines.append('# TYPE {} histogram'.format(metric))
         buckets = {int(k): int(v) for k, v in (hist.get('buckets') or {}).items()}
         cumulative = 0
@@ -66,7 +94,7 @@ def to_prometheus_text(snapshot: Dict[str, Any],
             cumulative += buckets.get(idx, 0)
             le = bucket_upper_bound(idx, unit)
             lines.append('{}_bucket{{le="{}"}} {}'.format(
-                metric, _format_value(le), cumulative))
+                metric, escape_label_value(_format_value(le)), cumulative))
         lines.append('{}_bucket{{le="+Inf"}} {}'.format(
             metric, int(hist.get('count', cumulative))))
         lines.append('{}_sum {}'.format(metric,
@@ -84,11 +112,21 @@ class JsonlEventLogger(object):
     ``PETASTORM_TPU_TELEMETRY_JSONL`` names a path); it writes at most once per
     ``interval_s`` and costs one monotonic-clock read otherwise. ``emit`` writes
     unconditionally (final flush, epoch boundary). Thread-safe; write failures
-    disable the logger after one warning rather than breaking the pipeline."""
+    disable the logger after one warning rather than breaking the pipeline.
 
-    def __init__(self, path: str, interval_s: float = 10.0) -> None:
+    ``max_bytes`` (default None = unbounded, the prior behavior) caps the log
+    file: when appending a line would push it past the cap, the current file
+    rotates to ``<path>.1`` (replacing any previous ``.1``) and a fresh file
+    starts — a week-long run driven by ``PETASTORM_TPU_TELEMETRY_JSONL`` keeps
+    at most ``2 * max_bytes`` on disk instead of filling it. Env form:
+    ``PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES`` (read by
+    :func:`logger_from_env`)."""
+
+    def __init__(self, path: str, interval_s: float = 10.0,
+                 max_bytes: Optional[int] = None) -> None:
         self._path = path
         self._interval_s = float(interval_s)
+        self._max_bytes = int(max_bytes) if max_bytes else None
         self._lock = threading.Lock()
         self._next_emit = 0.0
         self._failed = False
@@ -125,6 +163,7 @@ class JsonlEventLogger(object):
         with self._lock:
             self._next_emit = time.monotonic() + self._interval_s
             try:
+                self._maybe_rotate(len(line))
                 with open(self._path, 'a') as f:
                     f.write(line)
             except OSError:
@@ -136,14 +175,37 @@ class JsonlEventLogger(object):
                 return False
         return True
 
+    def _maybe_rotate(self, incoming_bytes: int) -> None:
+        """Size-capped rotation (caller holds the lock): when the pending line
+        would push the file past ``max_bytes``, the current file becomes
+        ``<path>.1`` (one generation kept — atomic ``os.replace``). A missing
+        file counts as size 0; other stat errors fall through to the append,
+        whose own failure path disables the logger."""
+        if self._max_bytes is None:
+            return
+        try:
+            size = os.path.getsize(self._path)
+        except OSError:
+            return  # nothing to rotate (first write, or unstatable path)
+        if size + incoming_bytes <= self._max_bytes:
+            return
+        os.replace(self._path, self._path + '.1')
+
 
 def logger_from_env(interval_s: float = 10.0) -> Optional[JsonlEventLogger]:
     """A :class:`JsonlEventLogger` targeting ``$PETASTORM_TPU_TELEMETRY_JSONL``,
-    or None when the variable is unset/empty."""
+    or None when the variable is unset/empty.
+    ``$PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES`` (optional, default unbounded)
+    arms the size-capped ``.1`` rotation for long runs."""
     path = os.environ.get('PETASTORM_TPU_TELEMETRY_JSONL')
     if not path:
         return None
-    return JsonlEventLogger(path, interval_s=interval_s)
+    raw_cap = os.environ.get('PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES', '')
+    try:
+        max_bytes: Optional[int] = int(raw_cap) if raw_cap else None
+    except ValueError:
+        max_bytes = None
+    return JsonlEventLogger(path, interval_s=interval_s, max_bytes=max_bytes)
 
 
 def load_snapshot(path: str) -> Dict[str, Any]:

@@ -30,6 +30,7 @@ from types import TracebackType
 from typing import Any, Dict, List, Optional, Type
 
 from petastorm_tpu.telemetry import registry as _registry
+from petastorm_tpu.telemetry import tracing as _tracing
 from petastorm_tpu.telemetry.registry import (DEFAULT_NUM_BUCKETS, SECONDS_UNIT,
                                               bucket_index)
 
@@ -74,6 +75,22 @@ COUNTERS = (
 #: call sites) — same catalog contract as COUNTERS
 SIZE_HISTOGRAMS = (
     'wire_bytes_copied',  # bytes materialized into new host memory per batch
+)
+
+#: declared flight-recorder instant events (``tracing.trace_instant(name)``
+#: call sites — docs/observability.md "Flight recorder"). Same catalog
+#: contract as COUNTERS: pipecheck's telemetry-names rule rejects any
+#: ``trace_instant`` of a name not listed here, so anomaly markers cannot
+#: silently drift from the timeline legend.
+TRACE_INSTANTS = (
+    'ventilate',           # a work item entered the pool (consumer, ventilator thread)
+    'rowgroup_consumed',   # the item's result was popped and accounted (consumer)
+    'quarantine',          # a rowgroup was quarantined (worker, or consumer hang path)
+    'watchdog_reap',       # a hung worker was SIGKILLed by the watchdog (consumer)
+    'worker_respawn',      # a dead worker's in-flight item was re-ventilated (consumer)
+    'breaker_transition',  # a circuit breaker changed state (any process)
+    'shm_crc_drop',        # a shm frame failed CRC and was dropped unread (consumer)
+    'shm_fallback',        # a result rode the ZMQ wire while the shm ring was enabled
 )
 
 
@@ -130,8 +147,12 @@ _process_recorder = StageRecorder()
 
 
 def record_stage(stage: str, seconds: float) -> None:
-    """Record one observation into the process-wide stage recorder."""
+    """Record one observation into the process-wide stage recorder (and, when
+    the flight recorder is armed, a matching trace event back-dated by the
+    measured duration — docs/observability.md "Flight recorder")."""
     _process_recorder.record(stage, seconds)
+    if _tracing.trace_enabled():
+        _tracing.trace_complete(stage, time.perf_counter() - seconds, seconds)
 
 
 def drain_stage_times() -> Optional[Dict[str, Dict[str, Any]]]:
@@ -153,7 +174,7 @@ class stage_span(object):
         self._start = 0.0
 
     def __enter__(self) -> 'stage_span':
-        if _registry.telemetry_enabled():
+        if _registry.telemetry_enabled() or _tracing.trace_enabled():
             self._start = time.perf_counter()
         return self
 
@@ -161,6 +182,10 @@ class stage_span(object):
                  exc: Optional[BaseException],
                  tb: Optional[TracebackType]) -> None:
         if self._start:
-            _process_recorder.record(self._stage,
-                                     time.perf_counter() - self._start)
+            duration = time.perf_counter() - self._start
+            _process_recorder.record(self._stage, duration)
+            if _tracing.trace_enabled():
+                # same measurement feeds both views: the histogram (aggregate)
+                # and the flight-recorder timeline (this specific span)
+                _tracing.trace_complete(self._stage, self._start, duration)
             self._start = 0.0

@@ -188,27 +188,47 @@ def check_store_roundtrip(rows=200, workers=2):
         UnischemaField('idx', np.int64, (), ScalarCodec(pa.int64()), False),
         UnischemaField('vec', np.float32, (8,), NdarrayCodec(), False),
     ])
-    with tempfile.TemporaryDirectory(prefix='petastorm_tpu_doctor_') as tmp:
-        url = 'file://' + tmp
-        write_rows(url, schema,
-                   ({'idx': i, 'vec': np.full(8, i, np.float32)}
-                    for i in range(rows)),
-                   rowgroup_size_mb=1)
-        start = time.perf_counter()
-        seen = []
-        # on_error='retry': the roundtrip doubles as a probe of the resilience path —
-        # a flaky local disk shows up as a non-zero retry count in the report rather
-        # than an opaque failure (docs/robustness.md).
-        with make_reader(url, workers_count=workers, num_epochs=1,
-                         on_error='retry') as reader:
-            for row in reader:
-                seen.append(int(row.idx))
-                if row.vec[0] != row.idx:
-                    return {'status': 'fail',
-                            'detail': 'row {} decoded wrong vec'.format(row.idx)}
-            diag = reader.diagnostics
-            telemetry = reader.telemetry_snapshot()
-        elapsed = time.perf_counter() - start
+    # Flight recorder armed for the roundtrip (docs/observability.md "Flight
+    # recorder"): the doctor's trace summary is the per-rowgroup view of the
+    # same read the telemetry block aggregates — restored (and the ring
+    # cleared) afterwards so the doctor leaves no armed recorder behind.
+    from petastorm_tpu.telemetry import tracing
+    trace_was_enabled = tracing.trace_enabled()
+    try:
+        # armed INSIDE the restoring try: a tempdir/write failure must not
+        # leave the recorder running process-wide. When the doctor itself arms
+        # the recorder, it also clears it first so the summary covers ONLY
+        # this roundtrip (a user-armed capture — PETASTORM_TPU_TRACE=1 — is
+        # left intact and the summary then spans their whole recording).
+        if not trace_was_enabled:
+            tracing.reset_tracing()
+        tracing.set_trace_enabled(True)
+        with tempfile.TemporaryDirectory(prefix='petastorm_tpu_doctor_') as tmp:
+            url = 'file://' + tmp
+            write_rows(url, schema,
+                       ({'idx': i, 'vec': np.full(8, i, np.float32)}
+                        for i in range(rows)),
+                       rowgroup_size_mb=1)
+            start = time.perf_counter()
+            seen = []
+            # on_error='retry': the roundtrip doubles as a probe of the resilience
+            # path — a flaky local disk shows up as a non-zero retry count in the
+            # report rather than an opaque failure (docs/robustness.md).
+            with make_reader(url, workers_count=workers, num_epochs=1,
+                             on_error='retry') as reader:
+                for row in reader:
+                    seen.append(int(row.idx))
+                    if row.vec[0] != row.idx:
+                        return {'status': 'fail',
+                                'detail': 'row {} decoded wrong vec'.format(row.idx)}
+                diag = reader.diagnostics
+                telemetry = reader.telemetry_snapshot()
+                trace = reader.trace_summary()
+            elapsed = time.perf_counter() - start
+    finally:
+        tracing.set_trace_enabled(trace_was_enabled)
+        if not trace_was_enabled:
+            tracing.reset_tracing()
     if sorted(seen) != list(range(rows)):
         return {'status': 'fail',
                 'detail': 'expected {} distinct rows, got {}'.format(
@@ -219,6 +239,9 @@ def check_store_roundtrip(rows=200, workers=2):
             'rowgroups_quarantined': diag.get('rowgroups_quarantined', 0),
             'quarantine': diag.get('quarantine', []),
             'telemetry': telemetry,
+            # lifted to report['trace'] by collect_report — the flight-recorder
+            # summary of docs/observability.md "Flight recorder"
+            'trace': trace,
             # lifted to report['resilience'] by collect_report — the hang/
             # integrity/breaker view of docs/robustness.md
             'resilience': {
@@ -272,6 +295,17 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
         from petastorm_tpu.telemetry.analyze import attribute_bottleneck
         report['telemetry'] = {'snapshot': snapshot,
                                'bottleneck': attribute_bottleneck(snapshot)}
+    # Flight-recorder block (docs/observability.md "Flight recorder"): event
+    # counts, dropped-event count, anomaly instants and the top-5 longest
+    # rowgroup traces of the roundtrip read. Always present so --json
+    # consumers find one stable key.
+    trace = report['store_roundtrip'].pop('trace', None)
+    if trace is None:
+        # one stable schema either way: the empty summary IS the summarizer's
+        # own empty-snapshot output, so the two paths cannot drift apart
+        from petastorm_tpu.telemetry.trace_export import summarize_trace
+        trace = summarize_trace({})
+    report['trace'] = trace
     # Resilience block (docs/robustness.md): breaker states + hung-reap/corrupt
     # counts, lifted to report level so --json consumers find one stable key.
     # Always present — dashboards alert on it without key-existence checks.
@@ -334,6 +368,18 @@ def _print_human(report):
         print('  telemetry: top stage {} ({:.0%} of {:.3f}s stage time) -> {}'
               .format(b['top_stage'], b['top_share'],
                       b.get('total_stage_seconds', 0.0), b['recommendation']))
+    trace = report.get('trace') or {}
+    if trace.get('events'):
+        anomalies = trace.get('anomaly_instants') or []
+        slowest = (trace.get('top_rowgroup_traces') or [{}])[0]
+        print('  trace: {} event(s) across {} process(es), {} rowgroup '
+              'trace(s), {} dropped; {} anomaly instant(s){}'.format(
+                  trace.get('events', 0), len(trace.get('processes', [])),
+                  trace.get('rowgroups_traced', 0),
+                  trace.get('dropped_events', 0), len(anomalies),
+                  '; slowest rowgroup {} at {} ms'.format(
+                      slowest.get('rowgroup'), slowest.get('duration_ms'))
+                  if slowest else ''))
     resilience = report.get('resilience') or {}
     open_breakers = sorted(
         name for name, state in (resilience.get('breakers') or {}).items()

@@ -83,6 +83,7 @@ import tempfile
 import threading
 import time
 
+from petastorm_tpu.telemetry import tracing as _tracing
 from petastorm_tpu.telemetry.registry import (BYTES_UNIT, MetricsRegistry,
                                               telemetry_enabled)
 from petastorm_tpu.workers import EmptyResultError, TimeoutWaitingForResultError
@@ -298,6 +299,10 @@ class ProcessPool(object):
         # an explicit PETASTORM_TPU_TELEMETRY in the env wins).
         self._child_env.setdefault('PETASTORM_TPU_TELEMETRY',
                                    '1' if telemetry_enabled() else '0')
+        # Same capture for the flight recorder: workers spawned while tracing
+        # is armed record their own timeline events (trace sidecar).
+        self._child_env.setdefault('PETASTORM_TPU_TRACE',
+                                   '1' if _tracing.trace_enabled() else '0')
         # Kept for the lifetime of the pool: respawns re-materialize the bootstrap file
         # (workers unlink it at startup).
         self._bootstrap_template = {
@@ -477,6 +482,7 @@ class ProcessPool(object):
         requeued items go to the FRONT of the pending queue (they are the oldest
         work — consumers may be blocked on exactly these rowgroups)."""
         requeued = []
+        requeued_ctx = []
         with self._state_lock:
             for token, identity in list(self._assigned.items()):
                 slot_gen = self._identity_slot.get(identity)
@@ -486,7 +492,10 @@ class ProcessPool(object):
                 self._dispatch_time.pop(token, None)
                 # New attempt number: any done the dead worker managed to flush
                 # for this token is now a stale ack and cannot retire the item.
-                self._attempt[token] = self._attempt.get(token, 0) + 1
+                reaped_attempt = self._attempt.get(token, 0)
+                self._attempt[token] = reaped_attempt + 1
+                requeued_ctx.append((token, self._items.get(token),
+                                     reaped_attempt))
                 # _delivered intentionally untouched: whether the dead worker's result
                 # already reached the consumer or is still in the PULL buffer, the
                 # FIRST result to be delivered marks the token and every later one is
@@ -503,6 +512,25 @@ class ProcessPool(object):
             '(%d/%d respawns used) and re-ventilating %d in-flight item(s)',
             slot, dead_process.pid, dead_process.returncode, self._workers_respawned,
             self._max_worker_respawns, len(requeued))
+        if _tracing.trace_enabled():
+            # Timeline markers for the dead attempt: the worker took its
+            # unpublished events with it, so this instant (old attempt) plus
+            # the replacement's spans (attempt+1) are how one rowgroup's two
+            # lives appear as distinct attempts on the merged trace.
+            import dill
+            for token, blob, reaped_attempt in requeued_ctx:
+                ctx = None
+                if blob is not None:
+                    try:
+                        ctx = self._kwargs_trace_ctx(dill.loads(blob),
+                                                     reaped_attempt)
+                    except Exception:  # noqa: BLE001 - an undecodable blob only costs the marker its context tag, never the respawn
+                        ctx = None
+                _tracing.trace_instant(
+                    'worker_respawn', ctx=ctx,
+                    args={'worker_slot': slot, 'exit_code':
+                          dead_process.returncode,
+                          'new_attempt': reaped_attempt + 1})
         self._processes[slot] = self._spawn_worker(slot, generation)
 
     # ----------------------------------------------------------- hang watchdog
@@ -597,6 +625,33 @@ class ProcessPool(object):
             reap_count = self._workers_hung_reaped
         if telemetry_enabled():
             self.telemetry.inc('watchdog_reap')
+        if _tracing.trace_enabled():
+            # Anomaly markers for the flight recorder, tagged with the reaped
+            # attempt's context while the items are still registered — the hung
+            # worker published nothing, so these instants ARE the reaped
+            # attempt's footprint on the merged timeline.
+            reap_args = {'worker_slot': slot, 'pid': process.pid,
+                         'stale_s': round(stale_s, 3) if stale_s is not None
+                         else None}
+            if overdue:
+                # one lock acquisition for all overdue tokens; decode and
+                # emit lock-free (mirrors the _respawn requeued_ctx pattern)
+                with self._state_lock:
+                    pairs = [(self._attempt.get(token, 0),
+                              self._items.get(token)) for token in overdue]
+                import dill
+                for attempt, blob in pairs:
+                    ctx = None
+                    if blob is not None:
+                        try:
+                            ctx = self._kwargs_trace_ctx(dill.loads(blob),
+                                                         attempt)
+                        except Exception:  # noqa: BLE001 - an undecodable blob only costs the marker its context tag, never the reap
+                            ctx = None
+                    _tracing.trace_instant('watchdog_reap', ctx=ctx,
+                                           args=reap_args)
+            else:
+                _tracing.trace_instant('watchdog_reap', args=reap_args)
         logger.error(
             'Worker %d (pid %d) is hung (heartbeat stale %.1fs, %d item(s) past '
             'the %s item deadline); reaping it (hung-reap #%d — consumes the '
@@ -703,7 +758,8 @@ class ProcessPool(object):
                 with self._state_lock:
                     self._wire_batches += 1
                     self._zmq_result_bytes += payload_bytes
-                    if self._ring is not None:
+                    shm_fallback = self._ring is not None
+                    if shm_fallback:
                         self._shm_fallback_batches += 1
                     if token not in self._items or token in self._delivered:
                         # Duplicate from a re-ventilated item whose first result was
@@ -712,6 +768,10 @@ class ProcessPool(object):
                         self._results_dropped += 1
                         continue
                     self._delivered.add(token)
+                if shm_fallback and _tracing.trace_enabled():
+                    # anomaly marker: this result rode the ZMQ wire although the
+                    # shm ring was enabled (oversized / slot-starved / breaker)
+                    _tracing.trace_instant('shm_fallback', args={'token': token})
                 copy_before = self._serializer_bytes_copied()
                 result = self._serializer.deserialize(payload[1:])
                 if telemetry_enabled():
@@ -777,6 +837,19 @@ class ProcessPool(object):
         try:
             result = self._serializer.deserialize(views)
             self._shm_breaker.record_success()
+            if _tracing.trace_enabled():
+                # consumer-side leg of the rowgroup's trace: the shm_map span
+                # tagged with the delivered batch's (epoch, rowgroup, attempt),
+                # so the exported timeline stitches worker and consumer tracks
+                item_id = getattr(result, 'item_id', None)
+                ctx = None
+                if item_id is not None:
+                    with self._state_lock:
+                        attempt = self._attempt.get(token, 0)
+                    ctx = (int(item_id[0]), int(item_id[1]), attempt)
+                _tracing.trace_complete(
+                    'shm_map', map_start, time.perf_counter() - map_start,
+                    ctx=ctx)
             if telemetry_enabled():
                 # shm_map: slot view + CRC verify + deserialize; copied bytes =
                 # descriptor frame + the serializer's receive-side copies
@@ -814,9 +887,15 @@ class ProcessPool(object):
             # done(attempt) was flushed before the SIGKILL below lands, it is
             # already queued behind this frame and would otherwise retire the
             # item before the respawn path can redeliver it.
-            self._attempt[token] = self._attempt.get(token, 0) + 1
+            reaped_attempt = self._attempt.get(token, 0)
+            self._attempt[token] = reaped_attempt + 1
         if telemetry_enabled():
             self.telemetry.inc('shm_crc_fail')
+        if _tracing.trace_enabled():
+            _tracing.trace_instant(
+                'shm_crc_drop', ctx=self._token_trace_ctx(token, reaped_attempt),
+                args={'worker_slot': descriptor.worker_slot,
+                      'ring_slot': descriptor.ring_slot, 'token': token})
         self._shm_breaker.record_failure()
         logger.error(
             'shm frame from worker %d (ring slot %d, token %d) failed CRC '
@@ -829,6 +908,28 @@ class ProcessPool(object):
             process.kill()
         # No slot release: the replacement worker starts with its range free,
         # and the death path re-ventilates everything the worker held.
+
+    def _token_trace_ctx(self, token, attempt):
+        """Causal trace context ``(epoch, rowgroup, attempt)`` for a dispatched
+        token, decoded from its ventilated kwargs blob — anomaly-path only
+        (reaps, respawns, CRC drops are rare; the hot path never loads blobs)."""
+        with self._state_lock:
+            blob = self._items.get(token)
+        if blob is None:
+            return None
+        import dill
+        try:
+            kwargs = dill.loads(blob)
+        except Exception:  # noqa: BLE001 - an undecodable blob only costs the anomaly marker its context tag, never the reap/redelivery itself
+            return None
+        return self._kwargs_trace_ctx(kwargs, attempt)
+
+    @staticmethod
+    def _kwargs_trace_ctx(kwargs, attempt):
+        piece = kwargs.get('piece_index')
+        if piece is None:
+            return None
+        return (int(kwargs.get('epoch_index', 0)), int(piece), int(attempt))
 
     def _serializer_bytes_copied(self):
         """Cumulative receive-side copied bytes from the serializer's stats (0 when

@@ -220,6 +220,12 @@ def main(bootstrap_path):
             # current ack from one flushed by a since-reaped worker
             current_shm_allowed[0] = len(frames) < 4 or frames[3] != b'0'
             attempt = frames[4] if len(frames) >= 5 else b'0'
+            # Causal trace context, attempt leg (docs/observability.md "Flight
+            # recorder"): the dispatch attempt rides the existing work frames;
+            # installing it here lets the worker tag every span with the exact
+            # delivery attempt — no new wire protocol needed.
+            from petastorm_tpu.telemetry.tracing import set_dispatch_attempt
+            set_dispatch_attempt(int(attempt))
             try:
                 worker.process(**kwargs)
                 results_socket.send_multipart([b'done', token, attempt])

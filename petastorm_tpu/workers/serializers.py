@@ -238,6 +238,11 @@ class ArrowIpcSerializer(object):
             # tripped-breaker states ({name: state_dict}, None when all healthy)
             # merged into Reader.diagnostics['breakers']
             'breakers': getattr(obj, 'breakers', None),
+            # flight-recorder sidecar (docs/observability.md "Flight
+            # recorder"): this process's drained trace events
+            # ({'pid', 'events', 'dropped'}, None while tracing is off) merged
+            # into the consumer-side recorder for Reader.dump_trace()
+            'trace': getattr(obj, 'trace', None),
         }
         ipc_buf, sidecar_blob, _ = encode_columnar(obj.columns, obj.num_rows,
                                                    meta_extra)
@@ -265,7 +270,8 @@ class ArrowIpcSerializer(object):
                              retries=meta.get('retries', 0), quarantine=quarantine,
                              cache_hit=meta.get('cache_hit'),
                              telemetry=meta.get('telemetry'),
-                             breakers=meta.get('breakers'))
+                             breakers=meta.get('breakers'),
+                             trace=meta.get('trace'))
 
 
 def _as_bytes(frame: Frame) -> bytes:

@@ -1,0 +1,7 @@
+"""Known-bad fixture: flight-recorder names off the spans.py catalog."""
+from petastorm_tpu.telemetry.tracing import trace_complete, trace_instant
+
+
+def work(start, dur):
+    trace_instant('watchdog_repa')  # typo: should be 'watchdog_reap'
+    trace_complete('decodee', start, dur)  # typo: should be 'decode'

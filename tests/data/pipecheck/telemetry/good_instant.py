@@ -1,0 +1,9 @@
+"""Known-good fixture: catalog flight-recorder names only."""
+from petastorm_tpu.telemetry.tracing import trace_complete, trace_instant
+
+
+def work(start, dur, hung):
+    trace_instant('watchdog_reap' if hung else 'worker_respawn',
+                  args={'worker_slot': 0})
+    trace_instant('breaker_transition', args={'breaker': 'shm_transport'})
+    trace_complete('shm_map', start, dur)

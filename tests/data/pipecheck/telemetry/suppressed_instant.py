@@ -1,0 +1,6 @@
+"""Suppression fixture: an off-catalog instant, explicitly allowed."""
+from petastorm_tpu.telemetry.tracing import trace_instant
+
+
+def work():
+    trace_instant('experimental_marker')  # pipecheck: disable=telemetry-names -- experiment-local timeline marker, removed with the experiment

@@ -24,6 +24,13 @@ def test_store_roundtrip_ok():
     assert s['status'] == 'ok'
     assert s['rows'] == 60
     assert s['rows_per_sec'] > 0
+    # flight-recorder summary of the same read (ISSUE 6): events recorded,
+    # none silently dropped, and the roundtrip left tracing disarmed
+    from petastorm_tpu.telemetry.tracing import trace_enabled
+    assert s['trace']['events'] > 0
+    assert s['trace']['dropped_events'] == 0
+    assert s['trace']['rowgroups_traced'] > 0
+    assert not trace_enabled()
 
 
 def test_collect_report_healthy_and_json_clean(capsys):
@@ -43,6 +50,13 @@ def test_collect_report_healthy_and_json_clean(capsys):
     assert resilience['cache_corrupt_entries'] == 0
     assert all(state['state'] == 'closed'
                for state in resilience['breakers'].values())
+    # flight-recorder block (ISSUE 6): one stable key, anomaly-free and
+    # drop-free on a clean local roundtrip
+    trace = report['trace']
+    assert trace['events'] > 0
+    assert trace['dropped_events'] == 0
+    assert trace['anomaly_instants'] == []
+    assert trace['top_rowgroup_traces']
 
 
 def test_human_report_warns_on_open_breaker(capsys):

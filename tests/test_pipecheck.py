@@ -46,6 +46,8 @@ def messages(report):
 BAD_FIXTURES = [
     ('telemetry/bad_stage.py', ['telemetry-names'], 2,
      ['decodee', 'watchdog_reep']),
+    ('telemetry/bad_instant.py', ['telemetry-names'], 2,
+     ['watchdog_repa', 'TRACE_INSTANTS', 'decodee']),
     ('clock/bad', ['clock-discipline'], 1, ['time.monotonic']),
     ('exceptions/bad_swallow.py', ['exception-hygiene'], 1, ['swallows']),
     ('exceptions/workers/bad_worker_swallow.py', ['exception-hygiene'], 1,
@@ -66,6 +68,7 @@ BAD_FIXTURES = [
 
 GOOD_FIXTURES = [
     ('telemetry/good_stage.py', ['telemetry-names']),
+    ('telemetry/good_instant.py', ['telemetry-names']),
     ('clock/good', ['clock-discipline']),
     ('exceptions/good_swallow.py', ['exception-hygiene']),
     ('locks/good_lock.py', ['lock-discipline']),
@@ -92,6 +95,7 @@ def test_known_good_fixture_is_clean(path, rules):
 
 @pytest.mark.parametrize('path,rules', [
     ('telemetry/suppressed_stage.py', ['telemetry-names']),
+    ('telemetry/suppressed_instant.py', ['telemetry-names']),
     ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
 ])
 def test_suppression_comment_is_honored_and_counted(path, rules):

@@ -232,6 +232,62 @@ def test_jsonl_logger_and_load_snapshot(tmp_path):
     assert snap['histograms']['decode']['count'] == 2
 
 
+def test_prometheus_help_lines_and_escaping():
+    """Satellite (ISSUE 6): every metric carries a # HELP/# TYPE pair, and a
+    pathological metric name — quotes, backslash, newline — degrades to a
+    sanitized series with escaped HELP text, never to an exposition a scraper
+    rejects (no raw newline mid-line, no unescaped quote in a label)."""
+    from petastorm_tpu.telemetry.export import escape_label_value
+    registry = MetricsRegistry()
+    evil = 'weird "stage"\nwith\\backslash'
+    registry.observe(evil, 0.5)
+    registry.inc('batches', 1)
+    text = to_prometheus_text(registry.snapshot())
+    for line in text.strip().splitlines():
+        # a pathological name must never smuggle a raw partial line through
+        assert line.startswith(('#', 'petastorm_tpu_')), line
+    assert '# HELP petastorm_tpu_batches ' in text
+    assert '# TYPE petastorm_tpu_batches counter' in text
+    # the HELP line for the evil metric carries the ESCAPED original name
+    help_lines = [ln for ln in text.splitlines()
+                  if ln.startswith('# HELP petastorm_tpu_weird')]
+    assert len(help_lines) == 1
+    assert '\\n' in help_lines[0] and '\\\\' in help_lines[0]
+    # label-value escaping contract (backslash, quote, newline)
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+def test_jsonl_logger_max_bytes_rotation(tmp_path):
+    """Satellite (ISSUE 6): with max_bytes set, the log rotates to <path>.1
+    instead of growing without bound; default (None) keeps the old unbounded
+    behavior. Lines are never split across the rotation boundary."""
+    registry = MetricsRegistry()
+    registry.observe('decode', 0.1)
+    snapshot = registry.snapshot()
+    line_bytes = len(json.dumps({'ts': 0.0, 'event': 'e', 'pid': 0,
+                                 'telemetry': snapshot})) + 1
+    path = str(tmp_path / 'events.jsonl')
+    logger = JsonlEventLogger(path, interval_s=0, max_bytes=int(line_bytes * 2.5))
+    for _ in range(5):
+        assert logger.emit(snapshot, event='e')
+    rotated = path + '.1'
+    assert os.path.exists(rotated)
+    # every surviving line is intact JSON, and the cap bounds both files
+    for p in (path, rotated):
+        lines = open(p).read().strip().splitlines()
+        assert lines, p
+        for ln in lines:
+            assert json.loads(ln)['telemetry']['histograms']['decode']
+        assert os.path.getsize(p) <= line_bytes * 3
+    # unbounded default: no rotation however much is written
+    path2 = str(tmp_path / 'unbounded.jsonl')
+    logger2 = JsonlEventLogger(path2, interval_s=0)
+    for _ in range(5):
+        assert logger2.emit(snapshot, event='e')
+    assert not os.path.exists(path2 + '.1')
+    assert len(open(path2).read().strip().splitlines()) == 5
+
+
 def test_prometheus_no_duplicate_inf_bucket():
     """An observation clamped into the LAST bucket must not yield two
     le=\"+Inf\" series (scrapers reject duplicate series)."""

@@ -1,0 +1,507 @@
+"""Flight-recorder tests (ISSUE 6): the bounded ring recorder, causal trace
+context propagation across processes, Perfetto export with flow arrows, and
+the anomaly timeline.
+
+Covers the acceptance criteria:
+
+- a process-pool ``make_reader`` run with tracing on produces a
+  Perfetto-loadable trace JSON in which at least one rowgroup's events span
+  >= 2 process tracks with a connecting flow arrow;
+- anomaly instants — an induced breaker flip and a watchdog reap via fault
+  injection — appear on the timeline;
+- trace context survives worker respawn: the reaped attempt and its
+  replacement appear as DISTINCT ``attempt`` values in the merged trace (and
+  the ``on_error='skip'`` hang-quarantine path marks both the reap and the
+  quarantine with the hung item's context);
+- drops are counted, never silent: the ring cap shows up in
+  ``dropped_events``, and a default-sized ring holds a full epoch.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry.spans import STAGES, TRACE_INSTANTS, stage_span
+from petastorm_tpu.telemetry.trace_export import (format_trace_summary,
+                                                  summarize_trace,
+                                                  to_chrome_trace,
+                                                  write_chrome_trace)
+from petastorm_tpu.telemetry.tracing import TraceRecorder
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Arm the flight recorder for one test, restore+clear afterwards (the
+    recorder is process-global, like the breaker board)."""
+    tracing.reset_tracing()
+    tracing.set_trace_enabled(True)
+    yield
+    tracing.set_trace_enabled(False)
+    tracing.clear_trace_context()
+    tracing.reset_tracing()
+
+
+def _write_store(root, num_rows=64, n_files=8, vec_len=8):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_rows
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('TracingProbe', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('vec', np.float32, (vec_len,), NdarrayCodec(), False),
+    ])
+    url = 'file://' + str(root)
+    write_rows(url, schema,
+               [{'id': i, 'vec': np.full(vec_len, i, np.float32)}
+                for i in range(num_rows)],
+               n_files=n_files, rowgroup_size_mb=1)
+    return url
+
+
+def _part_files(root):
+    return sorted(glob.glob(os.path.join(str(root), '**', '*.parquet'),
+                            recursive=True))
+
+
+def _events_by_rowgroup(snapshot):
+    """{(epoch, rowgroup): [event_record, ...]} for ctx-tagged events."""
+    groups = {}
+    for record in snapshot['events']:
+        ctx = record.get('ctx')
+        if ctx:
+            groups.setdefault((ctx[0], ctx[1]), []).append(record)
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# recorder units
+# ---------------------------------------------------------------------------
+
+class TestTraceRecorder(object):
+    def test_ring_is_bounded_and_drops_are_counted(self):
+        recorder = TraceRecorder(capacity=16)
+        for i in range(40):
+            recorder.record(float(i), 1.0, 'X', 'decode', (0, i, 0), None)
+        snap = recorder.snapshot()
+        # never silent: 40 recorded, 16 retained, 24 counted as dropped
+        assert len(snap['events']) == 16
+        assert snap['dropped_events'] == 24
+        assert recorder.dropped_events() == 24
+        # the ring keeps the NEWEST events (a flight recorder's contract)
+        kept = [rec['ts_us'] for rec in snap['events']]
+        assert kept == [float(i) for i in range(24, 40)]
+
+    def test_drain_clears_only_the_calling_thread(self):
+        recorder = TraceRecorder(capacity=64)
+        recorder.record(1.0, 1.0, 'X', 'decode', None, None)
+        from_other_thread = []
+
+        def other():
+            recorder.record(2.0, 1.0, 'X', 'transform', None, None)
+            from_other_thread.append(recorder.drain())
+        thread = threading.Thread(target=other)
+        thread.start()
+        thread.join()
+        other_events, _ = from_other_thread[0]
+        assert [event[3] for event in other_events] == ['transform']
+        # this thread's ring is untouched by the other thread's drain
+        own, _ = recorder.drain()
+        assert [event[3] for event in own] == ['decode']
+        assert recorder.drain() is None
+
+    def test_drain_reports_drop_deltas_not_cumulative(self):
+        """Each drain carries only the drops since the previous drain: the
+        consumer SUMS sidecar drop counts, so a cumulative figure would be
+        re-added once per later batch (review finding on the first cut)."""
+        recorder = TraceRecorder(capacity=4)
+        for i in range(10):
+            recorder.record(float(i), 0.0, 'X', 'decode', None, None)
+        events, dropped = recorder.drain()
+        assert len(events) == 4 and dropped == 6
+        recorder.record(99.0, 0.0, 'X', 'decode', None, None)
+        events, dropped = recorder.drain()
+        assert len(events) == 1 and dropped == 0  # delta, not 6 again
+        # round-tripping two such sidecars through a consumer recorder sums
+        # to the true total
+        consumer = TraceRecorder(capacity=64)
+        consumer.merge(1, [], dropped=6)
+        consumer.merge(1, [], dropped=0)
+        assert consumer.snapshot()['dropped_events'] == 6
+
+    def test_foreign_args_pid_key_survives_merge(self):
+        """The producing pid travels out-of-band: an event whose own args
+        carry a 'pid' (e.g. a marker naming a reaped child process) must not
+        be clobbered or stripped (review finding on the first cut)."""
+        recorder = TraceRecorder(capacity=16)
+        recorder.merge(4242, [[1.0, 0.0, 'i', 'quarantine', None, 0,
+                               {'pid': 999}]])
+        (record,) = recorder.snapshot()['events']
+        assert record['pid'] == 4242
+        assert record['args'] == {'pid': 999}
+
+    def test_dead_thread_rings_are_released_but_events_retired(self):
+        """The registry holds weak references: rings of exited threads are
+        collectable (a long-lived process creating readers repeatedly does
+        not grow without bound) — but an exiting thread's UNDRAINED events
+        are retired into the bounded process buffer, so a ventilator/loader
+        thread finishing before the dump still contributes its events."""
+        import gc
+        recorder = TraceRecorder(capacity=32)
+
+        def worker(index):
+            recorder.record(float(index), 0.0, 'i', 'ventilate',
+                            (0, index, 0), None)
+            # no drain: the thread dies with its ring still loaded
+        for i in range(5):
+            thread = threading.Thread(target=worker, args=(i,))
+            thread.start()
+            thread.join()
+        gc.collect()
+        with recorder._lock:
+            live = recorder._live_rings()
+        assert len(live) <= 1, 'dead threads must not pin their rings'
+        snap = recorder.snapshot()
+        assert {rec['ctx'][1] for rec in snap['events']} == set(range(5))
+        assert snap['dropped_events'] == 0
+
+    def test_foreign_merge_preserves_pid_and_ctx(self):
+        recorder = TraceRecorder(capacity=64)
+        recorder.merge(4242, [[5.0, 2.0, 'X', 'rowgroup_read', [1, 7, 2], 9,
+                               {'note': 'w'}]], dropped=3)
+        snap = recorder.snapshot()
+        (record,) = snap['events']
+        assert record['pid'] == 4242
+        assert record['ctx'] == [1, 7, 2]
+        assert record['name'] == 'rowgroup_read'
+        assert record['args'] == {'note': 'w'}
+        assert snap['dropped_events'] == 3
+
+    def test_reset_clears_everything(self):
+        recorder = TraceRecorder(capacity=8)
+        for i in range(20):
+            recorder.record(float(i), 0.0, 'i', 'quarantine', None, None)
+        recorder.merge(1, [[0.0, 0.0, 'i', 'quarantine', None, 0, None]])
+        recorder.reset()
+        snap = recorder.snapshot()
+        assert snap['events'] == [] and snap['dropped_events'] == 0
+
+
+def test_disabled_by_default_records_nothing(tmp_path):
+    """Tracing is opt-in: with the switch off (the default), spans and instants
+    cost one attribute read and the snapshot stays empty."""
+    tracing.reset_tracing()
+    assert not tracing.trace_enabled()
+    with stage_span('decode'):
+        pass
+    tracing.trace_instant('watchdog_reap')
+    tracing.trace_complete('decode', 0.0, 0.1)
+    assert tracing.drain_trace_events() is None
+    assert tracing.trace_snapshot()['events'] == []
+
+
+def test_context_tags_spans_and_instants(armed):
+    tracing.set_trace_context(2, 5, 1)
+    try:
+        with stage_span('decode'):
+            pass
+        tracing.trace_instant('quarantine', args={'reason': 'error'})
+        # explicit ctx wins over the ambient one
+        tracing.trace_instant('watchdog_reap', ctx=(0, 9, 0))
+    finally:
+        tracing.clear_trace_context()
+    with stage_span('shuffle'):  # outside any item: no ctx
+        pass
+    events = {rec['name']: rec for rec in tracing.trace_snapshot()['events']}
+    assert events['decode']['ctx'] == [2, 5, 1]
+    assert events['quarantine']['ctx'] == [2, 5, 1]
+    assert events['watchdog_reap']['ctx'] == [0, 9, 0]
+    assert events['shuffle']['ctx'] is None
+    assert events['decode']['ph'] == 'X' and events['decode']['dur_us'] >= 0
+
+
+def test_instant_names_are_declared():
+    """Every instant the runtime emits is in the TRACE_INSTANTS catalog (the
+    pipecheck rule enforces the call sites; this guards the catalog itself)."""
+    for name in ('ventilate', 'rowgroup_consumed', 'quarantine',
+                 'watchdog_reap', 'worker_respawn', 'breaker_transition',
+                 'shm_crc_drop', 'shm_fallback'):
+        assert name in TRACE_INSTANTS
+    assert not set(TRACE_INSTANTS) & set(STAGES)
+
+
+# ---------------------------------------------------------------------------
+# export units
+# ---------------------------------------------------------------------------
+
+def _synthetic_snapshot():
+    """A two-process snapshot: worker 111 produced rowgroup (0, 3), the
+    consumer (pid 222) mapped and consumed it."""
+    return {'pid': 222, 'dropped_events': 1, 'events': [
+        {'pid': 222, 'tid': 1, 'ts_us': 5.0, 'dur_us': 0.0, 'ph': 'i',
+         'name': 'ventilate', 'ctx': [0, 3, 0], 'args': None},
+        {'pid': 111, 'tid': 7, 'ts_us': 10.0, 'dur_us': 30.0, 'ph': 'X',
+         'name': 'rowgroup_read', 'ctx': [0, 3, 0], 'args': None},
+        {'pid': 111, 'tid': 7, 'ts_us': 45.0, 'dur_us': 20.0, 'ph': 'X',
+         'name': 'decode', 'ctx': [0, 3, 0], 'args': None},
+        {'pid': 222, 'tid': 1, 'ts_us': 80.0, 'dur_us': 5.0, 'ph': 'X',
+         'name': 'shm_map', 'ctx': [0, 3, 1], 'args': None},
+        {'pid': 222, 'tid': 1, 'ts_us': 90.0, 'dur_us': 0.0, 'ph': 'i',
+         'name': 'watchdog_reap', 'ctx': [0, 4, 0],
+         'args': {'worker_slot': 1}},
+    ]}
+
+
+def test_chrome_trace_tracks_flows_and_metadata():
+    trace = to_chrome_trace(_synthetic_snapshot())
+    json.dumps(trace)  # Perfetto loads JSON — the dict must serialize
+    events = trace['traceEvents']
+    meta = {e['pid']: e['args']['name'] for e in events if e['ph'] == 'M'}
+    assert 'consumer' in meta[222] and 'worker' in meta[111]
+    slices = [e for e in events if e['ph'] == 'X']
+    assert {e['pid'] for e in slices} == {111, 222}
+    # ctx surfaces as args for the Perfetto selection panel
+    read = next(e for e in slices if e['name'] == 'rowgroup_read')
+    assert read['args'] == {'epoch': 0, 'rowgroup': 3, 'attempt': 0}
+    # flow arrow: starts at the END of the worker's last span for (0, 3),
+    # finishes at the consumer's first event for it — same binding id
+    start = next(e for e in events if e['ph'] == 's')
+    finish = next(e for e in events if e['ph'] == 'f')
+    assert start['id'] == finish['id'] == 'rg-0-3'
+    assert start['pid'] == 111 and start['ts'] == 65.0
+    assert finish['pid'] == 222 and finish['ts'] == 80.0 and finish['bp'] == 'e'
+    # instants carry process scope; dropped count is surfaced, not swallowed
+    instant = next(e for e in events if e['ph'] == 'i'
+                   and e['name'] == 'watchdog_reap')
+    assert instant['s'] == 'p' and instant['cat'] == 'anomaly'
+    assert trace['otherData']['dropped_events'] == 1
+
+
+def test_summary_ranks_rowgroups_and_filters_lifecycle_instants():
+    summary = summarize_trace(_synthetic_snapshot())
+    assert summary['events'] == 5
+    assert summary['dropped_events'] == 1
+    assert summary['processes'] == [111, 222]
+    # lifecycle instants stay out of the anomaly list
+    assert [i['name'] for i in summary['anomaly_instants']] == ['watchdog_reap']
+    top = summary['top_rowgroup_traces'][0]
+    # rowgroup 3: 5us (ventilate) .. 85us (shm_map end) over two processes,
+    # with the re-delivery visible as two distinct attempts
+    assert (top['epoch'], top['rowgroup']) == (0, 3)
+    assert top['duration_ms'] == 0.08
+    assert top['attempts'] == [0, 1]
+    assert top['processes'] == 2
+    text = format_trace_summary(summary)
+    assert 'watchdog_reap' in text and 'rowgroup 3' in text
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: cross-process causal tracing
+# ---------------------------------------------------------------------------
+
+def test_cross_process_trace_spans_two_tracks_with_flow(tmp_path, armed):
+    """Acceptance (ISSUE 6): a process-pool read with tracing on yields a
+    Perfetto-loadable JSON where at least one rowgroup's events span >= 2
+    process tracks joined by a flow arrow; zero events are dropped at the
+    default ring size."""
+    from petastorm_tpu import make_reader
+
+    url = _write_store(tmp_path / 'store', num_rows=64, n_files=8)
+    with make_reader(url, reader_pool_type='process', workers_count=2,
+                     num_epochs=1, shuffle_row_groups=False,
+                     shm_transport=True, trace=True) as reader:
+        ids = sorted(int(row.id) for row in reader)
+        trace_path = str(tmp_path / 'trace.json')
+        trace = reader.dump_trace(trace_path)
+        summary = reader.trace_summary()
+        diag = reader.diagnostics
+    assert ids == list(range(64))
+    assert summary['dropped_events'] == 0, 'default ring must hold one epoch'
+    assert summary['events'] > 0
+    consumer_pid = os.getpid()
+    worker_pids = [pid for pid in summary['processes'] if pid != consumer_pid]
+    assert worker_pids, 'worker-side events must cross the process boundary'
+    # at least one rowgroup's own events live on >= 2 process tracks
+    assert any(trace['events'] > 0 and trace['processes'] >= 2
+               for trace in summary['top_rowgroup_traces'])
+    # worker stages are ctx-tagged: every piece read in a worker process
+    snapshot = tracing.trace_snapshot()
+    groups = _events_by_rowgroup(snapshot)
+    assert len(groups) == 8
+    spanning = [key for key, records in groups.items()
+                if len({rec['pid'] for rec in records}) >= 2]
+    assert spanning
+    worker_stage_names = {rec['name'] for records in groups.values()
+                          for rec in records
+                          if rec['pid'] != consumer_pid and rec['ph'] == 'X'}
+    assert {'rowgroup_read', 'decode'} <= worker_stage_names
+    # the exported JSON is loadable and contains a bound flow arrow
+    on_disk = json.load(open(trace_path))
+    assert on_disk == trace
+    starts = [e for e in on_disk['traceEvents'] if e.get('ph') == 's']
+    finishes = {e['id'] for e in on_disk['traceEvents'] if e.get('ph') == 'f'}
+    assert starts and {e['id'] for e in starts} & finishes
+    pids_in_trace = {e['pid'] for e in on_disk['traceEvents']
+                     if e.get('ph') == 'X'}
+    assert len(pids_in_trace) >= 2
+    # diagnostics carries the summary while tracing is armed
+    assert diag['trace']['events'] > 0
+
+
+@pytest.mark.faultinject
+def test_anomaly_timeline_reap_quarantine_and_breaker_flip(tmp_path, armed):
+    """Acceptance (ISSUE 6): one induced watchdog reap (fault injection) and
+    one induced breaker flip both appear as anomaly instants on the exported
+    timeline, context-tagged to the hung rowgroup where one exists."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.resilience import CircuitBreaker
+    from petastorm_tpu.test_util.fault_injection import (
+        FaultRule, FaultSchedule, fault_injecting_filesystem)
+
+    url = _write_store(tmp_path / 'store', num_rows=64, n_files=8)
+    target = os.path.basename(_part_files(tmp_path / 'store')[3])
+    sched = FaultSchedule(tmp_path / 'faults',
+                          [FaultRule(target, kind='hang', times=1)])
+    with make_reader(url, reader_pool_type='process', workers_count=2,
+                     num_epochs=1, shuffle_row_groups=False, on_error='skip',
+                     item_deadline_s=2.0,
+                     filesystem=fault_injecting_filesystem(sched)) as reader:
+        ids = sorted(int(row.id) for row in reader)
+        diag = reader.diagnostics
+    assert len(ids) == 56
+    assert diag['workers_hung_reaped'] == 1
+    # induced breaker flip, recorded while the capture is still armed
+    breaker = CircuitBreaker('trace_probe', failure_threshold=1)
+    breaker.record_failure()
+    assert breaker.state == 'open'
+
+    summary = summarize_trace(tracing.trace_snapshot())
+    instants = {i['name']: i for i in summary['anomaly_instants']}
+    assert 'watchdog_reap' in instants
+    assert 'quarantine' in instants
+    assert 'breaker_transition' in instants
+    assert instants['breaker_transition']['args']['breaker'] == 'trace_probe'
+    assert instants['breaker_transition']['args']['to_state'] == 'open'
+    # both hang markers are context-tagged to the hung piece (index 3)
+    assert instants['watchdog_reap']['ctx'] == [0, 3, 0]
+    assert instants['quarantine']['ctx'] == [0, 3, 0]
+    # and they render as 'i' events on the exported timeline
+    trace = to_chrome_trace(tracing.trace_snapshot())
+    timeline_instants = {e['name'] for e in trace['traceEvents']
+                         if e.get('ph') == 'i' and e.get('cat') == 'anomaly'}
+    assert {'watchdog_reap', 'quarantine',
+            'breaker_transition'} <= timeline_instants
+
+
+@pytest.mark.faultinject
+def test_respawned_attempt_is_distinct_in_merged_trace(tmp_path, armed):
+    """Acceptance (ISSUE 6): a worker SIGKILLed mid-item (fault kind='kill')
+    leaves its reaped attempt on the timeline as the worker_respawn instant
+    (attempt 0) while the replacement's spans carry attempt 1 — two distinct
+    attempt values for one rowgroup in the merged trace."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.test_util.fault_injection import (
+        FaultRule, FaultSchedule, fault_injecting_filesystem)
+
+    url = _write_store(tmp_path / 'store', num_rows=64, n_files=8)
+    target = os.path.basename(_part_files(tmp_path / 'store')[3])
+    sched = FaultSchedule(tmp_path / 'faults',
+                          [FaultRule(target, kind='kill', times=1)])
+    with make_reader(url, reader_pool_type='process', workers_count=2,
+                     num_epochs=1, shuffle_row_groups=False, shm_transport=True,
+                     filesystem=fault_injecting_filesystem(sched)) as reader:
+        ids = sorted(int(row.id) for row in reader)
+        diag = reader.diagnostics
+    assert ids == list(range(64))
+    assert diag['workers_respawned'] == 1
+
+    snapshot = tracing.trace_snapshot()
+    groups = _events_by_rowgroup(snapshot)
+    respawns = [rec for rec in snapshot['events']
+                if rec['name'] == 'worker_respawn']
+    assert respawns, 'the reaped attempt must leave a timeline marker'
+    (respawn,) = respawns
+    assert respawn['ctx'] is not None
+    epoch, piece, reaped_attempt = respawn['ctx']
+    assert reaped_attempt == 0
+    assert respawn['args']['new_attempt'] == 1
+    # the replacement's worker spans for the SAME rowgroup carry attempt 1
+    records = groups[(epoch, piece)]
+    attempts = {rec['ctx'][2] for rec in records}
+    assert {0, 1} <= attempts, attempts
+    worker_attempts = {rec['ctx'][2] for rec in records
+                       if rec['ph'] == 'X' and rec['pid'] != os.getpid()}
+    assert worker_attempts == {1}
+
+
+def test_trace_sidecar_absent_when_disarmed(tmp_path):
+    """With tracing off, batches carry no trace sidecar and diagnostics no
+    trace block — the flight recorder costs nothing it did not opt into."""
+    from petastorm_tpu import make_reader
+    tracing.reset_tracing()
+    url = _write_store(tmp_path / 'store', num_rows=16, n_files=2)
+    with make_reader(url, reader_pool_type='dummy', num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        batches = list(reader.iter_columnar())
+        diag = reader.diagnostics
+    assert all(batch.trace is None for batch in batches)
+    assert 'trace' not in diag
+    assert tracing.trace_snapshot()['events'] == []
+
+
+def test_traced_epoch_overhead_within_budget(tmp_path):
+    """Overhead guard (acceptance <= 3% on the bench; here a generous unit
+    bound like the telemetry one — 2x + 0.25s absolute floor — so shared-host
+    noise cannot flake while a real regression still fails)."""
+    from petastorm_tpu import make_reader
+
+    url = _write_store(tmp_path / 'store', num_rows=256, n_files=4, vec_len=32)
+
+    def epoch_seconds():
+        with make_reader(url, reader_pool_type='dummy', num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            start = time.perf_counter()
+            n = sum(batch.num_rows for batch in reader.iter_columnar())
+            elapsed = time.perf_counter() - start
+        assert n == 256
+        return elapsed
+
+    baseline = min(epoch_seconds() for _ in range(2))
+    tracing.reset_tracing()
+    tracing.set_trace_enabled(True)
+    try:
+        traced = min(epoch_seconds() for _ in range(2))
+        assert tracing.trace_snapshot()['dropped_events'] == 0
+    finally:
+        tracing.set_trace_enabled(False)
+        tracing.reset_tracing()
+    assert traced <= baseline * 2 + 0.25, (traced, baseline)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_trace_cli_writes_perfetto_json(tmp_path, capsys):
+    """``petastorm-tpu-throughput trace`` captures a real read and writes a
+    loadable Chrome-trace file; tracing is disarmed afterwards."""
+    from petastorm_tpu.benchmark.cli import main as cli_main
+    tracing.reset_tracing()
+    url = _write_store(tmp_path / 'store', num_rows=32, n_files=4)
+    out = str(tmp_path / 'trace.json')
+    rc = cli_main(['trace', url, '-o', out, '-p', 'thread',
+                   '-w', '2', '--json'])
+    assert rc == 0
+    assert not tracing.trace_enabled()
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary['rows'] == 32
+    assert summary['events'] > 0
+    assert summary['output'] == out
+    trace = json.load(open(out))
+    names = {e['name'] for e in trace['traceEvents']}
+    assert 'rowgroup_read' in names and 'ventilate' in names
+    tracing.reset_tracing()
