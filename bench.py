@@ -154,8 +154,8 @@ _HEADLINE_FALLBACKS = (
 
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
-                 'flash', 'moe', 'wire_bench', 'telemetry', 'resilience',
-                 'pipecheck', 'tracing')
+                 'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
+                 'resilience', 'pipecheck', 'tracing')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -164,10 +164,10 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'wire_bench', 'telemetry',
-                     'tracing', 'resilience', 'mnist_scan_stream', 'flash',
-                     'moe', 'imagenet_scan', 'imagenet_stream', 'decode_delta',
-                     'bare_reader', 'mnist_stream')
+SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'decode_bench', 'wire_bench',
+                     'telemetry', 'tracing', 'resilience', 'mnist_scan_stream',
+                     'flash', 'moe', 'imagenet_scan', 'imagenet_stream',
+                     'decode_delta', 'bare_reader', 'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
 
@@ -1568,6 +1568,22 @@ def child_main():
                 by_rule.get('mypy-ratchet', 0),
         })
 
+    def run_decode_bench():
+        """Vectorized decode-engine microbench (host-only, fast): per-codec
+        decoded rows/s + MB/s through the compiled DecodePlan vs the per-cell
+        fallback path, plus the predicate pushdown ratio — the ISSUE-7
+        acceptance numbers (compressed_ndarray/image speedups; image kernels
+        scale with decode_threads — docs/performance.md "Vectorized decode
+        engine")."""
+        from petastorm_tpu.benchmark.decode_bench import \
+            run_decode_bench as decode_bench
+        fields = decode_bench(
+            rows=int(os.environ.get('BENCH_DECODE_ROWS', 2000)),
+            image_rows=int(os.environ.get('BENCH_DECODE_IMAGE_ROWS', 512)))
+        # decode_threads already carries the section prefix — don't double it
+        results.update({key if key.startswith('decode_') else 'decode_' + key:
+                        value for key, value in fields.items()})
+
     def run_decode():
         decode_host, decode_onchip = run_decode_delta()
         results.update({
@@ -1588,6 +1604,7 @@ def child_main():
         'flash': run_flash,
         'moe': run_moe,
         'wire_bench': run_wire_bench,
+        'decode_bench': run_decode_bench,
         'telemetry': run_telemetry,
         'tracing': run_tracing,
         'resilience': run_resilience,

@@ -3,7 +3,11 @@ petastorm/benchmark/cli.py / petastorm-throughput.py console script).
 
 Subcommands: a first positional of ``wire-bench`` dispatches to
 :mod:`petastorm_tpu.benchmark.wire_bench` (zero-copy data-plane microbench, JSON
-output); ``analyze`` dispatches to :mod:`petastorm_tpu.telemetry.analyze` (stage
+output); ``decode-bench`` dispatches to
+:mod:`petastorm_tpu.benchmark.decode_bench` (vectorized decode-engine
+microbench: per-codec engine-vs-fallback kernel rates + predicate pushdown —
+docs/performance.md "Vectorized decode engine"); ``analyze`` dispatches to
+:mod:`petastorm_tpu.telemetry.analyze` (stage
 time-share ranking + bottleneck-to-knob mapping over a telemetry snapshot /
 JSONL event log — docs/observability.md); ``trace`` dispatches to
 :mod:`petastorm_tpu.telemetry.trace_export` (flight-recorder capture of a real
@@ -31,6 +35,9 @@ def main(argv=None):
     if argv and argv[0] == 'wire-bench':
         from petastorm_tpu.benchmark.wire_bench import main as wire_bench_main
         return wire_bench_main(argv[1:])
+    if argv and argv[0] == 'decode-bench':
+        from petastorm_tpu.benchmark.decode_bench import main as decode_bench_main
+        return decode_bench_main(argv[1:])
     if argv and argv[0] == 'analyze':
         from petastorm_tpu.telemetry.analyze import main as analyze_main
         return analyze_main(argv[1:])

@@ -1,0 +1,229 @@
+"""decode-bench: microbenchmarks of the vectorized columnar decode engine
+(docs/performance.md "Vectorized decode engine").
+
+Per-codec whole-column kernel timings over synthetic in-memory Arrow columns —
+no filesystem, no pools — so the numbers isolate exactly what ISSUE-7 changed:
+
+- **codec kernels**: decoded rows/s and decoded MB/s for each codec through the
+  compiled :class:`~petastorm_tpu.decode_engine.DecodePlan` (the engine path the
+  rowgroup worker runs) vs the per-cell fallback path (base
+  ``FieldCodec.decode_column`` + stacking — the pre-engine behavior), plus
+  their ratio ``<codec>_speedup`` (the ISSUE-7 acceptance number for
+  ``compressed_ndarray`` and the image codecs).
+- **predicate pushdown**: ``in_set`` keep-mask rows/s through
+  :func:`~petastorm_tpu.decode_engine.compile_predicate` (Arrow ``is_in`` on the
+  pre-decode table) vs the per-row decoded dict loop, and the
+  ``in_pseudorandom_split`` vectorized-vs-row-loop ratio.
+
+Image-kernel note: ``cv2.imdecode`` dominates image columns, so their engine
+win scales with the GIL-released decode fan-out (``PETASTORM_TPU_DECODE_THREADS``,
+default ``min(4, cpu_count)``); the emitted ``decode_threads`` field records
+what this run had. Run via ``petastorm-tpu-throughput decode-bench`` or
+``python -m petastorm_tpu.benchmark.decode_bench``; ``bench.py`` embeds it as
+the ``decode_bench`` section. All numbers are one JSON-safe dict.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_DEFAULT_ROWS = 2000
+_DEFAULT_IMAGE_ROWS = 512
+_DEFAULT_NDARRAY_HW = 32
+_DEFAULT_IMAGE_HW = 32
+_TIMED_REPEATS = 3
+
+
+def _best_rate(fn: Callable[[], Any], repeats: int = _TIMED_REPEATS) -> Tuple[float, Any]:
+    """(best wall seconds, last result) over ``repeats`` runs — best-of defends a
+    microbench against shared-host scheduling transients."""
+    best = float('inf')
+    result: Any = None
+    for _ in range(repeats):
+        start = time.perf_counter()
+        result = fn()
+        best = min(best, time.perf_counter() - start)
+    return best, result
+
+
+def _decoded_nbytes(columns: Any) -> int:
+    """Total decoded payload bytes of one column result (ndarray or list)."""
+    if isinstance(columns, np.ndarray):
+        return int(columns.nbytes)
+    return int(sum(0 if v is None else np.asarray(v).nbytes for v in columns))
+
+
+def _make_codec_column(kind: str, rows: int, ndarray_hw: int,
+                       image_hw: int) -> Tuple[Any, Any]:
+    """(UnischemaField, encoded Arrow column) for one synthetic codec column."""
+    import pyarrow as pa
+    from petastorm_tpu.codecs import (CompressedImageCodec,
+                                      CompressedNdarrayCodec, NdarrayCodec,
+                                      ScalarCodec)
+    from petastorm_tpu.unischema import UnischemaField
+    rng = np.random.RandomState(17)
+    if kind == 'scalar':
+        field = UnischemaField('value', np.int64, (), ScalarCodec(), False)
+        return field, pa.chunked_array([pa.array(
+            rng.randint(0, 1 << 40, size=rows).tolist(), type=pa.int64())])
+    if kind == 'ndarray':
+        field = UnischemaField('tensor', np.float32, (ndarray_hw, ndarray_hw),
+                               NdarrayCodec(), False)
+    elif kind == 'compressed_ndarray':
+        field = UnischemaField('tensor', np.float32, (ndarray_hw, ndarray_hw),
+                               CompressedNdarrayCodec(), False)
+    elif kind in ('image_png', 'image_jpeg'):
+        codec = CompressedImageCodec('png' if kind == 'image_png' else 'jpeg',
+                                     quality=80)
+        field = UnischemaField('image', np.uint8, (image_hw, image_hw, 3),
+                               codec, False)
+    else:
+        raise ValueError('Unknown codec kind {!r}'.format(kind))
+    if kind.startswith('image'):
+        values: List[np.ndarray] = [
+            rng.randint(0, 255, (image_hw, image_hw, 3), dtype=np.uint8)
+            for _ in range(rows)]
+    else:
+        values = [(rng.rand(ndarray_hw, ndarray_hw) * 8).astype(np.float32)
+                  for _ in range(rows)]
+    blobs = [field.codec.encode(field, v) for v in values]
+    return field, pa.chunked_array([pa.array(blobs, type=pa.binary())])
+
+
+def codec_kernel_bench(rows: int = _DEFAULT_ROWS,
+                       image_rows: int = _DEFAULT_IMAGE_ROWS,
+                       ndarray_hw: int = _DEFAULT_NDARRAY_HW,
+                       image_hw: int = _DEFAULT_IMAGE_HW) -> Dict[str, float]:
+    """Engine (compiled DecodePlan) vs per-cell fallback for every codec: rows/s
+    both ways, decoded MB/s through the engine, and the speedup ratio."""
+    import pyarrow as pa
+    from petastorm_tpu.codecs import FieldCodec
+    from petastorm_tpu.decode_engine import compile_decode_plan, stack_if_uniform
+    from petastorm_tpu.unischema import Unischema
+    out: Dict[str, float] = {}
+    for kind in ('scalar', 'ndarray', 'compressed_ndarray', 'image_png',
+                 'image_jpeg'):
+        n = image_rows if kind.startswith('image') else rows
+        field, column = _make_codec_column(kind, n, ndarray_hw, image_hw)
+        schema = Unischema('DecodeBench', [field])
+        plan = compile_decode_plan(schema, [field.name])
+        table = pa.table({field.name: column})
+        engine_s, engine_result = _best_rate(
+            lambda plan=plan, table=table, name=field.name:
+            plan.execute(table)[name])
+
+        def fallback() -> Any:
+            # the pre-engine worker path: python-object cells, per-cell decode
+            # dispatch, stacked at the end
+            values = FieldCodec.decode_column(field.codec, field,
+                                              column.to_pylist())
+            return stack_if_uniform(values, field)
+
+        fallback_s, fallback_result = _best_rate(fallback)
+        if isinstance(engine_result, np.ndarray):
+            np.testing.assert_array_equal(engine_result,
+                                          np.asarray(fallback_result))
+        out['{}_engine_rows_per_sec'.format(kind)] = round(n / engine_s, 1)
+        out['{}_fallback_rows_per_sec'.format(kind)] = round(n / fallback_s, 1)
+        out['{}_engine_mb_per_sec'.format(kind)] = round(
+            _decoded_nbytes(engine_result) / engine_s / (1 << 20), 2)
+        out['{}_speedup'.format(kind)] = round(fallback_s / engine_s, 2)
+    return out
+
+
+def pushdown_bench(rows: int = _DEFAULT_ROWS * 10) -> Dict[str, float]:
+    """Compiled predicate mask vs the decoded per-row dict loop, over an int64
+    ``in_set`` and a string-keyed ``in_pseudorandom_split``."""
+    import pyarrow as pa
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.decode_engine import (compile_decode_plan,
+                                             compile_predicate,
+                                             evaluate_predicate_mask)
+    from petastorm_tpu.predicates import in_pseudorandom_split, in_set
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    rng = np.random.RandomState(5)
+    field = UnischemaField('label', np.int64, (), ScalarCodec(), False)
+    schema = Unischema('PushdownBench', [field])
+    table = pa.table({'label': pa.array(
+        rng.randint(0, 100, size=rows).tolist(), type=pa.int64())})
+    predicate = in_set({1, 5, 12, 77}, 'label')
+    compiled = compile_predicate(predicate, schema)
+    assert compiled is not None
+    pushdown_s, mask = _best_rate(lambda: compiled.evaluate(table))
+    decoded = compile_decode_plan(schema, ['label']).execute(table)
+
+    def python_rows() -> np.ndarray:
+        out = np.zeros(rows, dtype=bool)
+        col = decoded['label']
+        for i in range(rows):
+            out[i] = bool(predicate.do_include({'label': col[i]}))
+        return out
+
+    python_s, python_mask = _best_rate(python_rows, repeats=1)
+    np.testing.assert_array_equal(mask, python_mask)
+
+    split = in_pseudorandom_split([0.5, 0.5], 0, 'label')
+    vector_s, vector_mask = _best_rate(
+        lambda: evaluate_predicate_mask(split, decoded, rows), repeats=1)
+
+    def split_rows() -> np.ndarray:
+        out = np.zeros(rows, dtype=bool)
+        col = decoded['label']
+        for i in range(rows):
+            out[i] = bool(split.do_include({'label': col[i]}))
+        return out
+
+    split_python_s, split_python_mask = _best_rate(split_rows, repeats=1)
+    np.testing.assert_array_equal(vector_mask, split_python_mask)
+    return {
+        'pushdown_in_set_rows_per_sec': round(rows / pushdown_s, 1),
+        'pushdown_python_rows_per_sec': round(rows / python_s, 1),
+        'pushdown_in_set_speedup': round(python_s / pushdown_s, 2),
+        'pushdown_split_speedup': round(split_python_s / vector_s, 2),
+    }
+
+
+def run_decode_bench(rows: int = _DEFAULT_ROWS,
+                     image_rows: int = _DEFAULT_IMAGE_ROWS,
+                     ndarray_hw: int = _DEFAULT_NDARRAY_HW,
+                     image_hw: int = _DEFAULT_IMAGE_HW,
+                     include_pushdown: bool = True) -> Dict[str, float]:
+    """Run every decode-bench section and merge the JSON-safe result dict."""
+    from petastorm_tpu.codecs import decode_thread_count
+    out: Dict[str, float] = {'decode_threads': float(decode_thread_count())}
+    out.update(codec_kernel_bench(rows, image_rows, ndarray_hw, image_hw))
+    if include_pushdown:
+        out.update(pushdown_bench())
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    """``decode-bench`` CLI entry: run the microbench and print one JSON line."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        description='petastorm_tpu vectorized decode-engine microbench')
+    parser.add_argument('--rows', type=int, default=_DEFAULT_ROWS,
+                        help='cells per non-image codec column')
+    parser.add_argument('--image-rows', type=int, default=_DEFAULT_IMAGE_ROWS,
+                        help='cells per image codec column')
+    parser.add_argument('--ndarray-hw', type=int, default=_DEFAULT_NDARRAY_HW,
+                        help='square tensor side for the ndarray codecs')
+    parser.add_argument('--image-hw', type=int, default=_DEFAULT_IMAGE_HW,
+                        help='square image side for the image codecs')
+    parser.add_argument('--no-pushdown', action='store_true',
+                        help='skip the predicate pushdown section')
+    args = parser.parse_args(argv)
+    result = run_decode_bench(rows=args.rows, image_rows=args.image_rows,
+                              ndarray_hw=args.ndarray_hw, image_hw=args.image_hw,
+                              include_pushdown=not args.no_pushdown)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

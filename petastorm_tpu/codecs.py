@@ -10,10 +10,82 @@ CompressedNdarrayCodec, CompressedImageCodec), re-designed for a TPU-first stack
 - decode returns C-contiguous numpy suitable for zero-copy ``jax.device_put``.
 """
 
+import os
+import threading
+import zlib
 from io import BytesIO
 
 import numpy as np
 import pyarrow as pa
+
+
+def decode_thread_count():
+    """Decode fan-out width for GIL-releasing batched kernels (``cv2.imdecode``,
+    zlib inflate): ``PETASTORM_TPU_DECODE_THREADS`` when set, else
+    ``min(4, cpu_count)`` — 1 disables the pool (docs/performance.md
+    "Vectorized decode engine")."""
+    env = os.environ.get('PETASTORM_TPU_DECODE_THREADS')
+    if env is not None:
+        return max(1, int(env))
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+#: below this many cells a thread fan-out costs more than it hides
+_MIN_PARALLEL_CELLS = 16
+
+_decode_pool_state = {'pool': None, 'threads': 0, 'pid': 0}
+_decode_pool_lock = threading.Lock()
+
+
+def _decode_pool(threads):
+    """Process-local decode thread pool, rebuilt under a lock if the width knob
+    or the pid changed (a pool of threads never survives a fork); a superseded
+    pool is shut down so its idle threads don't linger."""
+    from concurrent.futures import ThreadPoolExecutor
+    state = _decode_pool_state
+    with _decode_pool_lock:
+        if (state['pool'] is None or state['threads'] != threads
+                or state['pid'] != os.getpid()):
+            if state['pool'] is not None and state['pid'] == os.getpid():
+                state['pool'].shutdown(wait=False)
+            state['pool'] = ThreadPoolExecutor(
+                max_workers=threads, thread_name_prefix='ptpu-decode')
+            state['threads'] = threads
+            state['pid'] = os.getpid()
+        return state['pool']
+
+
+def _binary_chunk_blobs(chunk):
+    """Zero-copy per-row ``uint8`` views into a binary chunk's data buffer
+    (sliced/offset chunks included), or None when the chunk is not binary-typed
+    or contains nulls — callers then fall back to ``to_pylist``."""
+    if chunk.null_count or len(chunk) == 0:
+        return None
+    if pa.types.is_large_binary(chunk.type) or pa.types.is_large_string(chunk.type):
+        off_dtype = np.dtype(np.int64)
+    elif pa.types.is_binary(chunk.type) or pa.types.is_string(chunk.type):
+        off_dtype = np.dtype(np.int32)
+    else:
+        return None
+    buffers = chunk.buffers()
+    if buffers[1] is None or buffers[2] is None:
+        return None
+    offsets = np.frombuffer(buffers[1], dtype=off_dtype, count=len(chunk) + 1,
+                            offset=chunk.offset * off_dtype.itemsize)
+    data = np.frombuffer(buffers[2], dtype=np.uint8)
+    bounds = offsets.tolist()
+    return [data[lo:hi] for lo, hi in zip(bounds, bounds[1:])]
+
+
+def _column_blobs(arrow_col):
+    """Flatten a (Chunked)Array of binary blobs into one list of zero-copy views
+    (``to_pylist`` bytes for null-bearing or exotic chunks)."""
+    chunks = arrow_col.chunks if isinstance(arrow_col, pa.ChunkedArray) else [arrow_col]
+    blobs = []
+    for chunk in chunks:
+        views = _binary_chunk_blobs(chunk)
+        blobs.extend(chunk.to_pylist() if views is None else views)
+    return blobs
 
 
 def _is_compliant_shape(data_shape, field_shape):
@@ -351,6 +423,52 @@ class NdarrayCodec(FieldCodec):
         return pa.binary()
 
 
+def _npz_npy_payload(blob):
+    """Extract the raw ``.npy`` member bytes out of a ``np.savez_compressed``
+    container WITHOUT ``BytesIO``/``ZipFile`` machinery: parse the single
+    member's zip local-file header and inflate the deflate stream in one raw
+    ``zlib`` call. Returns None for any unexpected layout — callers fall back
+    to ``np.load``."""
+    head = bytes(memoryview(blob)[:30])
+    if len(head) < 30 or head[:4] != b'PK\x03\x04':
+        return None
+    flags = int.from_bytes(head[6:8], 'little')
+    method = int.from_bytes(head[8:10], 'little')
+    name_len = int.from_bytes(head[26:28], 'little')
+    extra_len = int.from_bytes(head[28:30], 'little')
+    body = memoryview(blob)[30 + name_len + extra_len:]
+    if method == 8:
+        try:
+            return zlib.decompressobj(-15).decompress(body)
+        except zlib.error:
+            return None
+    if method == 0 and not flags & 0x08:
+        # stored uncompressed with a known size (flag bit 3 would mean the size
+        # only lives in a trailing data descriptor — np.load handles that)
+        size = int.from_bytes(head[18:22], 'little')
+        return bytes(body[:size])
+    return None
+
+
+def _cached_npy_meta(payload, cache):
+    """``(shape, fortran, dtype, offset)`` of an npy blob, memoized by header
+    prefix: the npy header is 64-byte aligned so ``payload[:64]`` is an O(1)
+    dict key, with full-prefix equality confirmed inside the bucket. None for
+    unparseable headers."""
+    probe = bytes(payload[:64])
+    for prefix, meta in cache.get(probe, ()):
+        if payload[:len(prefix)] == prefix:
+            return meta
+    parsed = _parse_npy_header(bytes(payload))
+    if parsed is None:
+        return None
+    offset, shape, fortran, dtype = parsed
+    meta = (shape, fortran, dtype, offset)
+    if len(cache) < 1024:
+        cache.setdefault(probe, []).append((bytes(payload[:offset]), meta))
+    return meta
+
+
 class CompressedNdarrayCodec(FieldCodec):
     """Stores a numpy tensor zlib-compressed via ``np.savez_compressed`` (reference:
     petastorm/codecs.py:174-212)."""
@@ -373,6 +491,94 @@ class CompressedNdarrayCodec(FieldCodec):
         memfile = BytesIO(value)
         with np.load(memfile, allow_pickle=False) as data:
             return np.ascontiguousarray(data['arr'])
+
+    @staticmethod
+    def _cell_payload_meta(blob, header_cache):
+        """One cell's (payload, meta): raw-deflate inflate + memoized npy header
+        parse. meta is None when the fast path cannot represent the cell (the
+        caller np.load-falls-back)."""
+        payload = _npz_npy_payload(blob)
+        if payload is None:
+            return None, None
+        meta = _cached_npy_meta(payload, header_cache)
+        if meta is None:
+            return payload, None
+        shape, fortran, dtype, offset = meta
+        if fortran or dtype.hasobject:
+            return payload, None
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if len(payload) - offset != nbytes:
+            return payload, None
+        return payload, meta
+
+    def _cell_fallback(self, unischema_field, blob, payload):
+        """Slow-path single cell: np.load on the inflated member when available
+        (container already validated), else the full zip decode."""
+        if payload is not None:
+            return np.ascontiguousarray(
+                np.load(BytesIO(bytes(payload)), allow_pickle=False))
+        return self.decode(unischema_field, bytes(memoryview(blob)))
+
+    def decode_column(self, unischema_field, values):
+        """Vectorized decode: every cell inflates through ONE raw zlib call (no
+        per-cell ``BytesIO``/``ZipFile`` re-parse) and npy headers are parsed
+        once per distinct header — the same shared-header trick as
+        :meth:`NdarrayCodec.decode_column`. Unknown containers fall back to
+        per-cell :meth:`decode`."""
+        header_cache = {}
+        out = []
+        for blob in values:
+            if blob is None:
+                out.append(None)
+                continue
+            payload, meta = self._cell_payload_meta(blob, header_cache)
+            if meta is None:
+                out.append(self._cell_fallback(unischema_field, blob, payload))
+                continue
+            shape, _, dtype, offset = meta
+            count = int(np.prod(shape, dtype=np.int64))
+            # .copy() keeps decode()'s writable-array contract
+            out.append(np.frombuffer(payload, dtype=dtype, count=count,
+                                     offset=offset).reshape(shape).copy())
+        return out
+
+    def decode_arrow_column(self, unischema_field, arrow_col):
+        """Whole-column decode with a preallocated output: blobs stream straight
+        out of the Arrow data buffer as zero-copy views, inflate via raw zlib,
+        and land in ONE ``(n,) + shape`` array when every cell shares one npy
+        header (the uniform-shape case); ragged/null/mixed columns demote to the
+        per-cell list contract."""
+        blobs = _column_blobs(arrow_col)
+        n = len(blobs)
+        if n == 0:
+            return []
+        header_cache = {}
+        out = None
+        cells = None
+        for i, blob in enumerate(blobs):
+            arr = None
+            cell = None
+            if blob is not None:
+                payload, meta = self._cell_payload_meta(blob, header_cache)
+                if meta is None:
+                    cell = self._cell_fallback(unischema_field, blob, payload)
+                else:
+                    shape, _, dtype, offset = meta
+                    count = int(np.prod(shape, dtype=np.int64))
+                    arr = np.frombuffer(payload, dtype=dtype, count=count,
+                                        offset=offset).reshape(shape)
+            if cells is None:
+                if arr is not None:
+                    if out is None and i == 0:
+                        out = np.empty((n,) + arr.shape, dtype=arr.dtype)
+                    if out is not None and arr.shape == out.shape[1:] \
+                            and arr.dtype == out.dtype:
+                        out[i] = arr
+                        continue
+                # first non-uniform cell: demote the filled prefix to a list
+                cells = [out[j] for j in range(i)] if out is not None else []
+            cells.append(cell if arr is None else arr.copy())
+        return out if cells is None else cells
 
     def arrow_type(self, unischema_field):
         return pa.binary()
@@ -433,6 +639,59 @@ class CompressedImageCodec(FieldCodec):
         if image_bgr.ndim == 3 and image_bgr.shape[2] == 3:
             image_bgr = cv2.cvtColor(image_bgr, cv2.COLOR_BGR2RGB)
         return np.ascontiguousarray(image_bgr.astype(unischema_field.numpy_dtype, copy=False))
+
+    #: decode_arrow_column slab marker: "this cell was written into the
+    #: preallocated output", distinct from a None (null) cell value
+    _IN_SLAB = object()
+
+    def decode_arrow_column(self, unischema_field, arrow_col):
+        """Batched whole-column image decode: per-row zero-copy blob views (no
+        ``to_pylist`` byte materialization), one ``cv2.imdecode`` per image
+        fanned across GIL-released decode threads
+        (``PETASTORM_TPU_DECODE_THREADS``), and the BGR->RGB conversion written
+        straight into a preallocated ``(n, h, w, c)`` output when the field
+        declares a fully-concrete shape. Ragged columns demote to the per-cell
+        list contract."""
+        import cv2
+        blobs = _column_blobs(arrow_col)
+        n = len(blobs)
+        if n == 0:
+            return []
+        dtype = np.dtype(unischema_field.numpy_dtype)
+        shape = tuple(unischema_field.shape)
+        uniform = bool(shape) and all(d is not None for d in shape)
+        out = np.empty((n,) + shape, dtype=dtype) if uniform else None
+        in_slab = self._IN_SLAB
+
+        def decode_one(i):
+            blob = blobs[i]
+            if blob is None:
+                return None
+            buf = blob if isinstance(blob, np.ndarray) \
+                else np.frombuffer(blob, dtype=np.uint8)
+            image_bgr = cv2.imdecode(buf, cv2.IMREAD_UNCHANGED)
+            if image_bgr is None:
+                raise ValueError('cv2.imdecode failed for field {}'
+                                 .format(unischema_field.name))
+            if out is not None and image_bgr.shape == shape \
+                    and image_bgr.dtype == dtype:
+                if image_bgr.ndim == 3 and image_bgr.shape[2] == 3:
+                    cv2.cvtColor(image_bgr, cv2.COLOR_BGR2RGB, dst=out[i])
+                else:
+                    out[i] = image_bgr
+                return in_slab
+            if image_bgr.ndim == 3 and image_bgr.shape[2] == 3:
+                image_bgr = cv2.cvtColor(image_bgr, cv2.COLOR_BGR2RGB)
+            return np.ascontiguousarray(image_bgr.astype(dtype, copy=False))
+
+        threads = decode_thread_count()
+        if threads > 1 and n >= _MIN_PARALLEL_CELLS:
+            results = list(_decode_pool(threads).map(decode_one, range(n)))
+        else:
+            results = [decode_one(i) for i in range(n)]
+        if out is not None and all(r is in_slab for r in results):
+            return out
+        return [out[i] if r is in_slab else r for i, r in enumerate(results)]
 
     def arrow_type(self, unischema_field):
         return pa.binary()

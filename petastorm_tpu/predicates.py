@@ -30,6 +30,16 @@ class in_set(PredicateBase):
         self._inclusion_values = set(inclusion_values)
         self._predicate_field = predicate_field
 
+    @property
+    def inclusion_values(self):
+        """The inclusion set (read-only; decode_engine pushdown introspects it)."""
+        return frozenset(self._inclusion_values)
+
+    @property
+    def predicate_field(self):
+        """Name of the field this predicate reads."""
+        return self._predicate_field
+
     def get_fields(self):
         return {self._predicate_field}
 
@@ -92,6 +102,11 @@ class in_negate(PredicateBase):
     def __init__(self, predicate):
         self._predicate = predicate
 
+    @property
+    def predicate(self):
+        """The negated inner predicate (read-only; pushdown introspection)."""
+        return self._predicate
+
     def get_fields(self):
         return self._predicate.get_fields()
 
@@ -111,6 +126,16 @@ class in_reduce(PredicateBase):
     def __init__(self, predicate_list, reduce_func):
         self._predicate_list = list(predicate_list)
         self._reduce_func = reduce_func
+
+    @property
+    def predicates(self):
+        """The reduced child predicates (read-only; pushdown introspection)."""
+        return tuple(self._predicate_list)
+
+    @property
+    def reduce_func(self):
+        """The reduction function (``all``/``any`` are pushdown-compilable)."""
+        return self._reduce_func
 
     def get_fields(self):
         fields = set()
@@ -143,6 +168,11 @@ class in_pseudorandom_split(PredicateBase):
         self._boundaries = np.cumsum([0.0] + list(fraction_list))
         self._subset_index = subset_index
         self._predicate_field = predicate_field
+
+    @property
+    def predicate_field(self):
+        """Name of the hash-bucketed key field (pushdown introspection)."""
+        return self._predicate_field
 
     def get_fields(self):
         return {self._predicate_field}

@@ -21,6 +21,7 @@ import time
 import numpy as np
 import pyarrow.dataset as pads
 
+from petastorm_tpu import decode_engine
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.telemetry.spans import (drain_stage_times, record_stage,
                                            stage_span)
@@ -160,6 +161,11 @@ class RowGroupWorker(WorkerBase):
         self._setup = args
         self._filesystem = None
         self._parquet_format = pads.ParquetFileFormat()
+        # compiled decode plans per output field set, memoized for the worker's
+        # lifetime (docs/performance.md "Vectorized decode engine"); predicates
+        # re-compile per piece — items may carry fresh unpickled instances, and
+        # compilation is closure-building only (no IO)
+        self._decode_plans = {}
 
     def _fs(self):
         if self._filesystem is None:
@@ -378,8 +384,14 @@ class RowGroupWorker(WorkerBase):
 
     def _two_phase_load(self, fragment_path, row_group_id, partition_keys,
                         worker_predicate, all_fields):
-        """Load predicate columns first, evaluate, then load remaining columns and filter
-        (reference: py_dict_reader_worker.py:201-269)."""
+        """Load predicate columns first, evaluate, then load only the REMAINING
+        columns and filter (reference: py_dict_reader_worker.py:201-269 — which
+        re-read every column; here each storage column is read exactly once,
+        with the already-materialized predicate table reused in the output).
+
+        Compilable predicates (docs/performance.md "Vectorized decode engine")
+        evaluate as whole-column pushdown on the pre-decode Arrow table;
+        ``in_lambda``/custom predicates keep the decoded per-row path."""
         setup = self._setup
         predicate_fields = sorted(worker_predicate.get_fields())
         unknown = [f for f in predicate_fields
@@ -390,24 +402,42 @@ class RowGroupWorker(WorkerBase):
         with stage_span('rowgroup_read'):
             predicate_table = fragment.to_table(
                 columns=self._storage_columns(predicate_fields))
-        predicate_columns = self._decode_table(predicate_table, partition_keys,
-                                               predicate_fields,
-                                               fragment_path=fragment_path)
-        mask = self._evaluate_predicate(worker_predicate, predicate_columns,
-                                        predicate_table.num_rows)
+        compiled = decode_engine.compile_predicate(
+            worker_predicate, setup.schema,
+            partition_field_names=setup.partition_field_names,
+            decode=setup.decode)
+        if compiled is not None:
+            with stage_span('decode'):
+                mask = compiled.evaluate(predicate_table)
+        else:
+            predicate_columns = self._decode_table(predicate_table, partition_keys,
+                                                   predicate_fields,
+                                                   fragment_path=fragment_path)
+            mask = self._evaluate_predicate(worker_predicate, predicate_columns,
+                                            predicate_table.num_rows)
         keep = np.nonzero(mask)[0]
+        import pyarrow as pa
+        all_storage = self._storage_columns(all_fields)
         if not len(keep):
             # No survivors: build an empty table from the schema without reading data.
-            import pyarrow as pa
             physical = fragment.physical_schema
-            names = self._storage_columns(all_fields)
             empty = pa.table({name: pa.array([], type=physical.field(name).type)
-                              for name in names})
+                              for name in all_storage})
             return empty, np.array([], dtype=np.int64)
-        # Re-read all needed columns (predicate columns included, so downstream sees one
-        # consistent table) and filter by surviving indices.
-        with stage_span('rowgroup_read'):
-            full_table = fragment.to_table(columns=self._storage_columns(all_fields))
+        # Single-read assembly: reuse the predicate columns already in memory and
+        # read only what the output still needs; downstream sees one consistent
+        # table in the output column order.
+        have = set(predicate_table.column_names)
+        remaining = [name for name in all_storage if name not in have]
+        if remaining:
+            with stage_span('rowgroup_read'):
+                remaining_table = fragment.to_table(columns=remaining)
+            full_table = pa.table(
+                {name: (predicate_table.column(name) if name in have
+                        else remaining_table.column(name))
+                 for name in all_storage})
+        else:
+            full_table = predicate_table.select(all_storage)
         return full_table, keep
 
     def _evaluate_predicate(self, worker_predicate, predicate_columns, num_rows):
@@ -420,61 +450,37 @@ class RowGroupWorker(WorkerBase):
                 raise ValueError('Batched predicate must return a boolean mask of shape '
                                  '({},); got {}'.format(num_rows, mask.shape))
             return mask
-        mask = np.zeros(num_rows, dtype=bool)
-        for i in range(num_rows):
-            row = {k: v[i] for k, v in predicate_columns.items()}
-            mask[i] = bool(worker_predicate.do_include(row))
-        return mask
+        # Row mode: one vectorized do_include call for the built-in predicate
+        # classes, a zip-driven row loop for in_lambda/custom subclasses
+        # (decode_engine; docs/performance.md "Vectorized decode engine").
+        return decode_engine.evaluate_predicate_mask(worker_predicate,
+                                                     predicate_columns, num_rows)
 
     # ---------------------------------------------------------------- decode
 
     def _decode_table(self, table, partition_keys, field_names, fragment_path=None):
-        """Arrow table -> {name: ndarray-or-list} of decoded values. Codec failures are
-        wrapped in :class:`DecodeFieldError` carrying the field name and fragment path as
-        structured attributes — a corrupt value names its store location, not just a
-        message."""
-        from petastorm_tpu.errors import DecodeFieldError
-        setup = self._setup
-        partition_keys = partition_keys or {}
-        num_rows = table.num_rows
-        columns = {}
+        """Arrow table -> {name: ndarray-or-list} of decoded values, through the
+        per-schema compiled :class:`~petastorm_tpu.decode_engine.DecodePlan`
+        (one whole-column kernel per field, no per-cell dispatch). Codec
+        failures are wrapped in :class:`DecodeFieldError` carrying the field
+        name and fragment path as structured attributes — a corrupt value names
+        its store location, not just a message."""
+        plan = self._decode_plan(tuple(field_names))
         with stage_span('decode'):
-            for name in field_names:
-                field = setup.schema.fields.get(name)
-                if name in setup.partition_field_names:
-                    value = partition_keys.get(name)
-                    columns[name] = self._partition_column(field, value, num_rows)
-                    continue
-                arrow_col = table.column(name)
-                if field is not None and field.codec is not None and setup.decode:
-                    try:
-                        decoded = field.codec.decode_arrow_column(field, arrow_col)
-                    except Exception as exc:
-                        raise DecodeFieldError(
-                            'Failed to decode field {!r} of fragment {!r}: {}'
-                            .format(name, fragment_path, exc),
-                            field_name=name, fragment_path=fragment_path) from exc
-                    if isinstance(decoded, np.ndarray):
-                        # codec returned a stacked fast-path column
-                        columns[name] = decoded
-                    else:
-                        columns[name] = _stack_if_uniform(decoded, field)
-                elif field is not None and field.shape != () and setup.decode:
-                    values = arrow_col.to_pylist()
-                    decoded = [None if v is None
-                               else np.asarray(v, dtype=field.numpy_dtype)
-                               for v in values]
-                    columns[name] = _stack_if_uniform(decoded, field)
-                else:
-                    columns[name] = _arrow_to_numpy(arrow_col)
-        return columns
+            return plan.execute(table, partition_keys or {},
+                                fragment_path=fragment_path)
 
-    @staticmethod
-    def _partition_column(field, value, num_rows):
-        if field is not None and np.dtype(field.numpy_dtype).kind not in ('U', 'S', 'O'):
-            value = np.dtype(field.numpy_dtype).type(value)
-            return np.full(num_rows, value)
-        return np.array([value] * num_rows, dtype=object)
+    def _decode_plan(self, field_names):
+        """Memoized decode-plan compilation for one output field tuple."""
+        plan = self._decode_plans.get(field_names)
+        if plan is None:
+            setup = self._setup
+            plan = decode_engine.compile_decode_plan(
+                setup.schema, list(field_names),
+                partition_field_names=setup.partition_field_names,
+                decode=setup.decode)
+            self._decode_plans[field_names] = plan
+        return plan
 
     # --------------------------------------------------------------- shuffle
 
@@ -495,25 +501,44 @@ class RowGroupWorker(WorkerBase):
         if spec is None:
             return columns, num_rows
         with stage_span('transform'):
+            if spec.func is None:
+                # Vectorized pre-pass (docs/performance.md "Vectorized decode
+                # engine"): a spec that only deletes/selects/redeclares fields
+                # needs no row or frame materialization — the decoded columns
+                # pass through untouched, reordered to the result schema.
+                return ({name: columns[name]
+                         for name in setup.result_schema.fields}, num_rows)
             if setup.batched_output:
                 import pandas as pd
                 frame = pd.DataFrame({name: list(col) if not isinstance(col, list)
                                       else col
                                       for name, col in columns.items()})
-                if spec.func is not None:
-                    frame = spec.func(frame)
+                frame = spec.func(frame)
                 out = {}
                 for name in setup.result_schema.fields:
                     field = setup.result_schema.fields[name]
                     values = list(frame[name])
                     out[name] = _stack_if_uniform(values, field)
                 return out, len(frame)
+            if spec.batched:
+                # Declared-batched row-path func: whole decoded columns in, whole
+                # columns out — the second half of the vectorized pre-pass.
+                out_columns = spec.func(dict(columns))
+                out = {}
+                out_rows = num_rows
+                for name in setup.result_schema.fields:
+                    field = setup.result_schema.fields[name]
+                    values = out_columns[name]
+                    if not isinstance(values, np.ndarray):
+                        values = _stack_if_uniform(list(values), field)
+                    out[name] = values
+                    out_rows = len(values)
+                return out, out_rows
             # Row path: func operates on one row dict at a time (reference:
             # py_dict_reader_worker.py:40-54).
             rows = [{name: col[i] for name, col in columns.items()}
                     for i in range(num_rows)]
-            if spec.func is not None:
-                rows = [spec.func(row) for row in rows]
+            rows = [spec.func(row) for row in rows]
             out = {}
             for name in setup.result_schema.fields:
                 field = setup.result_schema.fields[name]
@@ -557,33 +582,8 @@ def _take(col, indices):
     return [col[i] for i in indices]
 
 
-def _stack_if_uniform(values, field):
-    """Stack per-row arrays into one (n,)+shape array when shapes are uniform and the
-    field declares no variable dims; otherwise keep a list (ragged)."""
-    if not values:
-        return np.empty((0,) + tuple(d or 0 for d in (field.shape if field else ())))
-    if field is not None and field.shape == ():
-        first = values[0]
-        if isinstance(first, (str, bytes)) or first is None:
-            return np.array(values, dtype=object)
-        return np.asarray(values)
-    if any(v is None for v in values):
-        return values
-    shapes = {np.asarray(v).shape for v in values}
-    if len(shapes) == 1:
-        return np.stack([np.asarray(v) for v in values])
-    return values
-
-
-def _arrow_to_numpy(arrow_col):
-    """Native column to numpy: scalars to typed arrays, strings to object arrays, lists to
-    lists of numpy arrays (reference: arrow_reader_worker.py:44-85)."""
-    import pyarrow.types as patypes
-    col_type = arrow_col.type
-    if patypes.is_list(col_type) or patypes.is_large_list(col_type):
-        return [None if v is None else np.asarray(v) for v in arrow_col.to_pylist()]
-    if (patypes.is_string(col_type) or patypes.is_large_string(col_type)
-            or patypes.is_binary(col_type) or patypes.is_large_binary(col_type)
-            or patypes.is_decimal(col_type)):
-        return np.array(arrow_col.to_pylist(), dtype=object)
-    return arrow_col.to_numpy(zero_copy_only=False)
+# Promoted into the strict-typed decode engine (satellite fixes included:
+# one asarray pass in stack_if_uniform, Arrow-native object arrays for
+# string/binary columns); aliased here for this module's internal callers.
+_stack_if_uniform = decode_engine.stack_if_uniform
+_arrow_to_numpy = decode_engine.arrow_to_numpy

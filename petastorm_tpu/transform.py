@@ -20,9 +20,17 @@ class TransformSpec(object):
     :param removed_fields: list of field names deleted by the transform. Mutually exclusive
         with ``selected_fields``.
     :param selected_fields: ordered list of field names to keep (output column order).
+    :param batched: row-reader vectorized mode (docs/performance.md "Vectorized
+        decode engine"): ``func`` receives the whole decoded rowgroup as a
+        ``{field: ndarray-or-list}`` columns dict and returns the transformed
+        columns dict — the worker skips the per-row dict materialization
+        entirely. Ignored by ``make_batch_reader`` (whose ``func`` is already
+        batched via pandas). A ``func=None`` spec never materializes rows in
+        either reader, ``batched`` or not.
     """
 
-    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None):
+    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None,
+                 batched=False):
         if removed_fields and selected_fields:
             raise ValueError('removed_fields and selected_fields are mutually exclusive '
                              '(reference semantics: petastorm/transform.py:49-52)')
@@ -30,6 +38,7 @@ class TransformSpec(object):
         self.edit_fields = edit_fields or []
         self.removed_fields = removed_fields or []
         self.selected_fields = selected_fields
+        self.batched = bool(batched)
 
 
 def transform_schema(schema, transform_spec):

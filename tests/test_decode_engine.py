@@ -1,0 +1,501 @@
+"""Vectorized decode engine tests (ISSUE 7): compiled plan kernels on adversarial
+Arrow layouts (sliced/offset chunks, nulls, ragged shapes, non-native endianness),
+predicate pushdown vs per-row Python equivalence, the single-read two-phase path,
+and the TransformSpec vectorized pre-pass."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu import decode_engine, make_reader
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  FieldCodec, NdarrayCodec, ScalarCodec)
+from petastorm_tpu.predicates import (in_lambda, in_negate,
+                                      in_pseudorandom_split, in_reduce, in_set)
+from petastorm_tpu.transform import TransformSpec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+# ------------------------------------------------------------- codec kernels
+
+
+def _per_cell_reference(field, arrow_col):
+    """The pre-engine worker behavior: python cells, per-cell decode dispatch."""
+    return FieldCodec.decode_column(field.codec, field, arrow_col.to_pylist())
+
+
+def _assert_columns_equal(actual, expected):
+    if isinstance(actual, np.ndarray) and isinstance(expected, np.ndarray):
+        np.testing.assert_array_equal(actual, expected)
+        return
+    actual = list(actual)
+    expected = list(expected)
+    assert len(actual) == len(expected)
+    for a, e in zip(actual, expected):
+        if e is None:
+            assert a is None
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(e))
+
+
+def _encoded_column(field, values, arrow_type=pa.binary()):
+    return pa.chunked_array([pa.array(
+        [None if v is None else field.codec.encode(field, v) for v in values],
+        type=arrow_type)])
+
+
+CODEC_CASES = [
+    ('ndarray', NdarrayCodec(), np.float32, (5, 3)),
+    ('compressed_ndarray', CompressedNdarrayCodec(), np.float32, (5, 3)),
+    ('image_png', CompressedImageCodec('png'), np.uint8, (8, 6, 3)),
+]
+
+
+def _codec_values(dtype, shape, n=7, seed=3):
+    rng = np.random.RandomState(seed)
+    if np.dtype(dtype) == np.uint8:
+        return [rng.randint(0, 255, shape).astype(dtype) for _ in range(n)]
+    return [rng.rand(*shape).astype(dtype) for _ in range(n)]
+
+
+@pytest.mark.parametrize('name,codec,dtype,shape', CODEC_CASES)
+def test_sliced_offset_chunks_decode_identically(name, codec, dtype, shape):
+    """A sliced chunk's buffer offsets must not shift the decoded payloads."""
+    field = UnischemaField('x', dtype, shape, codec, False)
+    values = _codec_values(dtype, shape, n=9)
+    col = _encoded_column(field, values)
+    sliced = pa.chunked_array([col.chunk(0).slice(2, 5)])
+    out = codec.decode_arrow_column(field, sliced)
+    _assert_columns_equal(out, _per_cell_reference(field, sliced))
+    _assert_columns_equal(out, [codec.decode(field, field.codec.encode(field, v))
+                                for v in values[2:7]])
+
+
+@pytest.mark.parametrize('name,codec,dtype,shape', CODEC_CASES)
+def test_null_containing_chunks_keep_none_cells(name, codec, dtype, shape):
+    field = UnischemaField('x', dtype, shape, codec, True)
+    values = _codec_values(dtype, shape, n=5)
+    values[1] = None
+    values[4] = None
+    col = _encoded_column(field, values)
+    out = codec.decode_arrow_column(field, col)
+    assert isinstance(out, list)
+    _assert_columns_equal(out, _per_cell_reference(field, col))
+
+
+@pytest.mark.parametrize('name,codec,dtype', [
+    ('ndarray', NdarrayCodec(), np.float32),
+    ('compressed_ndarray', CompressedNdarrayCodec(), np.float32),
+])
+def test_ragged_shapes_demote_to_lists(name, codec, dtype):
+    field = UnischemaField('x', dtype, (None, None), codec, False)
+    rng = np.random.RandomState(0)
+    values = [rng.rand(2, 3).astype(dtype), rng.rand(2, 3).astype(dtype),
+              rng.rand(4, 1).astype(dtype)]
+    out = codec.decode_arrow_column(field, _encoded_column(field, values))
+    assert isinstance(out, list)
+    for a, e in zip(out, values):
+        np.testing.assert_array_equal(np.asarray(a), e)
+
+
+@pytest.mark.parametrize('name,codec', [
+    ('ndarray', NdarrayCodec()),
+    ('compressed_ndarray', CompressedNdarrayCodec()),
+])
+def test_non_native_endian_dtypes(name, codec):
+    """Big-endian payloads must decode with their declared byte order intact."""
+    be = np.dtype('>f4')
+    field = UnischemaField('x', be, (3, 3), codec, False)
+    rng = np.random.RandomState(1)
+    values = [rng.rand(3, 3).astype(be) for _ in range(4)]
+    out = codec.decode_arrow_column(field, _encoded_column(field, values))
+    stacked = np.asarray(out) if isinstance(out, np.ndarray) else np.stack(
+        [np.asarray(v) for v in out])
+    np.testing.assert_array_equal(stacked, np.stack(values))
+
+
+def test_mixed_uniform_then_ragged_chunk_demotes_cleanly():
+    """The preallocated fast path must demote mid-column without losing the
+    already-decoded prefix."""
+    codec = CompressedNdarrayCodec()
+    field = UnischemaField('x', np.float32, (None, None), codec, False)
+    rng = np.random.RandomState(2)
+    values = [rng.rand(2, 2).astype(np.float32) for _ in range(3)]
+    values.append(rng.rand(5, 5).astype(np.float32))
+    out = codec.decode_arrow_column(field, _encoded_column(field, values))
+    assert isinstance(out, list) and len(out) == 4
+    for a, e in zip(out, values):
+        np.testing.assert_array_equal(np.asarray(a), e)
+
+
+def test_compressed_ndarray_engine_output_is_writable():
+    codec = CompressedNdarrayCodec()
+    field = UnischemaField('x', np.float32, (2, 2), codec, False)
+    values = _codec_values(np.float32, (2, 2), n=3)
+    out = codec.decode_arrow_column(field, _encoded_column(field, values))
+    assert isinstance(out, np.ndarray) and out.flags.writeable
+    cells = codec.decode_column(field, [field.codec.encode(field, v)
+                                        for v in values])
+    assert all(c.flags.writeable for c in cells)
+
+
+def test_image_decode_thread_fanout_matches_serial(monkeypatch):
+    """The threaded image kernel must be bit-identical to the serial one."""
+    codec = CompressedImageCodec('png')
+    field = UnischemaField('img', np.uint8, (8, 6, 3), codec, False)
+    values = _codec_values(np.uint8, (8, 6, 3), n=24)
+    col = _encoded_column(field, values)
+    monkeypatch.setenv('PETASTORM_TPU_DECODE_THREADS', '1')
+    serial = codec.decode_arrow_column(field, col)
+    monkeypatch.setenv('PETASTORM_TPU_DECODE_THREADS', '3')
+    threaded = codec.decode_arrow_column(field, col)
+    assert isinstance(serial, np.ndarray) and isinstance(threaded, np.ndarray)
+    np.testing.assert_array_equal(serial, threaded)
+
+
+# ------------------------------------------------------------ decode plans
+
+
+def _scalar_schema():
+    return Unischema('PlanSchema', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('name', np.str_, (), ScalarCodec(), False),
+        UnischemaField('vec', np.float32, (2, 2), NdarrayCodec(), False),
+    ])
+
+
+def _scalar_table(n=10):
+    schema = _scalar_schema()
+    rng = np.random.RandomState(0)
+    vecs = [rng.rand(2, 2).astype(np.float32) for _ in range(n)]
+    table = pa.table({
+        'id': pa.array(list(range(n)), type=pa.int64()),
+        'name': pa.array(['row_{}'.format(i % 4) for i in range(n)]),
+        'vec': pa.array([schema.fields['vec'].codec.encode(
+            schema.fields['vec'], v) for v in vecs], type=pa.binary()),
+    })
+    return schema, table, vecs
+
+
+def test_decode_plan_matches_field_kinds():
+    schema, table, vecs = _scalar_table()
+    plan = decode_engine.compile_decode_plan(schema, ['id', 'name', 'vec'])
+    columns = plan.execute(table)
+    np.testing.assert_array_equal(columns['id'], np.arange(10))
+    assert columns['name'].dtype == np.dtype(object)
+    assert columns['name'][3] == 'row_3'
+    np.testing.assert_array_equal(columns['vec'], np.stack(vecs))
+
+
+def test_decode_plan_partition_and_decode_off():
+    schema, table, _ = _scalar_table()
+    plan = decode_engine.compile_decode_plan(
+        schema, ['id', 'part'], partition_field_names={'part'}, decode=False)
+    columns = plan.execute(table, partition_keys={'part': 'p_1'})
+    assert list(columns['part']) == ['p_1'] * 10
+    np.testing.assert_array_equal(columns['id'], np.arange(10))
+
+
+def test_decode_plan_wraps_codec_failures():
+    from petastorm_tpu.errors import DecodeFieldError
+    schema = Unischema('Bad', [
+        UnischemaField('vec', np.float32, (2, 2), NdarrayCodec(), False)])
+    table = pa.table({'vec': pa.array([b'not-a-npy-blob'], type=pa.binary())})
+    plan = decode_engine.compile_decode_plan(schema, ['vec'])
+    with pytest.raises(DecodeFieldError) as exc_info:
+        plan.execute(table, fragment_path='frag.parquet')
+    assert exc_info.value.field_name == 'vec'
+    assert exc_info.value.fragment_path == 'frag.parquet'
+
+
+def test_stack_if_uniform_single_conversion_semantics():
+    ragged = [np.zeros((2, 2)), np.zeros((3, 2))]
+    field = UnischemaField('x', np.float64, (None, 2), None, False)
+    assert isinstance(decode_engine.stack_if_uniform(ragged, field), list)
+    uniform = decode_engine.stack_if_uniform(
+        [np.ones((2, 2)), np.zeros((2, 2))], field)
+    assert uniform.shape == (2, 2, 2)
+    with_none = decode_engine.stack_if_uniform([np.ones((2, 2)), None], field)
+    assert isinstance(with_none, list) and with_none[1] is None
+
+
+def test_arrow_to_numpy_object_paths():
+    strings = decode_engine.arrow_to_numpy(
+        pa.chunked_array([pa.array(['a', None, 'b'])]))
+    assert strings.dtype == np.dtype(object)
+    assert strings[1] is None and strings[2] == 'b'
+    lists = decode_engine.arrow_to_numpy(
+        pa.chunked_array([pa.array([[1, 2], None, [3]])]))
+    assert isinstance(lists, list) and lists[1] is None
+    np.testing.assert_array_equal(lists[0], [1, 2])
+
+
+# ------------------------------------------------------ predicate pushdown
+
+
+def _pushdown_schema_and_table(n=64):
+    schema = Unischema('PredSchema', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('key', np.str_, (), ScalarCodec(), False),
+        UnischemaField('score', np.float32, (), ScalarCodec(), False),
+    ])
+    rng = np.random.RandomState(7)
+    table = pa.table({
+        'id': pa.array([int(v) for v in rng.randint(0, 20, size=n)],
+                       type=pa.int64()),
+        'key': pa.array(['k_{}'.format(i % 9) for i in range(n)]),
+        'score': pa.array([float(v) for v in rng.rand(n)], type=pa.float32()),
+    })
+    return schema, table
+
+
+def _python_row_mask(predicate, schema, table):
+    """The per-row reference: decode every predicate column, loop row dicts."""
+    fields = sorted(predicate.get_fields())
+    plan = decode_engine.compile_decode_plan(schema, fields)
+    columns = plan.execute(table)
+    mask = np.zeros(table.num_rows, dtype=bool)
+    for i in range(table.num_rows):
+        mask[i] = bool(predicate.do_include(
+            {name: columns[name][i] for name in fields}))
+    return mask
+
+
+EQUIVALENCE_PREDICATES = [
+    ('in_set_int', lambda: in_set({1, 5, 7, 19}, 'id')),
+    ('in_set_empty', lambda: in_set(set(), 'id')),
+    ('in_set_str', lambda: in_set({'k_2', 'k_8', 'missing'}, 'key')),
+    ('in_set_float', lambda: in_set({0.25, 0.5}, 'score')),
+    ('in_negate', lambda: in_negate(in_set({3, 4}, 'id'))),
+    ('in_reduce_all', lambda: in_reduce(
+        [in_set(set(range(10)), 'id'), in_set({'k_1', 'k_2', 'k_3'}, 'key')], all)),
+    ('in_reduce_any', lambda: in_reduce(
+        [in_set({1}, 'id'), in_negate(in_set({'k_0'}, 'key'))], any)),
+    ('split_str', lambda: in_pseudorandom_split([0.3, 0.4, 0.3], 1, 'key')),
+    ('split_int', lambda: in_pseudorandom_split([0.5, 0.5], 0, 'id')),
+    ('nested', lambda: in_negate(in_reduce(
+        [in_pseudorandom_split([0.6, 0.4], 0, 'key'), in_set({2, 4, 6}, 'id')],
+        any))),
+]
+
+
+@pytest.mark.parametrize('name,make_predicate', EQUIVALENCE_PREDICATES)
+def test_pushdown_mask_equals_python_row_mask(name, make_predicate):
+    """Acceptance: bit-identical row selection for every compilable predicate."""
+    schema, table = _pushdown_schema_and_table()
+    predicate = make_predicate()
+    compiled = decode_engine.compile_predicate(predicate, schema)
+    assert compiled is not None, 'expected {} to compile'.format(name)
+    mask = compiled.evaluate(table)
+    np.testing.assert_array_equal(mask, _python_row_mask(predicate, schema, table))
+
+
+def test_pushdown_str_bytes_families_never_cross_match():
+    """Arrow would silently encode str<->bytes across string/binary columns;
+    the compiled path must keep the Python answer (no match) instead."""
+    schema = Unischema('Families', [
+        UnischemaField('b', np.bytes_, (), ScalarCodec(), False),
+        UnischemaField('s', np.str_, (), ScalarCodec(), False),
+    ])
+    table = pa.table({'b': pa.array([b'a', b'z'], type=pa.binary()),
+                      's': pa.array(['a', 'z'])})
+    for predicate in (in_set({'a'}, 'b'), in_set({b'a'}, 's')):
+        compiled = decode_engine.compile_predicate(predicate, schema)
+        assert compiled is not None
+        mask = compiled.evaluate(table)
+        np.testing.assert_array_equal(
+            mask, _python_row_mask(predicate, schema, table))
+        assert not mask.any()
+    matching = decode_engine.compile_predicate(in_set({b'a'}, 'b'), schema)
+    np.testing.assert_array_equal(matching.evaluate(table), [True, False])
+
+
+def test_pushdown_out_of_range_int_set_falls_back_in_band():
+    """pa.array raises OverflowError (not an Arrow error) for out-of-C-range
+    ints; the leaf must fall back to the numpy mirror, not crash the worker."""
+    schema = Unischema('Narrow', [
+        UnischemaField('x', np.uint8, (), ScalarCodec(), False)])
+    table = pa.table({'x': pa.array([0, 255, 7], type=pa.uint8())})
+    predicate = in_set({-1, 255, 2 ** 70}, 'x')
+    compiled = decode_engine.compile_predicate(predicate, schema)
+    assert compiled is not None
+    mask = compiled.evaluate(table)
+    np.testing.assert_array_equal(mask, _python_row_mask(predicate, schema, table))
+    np.testing.assert_array_equal(mask, [False, True, False])
+
+
+def test_field_less_predicate_still_called_per_row():
+    calls = []
+
+    def always(*args):
+        calls.append(1)
+        return True
+
+    mask = decode_engine.evaluate_predicate_mask(in_lambda([], always), {}, 4)
+    np.testing.assert_array_equal(mask, [True] * 4)
+    assert len(calls) == 4
+
+
+def test_pushdown_split_is_deterministic_across_compiles():
+    schema, table = _pushdown_schema_and_table()
+    predicate = in_pseudorandom_split([0.5, 0.5], 1, 'key')
+    first = decode_engine.compile_predicate(predicate, schema).evaluate(table)
+    second = decode_engine.compile_predicate(predicate, schema).evaluate(table)
+    np.testing.assert_array_equal(first, second)
+    assert 0 < first.sum() < table.num_rows  # both buckets populated
+
+
+def test_pushdown_handles_null_scalars_like_python():
+    schema = Unischema('Nulls', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), True)])
+    table = pa.table({'id': pa.array([1, None, 5, None], type=pa.int64())})
+    predicate = in_set({1, 5}, 'id')
+    compiled = decode_engine.compile_predicate(predicate, schema)
+    mask = compiled.evaluate(table)
+    np.testing.assert_array_equal(mask, [True, False, True, False])
+    np.testing.assert_array_equal(mask, _python_row_mask(predicate, schema, table))
+
+
+@pytest.mark.parametrize('name,predicate_factory', [
+    ('in_lambda', lambda: in_lambda(['id'], lambda v: v > 3)),
+    ('custom_reduce', lambda: in_reduce([in_set({1}, 'id')],
+                                        lambda results: sum(results) > 0)),
+    ('unknown_field', lambda: in_set({1}, 'no_such_field')),
+])
+def test_uncompilable_predicates_return_none(name, predicate_factory):
+    schema, _ = _pushdown_schema_and_table()
+    assert decode_engine.compile_predicate(predicate_factory(), schema) is None
+
+
+def test_subclassed_predicate_is_not_compiled():
+    """Exact-type gate: a subclass may override do_include semantics."""
+
+    class _Flipped(in_set):
+        def do_include(self, values):
+            return not super().do_include(values)
+
+    schema, _ = _pushdown_schema_and_table()
+    assert decode_engine.compile_predicate(_Flipped({1}, 'id'), schema) is None
+
+
+def test_partition_field_predicates_fall_back():
+    schema, _ = _pushdown_schema_and_table()
+    assert decode_engine.compile_predicate(
+        in_set({'p_0'}, 'key'), schema, partition_field_names={'key'}) is None
+
+
+def test_evaluate_predicate_mask_vectorized_and_row_paths_agree():
+    columns = {'id': np.array([0, 1, 2, 3, 4, 5], dtype=np.int64)}
+    vectorized = decode_engine.evaluate_predicate_mask(
+        in_set({1, 4}, 'id'), columns, 6)
+    np.testing.assert_array_equal(vectorized, [False, True, False, False, True,
+                                               False])
+    lam = in_lambda(['id'], lambda v: v % 2 == 0)
+    row_looped = decode_engine.evaluate_predicate_mask(lam, columns, 6)
+    np.testing.assert_array_equal(row_looped, [True, False, True, False, True,
+                                               False])
+
+
+# ------------------------------------------------- end-to-end reader paths
+
+
+def test_reader_pushdown_matches_lambda_fallback(synthetic_dataset):
+    """Same rows whether the predicate compiles (in_set) or not (in_lambda)."""
+    with make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                     shuffle_row_groups=False,
+                     predicate=in_set({0, 1, 2, 3}, 'id2')) as reader:
+        pushdown_ids = sorted(row.id for row in reader)
+    with make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                     shuffle_row_groups=False,
+                     predicate=in_lambda(['id2'], lambda v: v in {0, 1, 2, 3})) \
+            as reader:
+        fallback_ids = sorted(row.id for row in reader)
+    expected = sorted(row['id'] for row in synthetic_dataset.rows
+                      if row['id2'] in {0, 1, 2, 3})
+    assert pushdown_ids == expected
+    assert fallback_ids == expected
+
+
+def test_reader_pushdown_split_matches_row_reference(synthetic_dataset):
+    """in_pseudorandom_split end to end: the worker's pushdown selection equals
+    the predicate's own scalar answers."""
+    predicate = in_pseudorandom_split([0.4, 0.6], 0, 'sensor_name')
+    with make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                     shuffle_row_groups=False, predicate=predicate) as reader:
+        got_ids = sorted(row.id for row in reader)
+    expected = sorted(
+        row['id'] for row in synthetic_dataset.rows
+        if predicate.do_include({'sensor_name': row['sensor_name']}))
+    assert got_ids == expected
+
+
+def test_single_read_two_phase_reads_each_column_once(synthetic_dataset):
+    """The predicate column (part of the read view) must not be re-read: rows
+    and values still come out right, and the predicate table is reused."""
+    with make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                     shuffle_row_groups=False,
+                     schema_fields=['id', 'id2', 'matrix'],
+                     predicate=in_set({1, 3}, 'id2')) as reader:
+        rows = list(reader)
+    expected = [row for row in synthetic_dataset.rows if row['id2'] in {1, 3}]
+    assert sorted(r.id for r in rows) == sorted(row['id'] for row in expected)
+    by_id = {row['id']: row for row in expected}
+    for row in rows:
+        np.testing.assert_array_equal(row.matrix, by_id[row.id]['matrix'])
+
+
+def test_two_phase_predicate_outside_read_view(synthetic_dataset):
+    """A predicate field the user did not select still drives the row selection
+    (the reader widens the read view to cover it — established semantics), and
+    the selected values come out right through the single-read assembly."""
+    with make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                     shuffle_row_groups=False, schema_fields=['id', 'matrix'],
+                     predicate=in_set({0}, 'id2')) as reader:
+        rows = list(reader)
+    expected_ids = sorted(row['id'] for row in synthetic_dataset.rows
+                          if row['id2'] == 0)
+    assert sorted(row.id for row in rows) == expected_ids
+    by_id = {row['id']: row for row in synthetic_dataset.rows}
+    for row in rows:
+        np.testing.assert_array_equal(row.matrix, by_id[row.id]['matrix'])
+
+
+# --------------------------------------------------- transform pre-pass
+
+
+def test_transform_spec_without_func_skips_row_materialization(synthetic_dataset):
+    spec = TransformSpec(removed_fields=['matrix_var', 'string_list'])
+    with make_reader(synthetic_dataset.url, workers_count=1, num_epochs=1,
+                     shuffle_row_groups=False,
+                     schema_fields=['id', 'matrix', 'matrix_var', 'string_list'],
+                     transform_spec=spec) as reader:
+        rows = list(reader)
+    assert len(rows) == len(synthetic_dataset.rows)
+    assert not hasattr(rows[0], 'matrix_var')
+    np.testing.assert_array_equal(
+        sorted(row.id for row in rows),
+        sorted(row['id'] for row in synthetic_dataset.rows))
+
+
+def test_batched_transform_spec_matches_row_transform(synthetic_dataset):
+    """A declared-batched columns-dict func must produce exactly what the
+    per-row func path produces."""
+    def row_func(row):
+        row['matrix'] = row['matrix'] * 2.0
+        return row
+
+    def batched_func(columns):
+        columns['matrix'] = columns['matrix'] * 2.0
+        return columns
+
+    kwargs = dict(workers_count=1, num_epochs=1, shuffle_row_groups=False,
+                  schema_fields=['id', 'matrix'])
+    with make_reader(synthetic_dataset.url,
+                     transform_spec=TransformSpec(row_func), **kwargs) as reader:
+        row_result = {row.id: row.matrix for row in reader}
+    with make_reader(synthetic_dataset.url,
+                     transform_spec=TransformSpec(batched_func, batched=True),
+                     **kwargs) as reader:
+        batched_result = {row.id: row.matrix for row in reader}
+    assert set(row_result) == set(batched_result)
+    for key, value in row_result.items():
+        np.testing.assert_array_equal(value, batched_result[key])
