@@ -155,7 +155,7 @@ _HEADLINE_FALLBACKS = (
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
-                 'resilience', 'pipecheck', 'tracing')
+                 'resilience', 'pipecheck', 'tracing', 'service')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -164,10 +164,11 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'decode_bench', 'wire_bench',
-                     'telemetry', 'tracing', 'resilience', 'mnist_scan_stream',
-                     'flash', 'moe', 'imagenet_scan', 'imagenet_stream',
-                     'decode_delta', 'bare_reader', 'mnist_stream')
+SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'decode_bench', 'service',
+                     'wire_bench', 'telemetry', 'tracing', 'resilience',
+                     'mnist_scan_stream', 'flash', 'moe', 'imagenet_scan',
+                     'imagenet_stream', 'decode_delta', 'bare_reader',
+                     'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
 
@@ -1544,6 +1545,74 @@ def child_main():
                                              {}).get('state', 'closed'),
         })
 
+    def run_service():
+        """Disaggregated input service (host-only; docs/service.md): one
+        localhost fleet epoch via make_reader(service_url=...) vs the
+        in-process process-pool epoch on the same store, plus a second
+        service epoch against the fleet's (now warm) shared cache — the
+        ISSUE-8 numbers: the TCP dispatch overhead a co-located deployment
+        pays, and the warm-hit speedup every OTHER job reading the same
+        dataset inherits."""
+        import shutil as _shutil
+        from petastorm_tpu.service.fleet import ServiceFleet
+        from petastorm_tpu.workers.process_pool import ProcessPool
+
+        service_workers = min(WORKERS, 2)
+
+        def pool_epoch():
+            reader = make_reader(url, reader_pool=ProcessPool(service_workers),
+                                 num_epochs=1, shuffle_row_groups=False)
+            rows = 0
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            reader.stop()
+            reader.join()
+            return rows / elapsed
+
+        def service_epoch(service_url):
+            reader = make_reader(url, service_url=service_url, num_epochs=1,
+                                 shuffle_row_groups=False)
+            rows = 0
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            diag = reader.diagnostics
+            reader.stop()
+            reader.join()
+            return rows / elapsed, diag
+
+        cache_dir = tempfile.mkdtemp(prefix='petastorm_tpu_bench_service_')
+        try:
+            with ServiceFleet(workers=service_workers,
+                              cache_dir=cache_dir) as fleet:
+                cold_rate, diag = service_epoch(fleet.service_url)
+                warm_rate, warm_diag = service_epoch(fleet.service_url)
+            pool_rate = pool_epoch()
+        finally:
+            _shutil.rmtree(cache_dir, ignore_errors=True)
+        overhead_pct = (pool_rate - cold_rate) / pool_rate * 100.0
+        warm_speedup = warm_rate / max(cold_rate, 1e-9)
+        log('service: {:.1f} rows/s over the fleet (cold) vs {:.1f} rows/s '
+            'in-process ({:+.1f}% dispatch overhead); warm shared-cache '
+            'epoch {:.1f} rows/s ({:.2f}x), {} shm batch(es), {} worker(s)'
+            .format(cold_rate, pool_rate, overhead_pct, warm_rate,
+                    warm_speedup, diag.get('service_shm_batches', 0),
+                    service_workers))
+        results.update({
+            'service_rows_per_sec': round(cold_rate, 1),
+            'service_pool_rows_per_sec': round(pool_rate, 1),
+            'service_overhead_pct': round(overhead_pct, 2),
+            'service_cache_warm_rows_per_sec': round(warm_rate, 1),
+            'service_cache_warm_speedup': round(warm_speedup, 3),
+            'service_shm_batches': diag.get('service_shm_batches', 0),
+            'service_warm_cache_hits': warm_diag.get('cache_hits', 0),
+            # provenance: the fleet shape behind the numbers
+            'service_workers': service_workers,
+        })
+
     def run_pipecheck():
         """Check phase (host-only, sub-second): the pipecheck static
         data-plane invariant analysis + the mypy-strict ratchet over the
@@ -1609,6 +1678,7 @@ def child_main():
         'tracing': run_tracing,
         'resilience': run_resilience,
         'pipecheck': run_pipecheck,
+        'service': run_service,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

@@ -25,6 +25,16 @@ from typing import Optional, Tuple
 PROTOCOL_PEER_FILES: Tuple[str, ...] = ('process_pool.py',
                                         'process_worker_main.py')
 
+#: the disaggregated input service's peer set (docs/service.md): dispatcher,
+#: service worker and client transport speak their own kind literals over
+#: TCP — an independent group, set-matched exactly like the in-process pair
+SERVICE_PEER_FILES: Tuple[str, ...] = ('dispatcher.py', 'service_worker.py',
+                                       'service_client.py')
+
+#: basenames whose ``to_bytes``/``from_bytes`` JSON descriptor key sets must
+#: match (shm slot descriptors; service registration/shm-result descriptors)
+DESCRIPTOR_FILES: Tuple[str, ...] = ('shm_ring.py', 'wire.py')
+
 #: modules under the injectable-clock discipline: direct ``time.time()`` /
 #: ``time.monotonic()`` / ``time.perf_counter()`` calls are findings — retry,
 #: backoff, deadline and breaker arithmetic must flow through the injected
@@ -62,6 +72,8 @@ class AnalysisConfig:
     """Resolved configuration for one pipecheck run (defaults above)."""
 
     protocol_peer_files: Tuple[str, ...] = PROTOCOL_PEER_FILES
+    service_peer_files: Tuple[str, ...] = SERVICE_PEER_FILES
+    descriptor_files: Tuple[str, ...] = DESCRIPTOR_FILES
     clock_disciplined_files: Tuple[str, ...] = CLOCK_DISCIPLINED_FILES
     worker_dir: str = WORKER_DIR
     datapath_files: Tuple[str, ...] = DATAPATH_FILES

@@ -15,7 +15,11 @@ path — the exact drift class this rule pins down statically:
   protocol peer files must be *dispatched on* (compared against a kind
   expression: ``kind``, ``frames[0]``/``frames[1]``, ``...recv()``) by a peer,
   and vice versa. Cross-checks fire only when at least two peer files are in
-  the analyzed set, so a lone fixture file is never half-judged.
+  the analyzed set, so a lone fixture file is never half-judged. Two
+  independent peer groups are matched: the in-process pool pair
+  (``process_pool.py``/``process_worker_main.py``) and the input service's
+  trio (``dispatcher.py``/``service_worker.py``/``service_client.py`` —
+  docs/service.md), each against its own kind set.
 - **shm descriptor keys**: the JSON keys ``to_bytes`` writes must equal the
   keys ``from_bytes`` reads (file: ``shm_ring.py``).
 - **sidecar keys**: the ``meta_extra`` keys ``serialize`` writes must each be
@@ -219,7 +223,13 @@ class ProtocolConformanceRule(Rule):
         if module.name in ctx.config.protocol_peer_files:
             state.setdefault('peers', {})[module.display] = \
                 extract_wire_kinds(module)
-        if module.name == 'shm_ring.py':
+        if module.name in ctx.config.service_peer_files:
+            # the input service's own peer group (dispatcher <-> service
+            # worker <-> client transport) — matched independently of the
+            # in-process pool pair, same mechanism
+            state.setdefault('service_peers', {})[module.display] = \
+                extract_wire_kinds(module)
+        if module.name in ctx.config.descriptor_files:
             findings.extend(self._check_descriptor_keys(module))
         if module.name == 'serializers.py':
             findings.extend(self._check_sidecar_keys(module))
@@ -233,31 +243,40 @@ class ProtocolConformanceRule(Rule):
     def finalize(self, ctx: AnalysisContext) -> Iterable[Finding]:
         state = ctx.rule_state(self.name)
         findings: List[Finding] = []
-        peers: Dict[str, _PeerExtraction] = state.get('peers', {})
-        if len(peers) >= 2:
-            produced: Dict[bytes, Tuple[str, int]] = {}
-            consumed: Dict[bytes, Tuple[str, int]] = {}
-            for extraction in peers.values():
-                for kind, site in extraction.produced.items():
-                    produced.setdefault(kind, site)
-                for kind, site in extraction.consumed.items():
-                    consumed.setdefault(kind, site)
-            for kind in sorted(set(produced) - set(consumed)):
-                path, line = produced[kind]
-                findings.append(Finding(
-                    self.name, path, line,
-                    'message kind {!r} is sent but no protocol peer '
-                    'dispatches on it — a consumer will drop or misroute it '
-                    '(peers: {})'.format(kind, ', '.join(sorted(peers)))))
-            for kind in sorted(set(consumed) - set(produced)):
-                path, line = consumed[kind]
-                findings.append(Finding(
-                    self.name, path, line,
-                    'message kind {!r} is dispatched on but never sent by '
-                    'any protocol peer — dead dispatch arm or a renamed '
-                    'producer (peers: {})'.format(kind,
-                                                  ', '.join(sorted(peers)))))
+        for group_key in ('peers', 'service_peers'):
+            findings.extend(self._match_peer_group(state.get(group_key, {})))
         findings.extend(self._check_quarantine_registry(ctx, state))
+        return findings
+
+    def _match_peer_group(self,
+                          peers: Dict[str, _PeerExtraction]) -> List[Finding]:
+        """Set-match one peer group's produced vs dispatched-on kinds
+        (cross-checks fire only with >= 2 peer files in the analyzed set)."""
+        findings: List[Finding] = []
+        if len(peers) < 2:
+            return findings
+        produced: Dict[bytes, Tuple[str, int]] = {}
+        consumed: Dict[bytes, Tuple[str, int]] = {}
+        for extraction in peers.values():
+            for kind, site in extraction.produced.items():
+                produced.setdefault(kind, site)
+            for kind, site in extraction.consumed.items():
+                consumed.setdefault(kind, site)
+        for kind in sorted(set(produced) - set(consumed)):
+            path, line = produced[kind]
+            findings.append(Finding(
+                self.name, path, line,
+                'message kind {!r} is sent but no protocol peer '
+                'dispatches on it — a consumer will drop or misroute it '
+                '(peers: {})'.format(kind, ', '.join(sorted(peers)))))
+        for kind in sorted(set(consumed) - set(produced)):
+            path, line = consumed[kind]
+            findings.append(Finding(
+                self.name, path, line,
+                'message kind {!r} is dispatched on but never sent by '
+                'any protocol peer — dead dispatch arm or a renamed '
+                'producer (peers: {})'.format(kind,
+                                              ', '.join(sorted(peers)))))
         return findings
 
     # --------------------------------------------------- descriptor/sidecar

@@ -14,7 +14,9 @@ JSONL event log — docs/observability.md); ``trace`` dispatches to
 read, exported as Chrome-trace/Perfetto JSON — docs/observability.md "Flight
 recorder"); ``pipecheck`` dispatches to
 :mod:`petastorm_tpu.analysis` (AST-based data-plane invariant analyzer —
-docs/static-analysis.md); ``doctor`` dispatches to
+docs/static-analysis.md); ``serve`` dispatches to
+:mod:`petastorm_tpu.service.fleet` (disaggregated input service: dispatcher +
+decode workers in one command — docs/service.md); ``doctor`` dispatches to
 :mod:`petastorm_tpu.tools.doctor` (environment health report); anything else
 is the legacy dataset-throughput measurement."""
 
@@ -47,6 +49,9 @@ def main(argv=None):
     if argv and argv[0] == 'pipecheck':
         from petastorm_tpu.analysis.cli import main as pipecheck_main
         return pipecheck_main(argv[1:])
+    if argv and argv[0] == 'serve':
+        from petastorm_tpu.service.fleet import serve as serve_main
+        return serve_main(argv[1:])
     if argv and argv[0] == 'doctor':
         from petastorm_tpu.tools.doctor import main as doctor_main
         return doctor_main(argv[1:])

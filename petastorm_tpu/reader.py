@@ -114,7 +114,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 filesystem=None, resume_state=None, reader_pool=None,
                 field_overrides=None, hdfs_driver='libhdfs', on_error='raise',
                 retry_policy=None, shm_transport=None, item_deadline_s=None,
-                heartbeat_interval_s=None, trace=None):
+                heartbeat_interval_s=None, trace=None, service_url=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -159,7 +159,16 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     :func:`~petastorm_tpu.telemetry.tracing.set_trace_enabled` (process-global,
     like the telemetry switch; workers spawned by this reader's pool inherit
     it), None (default) leaves the ``PETASTORM_TPU_TRACE`` env setting in
-    place. Export the capture with ``Reader.dump_trace()``."""
+    place. Export the capture with ``Reader.dump_trace()``.
+
+    Disaggregated input service (docs/service.md): ``service_url``
+    (``'tcp://host:port'``) points this reader at a shared preprocessing
+    fleet instead of building an in-process pool — decode runs on the
+    service's workers, results arrive over TCP (shm fast path when
+    co-located), and ``on_error`` modes, the quarantine ledger, telemetry
+    and tracing work unchanged. Pool-shape arguments are ignored (the fleet
+    defines its own shape); ``None`` (default) keeps today's in-process
+    behavior byte-identical."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -183,6 +192,12 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings, cache_format,
                         has_transform=transform_spec is not None)
+    if service_url is not None:
+        if reader_pool is not None:
+            raise ValueError('service_url and reader_pool are mutually '
+                             'exclusive — the service defines the pool')
+        from petastorm_tpu.service.service_client import ServicePool
+        reader_pool = ServicePool(service_url)
     if reader_pool is not None:
         # Pool-shape kwargs describe a pool this call is NOT building (ADVICE.md r1).
         ignored = [name for name, value, default in [
@@ -194,8 +209,12 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
             ('heartbeat_interval_s', heartbeat_interval_s, None)]
             if value != default]
         if ignored:
-            warnings.warn('reader_pool was supplied; ignoring pool-shape arguments {} '
-                          '(the pre-built pool defines its own shape)'.format(ignored))
+            warnings.warn('{} was supplied; ignoring pool-shape arguments {} '
+                          '(the {} defines its own shape)'.format(
+                              'service_url' if service_url is not None
+                              else 'reader_pool', ignored,
+                              'service fleet' if service_url is not None
+                              else 'pre-built pool'))
     pool = reader_pool if reader_pool is not None else _make_pool(
         reader_pool_type, workers_count, results_queue_size, shm_transport,
         item_deadline_s, heartbeat_interval_s)
@@ -225,12 +244,12 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       storage_options=None, filesystem=None,
                       resume_state=None, hdfs_driver='libhdfs', on_error='raise',
                       retry_policy=None, shm_transport=None, item_deadline_s=None,
-                      heartbeat_interval_s=None, trace=None):
+                      heartbeat_interval_s=None, trace=None, service_url=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
-    ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` behave exactly
-    as in :func:`make_reader`.
+    ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
+    ``service_url`` behave exactly as in :func:`make_reader`.
     """
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
@@ -253,8 +272,27 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings, cache_format,
                         has_transform=transform_spec is not None)
-    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      shm_transport, item_deadline_s, heartbeat_interval_s)
+    if service_url is not None:
+        # Pool-shape kwargs describe a pool this call is NOT building — the
+        # service fleet defines its own shape (same contract as make_reader's
+        # reader_pool warning).
+        ignored = [name for name, value, default in [
+            ('workers_count', workers_count, _DEFAULT_WORKERS_COUNT),
+            ('results_queue_size', results_queue_size, _DEFAULT_RESULTS_QUEUE_SIZE),
+            ('reader_pool_type', reader_pool_type, _DEFAULT_POOL_TYPE),
+            ('shm_transport', shm_transport, None),
+            ('item_deadline_s', item_deadline_s, None),
+            ('heartbeat_interval_s', heartbeat_interval_s, None)]
+            if value != default]
+        if ignored:
+            warnings.warn('service_url was supplied; ignoring pool-shape '
+                          'arguments {} (the service fleet defines its own '
+                          'shape)'.format(ignored))
+        from petastorm_tpu.service.service_client import ServicePool
+        pool = ServicePool(service_url)
+    else:
+        pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                          shm_transport, item_deadline_s, heartbeat_interval_s)
     return Reader(dataset_url_or_urls, handle=handle, schema=None,
                   schema_fields=schema_fields,
                   reader_pool=pool, seed=seed, shuffle_rows=shuffle_rows,

@@ -1,0 +1,38 @@
+"""Disaggregated input service: one shared preprocessing fleet serving many readers.
+
+The cross-process data plane (ZMQ ROUTER/DEALER dispatch, wire codec, watchdog,
+breakers — PRs 1-6) promoted to a standalone network service, mirroring the
+tf.data-service split (arXiv 2210.14826: "A Case for Disaggregating ML Input
+Data Processing"): decode workers and a warm Arrow-IPC rowgroup cache are
+amortized across every job reading the same dataset, and a reader joins with
+nothing but ``make_reader(..., service_url='tcp://host:port')``.
+
+Three roles (docs/service.md):
+
+- :class:`~petastorm_tpu.service.dispatcher.Dispatcher` — the broker: a ROUTER
+  front-end for N concurrent reader clients, a ROUTER back-end where elastic
+  decode workers register and heartbeat, per-client deficit-round-robin
+  fair-share scheduling over rowgroup work items, and admission control with a
+  bounded per-client in-flight window (explicit BUSY rejection).
+- :mod:`~petastorm_tpu.service.service_worker` — a stateless decode worker
+  process: wraps the existing :class:`~petastorm_tpu.reader_worker.RowGroupWorker`
+  decode path, joins/leaves the dispatcher at runtime, serves results over TCP
+  via the :mod:`~petastorm_tpu.workers.serializers` wire codec (one-shot
+  shared-memory fast path when co-located with the client), and shares one
+  :class:`~petastorm_tpu.cache.ArrowIpcDiskCache` directory with its siblings.
+- :class:`~petastorm_tpu.service.service_client.ServicePool` — the client
+  transport: implements the same pool interface as
+  :class:`~petastorm_tpu.workers.process_pool.ProcessPool`, so ``Reader``,
+  ``on_error`` resilience modes, the quarantine ledger, telemetry sidecars and
+  trace context all work unchanged over the network.
+
+:class:`~petastorm_tpu.service.fleet.ServiceFleet` runs dispatcher + N worker
+processes on one host (the ``petastorm-tpu-throughput serve`` CLI and the
+tests/bench entry point)."""
+
+from petastorm_tpu.service.dispatcher import Dispatcher, FairShareScheduler
+from petastorm_tpu.service.fleet import ServiceFleet
+from petastorm_tpu.service.service_client import ServicePool, fetch_service_state
+
+__all__ = ['Dispatcher', 'FairShareScheduler', 'ServiceFleet', 'ServicePool',
+           'fetch_service_state']

@@ -1,0 +1,949 @@
+"""The service dispatcher: fair-share broker between reader clients and decode workers.
+
+Socket topology (docs/service.md):
+
+    client DEALER  <─>  ROUTER (client endpoint, ``service_url`` port)
+    worker DEALER  <─>  ROUTER (worker endpoint, ``port + 1``)
+
+Clients ``hello``/``open`` (register + ship a dilled worker setup), then
+``submit`` rowgroup work items; workers ``register`` (a
+:class:`~petastorm_tpu.service.wire.WorkerDescriptor`), announce idleness with
+``w_ready`` and receive ``work`` assignments — the same pull-based dispatch as
+the in-process pool (``workers/process_pool.py``), so nothing ever queues in a
+dead worker's socket buffer and every assignment is attributable.
+
+Scheduling is **deficit round robin** per client
+(:class:`FairShareScheduler`): each visit tops a client's deficit up by one
+quantum and serves while the deficit covers the next item, so N clients with
+pending work split the worker fleet evenly regardless of how fast each one
+submits — the skewed-demand fairness the tf.data-service model calls for
+(arXiv 2210.14826). **Admission control** bounds each client to a fixed
+in-flight window (queued + assigned); a submit beyond it is rejected with an
+explicit ``busy`` reply the client backs off on, so one greedy reader can
+neither queue unboundedly nor starve the fleet.
+
+**Elastic workers**: workers join (``register``) and leave (``w_leave``, or
+just vanish) at any time. Liveness rides the PR-4 watchdog model: workers
+stamp ``w_heartbeat`` sequence numbers, the dispatcher detects *change*
+consumer-side (no cross-process clocks), and a worker whose stamp stalls past
+its staleness window is deregistered — its in-flight items re-enter the owning
+clients' queues (attempt-bumped, so a stale straggler ack can never retire a
+redelivered item: the exact protocol ``process_pool.py`` uses). An item
+re-queued more than ``max_item_attempts`` times fails loudly to its client
+instead of poisoning the fleet forever.
+
+The ``state`` request returns a JSON snapshot (clients, workers, queue depths,
+fair-share debts) surfaced through ``Reader.diagnostics['service']``, doctor,
+and the ``petastorm-tpu-throughput serve`` CLI."""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import pickle
+import threading
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
+
+from petastorm_tpu.service.wire import WorkerDescriptor
+
+logger = logging.getLogger(__name__)
+
+#: client-side message kinds (client ROUTER): requests up, replies/results down
+MSG_HELLO, MSG_WELCOME = b'hello', b'welcome'
+MSG_OPEN, MSG_OPENED = b'open', b'opened'
+MSG_SUBMIT, MSG_ACCEPT, MSG_BUSY = b'submit', b'accept', b'busy'
+#: submit from an identity this dispatcher does not know (restart, or a
+#: TTL-collected idle client): the client must re-``hello``/``open`` and
+#: resubmit — how an epoch survives a dispatcher restart
+MSG_REJOIN = b'rejoin'
+MSG_RESULT, MSG_RESULT_SHM, MSG_ERROR = b'result', b'result_shm', b'error'
+MSG_SHM_FAIL, MSG_BYE, MSG_STATE = b'shm_fail', b'bye', b'state'
+#: worker-side message kinds (worker ROUTER): registration/results up, work down
+MSG_REGISTER, MSG_REGISTERED = b'register', b'registered'
+MSG_W_READY, MSG_WORK, MSG_W_STOP = b'w_ready', b'work', b'w_stop'
+MSG_W_HEARTBEAT, MSG_W_RESULT, MSG_W_RESULT_SHM = (b'w_heartbeat', b'w_result',
+                                                   b'w_result_shm')
+MSG_W_DONE, MSG_W_ERROR = b'w_done', b'w_error'
+MSG_W_NEED_SETUP, MSG_W_LEAVE = b'w_need_setup', b'w_leave'
+
+#: default per-client in-flight window (queued + assigned) before ``busy``
+DEFAULT_ADMISSION_WINDOW = 16
+#: default DRR quantum (work items per scheduling visit; items are rowgroups,
+#: so unit cost is the right granularity)
+DEFAULT_QUANTUM = 1.0
+#: how long a worker's heartbeat stamp may go unchanged before it counts as
+#: departed (floored at 4x its own declared heartbeat interval, the same
+#: jitter margin the in-process watchdog enforces)
+DEFAULT_STALE_TIMEOUT_S = 15.0
+#: re-dispatch budget per work item across worker deaths — a rowgroup that
+#: kills every worker it lands on must fail loudly, not roam the fleet forever
+DEFAULT_MAX_ITEM_ATTEMPTS = 5
+#: how long a client may go completely silent (no hello/submit/shm_fail)
+#: before the dispatcher garbage-collects its record + setups — an alive
+#: client that got collected anyway simply ``rejoin``s on its next submit
+DEFAULT_CLIENT_TTL_S = 900.0
+
+
+class _ClientState(object):
+    """Dispatcher-side record of one connected reader client."""
+
+    __slots__ = ('key', 'name', 'host', 'window', 'queue', 'assigned',
+                 'deficit', 'served', 'busy_rejections', 'last_seen',
+                 'setup_ids')
+
+    def __init__(self, key: bytes, name: str, host: str, window: int,
+                 now: float) -> None:
+        self.key = key
+        self.name = name
+        self.host = host
+        self.window = window
+        self.queue: Deque[int] = collections.deque()
+        self.assigned: Set[int] = set()
+        self.deficit = 0.0
+        self.served = 0
+        self.busy_rejections = 0
+        self.last_seen = now
+        self.setup_ids: Set[bytes] = set()
+
+    def in_flight(self) -> int:
+        """Items this client currently owns inside the service."""
+        return len(self.queue) + len(self.assigned)
+
+
+class _WorkerState(object):
+    """Dispatcher-side record of one registered decode worker."""
+
+    __slots__ = ('key', 'descriptor', 'assigned', 'known_setups',
+                 'hb_seq', 'hb_changed_at')
+
+    def __init__(self, key: bytes, descriptor: WorkerDescriptor,
+                 now: float) -> None:
+        self.key = key
+        self.descriptor = descriptor
+        self.assigned: Set[int] = set()
+        self.known_setups: Set[bytes] = set()
+        self.hb_seq = -1
+        self.hb_changed_at = now
+
+
+class _TokenState(object):
+    """One submitted work item, alive until done-acked (or failed)."""
+
+    __slots__ = ('token', 'client_key', 'client_token', 'setup_id', 'blob',
+                 'attempt', 'worker_key', 'delivered', 'shm_ok')
+
+    def __init__(self, token: int, client_key: bytes, client_token: bytes,
+                 setup_id: bytes, blob: bytes) -> None:
+        self.token = token
+        self.client_key = client_key
+        self.client_token = client_token
+        self.setup_id = setup_id
+        self.blob = blob
+        self.attempt = 0
+        self.worker_key: Optional[bytes] = None
+        self.delivered = False
+        #: cleared on the first shm delivery failure (``shm_fail``): the
+        #: redelivery must ride plain wire frames — a false co-location match
+        #: (same hostname, different namespaces) would otherwise loop forever
+        self.shm_ok = True
+
+
+class Assignment(object):
+    """One scheduling decision: which worker runs which item, with everything
+    the dispatcher needs to build the ``work`` message (the setup blob is
+    attached only the first time this worker sees this setup)."""
+
+    __slots__ = ('worker_key', 'token', 'setup_id', 'blob', 'attempt',
+                 'colocated', 'setup_blob')
+
+    def __init__(self, worker_key: bytes, token: int, setup_id: bytes,
+                 blob: bytes, attempt: int, colocated: bool,
+                 setup_blob: Optional[bytes]) -> None:
+        self.worker_key = worker_key
+        self.token = token
+        self.setup_id = setup_id
+        self.blob = blob
+        self.attempt = attempt
+        self.colocated = colocated
+        self.setup_blob = setup_blob
+
+
+class FairShareScheduler(object):
+    """Socket-free scheduling core: DRR fair share, admission control, token
+    lifecycle and worker liveness — everything the dispatcher decides, none of
+    what it transports. All clocks are injected (``clock``) so the fairness
+    and staleness behavior is unit-testable deterministically."""
+
+    def __init__(self, admission_window: int = DEFAULT_ADMISSION_WINDOW,
+                 quantum: float = DEFAULT_QUANTUM,
+                 stale_timeout_s: float = DEFAULT_STALE_TIMEOUT_S,
+                 max_item_attempts: int = DEFAULT_MAX_ITEM_ATTEMPTS,
+                 item_deadline_s: Optional[float] = None,
+                 client_ttl_s: float = DEFAULT_CLIENT_TTL_S,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if quantum <= 0:
+            raise ValueError('quantum must be > 0, got {!r}'.format(quantum))
+        if admission_window < 1:
+            raise ValueError('admission_window must be >= 1')
+        self.admission_window = admission_window
+        self.quantum = quantum
+        self.stale_timeout_s = stale_timeout_s
+        self.max_item_attempts = max_item_attempts
+        #: optional per-item wall-clock budget (the service-side analog of the
+        #: pool's ``item_deadline_s`` watchdog): a worker holding one item
+        #: longer is treated exactly like a stale-heartbeat worker — its
+        #: heartbeat thread keeps stamping through a wedged decode, so
+        #: liveness alone cannot see a hung item
+        self.item_deadline_s = item_deadline_s
+        self.client_ttl_s = client_ttl_s
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._clients: Dict[bytes, _ClientState] = {}
+        self._workers: Dict[bytes, _WorkerState] = {}
+        self._worker_id_index: Dict[int, bytes] = {}
+        self._tokens: Dict[int, _TokenState] = {}
+        self._next_token = 0
+        self._active: Deque[bytes] = collections.deque()  # clients w/ queued work
+        self._ready_workers: Deque[bytes] = collections.deque()
+        self._setups: Dict[bytes, bytes] = {}
+        self._assign_time: Dict[int, float] = {}
+        # ----------------------------------------------------- aggregates
+        self.busy_rejections = 0
+        self.results_dropped = 0
+        self.items_requeued = 0
+        self.items_failed = 0
+        self.workers_registered_total = 0
+        self.workers_departed = 0
+
+    # ------------------------------------------------------------- clients
+
+    def add_client(self, key: bytes, name: str, host: str,
+                   window: Optional[int] = None) -> int:
+        """Register (or re-register) a client; returns its effective window."""
+        with self._lock:
+            effective = min(window or self.admission_window,
+                            self.admission_window)
+            self._clients[key] = _ClientState(key, name, host, effective,
+                                              self._clock())
+            return effective
+
+    def has_client(self, key: bytes) -> bool:
+        """True when ``key`` is a registered client. A submit from an
+        unregistered identity (dispatcher restart, or a TTL-collected idle
+        client) gets a ``rejoin`` reply instead of a misleading ``busy``."""
+        with self._lock:
+            return key in self._clients
+
+    def remove_client(self, key: bytes) -> None:
+        """Drop a departed client: its queued items and setups die, its
+        assigned items finish on the workers and their results are dropped
+        on delivery."""
+        with self._lock:
+            client = self._clients.pop(key, None)
+            if client is None:
+                return
+            for token in client.queue:
+                self._tokens.pop(token, None)
+            for setup_id in client.setup_ids:
+                self._setups.pop(setup_id, None)
+            try:
+                self._active.remove(key)
+            except ValueError:
+                pass
+
+    def expired_clients(self) -> List[bytes]:
+        """Clients silent past ``client_ttl_s`` with nothing in flight —
+        garbage for the caller to :meth:`remove_client` (a live client that
+        gets collected anyway just ``rejoin``s on its next submit)."""
+        with self._lock:
+            now = self._clock()
+            return [key for key, client in self._clients.items()
+                    if not client.in_flight()
+                    and now - client.last_seen > self.client_ttl_s]
+
+    def add_setup(self, client_key: bytes, setup_id: bytes,
+                  blob: bytes) -> None:
+        """Store a client's dilled worker setup for lazy per-worker shipping
+        (owned by the client — collected with it)."""
+        with self._lock:
+            self._setups[setup_id] = blob
+            client = self._clients.get(client_key)
+            if client is not None:
+                client.setup_ids.add(setup_id)
+                client.last_seen = self._clock()
+
+    def submit(self, client_key: bytes, client_token: bytes, setup_id: bytes,
+               blob: bytes) -> Optional[int]:
+        """Admission-checked submit: returns the global token, or None when
+        the client's window is full (the caller replies ``busy``)."""
+        with self._lock:
+            client = self._clients.get(client_key)
+            if client is None:
+                return None
+            client.last_seen = self._clock()
+            if client.in_flight() >= client.window:
+                client.busy_rejections += 1
+                self.busy_rejections += 1
+                return None
+            token = self._next_token
+            self._next_token += 1
+            self._tokens[token] = _TokenState(token, client_key, client_token,
+                                              setup_id, blob)
+            client.queue.append(token)
+            if client.key not in self._active:
+                self._active.append(client.key)
+            return token
+
+    # ------------------------------------------------------------- workers
+
+    def add_worker(self, key: bytes, descriptor: WorkerDescriptor) -> None:
+        """Register a worker (elastic join — any time, including mid-epoch).
+        Idempotent per identity: a re-sent ``register`` (slow-ack retry) must
+        neither reset the worker's assignment record nor double-count it."""
+        with self._lock:
+            if key in self._workers:
+                return
+            self._workers[key] = _WorkerState(key, descriptor, self._clock())
+            self._worker_id_index[descriptor.worker_id] = key
+            self.workers_registered_total += 1
+
+    def remove_worker(self, key: bytes) -> List[Tuple[int, bytes, bytes]]:
+        """Deregister a worker (leave, or reaped as stale) and re-queue its
+        in-flight items at the FRONT of their owners' queues (oldest work
+        first, same as the pool's respawn path). Returns the items that
+        exhausted their attempt budget as ``(token, client_key,
+        client_token)`` — the caller fails those loudly to their clients."""
+        failed: List[Tuple[int, bytes, bytes]] = []
+        with self._lock:
+            worker = self._workers.pop(key, None)
+            if worker is None:
+                return failed
+            if self._worker_id_index.get(worker.descriptor.worker_id) == key:
+                del self._worker_id_index[worker.descriptor.worker_id]
+            try:
+                self._ready_workers.remove(key)
+            except ValueError:
+                pass
+            self.workers_departed += 1
+            for token in sorted(worker.assigned):
+                state = self._tokens.get(token)
+                self._assign_time.pop(token, None)
+                if state is None:
+                    continue
+                state.worker_key = None
+                # a stale ack from the departed worker can never retire the
+                # redelivered attempt (echoed-attempt protocol, process_pool.py)
+                state.attempt += 1
+                if state.attempt >= self.max_item_attempts:
+                    del self._tokens[token]
+                    client = self._clients.get(state.client_key)
+                    if client is not None:
+                        client.assigned.discard(token)
+                    self.items_failed += 1
+                    failed.append((token, state.client_key,
+                                   state.client_token))
+                    continue
+                client = self._clients.get(state.client_key)
+                if client is None:
+                    del self._tokens[token]
+                    continue
+                client.assigned.discard(token)
+                client.queue.appendleft(token)
+                if client.key not in self._active:
+                    # oldest work first: schedule this client ahead of the
+                    # regular rotation
+                    self._active.appendleft(client.key)
+                self.items_requeued += 1
+        return failed
+
+    def worker_ready(self, key: bytes) -> None:
+        """A worker announced itself idle; it may receive one assignment."""
+        with self._lock:
+            if key in self._workers and key not in self._ready_workers:
+                self._ready_workers.append(key)
+
+    def heartbeat(self, worker_id: int, seq: int) -> None:
+        """Record a worker's liveness stamp (change-detected on our clock —
+        no cross-process clock comparison, the PR-4 discipline)."""
+        with self._lock:
+            key = self._worker_id_index.get(worker_id)
+            worker = self._workers.get(key) if key is not None else None
+            if worker is not None and worker.hb_seq != seq:
+                worker.hb_seq = seq
+                worker.hb_changed_at = self._clock()
+
+    def stale_workers(self) -> List[bytes]:
+        """Workers to reap: heartbeat stamp unchanged past the staleness
+        window (departed or process-wide wedged), or — when an
+        ``item_deadline_s`` is set — holding an item past its wall-clock
+        budget (a wedged *decode* keeps heartbeating from its independent
+        stamp thread, so item progress needs its own detector, exactly as in
+        the in-process pool's two-detector watchdog). The caller removes
+        them; re-queue + the attempt budget take it from there."""
+        with self._lock:
+            now = self._clock()
+            stale = []
+            for key, worker in self._workers.items():
+                interval = worker.descriptor.heartbeat_interval_s or 0.0
+                window = max(self.stale_timeout_s, 4 * interval)
+                if now - worker.hb_changed_at > window:
+                    stale.append(key)
+                    continue
+                if self.item_deadline_s is not None and any(
+                        now - self._assign_time.get(token, now)
+                        > self.item_deadline_s
+                        for token in worker.assigned):
+                    stale.append(key)
+            return stale
+
+    # ----------------------------------------------------------- scheduling
+
+    def next_assignment(self) -> Optional[Assignment]:
+        """One DRR scheduling step: pick the next (client, item) pair and a
+        ready worker for it, or None when either side is empty.
+
+        Each visit to the head-of-rotation client serves it if its deficit
+        covers one item, else tops the deficit up by ``quantum`` and rotates —
+        so over any window, every client with pending work is served in
+        proportion to its quantum, regardless of submit rate (deficit round
+        robin with unit item cost)."""
+        with self._lock:
+            if not self._ready_workers:
+                return None
+            guard = 2 * len(self._active) + 1
+            while self._active and guard > 0:
+                guard -= 1
+                key = self._active[0]
+                client = self._clients.get(key)
+                if client is None or not client.queue:
+                    self._active.popleft()
+                    if client is not None:
+                        client.deficit = 0.0
+                    continue
+                if client.deficit < 1.0:
+                    client.deficit += self.quantum
+                    if client.deficit < 1.0:
+                        self._active.rotate(-1)
+                        continue
+                worker_key = self._pick_worker()
+                if worker_key is None:
+                    return None
+                client.deficit -= 1.0
+                token = client.queue.popleft()
+                if not client.queue:
+                    self._active.popleft()
+                    client.deficit = 0.0
+                else:
+                    self._active.rotate(-1)
+                state = self._tokens.get(token)
+                if state is None:  # superseded while queued
+                    self._ready_workers.appendleft(worker_key)
+                    continue
+                worker = self._workers[worker_key]
+                state.worker_key = worker_key
+                worker.assigned.add(token)
+                client.assigned.add(token)
+                self._assign_time[token] = self._clock()
+                colocated = (worker.descriptor.shm_results
+                             and worker.descriptor.host == client.host
+                             and state.shm_ok)
+                setup_blob: Optional[bytes] = None
+                if state.setup_id not in worker.known_setups:
+                    setup_blob = self._setups.get(state.setup_id)
+                    if setup_blob is not None:
+                        # only a SHIPPED setup counts as known — a missing
+                        # blob must keep triggering w_need_setup until the
+                        # attempt budget fails the item loudly
+                        worker.known_setups.add(state.setup_id)
+                return Assignment(worker_key, token, state.setup_id,
+                                  state.blob, state.attempt, colocated,
+                                  setup_blob)
+            return None
+
+    def _pick_worker(self) -> Optional[bytes]:
+        while self._ready_workers:
+            key = self._ready_workers.popleft()
+            if key in self._workers:
+                return key
+        return None
+
+    def _bump_or_requeue(self, token: int) -> Optional[Tuple[int, bytes,
+                                                             bytes]]:
+        """Shared re-delivery path (worker lacked the setup, client lost a
+        shm segment): bump the attempt and re-queue at the front — or, once
+        the attempt budget is spent, retire the item and return ``(token,
+        client_key, client_token)`` for the caller to fail loudly. Called
+        under ``_lock``."""
+        state = self._tokens.get(token)
+        if state is None:
+            return None
+        state.worker_key = None
+        state.delivered = False
+        state.attempt += 1
+        self._assign_time.pop(token, None)
+        client = self._clients.get(state.client_key)
+        if client is None:
+            del self._tokens[token]
+            return None
+        if state.attempt >= self.max_item_attempts:
+            del self._tokens[token]
+            client.assigned.discard(token)
+            self.items_failed += 1
+            return (token, state.client_key, state.client_token)
+        client.assigned.discard(token)
+        if token not in client.queue:
+            client.queue.appendleft(token)
+            if client.key not in self._active:
+                self._active.appendleft(client.key)
+        self.items_requeued += 1
+        return None
+
+    def forget_setups(self, worker_key: bytes,
+                      token: int) -> Optional[Tuple[int, bytes, bytes]]:
+        """A worker reported it lacks a setup the dispatcher believed it had
+        (``w_need_setup`` — e.g. the blob raced its registration reset):
+        clear its record and re-queue the item so the next dispatch re-ships
+        it. Returns the failure route once the item's attempt budget is
+        spent (a setup that can never be shipped must fail loudly, not spin
+        between dispatcher and worker forever)."""
+        with self._lock:
+            worker = self._workers.get(worker_key)
+            if worker is not None:
+                worker.known_setups.clear()
+                worker.assigned.discard(token)
+            return self._bump_or_requeue(token)
+
+    # --------------------------------------------------------- result flow
+
+    def result_route(self, token: int) -> Optional[Tuple[bytes, bytes]]:
+        """Where to forward a worker result: ``(client_key, client_token)``,
+        or None when the token is retired/superseded (duplicate from a
+        re-dispatched item whose first result already went out — dropped and
+        counted, exactly like the pool's ``results_dropped``)."""
+        with self._lock:
+            state = self._tokens.get(token)
+            if state is None or state.delivered:
+                self.results_dropped += 1
+                return None
+            if self._clients.get(state.client_key) is None:
+                self.results_dropped += 1
+                return None
+            state.delivered = True
+            return state.client_key, state.client_token
+
+    def retire(self, token: int, attempt: Optional[int]) -> None:
+        """A ``w_done`` ack: retire the item iff the echoed attempt is
+        current (a stale ack from a since-removed worker must neither retire
+        an undelivered redelivery nor double-retire one)."""
+        with self._lock:
+            state = self._tokens.get(token)
+            if state is None:
+                return
+            if attempt is not None and attempt != state.attempt:
+                return
+            del self._tokens[token]
+            self._assign_time.pop(token, None)
+            client = self._clients.get(state.client_key)
+            if client is not None:
+                client.assigned.discard(token)
+                client.served += 1
+            if state.worker_key is not None:
+                worker = self._workers.get(state.worker_key)
+                if worker is not None:
+                    worker.assigned.discard(token)
+
+    def fail(self, token: int) -> Optional[Tuple[bytes, bytes]]:
+        """Terminal worker error for an item: retire it and return the owning
+        ``(client_key, client_token)`` to forward the error to."""
+        with self._lock:
+            state = self._tokens.pop(token, None)
+            self._assign_time.pop(token, None)
+            if state is None:
+                return None
+            client = self._clients.get(state.client_key)
+            if client is not None:
+                client.assigned.discard(token)
+            if state.worker_key is not None:
+                worker = self._workers.get(state.worker_key)
+                if worker is not None:
+                    worker.assigned.discard(token)
+            if client is None:
+                return None
+            return state.client_key, state.client_token
+
+    def requeue_token(self, token: int) -> Optional[Tuple[int, bytes, bytes]]:
+        """Client-requested redelivery (``shm_fail``: it could not attach or
+        verify a co-located segment) — put the item back at the front of its
+        queue, pinned to the plain-wire transport from now on (a false
+        co-location match must converge to TCP, not loop). Returns the
+        failure route once the attempt budget is spent."""
+        with self._lock:
+            state = self._tokens.get(token)
+            if state is None:
+                return None
+            state.shm_ok = False
+            if state.worker_key is not None:
+                worker = self._workers.get(state.worker_key)
+                if worker is not None:
+                    worker.assigned.discard(token)
+            return self._bump_or_requeue(token)
+
+    # ------------------------------------------------------------ snapshot
+
+    def worker_count(self) -> int:
+        """Currently-registered decode workers."""
+        with self._lock:
+            return len(self._workers)
+
+    def worker_keys(self) -> List[bytes]:
+        """Identities of every registered worker (stop-broadcast routing)."""
+        with self._lock:
+            return list(self._workers)
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-safe snapshot: clients (queue depth / in-flight / served /
+        fair-share debt), workers (assigned / heartbeat age), and the
+        aggregate admission + requeue counters — the ``state`` reply body."""
+        with self._lock:
+            now = self._clock()
+            return {
+                'workers': [{
+                    'worker_id': w.descriptor.worker_id,
+                    'pid': w.descriptor.pid,
+                    'host': w.descriptor.host,
+                    'shm_results': w.descriptor.shm_results,
+                    'assigned': len(w.assigned),
+                    'heartbeat_age_s': round(now - w.hb_changed_at, 3),
+                } for w in self._workers.values()],
+                'clients': [{
+                    'name': c.name,
+                    'host': c.host,
+                    'window': c.window,
+                    'queued': len(c.queue),
+                    'in_flight': c.in_flight(),
+                    'served': c.served,
+                    'deficit': round(c.deficit, 3),
+                    'busy_rejections': c.busy_rejections,
+                } for c in self._clients.values()],
+                'queue_depth': sum(len(c.queue)
+                                   for c in self._clients.values()),
+                'in_flight': len(self._tokens),
+                'ready_workers': len(self._ready_workers),
+                'busy_rejections': self.busy_rejections,
+                'results_dropped': self.results_dropped,
+                'items_requeued': self.items_requeued,
+                'items_failed': self.items_failed,
+                'workers_registered_total': self.workers_registered_total,
+                'workers_departed': self.workers_departed,
+            }
+
+
+class Dispatcher(object):
+    """ZMQ front of the scheduler: binds the client + worker ROUTERs, pumps
+    messages on a daemon thread, and translates scheduler decisions into
+    ``work`` sends. All socket use stays on the dispatcher thread (ROUTER
+    sends are not thread-safe); :meth:`state` reads the scheduler snapshot
+    under its own lock from any thread."""
+
+    def __init__(self, host: str = '127.0.0.1', port: Optional[int] = None,
+                 admission_window: int = DEFAULT_ADMISSION_WINDOW,
+                 quantum: float = DEFAULT_QUANTUM,
+                 stale_timeout_s: float = DEFAULT_STALE_TIMEOUT_S,
+                 max_item_attempts: int = DEFAULT_MAX_ITEM_ATTEMPTS,
+                 item_deadline_s: Optional[float] = None,
+                 client_ttl_s: float = DEFAULT_CLIENT_TTL_S) -> None:
+        self._host = host
+        self._port = port
+        self.scheduler = FairShareScheduler(
+            admission_window=admission_window, quantum=quantum,
+            stale_timeout_s=stale_timeout_s,
+            max_item_attempts=max_item_attempts,
+            item_deadline_s=item_deadline_s, client_ttl_s=client_ttl_s)
+        self._context: Any = None
+        self._client_socket: Any = None
+        self._worker_socket: Any = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        self._next_stale_check = 0.0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> str:
+        """Bind both ROUTERs and start the pump thread; returns the
+        ``service_url`` clients connect to."""
+        import zmq
+        from petastorm_tpu.service.wire import WORKER_PORT_OFFSET
+        self._context = zmq.Context()
+        self._client_socket = self._context.socket(zmq.ROUTER)
+        self._worker_socket = self._context.socket(zmq.ROUTER)
+        if self._port is not None:
+            self._client_socket.bind('tcp://{}:{}'.format(self._host,
+                                                          self._port))
+            self._worker_socket.bind('tcp://{}:{}'.format(
+                self._host, self._port + WORKER_PORT_OFFSET))
+        else:
+            # adjacent-port pair from the ephemeral range: retry until a port
+            # P with P+1 also free is found (bounded — ranges are sparse)
+            last_error: Optional[Exception] = None
+            for _ in range(32):
+                port = self._client_socket.bind_to_random_port(
+                    'tcp://{}'.format(self._host))
+                try:
+                    self._worker_socket.bind('tcp://{}:{}'.format(
+                        self._host, port + WORKER_PORT_OFFSET))
+                    self._port = port
+                    break
+                except zmq.ZMQError as exc:
+                    last_error = exc
+                    self._client_socket.unbind('tcp://{}:{}'.format(
+                        self._host, port))
+            else:
+                raise RuntimeError('could not find an adjacent free port '
+                                   'pair: {!r}'.format(last_error))
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name='petastorm-tpu-dispatcher')
+        self._thread.start()
+        return self.service_url
+
+    @property
+    def service_url(self) -> str:
+        """The URL readers pass as ``make_reader(service_url=...)``."""
+        return 'tcp://{}:{}'.format(self._host, self._port)
+
+    def state(self) -> Dict[str, Any]:
+        """The scheduler snapshot (same dict the ``state`` request returns)."""
+        return self.scheduler.state()
+
+    def stop(self) -> None:
+        """Stop the pump thread; ``w_stop`` is broadcast to registered
+        workers from the pump thread on its way out."""
+        self._stop_event.set()
+
+    def join(self, timeout: float = 10.0) -> None:
+        """Wait for the pump thread and release the sockets."""
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        if self._context is not None:
+            for sock in (self._client_socket, self._worker_socket):
+                if sock is not None:
+                    sock.close(linger=0)
+            self._context.term()
+            self._context = None
+
+    # ----------------------------------------------------------------- pump
+
+    def _pump(self) -> None:
+        import zmq
+        poller = zmq.Poller()
+        poller.register(self._client_socket, zmq.POLLIN)
+        poller.register(self._worker_socket, zmq.POLLIN)
+        while not self._stop_event.is_set():
+            events = dict(poller.poll(100))
+            if self._client_socket in events:
+                for _ in range(64):  # drain a bounded burst per tick
+                    try:
+                        frames = self._client_socket.recv_multipart(
+                            zmq.NOBLOCK)
+                    except zmq.Again:
+                        break
+                    try:
+                        self._handle_client(frames)
+                    except Exception:  # noqa: BLE001 - one malformed client frame must not take the whole service down
+                        logger.exception('dispatcher: dropping malformed '
+                                         'client message')
+            if self._worker_socket in events:
+                for _ in range(64):
+                    try:
+                        frames = self._worker_socket.recv_multipart(
+                            zmq.NOBLOCK)
+                    except zmq.Again:
+                        break
+                    try:
+                        self._handle_worker(frames)
+                    except Exception:  # noqa: BLE001 - one malformed worker frame must not take the whole service down
+                        logger.exception('dispatcher: dropping malformed '
+                                         'worker message')
+            self._check_stale()
+            self._dispatch_ready()
+        self._broadcast_stop()
+
+    def _broadcast_stop(self) -> None:
+        for key in self.scheduler.worker_keys():
+            try:
+                self._worker_socket.send_multipart([key, MSG_W_STOP])
+            except Exception:  # noqa: BLE001 - shutdown is best-effort; the workers' parent watchdog is the backstop
+                pass
+
+    # -------------------------------------------------------- client frames
+
+    def _handle_client(self, frames: List[bytes]) -> None:
+        if len(frames) < 2:
+            return
+        identity = frames[0]
+        kind = bytes(frames[1])
+        if kind == MSG_SUBMIT and len(frames) >= 5:
+            if not self.scheduler.has_client(identity):
+                # restart / TTL-collected idle client: busy would be a lie
+                # (the client would back off forever) — tell it to rejoin
+                self._client_socket.send_multipart(
+                    [identity, MSG_REJOIN, frames[2]])
+                return
+            token = self.scheduler.submit(identity, bytes(frames[2]),
+                                          bytes(frames[3]), frames[4])
+            if token is None:
+                self._client_socket.send_multipart(
+                    [identity, MSG_BUSY, frames[2]])
+            else:
+                self._client_socket.send_multipart(
+                    [identity, MSG_ACCEPT, frames[2]])
+            return
+        if kind == MSG_HELLO and len(frames) >= 5:
+            name = bytes(frames[2]).decode('utf-8', 'replace')
+            host = bytes(frames[3]).decode('utf-8', 'replace')
+            window = int(bytes(frames[4]))
+            effective = self.scheduler.add_client(identity, name, host,
+                                                  window or None)
+            body = json.dumps({
+                'workers': self.scheduler.worker_count(),
+                'window': effective,
+                'host': self._host,
+            }).encode('utf-8')
+            self._client_socket.send_multipart([identity, MSG_WELCOME, body])
+            return
+        if kind == MSG_OPEN and len(frames) >= 4:
+            self.scheduler.add_setup(identity, bytes(frames[2]), frames[3])
+            self._client_socket.send_multipart(
+                [identity, MSG_OPENED, frames[2]])
+            return
+        if kind == MSG_STATE:
+            body = json.dumps(self.scheduler.state()).encode('utf-8')
+            self._client_socket.send_multipart([identity, MSG_STATE, body])
+            return
+        if kind == MSG_SHM_FAIL and len(frames) >= 3:
+            # the client could not attach a co-located segment — redeliver
+            # (wire-pinned); past the attempt budget, fail it loudly
+            failed = self.scheduler.requeue_token(int(bytes(frames[2])))
+            if failed is not None:
+                self._send_attempt_exhausted(failed[1], failed[2])
+            return
+        if kind == MSG_BYE:
+            self.scheduler.remove_client(identity)
+            return
+        logger.debug('dispatcher: unknown client message kind %r', kind)
+
+    # -------------------------------------------------------- worker frames
+
+    def _handle_worker(self, frames: List[bytes]) -> None:
+        if len(frames) < 2:
+            return
+        identity = frames[0]
+        kind = bytes(frames[1])
+        if kind == MSG_W_HEARTBEAT and len(frames) >= 4:
+            self.scheduler.heartbeat(int(bytes(frames[2])),
+                                     int(bytes(frames[3])))
+            return
+        if kind == MSG_W_RESULT and len(frames) >= 4:
+            token = int(bytes(frames[2]))
+            route = self.scheduler.result_route(token)
+            if route is not None:
+                client_key, client_token = route
+                self._client_socket.send_multipart(
+                    [client_key, MSG_RESULT, client_token] + frames[4:])
+            return
+        if kind == MSG_W_RESULT_SHM and len(frames) >= 5:
+            token = int(bytes(frames[2]))
+            route = self.scheduler.result_route(token)
+            if route is not None:
+                client_key, client_token = route
+                self._client_socket.send_multipart(
+                    [client_key, MSG_RESULT_SHM, client_token, frames[4]])
+            return
+        if kind == MSG_W_DONE and len(frames) >= 4:
+            self.scheduler.retire(int(bytes(frames[2])),
+                                  int(bytes(frames[3])))
+            return
+        if kind == MSG_W_ERROR and len(frames) >= 5:
+            route = self.scheduler.fail(int(bytes(frames[2])))
+            if route is not None:
+                client_key, client_token = route
+                self._client_socket.send_multipart(
+                    [client_key, MSG_ERROR, client_token, frames[4]])
+            return
+        if kind == MSG_W_READY:
+            self.scheduler.worker_ready(identity)
+            return
+        if kind == MSG_REGISTER and len(frames) >= 3:
+            descriptor = WorkerDescriptor.from_bytes(bytes(frames[2]))
+            self.scheduler.add_worker(identity, descriptor)
+            logger.info('dispatcher: worker %d (pid %d, host %s) registered',
+                        descriptor.worker_id, descriptor.pid, descriptor.host)
+            self._worker_socket.send_multipart([identity, MSG_REGISTERED])
+            return
+        if kind == MSG_W_NEED_SETUP and len(frames) >= 3:
+            failed = self.scheduler.forget_setups(identity,
+                                                  int(bytes(frames[2])))
+            if failed is not None:
+                self._send_attempt_exhausted(failed[1], failed[2])
+            return
+        if kind == MSG_W_LEAVE:
+            self._depart_worker(identity, reason='left')
+            return
+        logger.debug('dispatcher: unknown worker message kind %r', kind)
+
+    # ------------------------------------------------------------ decisions
+
+    def _send_attempt_exhausted(self, client_key: bytes,
+                                client_token: bytes) -> None:
+        """Fail one item loudly to its owning client: the item burned its
+        whole re-delivery budget (worker deaths, unshippable setup, lost shm
+        segments) and re-queuing it again would only poison the fleet."""
+        from petastorm_tpu.errors import TransientIOError
+        blob = pickle.dumps((
+            TransientIOError(
+                'work item re-dispatched {} times across service worker '
+                'failures; giving up'.format(
+                    self.scheduler.max_item_attempts)),
+            'service dispatcher: attempt budget exhausted'))
+        self._client_socket.send_multipart(
+            [client_key, MSG_ERROR, client_token, blob])
+
+    def _depart_worker(self, key: bytes, reason: str) -> None:
+        failed = self.scheduler.remove_worker(key)
+        if failed:
+            logger.error('dispatcher: %d item(s) exhausted their attempt '
+                         'budget when worker %s (%s)', len(failed),
+                         key.hex(), reason)
+        for _token, client_key, client_token in failed:
+            self._send_attempt_exhausted(client_key, client_token)
+
+    def _check_stale(self) -> None:
+        now = time.monotonic()
+        if now < self._next_stale_check:
+            return
+        self._next_stale_check = now + 0.5
+        for key in self.scheduler.stale_workers():
+            logger.warning('dispatcher: worker %s heartbeat went stale (or '
+                           'an item passed its deadline); deregistering and '
+                           're-queuing its items', key.hex())
+            self._depart_worker(key, reason='went stale')
+        for key in self.scheduler.expired_clients():
+            logger.info('dispatcher: collecting idle client %s (silent past '
+                        'the %gs TTL)', key.hex(),
+                        self.scheduler.client_ttl_s)
+            self.scheduler.remove_client(key)
+
+    def _dispatch_ready(self) -> None:
+        while True:
+            assignment = self.scheduler.next_assignment()
+            if assignment is None:
+                return
+            self._worker_socket.send_multipart([
+                assignment.worker_key, MSG_WORK,
+                b'%d' % assignment.token, assignment.setup_id,
+                assignment.blob, b'%d' % assignment.attempt,
+                b'1' if assignment.colocated else b'0',
+                assignment.setup_blob if assignment.setup_blob is not None
+                else b''])

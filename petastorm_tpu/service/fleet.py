@@ -1,0 +1,250 @@
+"""Single-host service runner: one dispatcher plus N spawned decode workers.
+
+:class:`ServiceFleet` is how the service is actually started — by the
+``petastorm-tpu-throughput serve`` CLI, by ``bench.py``'s service section and
+by the tests: it runs a :class:`~petastorm_tpu.service.dispatcher.Dispatcher`
+in-process (a daemon thread) and spawns each worker as a fresh interpreter
+running :mod:`petastorm_tpu.service.service_worker` (spawn, never fork — the
+same JVM/libhdfs rationale as the in-process pool), all sharing one cache
+directory. Workers are *elastic*: :meth:`spawn_worker` adds one at any time
+(it registers with the live dispatcher), :meth:`kill_worker` SIGKILLs one
+(the dispatcher's heartbeat watchdog deregisters it and re-queues its
+items) — the join/leave choreography the tests drive explicitly.
+
+A multi-host deployment runs the same two entry points by hand: one
+``serve --workers 0`` for the dispatcher, and ``service_worker`` processes
+pointed at its URL from every decode host (docs/service.md's deployment
+matrix)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from petastorm_tpu.service.dispatcher import (DEFAULT_ADMISSION_WINDOW,
+                                              DEFAULT_CLIENT_TTL_S,
+                                              DEFAULT_MAX_ITEM_ATTEMPTS,
+                                              DEFAULT_QUANTUM,
+                                              DEFAULT_STALE_TIMEOUT_S,
+                                              Dispatcher)
+from petastorm_tpu.service.wire import worker_endpoint
+
+logger = logging.getLogger(__name__)
+
+#: how long ``start`` waits for the initial workers to register
+_WORKER_STARTUP_TIMEOUT_S = 60
+
+
+class ServiceFleet(object):
+    """Dispatcher + N service-worker processes on this host (module doc).
+
+    ``cache_dir`` (created when missing) is shared by every worker — the
+    fleet-wide warm Arrow-IPC rowgroup cache; None disables the shared cache
+    and each client's own cache setting applies. ``shm_results`` enables the
+    one-shot shared-memory result path for co-located clients."""
+
+    def __init__(self, workers: int = 2, host: str = '127.0.0.1',
+                 port: Optional[int] = None,
+                 cache_dir: Optional[str] = None,
+                 cache_size_limit: Optional[int] = None,
+                 shm_results: bool = True,
+                 heartbeat_interval_s: float = 0.5,
+                 stale_timeout_s: float = DEFAULT_STALE_TIMEOUT_S,
+                 admission_window: int = DEFAULT_ADMISSION_WINDOW,
+                 quantum: float = DEFAULT_QUANTUM,
+                 max_item_attempts: int = DEFAULT_MAX_ITEM_ATTEMPTS,
+                 item_deadline_s: Optional[float] = None,
+                 client_ttl_s: float = DEFAULT_CLIENT_TTL_S) -> None:
+        self._initial_workers = workers
+        self._cache_dir = cache_dir
+        self._cache_size_limit = cache_size_limit
+        self._shm_results = shm_results
+        self._heartbeat_interval_s = heartbeat_interval_s
+        self.dispatcher = Dispatcher(
+            host=host, port=port, admission_window=admission_window,
+            quantum=quantum, stale_timeout_s=stale_timeout_s,
+            max_item_attempts=max_item_attempts,
+            item_deadline_s=item_deadline_s, client_ttl_s=client_ttl_s)
+        self.processes: List[subprocess.Popen] = []
+        self._next_worker_id = 0
+        self.service_url: Optional[str] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> str:
+        """Start the dispatcher and the initial workers; blocks until every
+        initial worker has registered. Returns the ``service_url``."""
+        self.service_url = self.dispatcher.start()
+        if self._cache_dir:
+            os.makedirs(self._cache_dir, exist_ok=True)
+        for _ in range(self._initial_workers):
+            self.spawn_worker()
+        deadline = time.monotonic() + _WORKER_STARTUP_TIMEOUT_S
+        while (self.dispatcher.scheduler.worker_count()
+               < self._initial_workers):
+            if time.monotonic() > deadline:
+                self.stop()
+                raise RuntimeError(
+                    'only {} of {} service workers registered within {}s'
+                    .format(self.dispatcher.scheduler.worker_count(),
+                            self._initial_workers,
+                            _WORKER_STARTUP_TIMEOUT_S))
+            time.sleep(0.05)
+        return self.service_url
+
+    def spawn_worker(self) -> subprocess.Popen:
+        """Spawn one decode worker (elastic join — works mid-epoch; it
+        registers with the dispatcher on its own)."""
+        if self.service_url is None:
+            raise RuntimeError('start() the fleet before spawning workers')
+        worker_id = self._next_worker_id
+        self._next_worker_id += 1
+        bootstrap: Dict[str, Any] = {
+            'worker_id': worker_id,
+            'worker_endpoint': worker_endpoint(self.service_url),
+            'heartbeat_interval_s': self._heartbeat_interval_s,
+            'shm_results': self._shm_results,
+            'parent_pid': os.getpid(),
+            'cache_dir': self._cache_dir,
+            'cache_size_limit': self._cache_size_limit,
+        }
+        fd, path = tempfile.mkstemp(suffix='.petastorm-tpu-service-worker')
+        with os.fdopen(fd, 'wb') as f:
+            pickle.dump(bootstrap, f)
+        env = dict(os.environ)
+        parent_paths = [p for p in sys.path if p]
+        existing = env.get('PYTHONPATH')
+        env['PYTHONPATH'] = os.pathsep.join(
+            parent_paths + ([existing] if existing else []))
+        process = subprocess.Popen(
+            [sys.executable, '-m', 'petastorm_tpu.service.service_worker',
+             path], env=env)
+        self.processes.append(process)
+        return process
+
+    def kill_worker(self, index: int = -1) -> int:
+        """SIGKILL one worker process (crash injection for the tests); the
+        dispatcher's staleness watchdog deregisters it and re-queues its
+        in-flight items. Returns the killed pid."""
+        process = self.processes[index]
+        process.kill()
+        process.wait(timeout=10)
+        return process.pid
+
+    def state(self) -> Dict[str, Any]:
+        """The dispatcher's scheduler snapshot (clients/workers/queues)."""
+        return self.dispatcher.state()
+
+    def stop(self) -> None:
+        """Stop the dispatcher (it broadcasts ``w_stop``) and reap the
+        worker processes — SIGTERM, then SIGKILL, for any worker that missed
+        the broadcast (e.g. one spawned moments before stop that never
+        finished registering)."""
+        self.dispatcher.stop()
+        self.dispatcher.join()
+        deadline = time.monotonic() + 5
+        for process in self.processes:
+            while process.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if process.poll() is None:
+                logger.info('service worker (pid %d) missed the stop '
+                            'broadcast; terminating it', process.pid)
+                process.terminate()
+                try:
+                    process.wait(timeout=2)
+                except subprocess.TimeoutExpired:
+                    logger.warning('service worker (pid %d) survived '
+                                   'SIGTERM; sending SIGKILL', process.pid)
+                    process.kill()
+                    try:
+                        process.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        logger.error('service worker (pid %d) is unreaped '
+                                     'after SIGKILL; abandoning it as a '
+                                     'zombie', process.pid)
+        self.processes = []
+
+    def __enter__(self) -> 'ServiceFleet':
+        self.start()
+        return self
+
+    def __exit__(self, exc_type: Any, exc_val: Any, exc_tb: Any) -> None:
+        self.stop()
+
+
+def serve(argv: Optional[List[str]] = None) -> int:
+    """``petastorm-tpu-throughput serve`` entry: run dispatcher + workers in
+    one command until interrupted, printing the service URL and a periodic
+    one-line state summary."""
+    import argparse
+    import json
+    parser = argparse.ArgumentParser(
+        description='Run a petastorm-tpu input-service fleet '
+                    '(dispatcher + decode workers) on this host')
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=8780,
+                        help='client port (workers register on port+1)')
+    parser.add_argument('--workers', type=int, default=4,
+                        help='decode workers to spawn (0 = dispatcher only; '
+                             'point remote service_worker processes at the '
+                             'worker endpoint)')
+    parser.add_argument('--cache-dir', default=None,
+                        help='shared Arrow-IPC rowgroup cache directory '
+                             '(warm across every client reading the same '
+                             'dataset)')
+    parser.add_argument('--cache-size-limit', type=int, default=None,
+                        help='shared cache size limit in bytes')
+    parser.add_argument('--admission-window', type=int,
+                        default=DEFAULT_ADMISSION_WINDOW,
+                        help='per-client in-flight window before BUSY')
+    parser.add_argument('--item-deadline-s', type=float, default=None,
+                        help='per-item wall-clock budget: a worker holding '
+                             'one rowgroup longer is deregistered and the '
+                             'item re-queued (default: off — catches hung '
+                             'decodes that keep heartbeating)')
+    parser.add_argument('--no-shm', action='store_true',
+                        help='disable the co-located shared-memory result '
+                             'path (TCP frames only)')
+    parser.add_argument('--state-interval', type=float, default=30.0,
+                        help='seconds between state summaries (0 = quiet)')
+    parser.add_argument('--json', action='store_true',
+                        help='print state summaries as JSON lines')
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    fleet = ServiceFleet(
+        workers=args.workers, host=args.host, port=args.port,
+        cache_dir=args.cache_dir, cache_size_limit=args.cache_size_limit,
+        shm_results=not args.no_shm, admission_window=args.admission_window,
+        item_deadline_s=args.item_deadline_s)
+    url = fleet.start()
+    print('petastorm-tpu input service running at {} ({} worker(s); '
+          'workers register on port {}). Point readers at '
+          'make_reader(..., service_url={!r}); Ctrl-C stops the fleet.'
+          .format(url, args.workers, args.port + 1, url))
+    try:
+        while True:
+            time.sleep(args.state_interval or 3600.0)
+            if args.state_interval:
+                state = fleet.state()
+                if args.json:
+                    print(json.dumps(state))
+                else:
+                    print('service: {} worker(s), {} client(s), queue depth '
+                          '{}, {} in flight, {} busy rejection(s), {} item(s) '
+                          're-queued'.format(
+                              len(state['workers']), len(state['clients']),
+                              state['queue_depth'], state['in_flight'],
+                              state['busy_rejections'],
+                              state['items_requeued']))
+    except KeyboardInterrupt:
+        print('stopping the fleet...')
+    finally:
+        fleet.stop()
+    return 0

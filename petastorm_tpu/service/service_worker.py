@@ -1,0 +1,361 @@
+"""Stateless decode worker for the disaggregated input service.
+
+Entry point executed inside each service worker process (the service-side
+mirror of ``workers/process_worker_main.py``): connect a DEALER to the
+dispatcher's worker ROUTER, ``register`` a
+:class:`~petastorm_tpu.service.wire.WorkerDescriptor`, then pull work —
+``w_ready`` up, ``work`` assignments down — until ``w_stop`` (or the parent
+process dies, the same orphan watchdog as the in-process pool).
+
+The worker is *stateless by contract*: everything dataset-specific arrives
+over the wire. A client's ``open`` blob (dilled ``{worker_class, worker_args,
+serializer}`` — in practice :class:`~petastorm_tpu.reader_worker.RowGroupWorker`
+plus its ``WorkerSetup``) is attached by the dispatcher to the first ``work``
+message each worker sees per setup; the worker instantiates and memoizes the
+runtime per setup id (a bounded LRU — old clients' runtimes are shut down,
+not hoarded). When the service is configured with a shared cache directory,
+the setup's cache is replaced with one fleet-wide
+:class:`~petastorm_tpu.cache.ArrowIpcDiskCache`, so a rowgroup decoded for one
+job is a warm mmap hit for every other job reading the same dataset — the
+amortization argument of the tf.data-service paper (arXiv 2210.14826).
+
+Results ride the :mod:`~petastorm_tpu.workers.serializers` wire codec as
+``w_result`` frames over TCP; when the dispatcher flags the owning client as
+co-located (same host token) and shm is enabled, the serialized frames are
+written into a fresh one-shot ``multiprocessing.shared_memory`` segment
+instead and only a CRC-carrying
+:class:`~petastorm_tpu.service.wire.ShmResultDescriptor` crosses the wire —
+the client maps, verifies, copies out and unlinks. A janitor unlinks any
+segment nobody claimed within a grace window, so dropped duplicates and dead
+clients cannot leak ``/dev/shm``.
+
+Heartbeats ride a private DEALER socket (``w_heartbeat`` sequence stamps, the
+PR-4 liveness model): the dispatcher detects stamp *change* on its own clock
+and deregisters a worker whose stamp stalls, re-queuing its in-flight items."""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import pickle
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from petastorm_tpu.service.wire import (ShmResultDescriptor, WorkerDescriptor,
+                                        host_token)
+
+logger = logging.getLogger(__name__)
+
+#: memoized per-setup runtimes kept per worker (old clients evict LRU)
+_SETUP_CACHE_LIMIT = 8
+#: seconds an unclaimed one-shot shm segment survives before the janitor
+#: unlinks it (covers dropped duplicate results and departed clients)
+_SHM_GRACE_S = 60.0
+#: how long to wait for the dispatcher's ``registered`` ack before retrying
+_REGISTER_TIMEOUT_MS = 2000
+
+
+def _watch_parent(parent_pid: int) -> None:
+    """Exit if the fleet parent dies, so no orphan workers linger (same
+    watchdog as ``workers/process_worker_main.py``)."""
+    import psutil
+    while True:
+        if not psutil.pid_exists(parent_pid):
+            os._exit(0)
+        time.sleep(1)
+
+
+def _heartbeat_loop(stop_event: threading.Event, context: Any, endpoint: str,
+                    worker_id: int, interval_s: float) -> None:
+    """Stamp liveness on a PRIVATE DEALER socket (ZMQ sockets are not
+    thread-safe — the main thread owns the work socket). Dropped sends are
+    fine: the dispatcher only needs *some* stamp to land inside its (much
+    longer) staleness window."""
+    import zmq
+    socket = context.socket(zmq.DEALER)
+    socket.setsockopt(zmq.SNDHWM, 8)
+    socket.setsockopt(zmq.LINGER, 0)
+    socket.connect(endpoint)
+    seq = 0
+    try:
+        while not stop_event.wait(interval_s):
+            seq += 1
+            try:
+                socket.send_multipart(
+                    [b'w_heartbeat', b'%d' % worker_id, b'%d' % seq],
+                    zmq.NOBLOCK)
+            except Exception:  # noqa: BLE001 - liveness must never kill a worker
+                pass
+    finally:
+        socket.close(linger=0)
+
+
+class _ShmPublisher(object):
+    """One-shot shared-memory result segments for co-located clients.
+
+    Each published result gets a fresh segment (created, unregistered from
+    this process's resource tracker — the CLIENT owns the unlink after
+    reading). The janitor reclaims segments nobody consumed within the grace
+    window; ``close`` unlinks everything still tracked."""
+
+    def __init__(self, grace_s: float = _SHM_GRACE_S) -> None:
+        self._grace_s = grace_s
+        self._created: Deque[Tuple[str, float]] = collections.deque()
+
+    def write(self, frames: List[Any],
+              checksum: bool = True) -> Optional[ShmResultDescriptor]:
+        """Write serialized ``frames`` back-to-back into a fresh segment;
+        returns the descriptor, or None when shared memory is unavailable
+        (the caller falls back to wire frames)."""
+        from multiprocessing import shared_memory
+        views = [memoryview(frame) for frame in frames]
+        lengths = [view.nbytes for view in views]
+        total = sum(lengths)
+        try:
+            segment = shared_memory.SharedMemory(create=True,
+                                                 size=max(total, 1))
+        except Exception:  # noqa: BLE001 - no /dev/shm: degrade to the TCP wire
+            logger.warning('one-shot shm segment unavailable; publishing '
+                           'over the wire', exc_info=True)
+            return None
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(segment._name, 'shared_memory')  # type: ignore[attr-defined]
+        except Exception:  # noqa: BLE001 - tracker internals shifted; janitor unlink still wins
+            pass
+        offset = 0
+        for view, length in zip(views, lengths):
+            segment.buf[offset:offset + length] = view.cast('B')
+            offset += length
+        crc: Optional[int] = None
+        if checksum:
+            from petastorm_tpu.workers.integrity import payload_checksum
+            crc = payload_checksum(views)
+        name = segment.name
+        segment.close()
+        self._created.append((name, time.monotonic()))
+        return ShmResultDescriptor(name, lengths, crc)
+
+    def _unlink(self, name: str) -> None:
+        from multiprocessing import shared_memory
+        try:
+            segment = shared_memory.SharedMemory(name=name)
+        except FileNotFoundError:
+            return  # the client consumed and unlinked it — the normal path
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(segment._name, 'shared_memory')  # type: ignore[attr-defined]
+        except Exception:  # noqa: BLE001 - tracker internals shifted
+            pass
+        segment.close()
+        try:
+            segment.unlink()
+        except FileNotFoundError:
+            pass
+
+    def janitor(self) -> None:
+        """Unlink segments past the grace window (nobody claimed them)."""
+        now = time.monotonic()
+        while self._created and now - self._created[0][1] > self._grace_s:
+            name, _ = self._created.popleft()
+            self._unlink(name)
+
+    def close(self) -> None:
+        """Unlink every segment still tracked (worker shutdown)."""
+        while self._created:
+            name, _ = self._created.popleft()
+            self._unlink(name)
+
+
+class _SetupRuntime(object):
+    """One client setup materialized on this worker: the decode worker
+    instance plus the wire serializer its results ship through."""
+
+    __slots__ = ('worker', 'serializer')
+
+    def __init__(self, worker: Any, serializer: Any) -> None:
+        self.worker = worker
+        self.serializer = serializer
+
+
+def _build_runtime(setup_blob: bytes, worker_id: int,
+                   publish: Callable[[Any], None],
+                   shared_cache: Any) -> _SetupRuntime:
+    """Materialize a client's dilled ``open`` payload into a runtime; when the
+    fleet ships a shared cache, it replaces the setup's own (the service owns
+    cache placement — that is the whole point of disaggregation)."""
+    import dill
+    spec = dill.loads(setup_blob)
+    worker_class = spec['worker_class']
+    worker_args = spec['worker_args']
+    serializer = spec['serializer']
+    if shared_cache is not None and hasattr(worker_args, 'cache'):
+        worker_args.cache = shared_cache
+    worker = worker_class(worker_id, publish, worker_args)
+    return _SetupRuntime(worker, serializer)
+
+
+def main(bootstrap_path: str) -> None:
+    """Service-worker process entry: load the pickled bootstrap file, connect
+    to the dispatcher's worker endpoint, register, and pull/process work items
+    until ``w_stop`` (or parent death)."""
+    with open(bootstrap_path, 'rb') as f:
+        bootstrap = pickle.load(f)
+    try:
+        os.unlink(bootstrap_path)
+    except OSError:
+        pass
+
+    import zmq
+
+    worker_id = int(bootstrap['worker_id'])
+    endpoint = bootstrap['worker_endpoint']
+    heartbeat_interval_s = float(bootstrap.get('heartbeat_interval_s', 0.5))
+    shm_results = bool(bootstrap.get('shm_results', True))
+    parent_pid = bootstrap.get('parent_pid')
+    if parent_pid is not None:
+        threading.Thread(target=_watch_parent, args=(parent_pid,),
+                         daemon=True).start()
+
+    shared_cache: Any = None
+    cache_dir = bootstrap.get('cache_dir')
+    if cache_dir:
+        from petastorm_tpu.cache import ArrowIpcDiskCache
+        shared_cache = ArrowIpcDiskCache(
+            cache_dir, int(bootstrap.get('cache_size_limit') or 10 << 30),
+            int(bootstrap.get('cache_row_size_estimate') or 0))
+
+    context = zmq.Context()
+    socket = context.socket(zmq.DEALER)
+    socket.connect(endpoint)
+
+    descriptor = WorkerDescriptor(
+        worker_id=worker_id, pid=os.getpid(), host=host_token(),
+        heartbeat_interval_s=heartbeat_interval_s, shm_results=shm_results)
+    registered = False
+    while not registered:
+        socket.send_multipart([b'register', descriptor.to_bytes()])
+        if not socket.poll(_REGISTER_TIMEOUT_MS, zmq.POLLIN):
+            continue  # dispatcher not up yet — re-announce
+        frames = socket.recv_multipart()
+        kind = frames[0]
+        if kind == b'registered':
+            registered = True
+
+    heartbeat_stop = threading.Event()
+    heartbeat_thread: Optional[threading.Thread] = None
+    if heartbeat_interval_s > 0:
+        heartbeat_thread = threading.Thread(
+            target=_heartbeat_loop,
+            args=(heartbeat_stop, context, endpoint, worker_id,
+                  heartbeat_interval_s),
+            daemon=True)
+        heartbeat_thread.start()
+
+    shm_publisher = _ShmPublisher() if shm_results else None
+    runtimes: 'collections.OrderedDict[bytes, _SetupRuntime]' = \
+        collections.OrderedDict()
+    current_token = [b'']
+    current_attempt = [b'0']
+    current_colocated = [False]
+    current_serializer: List[Any] = [None]
+
+    def publish(result: Any) -> None:
+        from petastorm_tpu.telemetry.spans import stage_span
+        with stage_span('serialize'):
+            frames = current_serializer[0].serialize(result)
+        if shm_publisher is not None and current_colocated[0]:
+            shm_descriptor = shm_publisher.write(frames)
+            if shm_descriptor is not None:
+                socket.send_multipart(
+                    [b'w_result_shm', current_token[0], current_attempt[0],
+                     shm_descriptor.to_bytes()])
+                return
+        socket.send_multipart(
+            [b'w_result', current_token[0], current_attempt[0]]
+            + list(frames))
+
+    import dill
+    socket.send_multipart([b'w_ready'])
+    stopping = False
+    while not stopping:
+        if not socket.poll(1000, zmq.POLLIN):
+            if shm_publisher is not None:
+                shm_publisher.janitor()
+            continue
+        frames = socket.recv_multipart()
+        kind = frames[0]
+        if kind == b'w_stop':
+            stopping = True
+            continue
+        if kind == b'registered':
+            continue  # duplicate ack from the registration retry loop
+        if kind != b'work' or len(frames) < 7:
+            continue  # unknown kind from a newer dispatcher: ignore
+        token, setup_id, blob = frames[1], frames[2], frames[3]
+        attempt, colocate_flag = frames[4], frames[5]
+        setup_blob = frames[6]
+        runtime = runtimes.get(setup_id)
+        if runtime is None:
+            if not setup_blob:
+                # the dispatcher believed this worker knew the setup (e.g. a
+                # pre-restart identity collision) — ask for a re-ship
+                socket.send_multipart([b'w_need_setup', token])
+                socket.send_multipart([b'w_ready'])
+                continue
+            try:
+                runtime = _build_runtime(setup_blob, worker_id, publish,
+                                         shared_cache)
+            except Exception as exc:  # noqa: BLE001 - ship to the owning client
+                error_blob = pickle.dumps((exc, traceback.format_exc()))
+                socket.send_multipart([b'w_error', token, attempt,
+                                       error_blob])
+                socket.send_multipart([b'w_ready'])
+                continue
+            runtimes[setup_id] = runtime
+            while len(runtimes) > _SETUP_CACHE_LIMIT:
+                _, evicted = runtimes.popitem(last=False)
+                evicted.worker.shutdown()
+        else:
+            runtimes.move_to_end(setup_id)
+        current_token[0] = token
+        current_attempt[0] = attempt
+        current_colocated[0] = colocate_flag == b'1'
+        current_serializer[0] = runtime.serializer
+        from petastorm_tpu.telemetry.tracing import set_dispatch_attempt
+        set_dispatch_attempt(int(attempt))
+        try:
+            # the kwargs decode belongs INSIDE the error funnel: a poison
+            # blob (dill version skew, client-only modules) must fail that
+            # one item to its owner, not kill this worker — the dispatcher
+            # would re-queue it onto the next worker and fell the whole fleet
+            kwargs = dill.loads(blob)
+            runtime.worker.process(**kwargs)
+            socket.send_multipart([b'w_done', token, attempt])
+        except Exception as exc:  # noqa: BLE001 - ship to the owning client
+            error_blob = pickle.dumps((exc, traceback.format_exc()))
+            socket.send_multipart([b'w_error', token, attempt, error_blob])
+        current_token[0] = b''
+        current_colocated[0] = False
+        if shm_publisher is not None:
+            shm_publisher.janitor()
+        socket.send_multipart([b'w_ready'])
+
+    socket.send_multipart([b'w_leave'])
+    for runtime in runtimes.values():
+        runtime.worker.shutdown()
+    heartbeat_stop.set()
+    if heartbeat_thread is not None:
+        heartbeat_thread.join(timeout=2 * heartbeat_interval_s + 1)
+    if shm_publisher is not None:
+        shm_publisher.close()
+    socket.close(linger=1000)
+    context.term()
+
+
+if __name__ == '__main__':
+    main(sys.argv[1])

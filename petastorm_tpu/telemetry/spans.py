@@ -69,6 +69,8 @@ COUNTERS = (
     'breaker_open',    # a circuit breaker tripped open (pool consumer side)
     'watchdog_reap',   # a hung worker was SIGKILLed by the watchdog (pool)
     'shm_crc_fail',    # a shm frame failed CRC verification (pool)
+    'service_busy',    # the input service rejected a submit (admission control)
+    'service_resubmit',  # a service item was re-requested (lost shm segment)
 )
 
 #: declared size histograms (``registry.observe(name, n, unit=BYTES_UNIT)``

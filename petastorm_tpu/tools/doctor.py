@@ -20,6 +20,11 @@ in order:
 5. **Pipecheck** — the static data-plane invariant analysis
    (:mod:`petastorm_tpu.analysis`, docs/static-analysis.md) over the
    installed package; findings print as a WARNING (``report['pipecheck']``).
+6. **Input service** — when ``--service-url`` (or the
+   ``PETASTORM_TPU_SERVICE_URL`` env var) names a disaggregated input
+   service (docs/service.md), probe its dispatcher: reachable? workers
+   registered? queue depth? An unreachable configured service prints a
+   WARNING (``report['service']``) — readers pointed at it will fail.
 
 Prints a human-readable report; with ``--json``, one machine-readable JSON
 line (the same dict :func:`collect_report` returns). Exit code 0 iff the
@@ -254,6 +259,43 @@ def check_store_roundtrip(rows=200, workers=2):
             }}
 
 
+def check_service(service_url=None, timeout_s=2.0):
+    """Probe the disaggregated input service (docs/service.md) when one is
+    configured — ``service_url`` argument or the ``PETASTORM_TPU_SERVICE_URL``
+    env var. Returns ``{'status': 'unconfigured'}`` when no URL is set,
+    ``{'status': 'ok', 'workers': N, 'clients': N, 'queue_depth': N, ...}``
+    when the dispatcher answers a state request, or ``{'status':
+    'unreachable', 'detail': ...}`` — which the human report prints as a
+    WARNING: a reader pointed at that URL will fail its hello."""
+    url = service_url or os.environ.get('PETASTORM_TPU_SERVICE_URL')
+    if not url:
+        return {'status': 'unconfigured'}
+    # tripped client-transport breakers registered by any ServicePool this
+    # process created (they live on the default board so they surface here
+    # and in Reader.diagnostics through one mechanism)
+    from petastorm_tpu.resilience import default_board
+    breakers = {name: state for name, state
+                in default_board().snapshot(only_tripped=True).items()
+                if name.startswith('service:')}
+    try:
+        from petastorm_tpu.service.service_client import fetch_service_state
+        state = fetch_service_state(url, timeout_s=timeout_s)
+    except Exception as exc:  # noqa: BLE001 - unreachability is the finding, not a doctor failure
+        return {'status': 'unreachable', 'service_url': url,
+                'detail': repr(exc), 'breakers': breakers}
+    workers = state.get('workers') or []
+    return {'status': 'ok', 'service_url': url,
+            'workers': len(workers),
+            'clients': len(state.get('clients') or []),
+            'queue_depth': state.get('queue_depth', 0),
+            'in_flight': state.get('in_flight', 0),
+            'busy_rejections': state.get('busy_rejections', 0),
+            'items_requeued': state.get('items_requeued', 0),
+            'workers_departed': state.get('workers_departed', 0),
+            'breakers': breakers,
+            'state': state}
+
+
 def check_pipecheck():
     """Run the pipecheck static analysis over the installed package
     (docs/static-analysis.md) and summarize: ``{'status': 'ok'|'findings',
@@ -273,7 +315,8 @@ def check_pipecheck():
             'first': report.findings[0].format() if report.findings else None}
 
 
-def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
+def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
+                   service_url=None):
     """Run every check; returns the full report dict (no printing)."""
     report = {'versions': check_versions()}
     report['backend'] = check_backend(timeout_s=probe_timeout_s)
@@ -321,6 +364,14 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180):
         report['pipecheck'] = check_pipecheck()
     except Exception as exc:  # noqa: BLE001 - the report must always complete
         report['pipecheck'] = {'status': 'fail', 'detail': repr(exc)}
+    # Input-service block (docs/service.md): when PETASTORM_TPU_SERVICE_URL
+    # (or --service-url) names a dispatcher, is it reachable and how does its
+    # fleet look? Always present so --json consumers find one stable key;
+    # an unconfigured service is a healthy install.
+    try:
+        report['service'] = check_service(service_url)
+    except Exception as exc:  # noqa: BLE001 - the report must always complete
+        report['service'] = {'status': 'fail', 'detail': repr(exc)}
     report['healthy'] = report['store_roundtrip'].get('status') == 'ok'
     return report
 
@@ -396,6 +447,21 @@ def _print_human(report):
         print('  resilience: {} — the roundtrip needed hang/corruption '
               'recovery on a local disk; check the hardware'.format(
                   ', '.join('{}={}'.format(k, v) for k, v in sorted(degraded.items()))))
+    service = report.get('service') or {}
+    if service.get('status') == 'ok':
+        print('  service: {} — {} worker(s), {} client(s), queue depth {} '
+              '(docs/service.md)'.format(
+                  service.get('service_url'), service.get('workers', 0),
+                  service.get('clients', 0), service.get('queue_depth', 0)))
+        if service.get('workers', 0) == 0:
+            print('  WARNING: input service at {} has NO registered decode '
+                  'workers — readers pointed at it will stall until workers '
+                  'join'.format(service.get('service_url')))
+    elif service.get('status') == 'unreachable':
+        print('  WARNING: input service at {} is UNREACHABLE ({}) — readers '
+              'with this service_url will fail their hello; is the '
+              'dispatcher running? (docs/service.md)'.format(
+                  service.get('service_url'), service.get('detail', '')))
     pipecheck = report.get('pipecheck') or {}
     if pipecheck.get('status') == 'ok':
         print('  pipecheck: clean — {} files, {} suppression(s) honored '
@@ -427,10 +493,15 @@ def main(argv=None):
                         help='link probe subprocess timeout (seconds)')
     parser.add_argument('--no-link', action='store_true',
                         help='skip the link bandwidth probe')
+    parser.add_argument('--service-url', default=None,
+                        help='probe this input-service dispatcher (default: '
+                             'the PETASTORM_TPU_SERVICE_URL env var; unset = '
+                             'skip)')
     args = parser.parse_args(argv)
     report = collect_report(probe_timeout_s=args.probe_timeout,
                             link=not args.no_link,
-                            link_timeout_s=args.link_timeout)
+                            link_timeout_s=args.link_timeout,
+                            service_url=args.service_url)
     if args.json:
         print(json.dumps(report))
     else:

@@ -1,0 +1,21 @@
+"""Known-bad fixture: service registration-descriptor key drift (writes
+'host', reads 'hostname')."""
+import json
+
+
+class WorkerDescriptor:
+    def __init__(self, worker_id, host, heartbeat_interval_s):
+        self.worker_id = worker_id
+        self.host = host
+        self.heartbeat_interval_s = heartbeat_interval_s
+
+    def to_bytes(self):
+        spec = {'worker_id': self.worker_id, 'host': self.host,
+                'heartbeat_interval_s': self.heartbeat_interval_s}
+        return json.dumps(spec).encode('utf-8')
+
+    @classmethod
+    def from_bytes(cls, blob):
+        spec = json.loads(bytes(blob).decode('utf-8'))
+        return cls(spec['worker_id'], spec['hostname'],
+                   spec['heartbeat_interval_s'])

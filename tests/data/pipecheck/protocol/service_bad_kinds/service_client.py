@@ -1,0 +1,11 @@
+"""Known-bad fixture set, client side: consumes the b'result' forwards the
+dispatcher fixture produces (itself clean — the drift is between the other
+two peers)."""
+
+
+def read(socket):
+    frames = socket.recv_multipart()
+    kind = frames[0]
+    if kind == b'result':
+        return frames[1:]
+    return None

@@ -1,0 +1,17 @@
+"""Known-bad fixture (worker side of the service trio): publishes a result
+kind the dispatcher never dispatches on, and never sends the kind the
+dispatcher expects."""
+
+
+def publish(socket, token, frames):
+    # b'w_result_v2' is not dispatched on by the peer dispatcher fixture
+    socket.send_multipart([b'w_result_v2', token] + frames)
+    socket.send_multipart([b'w_done', token])
+
+
+def loop(socket):
+    frames = socket.recv_multipart()
+    kind = frames[0]
+    if kind == b'work':
+        return frames[1:]
+    return None

@@ -1,0 +1,19 @@
+"""Known-good fixture (dispatcher side): matches the service worker and
+client fixtures' kinds."""
+
+MSG_W_RESULT, MSG_W_DONE, MSG_WORK = b'w_result', b'w_done', b'work'
+
+
+def handle_worker(worker_socket, client_socket):
+    frames = worker_socket.recv_multipart()
+    kind = bytes(frames[1])
+    if kind == MSG_W_RESULT:
+        client_socket.send_multipart([frames[0], b'result'] + frames[2:])
+        return True
+    if kind == MSG_W_DONE:
+        return None
+    return None
+
+
+def dispatch(worker_socket, identity, token, blob):
+    worker_socket.send_multipart([identity, MSG_WORK, token, blob])

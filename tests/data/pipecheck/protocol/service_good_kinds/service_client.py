@@ -1,0 +1,10 @@
+"""Known-good fixture (client side): consumes the dispatcher fixture's
+forwarded results."""
+
+
+def read(socket):
+    frames = socket.recv_multipart()
+    kind = frames[0]
+    if kind == b'result':
+        return frames[1:]
+    return None

@@ -1,0 +1,15 @@
+"""Known-good fixture (worker side): matches the dispatcher fixture's
+kinds."""
+
+
+def publish(socket, token, frames):
+    socket.send_multipart([b'w_result', token] + frames)
+    socket.send_multipart([b'w_done', token])
+
+
+def loop(socket):
+    frames = socket.recv_multipart()
+    kind = frames[0]
+    if kind == b'work':
+        return frames[1:]
+    return None

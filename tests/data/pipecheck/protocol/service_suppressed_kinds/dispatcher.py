@@ -1,0 +1,18 @@
+"""Suppression fixture (dispatcher side): dispatches on a kind no peer sends,
+with the finding suppressed under an explicit reason."""
+
+MSG_W_DONE, MSG_WORK = b'w_done', b'work'
+
+
+def handle_worker(worker_socket):
+    frames = worker_socket.recv_multipart()
+    kind = bytes(frames[1])
+    if kind == b'w_legacy_result':  # pipecheck: disable=protocol-conformance -- kept one release for rolling worker upgrades
+        return frames[2:]
+    if kind == MSG_W_DONE:
+        return None
+    return None
+
+
+def dispatch(worker_socket, identity, token, blob):
+    worker_socket.send_multipart([identity, MSG_WORK, token, blob])

@@ -1,0 +1,14 @@
+"""Suppression fixture (worker side): clean peer of the suppressed
+dispatcher fixture."""
+
+
+def publish(socket, token, frames):
+    socket.send_multipart([b'w_done', token] + frames)
+
+
+def loop(socket):
+    frames = socket.recv_multipart()
+    kind = frames[0]
+    if kind == b'work':
+        return frames[1:]
+    return None

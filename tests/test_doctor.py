@@ -33,7 +33,8 @@ def test_store_roundtrip_ok():
     assert not trace_enabled()
 
 
-def test_collect_report_healthy_and_json_clean(capsys):
+def test_collect_report_healthy_and_json_clean(capsys, monkeypatch):
+    monkeypatch.delenv('PETASTORM_TPU_SERVICE_URL', raising=False)
     rc = doctor.main(['--json', '--no-link', '--probe-timeout', '120'])
     out = capsys.readouterr().out.strip()
     report = json.loads(out)
@@ -42,6 +43,9 @@ def test_collect_report_healthy_and_json_clean(capsys):
     assert report['backend']['status'] == 'up'
     assert 'link' not in report  # --no-link honored
     assert report['store_roundtrip']['status'] == 'ok'
+    # input-service block (ISSUE 8): one stable key; no configured service
+    # is a healthy install
+    assert report['service'] == {'status': 'unconfigured'}
     # resilience block (docs/robustness.md): always present, healthy on a
     # clean local roundtrip — no open breakers, no hung reaps, no corruption
     resilience = report['resilience']
@@ -59,6 +63,68 @@ def test_collect_report_healthy_and_json_clean(capsys):
     assert trace['top_rowgroup_traces']
 
 
+def test_service_unconfigured_by_default(monkeypatch):
+    monkeypatch.delenv('PETASTORM_TPU_SERVICE_URL', raising=False)
+    assert doctor.check_service() == {'status': 'unconfigured'}
+
+
+def test_service_unreachable_reported(monkeypatch):
+    # nothing listens on port 1; the probe must come back structured, fast
+    s = doctor.check_service('tcp://127.0.0.1:1', timeout_s=0.5)
+    assert s['status'] == 'unreachable'
+    assert s['service_url'] == 'tcp://127.0.0.1:1'
+    assert 'detail' in s and 'breakers' in s
+    # the env var is the other configuration path (ISSUE 8)
+    monkeypatch.setenv('PETASTORM_TPU_SERVICE_URL', 'tcp://127.0.0.1:1')
+    assert doctor.check_service(timeout_s=0.5)['status'] == 'unreachable'
+
+
+def test_service_reachable_reports_fleet_shape():
+    from petastorm_tpu.service.dispatcher import Dispatcher
+    dispatcher = Dispatcher()
+    url = dispatcher.start()
+    try:
+        s = doctor.check_service(url, timeout_s=5.0)
+    finally:
+        dispatcher.stop()
+        dispatcher.join()
+    assert s['status'] == 'ok'
+    assert s['service_url'] == url
+    assert s['workers'] == 0 and s['clients'] == 0
+    assert s['queue_depth'] == 0
+
+
+def test_human_report_warns_on_unreachable_service(capsys):
+    report = {
+        'versions': {'petastorm_tpu': 'x', 'python': 'x', 'jax': 'x',
+                     'pyarrow': 'x'},
+        'backend': {'status': 'down', 'detail': ''},
+        'store_roundtrip': {'status': 'ok', 'rows': 1, 'rows_per_sec': 1.0},
+        'service': {'status': 'unreachable',
+                    'service_url': 'tcp://fleet:8780', 'detail': 'timeout'},
+        'healthy': True,
+    }
+    doctor._print_human(report)
+    out = capsys.readouterr().out
+    assert 'WARNING: input service at tcp://fleet:8780 is UNREACHABLE' in out
+
+
+def test_human_report_warns_on_workerless_service(capsys):
+    report = {
+        'versions': {'petastorm_tpu': 'x', 'python': 'x', 'jax': 'x',
+                     'pyarrow': 'x'},
+        'backend': {'status': 'down', 'detail': ''},
+        'store_roundtrip': {'status': 'ok', 'rows': 1, 'rows_per_sec': 1.0},
+        'service': {'status': 'ok', 'service_url': 'tcp://fleet:8780',
+                    'workers': 0, 'clients': 0, 'queue_depth': 0},
+        'healthy': True,
+    }
+    doctor._print_human(report)
+    out = capsys.readouterr().out
+    assert 'service: tcp://fleet:8780' in out
+    assert 'NO registered decode workers' in out
+
+
 def test_human_report_warns_on_open_breaker(capsys):
     report = {
         'versions': {'petastorm_tpu': 'x', 'python': 'x', 'jax': 'x',
@@ -74,6 +140,18 @@ def test_human_report_warns_on_open_breaker(capsys):
     out = capsys.readouterr().out
     assert 'WARNING: circuit breaker(s) not closed: cache:/tmp/c' in out
     assert 'workers_hung_reaped=2' in out and 'shm_crc_failures=1' in out
+
+
+def test_json_report_with_unreachable_service_url(capsys):
+    # --service-url names a dead dispatcher: the block reports it, but an
+    # unreachable EXTERNAL service does not make the install unhealthy
+    rc = doctor.main(['--json', '--no-link', '--probe-timeout', '120',
+                      '--service-url', 'tcp://127.0.0.1:1'])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0
+    assert report['healthy'] is True
+    assert report['service']['status'] == 'unreachable'
+    assert report['service']['service_url'] == 'tcp://127.0.0.1:1'
 
 
 def test_human_report_prints_verdict(capsys):

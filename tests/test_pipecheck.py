@@ -64,6 +64,10 @@ BAD_FIXTURES = [
      ["'telemetry'", "'breakers'"]),
     ('protocol/bad_reason/quarantiner.py', ['protocol-conformance'], 1,
      ['cosmic-ray']),
+    ('protocol/service_bad_kinds', ['protocol-conformance'], 2,
+     ["b'w_result_v2'", "b'w_result'"]),
+    ('protocol/service_bad_descriptor/wire.py', ['protocol-conformance'], 2,
+     ["'host'", "'hostname'"]),
 ]
 
 GOOD_FIXTURES = [
@@ -73,6 +77,7 @@ GOOD_FIXTURES = [
     ('exceptions/good_swallow.py', ['exception-hygiene']),
     ('locks/good_lock.py', ['lock-discipline']),
     ('protocol/good_kinds', ['protocol-conformance']),
+    ('protocol/service_good_kinds', ['protocol-conformance']),
 ]
 
 
@@ -97,6 +102,7 @@ def test_known_good_fixture_is_clean(path, rules):
     ('telemetry/suppressed_stage.py', ['telemetry-names']),
     ('telemetry/suppressed_instant.py', ['telemetry-names']),
     ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
+    ('protocol/service_suppressed_kinds', ['protocol-conformance']),
 ])
 def test_suppression_comment_is_honored_and_counted(path, rules):
     report = run([FIXTURES / path], rules=rules)
@@ -236,6 +242,40 @@ def test_mutation_new_zmq_kind_sent_but_not_dispatched(tmp_path):
     text = '\n'.join(messages(report))
     assert "b'result_v2'" in text and 'no protocol peer dispatches' in text
     assert "b'result_shm'" in text and 'never sent' in text
+
+
+def test_mutation_service_kind_sent_but_not_dispatched(tmp_path):
+    """Guards the REAL service trio (ISSUE 8): renaming a worker-published
+    result kind without updating the dispatcher's dispatch arm must surface
+    on both sides of the drift."""
+    _copy_mutated(PKG / 'service' / 'service_worker.py',
+                  tmp_path / 'service_worker.py',
+                  "[b'w_result', current_token[0]",
+                  "[b'w_result_v2', current_token[0]")
+    shutil.copy(PKG / 'service' / 'dispatcher.py',
+                tmp_path / 'dispatcher.py')
+    shutil.copy(PKG / 'service' / 'service_client.py',
+                tmp_path / 'service_client.py')
+    report = run([tmp_path], rules=['protocol-conformance'])
+    text = '\n'.join(messages(report))
+    assert "b'w_result_v2'" in text and 'no protocol peer dispatches' in text
+    assert "b'w_result'" in text and 'never sent' in text
+    # the unmutated trio is clean (the baseline the mutation perturbs)
+    shutil.copy(PKG / 'service' / 'service_worker.py',
+                tmp_path / 'service_worker.py')
+    assert run([tmp_path], rules=['protocol-conformance']).clean
+
+
+def test_mutation_service_descriptor_key_drift(tmp_path):
+    """Renaming a registration-descriptor key on the write side only must
+    surface as written-but-never-read + read-but-never-written."""
+    _copy_mutated(PKG / 'service' / 'wire.py', tmp_path / 'wire.py',
+                  "'heartbeat_interval_s': self.heartbeat_interval_s",
+                  "'hb_interval_s': self.heartbeat_interval_s")
+    report = run([tmp_path], rules=['protocol-conformance'])
+    text = '\n'.join(messages(report))
+    assert "'hb_interval_s'" in text and 'never read' in text
+    assert "'heartbeat_interval_s'" in text and 'never written' in text
 
 
 def test_mutation_sidecar_key_dropped_from_real_deserialize(tmp_path):

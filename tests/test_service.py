@@ -1,0 +1,592 @@
+"""Disaggregated input service tests (ISSUE 8, docs/service.md).
+
+Three layers:
+
+- **scheduler units** (no sockets): deficit-round-robin fairness under skewed
+  demand, admission-window BUSY verdicts, heartbeat-staleness reaping and the
+  stale-ack/attempt protocol — all on :class:`FairShareScheduler` with an
+  injectable clock, so the fairness contract is deterministic;
+- **wire units**: URL parsing and descriptor round-trips;
+- **end-to-end** against a real localhost fleet (dispatcher thread + spawned
+  decode-worker processes): `make_reader(service_url=...)` row parity with a
+  plain reader, two concurrent readers, cross-client warm cache hits, elastic
+  worker join mid-epoch, worker SIGKILL mid-item with zero lost rows
+  (faultinject), quarantine parity with the in-process pool (faultinject),
+  admission-control BUSY backpressure, and the unreachable-dispatcher error.
+"""
+import glob
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.errors import TransientIOError
+from petastorm_tpu.etl.dataset_metadata import write_rows
+from petastorm_tpu.resilience import RetryPolicy
+from petastorm_tpu.service.dispatcher import FairShareScheduler
+from petastorm_tpu.service.fleet import ServiceFleet
+from petastorm_tpu.service.service_client import (ServicePool,
+                                                  fetch_service_state)
+from petastorm_tpu.service.wire import (ShmResultDescriptor, WorkerDescriptor,
+                                        parse_service_url, worker_endpoint)
+from petastorm_tpu.test_util.fault_injection import (FaultRule, FaultSchedule,
+                                                     fault_injecting_filesystem)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+from petastorm_tpu.workers.worker_base import WorkerBase
+
+FAST_RETRIES = RetryPolicy(max_attempts=3, backoff_base_s=0.01,
+                           max_backoff_s=0.05)
+NUM_ROWS = 200
+ROWS_PER_FILE = 25  # -> 8 part files / 8 rowgroup work items per epoch
+
+
+def _write_store(root, num_rows=NUM_ROWS):
+    schema = Unischema('ServiceProbe', [
+        UnischemaField('idx', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('vec', np.float32, (16,), NdarrayCodec(), False),
+    ])
+    url = 'file://' + str(root)
+    write_rows(url, schema,
+               [{'idx': i, 'vec': np.full(16, i, np.float32)}
+                for i in range(num_rows)],
+               rows_per_file=ROWS_PER_FILE, rowgroup_size_mb=1)
+    return url
+
+
+def _part_files(root):
+    files = sorted(glob.glob(os.path.join(str(root), '**', '*.parquet'),
+                             recursive=True))
+    assert files, 'no part files under {}'.format(root)
+    return files
+
+
+def _read_ids(reader):
+    return sorted(int(row.idx) for row in reader)
+
+
+@pytest.fixture(scope='module')
+def service_store(tmp_path_factory):
+    root = tmp_path_factory.mktemp('service') / 'store'
+    return {'url': _write_store(root), 'root': root}
+
+
+@pytest.fixture(scope='module')
+def fleet(tmp_path_factory):
+    """One shared two-worker fleet with a shared cache dir — reused by every
+    test that does not kill workers (many clients per fleet is the design)."""
+    cache_dir = str(tmp_path_factory.mktemp('service_cache'))
+    with ServiceFleet(workers=2, cache_dir=cache_dir,
+                      stale_timeout_s=10.0) as running:
+        yield running
+
+
+# ---------------------------------------------------------------------------
+# FairShareScheduler units (injectable clock, no sockets)
+# ---------------------------------------------------------------------------
+
+class TestFairShareScheduler(object):
+    def _scheduler(self, **kwargs):
+        self.now = [0.0]
+        kwargs.setdefault('clock', lambda: self.now[0])
+        return FairShareScheduler(**kwargs)
+
+    @staticmethod
+    def _register_worker(sched, key=b'w0', worker_id=0):
+        sched.add_worker(key, WorkerDescriptor(worker_id=worker_id, pid=1,
+                                               host='h', shm_results=False))
+        sched.worker_ready(key)
+
+    def test_drr_alternates_between_skewed_clients(self):
+        """The acceptance fairness shape: client A floods 40 items, client B
+        trickles 10 — service order must alternate A,B,A,B while both have
+        pending work, so B's throughput stays within ~2x of A's regardless
+        of the demand skew."""
+        sched = self._scheduler(admission_window=64)
+        sched.add_client(b'A', 'a', 'h')
+        sched.add_client(b'B', 'b', 'h')
+        for i in range(40):
+            assert sched.submit(b'A', b'%d' % i, b's', b'blob') is not None
+        for i in range(10):
+            assert sched.submit(b'B', b'%d' % i, b's', b'blob') is not None
+        sched.add_setup(b'A', b's', b'setup')
+        self._register_worker(sched)
+        served = []
+        for _ in range(20):
+            assignment = sched.next_assignment()
+            assert assignment is not None
+            owner, _ = sched.result_route(assignment.token)
+            served.append(owner)
+            sched.retire(assignment.token, assignment.attempt)
+            sched.worker_ready(b'w0')
+        # strict alternation while both queues are non-empty
+        assert served.count(b'A') == 10 and served.count(b'B') == 10
+        assert all(served[i] != served[i + 1] for i in range(19)), served
+
+    def test_drr_single_client_gets_full_fleet(self):
+        sched = self._scheduler()
+        sched.add_client(b'A', 'a', 'h')
+        tokens = [sched.submit(b'A', b'%d' % i, b's', b'b') for i in range(3)]
+        assert all(t is not None for t in tokens)
+        self._register_worker(sched)
+        assignment = sched.next_assignment()
+        assert assignment is not None and assignment.token == tokens[0]
+
+    def test_admission_window_rejects_beyond_bound(self):
+        sched = self._scheduler(admission_window=2)
+        sched.add_client(b'A', 'a', 'h')
+        assert sched.submit(b'A', b'0', b's', b'b') is not None
+        assert sched.submit(b'A', b'1', b's', b'b') is not None
+        assert sched.submit(b'A', b'2', b's', b'b') is None  # BUSY
+        assert sched.busy_rejections == 1
+        assert sched.state()['clients'][0]['busy_rejections'] == 1
+
+    def test_window_frees_on_retire_not_on_assignment(self):
+        sched = self._scheduler(admission_window=1)
+        sched.add_client(b'A', 'a', 'h')
+        token = sched.submit(b'A', b'0', b's', b'b')
+        self._register_worker(sched)
+        assignment = sched.next_assignment()
+        assert assignment.token == token
+        # assigned-but-unfinished still occupies the window
+        assert sched.submit(b'A', b'1', b's', b'b') is None
+        sched.result_route(token)
+        sched.retire(token, assignment.attempt)
+        assert sched.submit(b'A', b'1', b's', b'b') is not None
+
+    def test_stale_worker_requeue_and_stale_ack_protocol(self):
+        """A worker whose heartbeat stamp stalls is reaped; its item re-queues
+        with a bumped attempt, and the dead attempt's late ack can no longer
+        retire the redelivery (the in-process pool's echoed-attempt rule)."""
+        sched = self._scheduler(stale_timeout_s=5.0)
+        sched.add_client(b'A', 'a', 'h')
+        sched.submit(b'A', b'0', b's', b'b')
+        self._register_worker(sched, b'w0', 0)
+        first = sched.next_assignment()
+        assert first.attempt == 0
+        sched.heartbeat(0, 1)
+        self.now[0] = 3.0
+        assert sched.stale_workers() == []
+        self.now[0] = 9.0  # stamp unchanged for 6s > 5s window
+        assert sched.stale_workers() == [b'w0']
+        assert sched.remove_worker(b'w0') == []  # within the attempt budget
+        assert sched.state()['queue_depth'] == 1
+        self._register_worker(sched, b'w1', 1)
+        second = sched.next_assignment()
+        assert second.token == first.token and second.attempt == 1
+        sched.retire(second.token, 0)  # the dead attempt's stale ack
+        assert sched.state()['in_flight'] == 1  # NOT retired
+        sched.retire(second.token, 1)
+        assert sched.state()['in_flight'] == 0
+
+    def test_attempt_budget_exhaustion_fails_item_loudly(self):
+        sched = self._scheduler(max_item_attempts=2)
+        sched.add_client(b'A', 'a', 'h')
+        sched.submit(b'A', b'7', b's', b'b')
+        failed = []
+        for generation in range(3):
+            key = b'w%d' % generation
+            self._register_worker(sched, key, generation)
+            if sched.next_assignment() is None:
+                break
+            failed = sched.remove_worker(key)
+            if failed:
+                break
+        assert failed and failed[0][1] == b'A' and failed[0][2] == b'7'
+        assert sched.items_failed == 1
+        assert sched.state()['in_flight'] == 0
+
+    def test_shm_fail_pins_item_to_wire_and_respects_budget(self):
+        """A lost/corrupt shm segment redelivers over plain wire frames (a
+        false co-location match must converge, not loop), and repeated
+        failures burn the attempt budget into a loud error."""
+        sched = self._scheduler(max_item_attempts=3)
+        sched.add_client(b'A', 'a', 'samehost')
+        sched.submit(b'A', b'0', b's', b'b')
+        sched.add_worker(b'w0', WorkerDescriptor(worker_id=0, pid=1,
+                                                 host='samehost',
+                                                 shm_results=True))
+        sched.worker_ready(b'w0')
+        first = sched.next_assignment()
+        assert first.colocated is True
+        sched.result_route(first.token)
+        assert sched.requeue_token(first.token) is None  # attempt 1 of 3
+        sched.worker_ready(b'w0')
+        second = sched.next_assignment()
+        assert second.token == first.token
+        assert second.colocated is False  # wire-pinned from now on
+        sched.result_route(second.token)
+        assert sched.requeue_token(second.token) is None  # attempt 2 of 3
+        sched.worker_ready(b'w0')
+        third = sched.next_assignment()
+        sched.result_route(third.token)
+        failed = sched.requeue_token(third.token)  # budget spent
+        assert failed == (third.token, b'A', b'0')
+        assert sched.state()['in_flight'] == 0
+
+    def test_missing_setup_burns_budget_instead_of_spinning(self):
+        """w_need_setup for a setup the dispatcher never received must fail
+        the item after max_item_attempts, not cycle forever."""
+        sched = self._scheduler(max_item_attempts=2)
+        sched.add_client(b'A', 'a', 'h')
+        sched.submit(b'A', b'0', b'unknown-setup', b'b')
+        self._register_worker(sched)
+        failed = None
+        for _ in range(4):
+            assignment = sched.next_assignment()
+            if assignment is None:
+                break
+            assert assignment.setup_blob is None
+            failed = sched.forget_setups(b'w0', assignment.token)
+            sched.worker_ready(b'w0')
+            if failed is not None:
+                break
+        assert failed is not None and failed[1] == b'A'
+        assert sched.state()['in_flight'] == 0
+
+    def test_idle_client_ttl_collection(self):
+        """A silent client (no bye — it crashed) is collected with its setup
+        blobs after the TTL; an alive one just rejoins on its next submit."""
+        sched = self._scheduler(client_ttl_s=100.0)
+        sched.add_client(b'A', 'a', 'h')
+        sched.add_setup(b'A', b's', b'blob')
+        self.now[0] = 50.0
+        assert sched.expired_clients() == []
+        self.now[0] = 151.0
+        assert sched.expired_clients() == [b'A']
+        sched.remove_client(b'A')
+        assert not sched.has_client(b'A')
+        # the setup died with its owner
+        sched.submit(b'A', b'0', b's', b'b')  # unknown client: no-op
+        assert sched.state()['clients'] == []
+
+    def test_item_deadline_reaps_heartbeating_worker(self):
+        """A worker whose decode wedges keeps heartbeating from its stamp
+        thread — only the per-item deadline can see it (the pool's
+        two-detector watchdog model, service-side)."""
+        sched = self._scheduler(stale_timeout_s=1000.0, item_deadline_s=5.0)
+        sched.add_client(b'A', 'a', 'h')
+        sched.submit(b'A', b'0', b's', b'b')
+        self._register_worker(sched)
+        assert sched.next_assignment() is not None
+        for tick in range(1, 5):
+            self.now[0] = float(tick)
+            sched.heartbeat(0, tick)  # liveness keeps stamping...
+        assert sched.stale_workers() == []
+        self.now[0] = 6.5  # ...but the item is now past its deadline
+        sched.heartbeat(0, 99)
+        assert sched.stale_workers() == [b'w0']
+
+    def test_duplicate_result_dropped_after_requeue_race(self):
+        sched = self._scheduler()
+        sched.add_client(b'A', 'a', 'h')
+        sched.submit(b'A', b'0', b's', b'b')
+        self._register_worker(sched, b'w0', 0)
+        assignment = sched.next_assignment()
+        assert sched.result_route(assignment.token) == (b'A', b'0')
+        # the worker died after publishing: item re-queued, redelivered...
+        sched.remove_worker(b'w0')
+        self._register_worker(sched, b'w1', 1)
+        redelivery = sched.next_assignment()
+        # ...and the second result for the same token is a duplicate
+        assert sched.result_route(redelivery.token) is None
+        assert sched.results_dropped == 1
+
+    def test_setup_blob_ships_once_per_worker(self):
+        sched = self._scheduler()
+        sched.add_client(b'A', 'a', 'h')
+        sched.add_setup(b'A', b's', b'SETUPBLOB')
+        sched.submit(b'A', b'0', b's', b'b')
+        sched.submit(b'A', b'1', b's', b'b')
+        self._register_worker(sched)
+        first = sched.next_assignment()
+        assert first.setup_blob == b'SETUPBLOB'
+        sched.retire(first.token, first.attempt)
+        sched.worker_ready(b'w0')
+        second = sched.next_assignment()
+        assert second.setup_blob is None  # this worker already has it
+
+
+# ---------------------------------------------------------------------------
+# wire units
+# ---------------------------------------------------------------------------
+
+class TestWire(object):
+    def test_parse_service_url(self):
+        assert parse_service_url('tcp://10.0.0.2:8780') == ('10.0.0.2', 8780)
+        assert parse_service_url('petastorm-service://fleet:9') == ('fleet', 9)
+        assert worker_endpoint('tcp://h:100') == 'tcp://h:101'
+        for bad in ('http://h:1', 'tcp://h', 'tcp://:5', 'tcp://h:x'):
+            with pytest.raises(ValueError):
+                parse_service_url(bad)
+
+    def test_worker_descriptor_roundtrip(self):
+        descriptor = WorkerDescriptor(worker_id=3, pid=42, host='box',
+                                      capacity=2, heartbeat_interval_s=0.25,
+                                      shm_results=True)
+        back = WorkerDescriptor.from_bytes(descriptor.to_bytes())
+        assert (back.worker_id, back.pid, back.host, back.capacity,
+                back.heartbeat_interval_s, back.shm_results) == \
+            (3, 42, 'box', 2, 0.25, True)
+
+    def test_shm_result_descriptor_roundtrip(self):
+        descriptor = ShmResultDescriptor('psm_x', [3, 0, 17], 12345)
+        back = ShmResultDescriptor.from_bytes(descriptor.to_bytes())
+        assert back.name == 'psm_x'
+        assert back.frame_lengths == [3, 0, 17] and back.total_bytes == 20
+        assert back.crc == 12345
+        assert ShmResultDescriptor.from_bytes(
+            ShmResultDescriptor('n', [], None).to_bytes()).crc is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end against a real localhost fleet
+# ---------------------------------------------------------------------------
+
+def test_service_reader_row_parity_and_diagnostics(service_store, fleet):
+    """Acceptance: the same make_reader call pointed at a service fleet
+    yields exactly the row set of a plain in-process reader, and the
+    dispatcher state snapshot surfaces through Reader.diagnostics."""
+    with make_reader(service_store['url'], num_epochs=1) as reader:
+        plain_ids = _read_ids(reader)
+    with make_reader(service_store['url'], service_url=fleet.service_url,
+                     num_epochs=1) as reader:
+        service_ids = _read_ids(reader)
+        diag = reader.diagnostics
+    assert service_ids == plain_ids == list(range(NUM_ROWS))
+    assert diag['rowgroups_quarantined'] == 0
+    service = diag['service']
+    assert service['reachable'] is True
+    assert len(service['workers']) == 2
+    assert {'queue_depth', 'busy_rejections', 'items_requeued',
+            'results_dropped'} <= set(service)
+    (client,) = [c for c in service['clients'] if c['served'] or c['in_flight']]
+    assert 'deficit' in client and 'window' in client
+    # co-located fleet: at least part of the epoch rode one-shot shm segments
+    assert diag['service_shm_batches'] + diag['wire_batches'] >= 8
+
+
+def test_two_concurrent_readers_same_fleet(service_store, fleet):
+    """Acceptance: two concurrent readers against one fleet each receive the
+    complete dataset (per-reader row sets identical to a plain reader)."""
+    results = {}
+    errors = []
+
+    def consume(name):
+        try:
+            with make_reader(service_store['url'],
+                             service_url=fleet.service_url,
+                             num_epochs=1) as reader:
+                results[name] = _read_ids(reader)
+        except Exception as exc:  # noqa: BLE001 - surfaced via the errors list
+            errors.append((name, exc))
+
+    threads = [threading.Thread(target=consume, args=(name,))
+               for name in ('a', 'b')]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=120)
+    assert not errors, errors
+    assert results['a'] == list(range(NUM_ROWS))
+    assert results['b'] == list(range(NUM_ROWS))
+
+
+def test_shared_cache_cross_client_warm_hit(service_store, fleet):
+    """Acceptance: a rowgroup decoded for one job is a warm hit for every
+    other job — the second (distinct) client's epoch is served from the
+    fleet's shared Arrow-IPC cache."""
+    with make_reader(service_store['url'], service_url=fleet.service_url,
+                     num_epochs=1) as reader:
+        assert _read_ids(reader) == list(range(NUM_ROWS))
+    with make_reader(service_store['url'], service_url=fleet.service_url,
+                     num_epochs=1) as reader:
+        assert _read_ids(reader) == list(range(NUM_ROWS))
+        diag = reader.diagnostics
+    # every rowgroup of the second client's epoch was a cache hit filled by
+    # an earlier client (the cache_hit sidecar rides the wire unchanged)
+    assert diag['cache_hits'] == NUM_ROWS // ROWS_PER_FILE
+    assert diag['cache_misses'] == 0
+
+
+def test_elastic_worker_join_mid_epoch(service_store):
+    """A worker spawned mid-epoch registers with the live dispatcher and
+    serves the remainder of the epoch (elastic scale-out)."""
+    import time
+    with ServiceFleet(workers=1, stale_timeout_s=10.0) as running:
+        with make_reader(service_store['url'], service_url=running.service_url,
+                         num_epochs=2, shuffle_row_groups=False) as reader:
+            seen = []
+            joined = False
+            for row in reader:
+                seen.append(int(row.idx))
+                if not joined and len(seen) >= NUM_ROWS // 4:
+                    running.spawn_worker()
+                    # hold the epoch open until the joiner has registered
+                    # (startup is a fresh interpreter — seconds)
+                    deadline = time.monotonic() + 60
+                    while (running.dispatcher.scheduler.worker_count() < 2
+                           and time.monotonic() < deadline):
+                        time.sleep(0.05)
+                    joined = True
+        assert sorted(seen) == sorted(list(range(NUM_ROWS)) * 2)
+        state = running.state()
+        assert state['workers_registered_total'] == 2
+        assert len(state['workers']) == 2
+
+
+@pytest.mark.faultinject
+def test_worker_sigkill_mid_item_loses_zero_rows(service_store, tmp_path):
+    """Acceptance: killing a service worker mid-epoch loses zero rows — the
+    dispatcher's heartbeat watchdog deregisters it and re-ventilates its
+    in-flight item across the network onto a surviving worker."""
+    target = os.path.basename(_part_files(service_store['root'])[3])
+    sched = FaultSchedule(tmp_path / 'faults',
+                          [FaultRule(target, kind='kill', times=1)])
+    with ServiceFleet(workers=2, stale_timeout_s=3.0) as running:
+        with make_reader(service_store['url'], service_url=running.service_url,
+                         num_epochs=1, shuffle_row_groups=False,
+                         filesystem=fault_injecting_filesystem(sched)) as reader:
+            ids = _read_ids(reader)
+            diag = reader.diagnostics
+        assert ids == list(range(NUM_ROWS))  # zero rows lost
+        assert diag['rowgroups_quarantined'] == 0
+        assert diag['service']['workers_departed'] >= 1
+        assert diag['service']['items_requeued'] >= 1
+    assert sched.trigger_count(0) >= 1  # the kill really fired
+
+
+@pytest.mark.faultinject
+def test_quarantine_parity_with_in_process_pool(service_store, tmp_path):
+    """Acceptance: on_error='skip' over the service quarantines exactly what
+    the in-process pool quarantines — the ledger rides the wire sidecar.
+    Own cache-less fleet: a warm shared cache would (correctly) serve the
+    poisoned rowgroup without touching the faulty filesystem."""
+    target = os.path.basename(_part_files(service_store['root'])[2])
+
+    def read_with_faults(state_dir, **kwargs):
+        sched = FaultSchedule(state_dir, [FaultRule(target)])  # always fails
+        with make_reader(service_store['url'], num_epochs=1,
+                         filesystem=fault_injecting_filesystem(sched),
+                         on_error='skip', retry_policy=FAST_RETRIES,
+                         shuffle_row_groups=False, **kwargs) as reader:
+            return _read_ids(reader), reader.diagnostics
+
+    with ServiceFleet(workers=2) as running:
+        service_ids, service_diag = read_with_faults(
+            tmp_path / 'service_faults', service_url=running.service_url)
+    pool_ids, pool_diag = read_with_faults(
+        tmp_path / 'pool_faults', reader_pool_type='thread', workers_count=2)
+    assert service_ids == pool_ids
+    assert len(service_ids) == NUM_ROWS - ROWS_PER_FILE
+    assert (service_diag['rowgroups_quarantined']
+            == pool_diag['rowgroups_quarantined'] == 1)
+    (service_entry,) = service_diag['quarantine']
+    (pool_entry,) = pool_diag['quarantine']
+    for entry in (service_entry, pool_entry):
+        assert target in entry['fragment_path']
+        assert entry['error_type'] == pool_entry['error_type']
+        assert entry['reason'] == 'error'
+
+
+class EchoWorker(WorkerBase):
+    """Service-shippable toy worker: publishes its input doubled (dilled to
+    the real spawned decode workers — the pool contract without Parquet)."""
+
+    def process(self, **kwargs):
+        """Publish ``{'value': kwargs['value'] * 2}``."""
+        self.publish_func({'value': kwargs['value'] * 2})
+
+
+def test_admission_busy_backpressure(tmp_path):
+    """A client pushing past the dispatcher's admission window gets explicit
+    BUSY rejections, backs off, and still completes every item."""
+    with ServiceFleet(workers=1, admission_window=2,
+                      shm_results=False) as running:
+        pool = ServicePool(running.service_url, window=8)
+        try:
+            pool._window = 8  # out-submit the dispatcher's clamped window
+            pool.start(EchoWorker, None, ventilator=None)
+            for i in range(8):
+                pool.ventilate(value=i)
+            values = sorted(pool.get_results(timeout=60)['value']
+                            for _ in range(8))
+            assert values == [2 * i for i in range(8)]
+            assert pool.diagnostics['busy_rejections'] >= 1
+            state = running.state()
+            assert state['busy_rejections'] >= 1
+        finally:
+            pool.stop()
+            pool.join()
+
+
+class PoisonOnLoad(object):
+    """Dills fine client-side, explodes inside the worker's dill.loads —
+    the poison-work-item shape (version skew / client-only modules)."""
+
+    def __reduce__(self):
+        """Reconstruct via :func:`_explode` (which raises)."""
+        return (_explode, ())
+
+
+def _explode():
+    """Deserialization bomb for :class:`PoisonOnLoad`."""
+    raise RuntimeError('poison kwargs blob')
+
+
+def test_poison_work_item_fails_loudly_without_killing_worker():
+    """A work item whose kwargs cannot even deserialize server-side must
+    error back to its owner as one failed item — not crash the worker (the
+    dispatcher would re-queue it onto the next one and fell the fleet)."""
+    with ServiceFleet(workers=1, shm_results=False) as running:
+        pool = ServicePool(running.service_url)
+        try:
+            pool.start(EchoWorker, None, ventilator=None)
+            pool.ventilate(value=PoisonOnLoad())
+            with pytest.raises(RuntimeError, match='poison kwargs blob'):
+                pool.get_results(timeout=60)
+        finally:
+            pool.join()
+        # the worker survived the poison item
+        assert running.processes[0].poll() is None
+        assert len(running.state()['workers']) == 1
+
+
+def test_client_rejoins_after_dispatcher_forgets_it():
+    """A dispatcher that lost this client's registration (restart / TTL
+    collection) answers submits with ``rejoin``; the client re-hellos,
+    re-opens its setup, resubmits, and the read completes."""
+    with ServiceFleet(workers=1, shm_results=False) as running:
+        pool = ServicePool(running.service_url)
+        try:
+            pool.start(EchoWorker, None, ventilator=None)
+            pool.ventilate(value=1)
+            assert pool.get_results(timeout=60)['value'] == 2
+            # simulate a restart: the scheduler forgets every client (and
+            # with them, their setups)
+            scheduler = running.dispatcher.scheduler
+            for key in list(scheduler._clients):
+                scheduler.remove_client(key)
+            pool.ventilate(value=21)
+            assert pool.get_results(timeout=60)['value'] == 42
+            assert pool.diagnostics['rejoins'] >= 1
+        finally:
+            pool.stop()
+            pool.join()
+
+
+def test_unreachable_service_url_raises_transient():
+    with pytest.raises(TransientIOError):
+        ServicePool('tcp://127.0.0.1:1', connect_timeout_s=0.5)
+    with pytest.raises(TransientIOError):
+        fetch_service_state('tcp://127.0.0.1:1', timeout_s=0.5)
+
+
+def test_service_url_and_reader_pool_are_mutually_exclusive(service_store):
+    from petastorm_tpu.workers.dummy_pool import DummyPool
+    with pytest.raises(ValueError, match='mutually exclusive'):
+        make_reader(service_store['url'], service_url='tcp://127.0.0.1:1',
+                    reader_pool=DummyPool())
