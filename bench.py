@@ -155,7 +155,7 @@ _HEADLINE_FALLBACKS = (
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
-                 'resilience', 'pipecheck', 'tracing', 'service')
+                 'resilience', 'pipecheck', 'tracing', 'service', 'autotune')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -164,11 +164,11 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'decode_bench', 'service',
-                     'wire_bench', 'telemetry', 'tracing', 'resilience',
-                     'mnist_scan_stream', 'flash', 'moe', 'imagenet_scan',
-                     'imagenet_stream', 'decode_delta', 'bare_reader',
-                     'mnist_stream')
+SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'autotune', 'decode_bench',
+                     'service', 'wire_bench', 'telemetry', 'tracing',
+                     'resilience', 'mnist_scan_stream', 'flash', 'moe',
+                     'imagenet_scan', 'imagenet_stream', 'decode_delta',
+                     'bare_reader', 'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
 
@@ -1613,6 +1613,196 @@ def child_main():
             'service_workers': service_workers,
         })
 
+    def run_autotune():
+        """Closed-loop autotuner (host-only; docs/autotuning.md): the ISSUE-9
+        acceptance numbers. Uses a dedicated heavier store (the mnist bench
+        store's epochs are ~10ms — shorter than any control window): a reader
+        started from deliberately degraded knobs (1 worker, in-flight window
+        1) runs time-budgeted epochs with the controller on — the median of
+        the last completed epochs shows what the hill climb converged to,
+        next to the degraded-off baseline and the fixed-default epoch rate.
+        The overhead guard runs the controller in measure-only mode (empty
+        knob allowlist: it samples telemetry every window but never actuates)
+        on a default-shaped reader — the <=3% controller-cost acceptance."""
+        from petastorm_tpu.autotune import AutotunePolicy
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_rows
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        at_rows = int(os.environ.get('BENCH_AUTOTUNE_ROWS', 8000))
+        at_url = 'file://' + os.path.join(
+            tempfile.gettempdir(),
+            'petastorm_tpu_bench_autotune_{}'.format(at_rows))
+        if not os.path.exists(at_url[len('file://'):]):
+            at_schema = Unischema('AutotuneBench', [
+                UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+                UnischemaField('vec', np.float32, (256,), NdarrayCodec(),
+                               False),
+            ])
+            write_rows(at_url, at_schema,
+                       ({'idx': i, 'vec': np.full(256, i % 97, np.float32)}
+                        for i in range(at_rows)), rowgroup_size_mb=1)
+
+        # calm pacing: 0.3s windows + a 2% gate keep scheduler noise from
+        # validating commits (a noisy gate lets the climb wander off the
+        # optimum it already found)
+        policy = AutotunePolicy(window_s=0.3, warmup_windows=1,
+                                hold_windows=1, min_improvement=0.02,
+                                cooldown_windows=3)
+        base_budget_s = float(os.environ.get('BENCH_AUTOTUNE_BASE_S', 2.5))
+        tuned_budget_s = float(os.environ.get('BENCH_AUTOTUNE_TUNED_S', 15.0))
+
+        def run_reader(workers, autotune=None, budget_s=base_budget_s,
+                       vent_in_flight=None):
+            """One time-budgeted run over whole epochs (num_epochs=None,
+            stopped at the first epoch boundary past the budget, always
+            completing >=2 epochs); returns (whole-run rows/s, completed
+            per-epoch rows/s list, autotune report). ``vent_in_flight`` pins
+            the ventilation window (1 = the deliberate degradation; the
+            tuner-found value = the converged-config measurement run)."""
+            kwargs = {'num_epochs': None, 'shuffle_row_groups': False,
+                      'autotune': autotune}
+            if workers is not None:
+                kwargs['workers_count'] = workers
+            reader = make_reader(at_url, **kwargs)
+            if vent_in_flight is not None:
+                reader._ventilator.set_max_in_flight(int(vent_in_flight))
+            rows = 0
+            epoch_rows = {}
+            epoch_start = {}
+            epoch_end = {}
+            cur_epoch = None
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                now = time.perf_counter()
+                epoch = batch.item_id[0] if batch.item_id else 0
+                if (epoch != cur_epoch and cur_epoch is not None
+                        and len(epoch_rows) >= 2
+                        and now - start > budget_s):
+                    break
+                cur_epoch = epoch
+                epoch_start.setdefault(epoch, now)
+                epoch_end[epoch] = now
+                epoch_rows[epoch] = epoch_rows.get(epoch, 0) + batch.num_rows
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            report = reader.autotune_report()
+            reader.stop()
+            reader.join()
+            # completed epochs only (the one we broke out of is complete —
+            # the break fires on the FIRST batch of the next epoch)
+            per_epoch = [epoch_rows[e] / max(epoch_end[e] - epoch_start[e],
+                                             1e-9)
+                         for e in sorted(epoch_rows)
+                         if epoch_rows[e] and epoch_end[e] > epoch_start[e]]
+            return rows / max(elapsed, 1e-9), per_epoch, report, elapsed
+
+        def tail_median(rates, fallback):
+            """Steady-state ('converged') rate of one run: the median of the
+            last quarter of its completed epochs — excludes spin-up for EVERY
+            run the same way, so tuned-vs-default compares plateau to plateau
+            (not the tuned plateau to a default average paying its warmup)."""
+            tail = rates[-max(1, len(rates) // 4):]
+            return sorted(tail)[len(tail) // 2] if tail else fallback
+
+        # The decode-threads knob actuates through the env contract; restore
+        # it so a tuned value cannot leak into later sections' readers.
+        saved_decode_threads = os.environ.get('PETASTORM_TPU_DECODE_THREADS')
+        try:
+            # warm-up run: pages the store into cache so no later config is
+            # the one paying the cold reads
+            run_reader(None, budget_s=base_budget_s / 2)
+            degraded_run_rate, degraded_epochs, _, _ = run_reader(
+                1, vent_in_flight=1)
+            tuned_rate, tuned_epoch_rates, report, _ = run_reader(
+                1, autotune=policy, budget_s=tuned_budget_s,
+                vent_in_flight=1)
+            # "converged" = the plateau of the CONFIGURATION the climb found,
+            # measured without the controller: the tuned run's own tail still
+            # pays the exploration tax (propose -> hold -> revert cycles keep
+            # perturbing a converged pipeline), which is controller overhead,
+            # not the quality of the answer it converged to.
+            knobs = report.get('knobs', {})
+            found_workers = int((knobs.get('pool_workers') or {})
+                                .get('value') or 1)
+            found_in_flight = int((knobs.get('ventilator_max_in_flight')
+                                   or {}).get('value') or 1)
+            found_decode = (knobs.get('decode_threads') or {}).get('value')
+            if found_decode is not None:
+                os.environ['PETASTORM_TPU_DECODE_THREADS'] = str(
+                    int(found_decode))
+            # Paired A/B/A/B/A/B alternation: ambient load on this shared
+            # host drifts run rates by far more than the effect size, so
+            # back-to-back interleaved rounds (ratio of summed plateau rates)
+            # cancel the drift to first order — the only comparison at this
+            # noise floor that means anything.
+            paired = {'default': [], 'converged': []}
+            for _ in range(3):
+                rate, epochs, _ignored, _t = run_reader(
+                    None, budget_s=base_budget_s / 2)
+                paired['default'].append(tail_median(epochs, rate))
+                rate, epochs, _ignored, _t = run_reader(
+                    found_workers, vent_in_flight=found_in_flight,
+                    budget_s=base_budget_s / 2)
+                paired['converged'].append(tail_median(epochs, rate))
+        finally:
+            if saved_decode_threads is None:
+                os.environ.pop('PETASTORM_TPU_DECODE_THREADS', None)
+            else:
+                os.environ['PETASTORM_TPU_DECODE_THREADS'] = saved_decode_threads
+        default_rate = sum(paired['default']) / len(paired['default'])
+        degraded_rate = tail_median(degraded_epochs, degraded_run_rate)
+        tuned_final = sum(paired['converged']) / len(paired['converged'])
+        # Controller overhead: a measure-only controller (samples telemetry +
+        # attributes the bottleneck every window, zero actuations) on a
+        # default-shaped reader, measured DIRECTLY — controller step seconds
+        # over run wall time. Whole-pipeline A/B deltas on this shared host
+        # drift by several percent between runs, far above the controller's
+        # true cost; the direct account is what the <=3% guard actually
+        # asserts about.
+        measure_only = AutotunePolicy(window_s=0.3, knob_ids=())
+        _rate, _epochs, guard_report, guard_elapsed = run_reader(
+            None, autotune=measure_only, budget_s=base_budget_s)
+        overhead_pct = (guard_report.get('controller_step_seconds', 0.0)
+                        / max(guard_elapsed, 1e-9) * 100.0)
+        decisions = report.get('decisions', [])
+        log('autotune: degraded {:.1f} -> converged config {:.1f} rows/s '
+            '(default {:.1f}) after {} epoch(s)/{} window(s); {} decision(s), '
+            '{} committed, {} reverted; workers {} in-flight {}; controller '
+            'overhead {:+.2f}%'.format(
+                degraded_rate, tuned_final, default_rate,
+                len(tuned_epoch_rates), report.get('windows', 0),
+                len(decisions), report.get('committed', 0),
+                report.get('reverted', 0), found_workers, found_in_flight,
+                overhead_pct))
+        results.update({
+            'autotune_default_rows_per_sec': round(default_rate, 1),
+            'autotune_degraded_rows_per_sec': round(degraded_rate, 1),
+            'autotune_tuned_rows_per_sec': round(tuned_rate, 1),
+            'autotune_tuned_final_epoch_rows_per_sec': round(tuned_final, 1),
+            'autotune_tuned_vs_default':
+                round(tuned_final / max(default_rate, 1e-9), 3),
+            'autotune_tuned_vs_degraded':
+                round(tuned_final / max(degraded_rate, 1e-9), 3),
+            'autotune_decisions': len(decisions),
+            'autotune_committed': report.get('committed', 0),
+            'autotune_reverted': report.get('reverted', 0),
+            'autotune_windows': report.get('windows', 0),
+            'autotune_frozen_by_breaker': report.get('frozen_by_breaker',
+                                                     False),
+            'autotune_final_pool_workers':
+                (knobs.get('pool_workers') or {}).get('value'),
+            'autotune_final_ventilator_max_in_flight':
+                (knobs.get('ventilator_max_in_flight') or {}).get('value'),
+            'autotune_final_decode_threads':
+                (knobs.get('decode_threads') or {}).get('value'),
+            'autotune_overhead_pct': round(overhead_pct, 2),
+            'autotune_tuned_epochs': len(tuned_epoch_rates),
+            # provenance: the store + budgets behind the numbers
+            'autotune_store_rows': at_rows,
+            'autotune_tuned_budget_s': tuned_budget_s,
+        })
+
     def run_pipecheck():
         """Check phase (host-only, sub-second): the pipecheck static
         data-plane invariant analysis + the mypy-strict ratchet over the
@@ -1679,6 +1869,7 @@ def child_main():
         'resilience': run_resilience,
         'pipecheck': run_pipecheck,
         'service': run_service,
+        'autotune': run_autotune,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

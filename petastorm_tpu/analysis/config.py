@@ -60,6 +60,11 @@ STAGE_CATALOG_SUFFIX: str = 'telemetry/spans.py'
 #: where the declared quarantine-reason registry lives (path suffix)
 QUARANTINE_REGISTRY_SUFFIX: str = 'resilience.py'
 
+#: where the autotuner's knob-id catalog lives (path suffix); ``Knob(...)``
+#: constructions and ``catalog.knob(...)`` references are checked against its
+#: ``KNOB_IDS`` tuple (telemetry-names rule, docs/autotuning.md)
+KNOB_CATALOG_SUFFIX: str = 'autotune/knobs.py'
+
 #: mypy option names a ratchet entry's section must set to True
 STRICT_FLAGS: Tuple[str, ...] = ('disallow_untyped_defs',
                                  'disallow_incomplete_defs',
@@ -79,6 +84,7 @@ class AnalysisConfig:
     datapath_files: Tuple[str, ...] = DATAPATH_FILES
     stage_catalog_suffix: str = STAGE_CATALOG_SUFFIX
     quarantine_registry_suffix: str = QUARANTINE_REGISTRY_SUFFIX
+    knob_catalog_suffix: str = KNOB_CATALOG_SUFFIX
     strict_flags: Tuple[str, ...] = STRICT_FLAGS
     #: explicit mypy.ini path; None = walk up from the analyzed roots
     mypy_ini_path: Optional[str] = None

@@ -13,7 +13,10 @@ entry, and no doc row knows about. This rule closes that hole statically:
 - ``trace_instant('x', ...)`` → ``x`` in ``TRACE_INSTANTS`` (the
   flight-recorder anomaly catalog — docs/observability.md "Flight recorder");
 - ``trace_complete('x', ...)`` → ``x`` in ``STAGES`` (a traced span IS a
-  stage span, just on the timeline instead of a histogram).
+  stage span, just on the timeline instead of a histogram);
+- ``Knob('x', ...)`` / ``<catalog>.knob('x')`` → ``x`` in ``KNOB_IDS``
+  (``autotune/knobs.py`` — the autotuner's knob-id catalog,
+  docs/autotuning.md): a typo'd knob id names a knob nobody turns.
 
 Conditional names (``'cache_hit' if hit else 'cache_miss'``) check both
 branches; non-literal names are skipped (they are register-time plumbing, not
@@ -25,7 +28,8 @@ own catalog), else from the installed ``petastorm_tpu.telemetry.spans``.
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional, Tuple
+import importlib
+from typing import Callable, Iterable, List, Optional, Tuple, TypeVar
 
 from petastorm_tpu.analysis.core import (AnalysisContext, Finding, Rule,
                                          SourceModule, extract_string_tuple,
@@ -37,6 +41,9 @@ _NAME_FUNCS = ('stage_span', 'record_stage', 'trace_complete',
                'observe_traced')
 #: call form checked against TRACE_INSTANTS (flight-recorder anomaly markers)
 _INSTANT_FUNCS = ('trace_instant',)
+#: call forms checked against KNOB_IDS: Knob construction and catalog lookup
+_KNOB_CTOR = 'Knob'
+_KNOB_ACCESSOR = 'knob'
 
 
 class _Catalog:
@@ -52,6 +59,14 @@ class _Catalog:
         self.origin = origin
 
 
+class _KnobCatalog:
+    """The declared autotuner knob ids (``KNOB_IDS`` in autotune/knobs.py)."""
+
+    def __init__(self, knob_ids: Tuple[str, ...], origin: str) -> None:
+        self.knob_ids = frozenset(knob_ids)
+        self.origin = origin
+
+
 def _catalog_from_tree(tree: ast.Module, origin: str) -> Optional[_Catalog]:
     stages = extract_string_tuple(tree, 'STAGES')
     if stages is None:
@@ -63,28 +78,62 @@ def _catalog_from_tree(tree: ast.Module, origin: str) -> Optional[_Catalog]:
                     tuple(trace_instants), origin)
 
 
-def load_catalog(ctx: AnalysisContext) -> Optional[_Catalog]:
-    """Resolve the stage/counter catalog (analyzed tree first, then the
-    installed package source)."""
-    cached = ctx.rule_state(TelemetryNamesRule.name).get('catalog')
+_CatalogT = TypeVar('_CatalogT')
+
+
+def _resolve_catalog(ctx: AnalysisContext, state_key: str, suffix: str,
+                     installed_module: str,
+                     from_tree: Callable[[ast.Module, str],
+                                         Optional[_CatalogT]]
+                     ) -> Optional[_CatalogT]:
+    """The ONE resolution dance every declared-name catalog uses: analyzed
+    tree first (a mutated copy is judged against its own declarations), then
+    the installed package source, cached in the rule state."""
+    state = ctx.rule_state(TelemetryNamesRule.name)
+    cached = state.get(state_key)
     if cached is not None:
         return cached  # type: ignore[return-value]
-    catalog: Optional[_Catalog] = None
-    module = ctx.find_module(ctx.config.stage_catalog_suffix)
+    catalog: Optional[_CatalogT] = None
+    module = ctx.find_module(suffix)
     if module is not None:
-        catalog = _catalog_from_tree(module.tree, module.display)
+        catalog = from_tree(module.tree, module.display)
     if catalog is None:
         try:
-            import petastorm_tpu.telemetry.spans as spans_module
-            path = spans_module.__file__
+            installed = importlib.import_module(installed_module)
+            path = installed.__file__
             if path is not None:
                 tree = ast.parse(open(path, encoding='utf-8').read())
-                catalog = _catalog_from_tree(tree, path)
+                catalog = from_tree(tree, path)
         except (ImportError, OSError, SyntaxError):
             catalog = None
     if catalog is not None:
-        ctx.rule_state(TelemetryNamesRule.name)['catalog'] = catalog
+        state[state_key] = catalog
     return catalog
+
+
+def load_catalog(ctx: AnalysisContext) -> Optional[_Catalog]:
+    """Resolve the stage/counter catalog (analyzed tree first, then the
+    installed package source)."""
+    return _resolve_catalog(ctx, 'catalog', ctx.config.stage_catalog_suffix,
+                            'petastorm_tpu.telemetry.spans',
+                            _catalog_from_tree)
+
+
+def _knob_catalog_from_tree(tree: ast.Module,
+                            origin: str) -> Optional[_KnobCatalog]:
+    knob_ids = extract_string_tuple(tree, 'KNOB_IDS')
+    if knob_ids is None:
+        return None
+    return _KnobCatalog(tuple(knob_ids), origin)
+
+
+def load_knob_catalog(ctx: AnalysisContext) -> Optional[_KnobCatalog]:
+    """Resolve the autotuner knob-id catalog — same resolution order as the
+    stage catalog, so a mutated copy is judged against its own ids."""
+    return _resolve_catalog(ctx, 'knob_catalog',
+                            ctx.config.knob_catalog_suffix,
+                            'petastorm_tpu.autotune.knobs',
+                            _knob_catalog_from_tree)
 
 
 class TelemetryNamesRule(Rule):
@@ -94,7 +143,8 @@ class TelemetryNamesRule(Rule):
     description = ('stage_span/record_stage/observe/inc/trace_complete/'
                    'trace_instant names must exist in the telemetry catalog '
                    '(STAGES / COUNTERS / SIZE_HISTOGRAMS / TRACE_INSTANTS in '
-                   'telemetry/spans.py)')
+                   'telemetry/spans.py); Knob()/catalog.knob() ids must exist '
+                   'in KNOB_IDS (autotune/knobs.py)')
 
     def check_module(self, module: SourceModule,
                      ctx: AnalysisContext) -> Iterable[Finding]:
@@ -103,6 +153,9 @@ class TelemetryNamesRule(Rule):
         catalog = load_catalog(ctx)
         if catalog is None:
             return []
+        knob_catalog = load_knob_catalog(ctx)
+        is_knob_catalog_module = module.posix().endswith(
+            ctx.config.knob_catalog_suffix)
         findings: List[Finding] = []
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call) or not node.args:
@@ -117,6 +170,7 @@ class TelemetryNamesRule(Rule):
             names: List[Tuple[str, int]] = []
             allowed: Optional[frozenset] = None
             family = ''
+            origin = catalog.origin
             if func_name in _NAME_FUNCS or attr_name in _NAME_FUNCS:
                 names = literal_str_values(node.args[0])
                 allowed = catalog.stages
@@ -133,6 +187,18 @@ class TelemetryNamesRule(Rule):
                 names = literal_str_values(node.args[0])
                 allowed = catalog.counters
                 family = 'COUNTERS'
+            elif ((func_name == _KNOB_CTOR or attr_name == _KNOB_CTOR
+                   or attr_name == _KNOB_ACCESSOR)
+                  and knob_catalog is not None and not is_knob_catalog_module):
+                # Knob('x', ...) construction / catalog.knob('x') lookup
+                # (first positional literal; kwarg-only constructions are
+                # register-time plumbing and skipped like any non-literal).
+                # The catalog module itself is exempt so KNOB_IDS can be
+                # grown alongside the Knob builders that first use an id.
+                names = literal_str_values(node.args[0])
+                allowed = knob_catalog.knob_ids
+                family = 'KNOB_IDS'
+                origin = knob_catalog.origin
             if not names or allowed is None:
                 continue
             for value, line in names:
@@ -142,5 +208,5 @@ class TelemetryNamesRule(Rule):
                         'telemetry name {!r} is not declared in {} '
                         '(catalog: {}) — it would mint an orphan metric no '
                         'dashboard or bottleneck map knows'.format(
-                            value, family, catalog.origin)))
+                            value, family, origin)))
         return findings

@@ -1,0 +1,37 @@
+"""Closed-loop autotuner: telemetry-driven online retuning of pipeline knobs.
+
+The subsystem that closes the loop PR 3 opened: ``attribute_bottleneck``
+already names the knob that moves the dominant stage — this package turns it,
+live, mid-epoch (docs/autotuning.md; the tf.data AUTOTUNE model,
+arXiv 2101.12127):
+
+- :mod:`~petastorm_tpu.autotune.knobs` — the typed knob actuation layer
+  (:class:`Knob`/:class:`KnobCatalog`, the declared ``KNOB_IDS`` catalog, and
+  builders that wire knobs into live readers/loaders/service schedulers);
+- :mod:`~petastorm_tpu.autotune.policy` — :class:`AutotunePolicy`, the pacing
+  and hysteresis constants;
+- :mod:`~petastorm_tpu.autotune.controller` — the hill-climbing
+  :class:`AutotuneController` (propose -> hold -> measure -> commit/revert,
+  breaker-board safety interlock, JSONL + flight-recorder decision audit).
+
+Enable per reader with ``make_reader(..., autotune=True)`` (or an
+:class:`AutotunePolicy`); inspect with ``Reader.autotune_report()`` /
+``diagnostics['autotune']``. The service dispatcher reuses the same controller
+core for its admission windows (``Dispatcher(autotune=...)``). Off by default:
+with ``autotune`` unset no controller is built and no knob is ever touched.
+"""
+
+from petastorm_tpu.autotune.controller import (AutotuneController,
+                                               choose_from_bottleneck,
+                                               setup_reader_autotune,
+                                               snapshot_delta)
+from petastorm_tpu.autotune.knobs import (KNOB_IDS, Knob, KnobCatalog,
+                                          build_loader_knobs,
+                                          build_reader_knobs,
+                                          build_service_knobs)
+from petastorm_tpu.autotune.policy import AutotunePolicy, resolve_policy
+
+__all__ = ['AutotuneController', 'AutotunePolicy', 'KNOB_IDS', 'Knob',
+           'KnobCatalog', 'build_loader_knobs', 'build_reader_knobs',
+           'build_service_knobs', 'choose_from_bottleneck', 'resolve_policy',
+           'setup_reader_autotune', 'snapshot_delta']

@@ -125,6 +125,12 @@ class LocalDiskCache(CacheBase):
         # process-local default board so its state rides the results-channel
         # breaker sidecar into Reader.diagnostics; injectable for tests.
         self._breaker = breaker if breaker is not None else self._default_breaker()
+        # Runtime bypass knob (docs/autotuning.md): forces get() onto the
+        # direct-fill path exactly like an open breaker, without touching the
+        # breaker's failure state. Turned by the autotuner when serving hits
+        # is measured slower than refilling (e.g. the pickle format's
+        # per-hit unpickle on a fast store).
+        self._forced_bypass = False
         # Approximate running byte total: seeded from one scan, bumped per store; the
         # expensive full rescan happens only when this crosses the limit.
         self._approx_bytes = None
@@ -170,11 +176,26 @@ class LocalDiskCache(CacheBase):
 
     # ------------------------------------------------------------------- get
 
+    @property
+    def bypass(self):
+        """True while the runtime bypass knob routes ``get`` to direct fills."""
+        return self._forced_bypass
+
+    def set_bypass(self, flag):
+        """Runtime cache-mode knob (docs/autotuning.md): ``True`` makes ``get``
+        serve direct fills (no read, no store — counted in
+        ``stats['bypass_reads']``) without touching the circuit breaker;
+        ``False`` restores normal hit/miss serving. Live for in-process pools;
+        process-pool workers capture the flag at spawn. Returns the flag."""
+        self._forced_bypass = bool(flag)
+        return self._forced_bypass
+
     def get(self, key, fill_cache_func):
-        if not self._breaker.allow():
-            # Breaker open: the disk under this cache keeps corrupting or
-            # erroring — bypass it entirely (no read, no store) until the
-            # cooldown's half-open probe passes. Degradation, never silence.
+        if self._forced_bypass or not self._breaker.allow():
+            # Breaker open (or the bypass knob is set): the disk under this
+            # cache keeps corrupting or erroring — bypass it entirely (no
+            # read, no store) until the cooldown's half-open probe passes.
+            # Degradation, never silence.
             with self._lock:
                 self.stats['bypass_reads'] += 1
             return fill_cache_func()
@@ -334,6 +355,25 @@ class ArrowIpcDiskCache(LocalDiskCache):
         super().__init__(path, size_limit_bytes, expected_row_size_bytes,
                          cleanup=cleanup, shards=shards, breaker=breaker)
         self._writable_hits = writable_hits
+        #: set by make_reader when the user passed an explicit
+        #: cache_extra_settings={'writable_hits': ...} — a pinned hit mode is
+        #: a consumer requirement, not an autotuner knob (docs/autotuning.md)
+        self.writable_hits_pinned = False
+
+    @property
+    def writable_hits(self):
+        """True when hits decode writable copies instead of read-only views."""
+        return self._writable_hits
+
+    def set_writable_hits(self, flag):
+        """Runtime hit-mode knob (docs/autotuning.md): ``False`` serves hits as
+        zero-copy read-only mmap views (fastest), ``True`` copies each column
+        out writable (required by in-place ``transform_spec`` consumers — the
+        autotuner only turns this knob on transform-free readers). Live for
+        in-process pools; process-pool workers capture the flag at spawn.
+        Returns the flag."""
+        self._writable_hits = bool(flag)
+        return self._writable_hits
 
     def _encode_value(self, value):
         from petastorm_tpu.workers.serializers import (_columns_num_rows,

@@ -227,6 +227,17 @@ class JaxDataLoader(object):
         self._scan_stream_cache_warned = False
         self._coalesce_fields = coalesce_fields
         self._unpack_programs = {}
+        # Closed-loop autotuning (docs/autotuning.md): when the reader carries
+        # a controller (make_reader(autotune=...)), contribute the loader's
+        # own knob — the shuffle-buffer fill threshold — to its catalog so the
+        # one controller tunes the whole pipeline. _active_buffer hands the
+        # live buffer to the knob's apply.
+        self._active_buffer = None
+        controller = getattr(reader, '_autotune', None)
+        if controller is not None:
+            from petastorm_tpu.autotune.knobs import build_loader_knobs
+            for knob in build_loader_knobs(self):
+                controller.catalog.add(knob)
 
     # ------------------------------------------------------------------ sharding
 
@@ -326,6 +337,7 @@ class JaxDataLoader(object):
     def _produce(self, out_queue, stop_event):
         try:
             buffer = self._make_buffer()
+            self._active_buffer = buffer
             for columns in self._reader_chunks():
                 # Feed the buffer in batch_size slices so a whole-rowgroup chunk (the
                 # iter_columnar fast path) cannot blow past the shuffling buffer's

@@ -172,6 +172,22 @@ class RandomShufflingBuffer(ShufflingBufferBase):
     def can_add(self):
         return self._size < self._capacity and not self._finished
 
+    @property
+    def min_after_retrieve(self):
+        """The current decorrelation floor."""
+        return self._min_after
+
+    def set_min_after_retrieve(self, value):
+        """Runtime adjust of the decorrelation floor, clamped to
+        ``[0, capacity]`` — the loader fill-threshold knob the autotuner turns
+        (docs/autotuning.md). A single attribute store, so it is safe to call
+        from a controller thread while the producer thread retrieves (the
+        buffer's not-thread-safe contract otherwise stands). Returns the
+        applied value."""
+        value = max(0, min(int(value), self._capacity))
+        self._min_after = value
+        return value
+
     def retrieve(self, n):
         if self._finished:
             take = min(n, self._size)

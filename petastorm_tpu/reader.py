@@ -96,8 +96,15 @@ def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_est
         else:
             raise ValueError('Unknown cache_format {!r} (expected arrow-ipc/pickle)'
                              .format(cache_format))
-        return cache_cls(cache_location, cache_size_limit, cache_row_size_estimate or 0,
-                         **extra)
+        cache = cache_cls(cache_location, cache_size_limit, cache_row_size_estimate or 0,
+                          **extra)
+        # An explicit writable_hits override is a statement about what the
+        # consumer needs (e.g. in-place mutation of hit columns with no
+        # transform_spec) — pin it so the autotuner never treats the hit mode
+        # as a free knob (docs/autotuning.md).
+        if 'writable_hits' in (cache_extra_settings or {}):
+            cache.writable_hits_pinned = True
+        return cache
     raise ValueError('Unknown cache_type {!r} (expected null/local-disk)'.format(cache_type))
 
 
@@ -114,7 +121,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 filesystem=None, resume_state=None, reader_pool=None,
                 field_overrides=None, hdfs_driver='libhdfs', on_error='raise',
                 retry_policy=None, shm_transport=None, item_deadline_s=None,
-                heartbeat_interval_s=None, trace=None, service_url=None):
+                heartbeat_interval_s=None, trace=None, service_url=None,
+                autotune=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -168,7 +176,18 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     co-located), and ``on_error`` modes, the quarantine ledger, telemetry
     and tracing work unchanged. Pool-shape arguments are ignored (the fleet
     defines its own shape); ``None`` (default) keeps today's in-process
-    behavior byte-identical."""
+    behavior byte-identical.
+
+    Closed-loop autotuning (docs/autotuning.md): ``autotune=True`` (or an
+    :class:`~petastorm_tpu.autotune.AutotunePolicy`) starts a controller
+    thread that samples this reader's telemetry mid-epoch, attributes the
+    bottleneck stage, and hill-climbs one knob at a time (ventilation depth,
+    pool workers, decode threads, cache mode — propose, hold, measure rows/s,
+    commit or revert) with the circuit-breaker board as a safety interlock.
+    Inspect with :meth:`Reader.autotune_report` / ``diagnostics['autotune']``;
+    every decision is also an ``autotune_decision`` JSONL/trace event. Off by
+    default — with ``autotune`` unset no controller exists and no knob is
+    ever touched."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -230,7 +249,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   storage_options=storage_options, filesystem=filesystem,
                   resume_state=resume_state, on_error=on_error,
                   retry_policy=retry_policy,
-                  initial_io_retries=construction_retries[0])
+                  initial_io_retries=construction_retries[0],
+                  autotune=autotune)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -244,12 +264,13 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       storage_options=None, filesystem=None,
                       resume_state=None, hdfs_driver='libhdfs', on_error='raise',
                       retry_policy=None, shm_transport=None, item_deadline_s=None,
-                      heartbeat_interval_s=None, trace=None, service_url=None):
+                      heartbeat_interval_s=None, trace=None, service_url=None,
+                      autotune=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
     ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
-    ``service_url`` behave exactly as in :func:`make_reader`.
+    ``service_url`` / ``autotune`` behave exactly as in :func:`make_reader`.
     """
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
@@ -304,7 +325,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   decode=False, storage_options=storage_options, filesystem=filesystem,
                   resume_state=resume_state, on_error=on_error,
                   retry_policy=retry_policy,
-                  initial_io_retries=construction_retries[0])
+                  initial_io_retries=construction_retries[0],
+                  autotune=autotune)
 
 
 class Reader(object):
@@ -317,7 +339,8 @@ class Reader(object):
                  num_epochs=1, cur_shard=None, shard_count=None, shard_seed=None,
                  cache=None, transform_spec=None, is_batched_reader=False, decode=True,
                  storage_options=None, filesystem=None, resume_state=None,
-                 on_error='raise', retry_policy=None, initial_io_retries=0):
+                 on_error='raise', retry_policy=None, initial_io_retries=0,
+                 autotune=None):
         from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
         retry_policy = resolve_retry_policy(on_error, retry_policy)
         construction_retries = [initial_io_retries]
@@ -340,6 +363,11 @@ class Reader(object):
         self._cache = cache
         self._cache_hits = 0
         self._cache_misses = 0
+        # Autotune goodput signal (docs/autotuning.md): rows delivered off the
+        # results channel — the controller's per-window rows/s numerator.
+        self._rows_consumed = 0
+        self._transform_spec = transform_spec
+        self._autotune = None
         # Pipeline telemetry (docs/observability.md): worker-process stage times
         # arrive on each batch's telemetry sidecar and merge here; pool-level
         # registries merge at snapshot time, so telemetry_snapshot() covers every
@@ -561,6 +589,15 @@ class Reader(object):
                                                      on_batch=self._note_item_consumed,
                                                      fast_forward=self._resume_fast_forward)
 
+        # Closed-loop autotuner (docs/autotuning.md): built only when asked —
+        # the disabled path constructs nothing and mutates nothing.
+        from petastorm_tpu.autotune.policy import resolve_policy
+        autotune_policy = resolve_policy(autotune)
+        if autotune_policy is not None:
+            from petastorm_tpu.autotune.controller import setup_reader_autotune
+            self._autotune = setup_reader_autotune(self, autotune_policy)
+            self._autotune.start()
+
     # --------------------------------------------------------------- sharding
 
     @staticmethod
@@ -701,6 +738,7 @@ class Reader(object):
             trace_instant('rowgroup_consumed', ctx=(epoch, piece, 0),
                           args={'rows': getattr(batch, 'num_rows', 0)})
         with self._accounting_lock:
+            self._rows_consumed += getattr(batch, 'num_rows', 0) or 0
             self._consumed_by_epoch.setdefault(epoch, set()).add((piece, drop))
             # Epochs complete strictly in order; results of later epochs accumulate in
             # their own sets until the earlier epoch's straggler items are popped.
@@ -791,6 +829,22 @@ class Reader(object):
             return self._io_retries
 
     @property
+    def rows_consumed(self):
+        """Cumulative rows delivered off the results channel (NGram: windows) —
+        the autotuner's goodput numerator (docs/autotuning.md)."""
+        with self._accounting_lock:
+            return self._rows_consumed
+
+    def autotune_report(self):
+        """The closed-loop autotuner's state (docs/autotuning.md): windows,
+        decision log, frozen-by-breaker flag, and current knob values/bounds —
+        ``{'enabled': False}`` when the reader was built without
+        ``autotune``."""
+        if self._autotune is None:
+            return {'enabled': False}
+        return self._autotune.report()
+
+    @property
     def telemetry(self):
         """The reader's consumer-side :class:`~petastorm_tpu.telemetry.MetricsRegistry`
         (worker sidecar merges land here); prefer :meth:`telemetry_snapshot` for
@@ -844,6 +898,10 @@ class Reader(object):
 
     def stop(self):
         self._stopped = True
+        if self._autotune is not None:
+            # the controller must stop turning knobs before the pool they
+            # actuate starts tearing down
+            self._autotune.stop()
         self._pool.stop()
 
     def join(self):
@@ -892,6 +950,10 @@ class Reader(object):
         # an empty recorder would just be noise in every dashboard).
         if trace_enabled():
             diag['trace'] = self.trace_summary()
+        # Autotune block only when a controller exists: the disabled path's
+        # diagnostics stay byte-identical to the seed.
+        if self._autotune is not None:
+            diag['autotune'] = self._autotune.report()
         return diag
 
     def __enter__(self):

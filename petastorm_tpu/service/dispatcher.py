@@ -89,16 +89,20 @@ DEFAULT_CLIENT_TTL_S = 900.0
 class _ClientState(object):
     """Dispatcher-side record of one connected reader client."""
 
-    __slots__ = ('key', 'name', 'host', 'window', 'queue', 'assigned',
-                 'deficit', 'served', 'busy_rejections', 'last_seen',
-                 'setup_ids')
+    __slots__ = ('key', 'name', 'host', 'window', 'requested_window',
+                 'queue', 'assigned', 'deficit', 'served', 'busy_rejections',
+                 'last_seen', 'setup_ids')
 
     def __init__(self, key: bytes, name: str, host: str, window: int,
-                 now: float) -> None:
+                 now: float, requested_window: Optional[int] = None) -> None:
         self.key = key
         self.name = name
         self.host = host
         self.window = window
+        #: the window the client ASKED for at hello (None = follow the
+        #: admission cap): a raised cap lifts follow-the-cap clients with it,
+        #: but never silently widens a client that asked for less
+        self.requested_window = requested_window
         self.queue: Deque[int] = collections.deque()
         self.assigned: Set[int] = set()
         self.deficit = 0.0
@@ -214,8 +218,66 @@ class FairShareScheduler(object):
         self.results_dropped = 0
         self.items_requeued = 0
         self.items_failed = 0
+        self.items_served = 0
         self.workers_registered_total = 0
         self.workers_departed = 0
+
+    # ------------------------------------------------------------- autotune
+
+    def set_admission_window(self, value: int) -> int:
+        """Bounded runtime retune of the admission cap (docs/autotuning.md):
+        new clients hello against the new cap; live clients whose window
+        exceeds it are clamped down, and clients that follow the cap (hello'd
+        without a window, or asked for more than the cap allows) are lifted
+        with it — but a client that asked for less than the new cap is never
+        silently widened past its request. Returns the applied value."""
+        with self._lock:
+            value = max(1, int(value))
+            self.admission_window = value
+            for client in self._clients.values():
+                requested = client.requested_window
+                client.window = min(requested or value, value)
+            return value
+
+    def set_client_windows(self, value: int) -> int:
+        """Runtime retune of every live client's in-flight depth, clamped to
+        ``[1, admission_window]`` (docs/autotuning.md) — the per-client half
+        of the service autotuner. Returns the applied value."""
+        with self._lock:
+            value = max(1, min(int(value), self.admission_window))
+            for client in self._clients.values():
+                client.window = value
+            return value
+
+    def effective_client_window(self) -> int:
+        """The smallest live client window (the admission cap when no client
+        is connected) — the service-client-window knob's current value."""
+        with self._lock:
+            if not self._clients:
+                return self.admission_window
+            return min(client.window for client in self._clients.values())
+
+    def autotune_snapshot(self) -> Dict[str, Any]:
+        """A telemetry-shaped snapshot of the scheduler's control signals
+        (cumulative counters + current gauges) for the autotune controller's
+        window deltas and ``attribute_bottleneck``'s service advisories."""
+        with self._lock:
+            return {
+                'histograms': {},
+                'counters': {'service_busy': self.busy_rejections,
+                             'service_resubmit': self.items_requeued},
+                'gauges': {
+                    'service_queue_depth': float(sum(
+                        len(c.queue) for c in self._clients.values())),
+                    'service_ready_workers': float(len(self._ready_workers)),
+                    'service_workers': float(len(self._workers)),
+                    'service_admission_window': float(self.admission_window),
+                    # inlined effective_client_window (we already hold _lock)
+                    'service_client_window': float(
+                        min((c.window for c in self._clients.values()),
+                            default=self.admission_window)),
+                },
+            }
 
     # ------------------------------------------------------------- clients
 
@@ -226,8 +288,18 @@ class FairShareScheduler(object):
             effective = min(window or self.admission_window,
                             self.admission_window)
             self._clients[key] = _ClientState(key, name, host, effective,
-                                              self._clock())
+                                              self._clock(),
+                                              requested_window=window)
             return effective
+
+    def client_window(self, key: bytes) -> int:
+        """The client's CURRENT in-flight window — piggybacked on every
+        accept/busy reply so live clients adopt dispatcher-side retuning
+        (the autotune window knobs would otherwise move a limit connected
+        clients never observe; docs/autotuning.md)."""
+        with self._lock:
+            client = self._clients.get(key)
+            return client.window if client is not None else self.admission_window
 
     def has_client(self, key: bytes) -> bool:
         """True when ``key`` is a registered client. A submit from an
@@ -549,6 +621,7 @@ class FairShareScheduler(object):
             if client is not None:
                 client.assigned.discard(token)
                 client.served += 1
+                self.items_served += 1
             if state.worker_key is not None:
                 worker = self._workers.get(state.worker_key)
                 if worker is not None:
@@ -635,9 +708,41 @@ class FairShareScheduler(object):
                 'results_dropped': self.results_dropped,
                 'items_requeued': self.items_requeued,
                 'items_failed': self.items_failed,
+                'items_served': self.items_served,
+                'admission_window': self.admission_window,
                 'workers_registered_total': self.workers_registered_total,
                 'workers_departed': self.workers_departed,
             }
+
+
+def choose_service_knob(prev: Dict[str, Any], cur: Dict[str, Any],
+                        rate: float, eligible: List[Any]) -> Optional[str]:
+    """The service controller's knob chooser (docs/autotuning.md): admission
+    signals instead of stage histograms. A window with fresh ``busy``
+    rejections while the queue is shallow means clients are throttled below
+    what the fleet could absorb — retune the live client windows; a queue deep
+    past the fleet's absorption rate points at the admission cap."""
+    ids = {knob.knob_id for knob in eligible}
+    busy_delta = (int((cur.get('counters') or {}).get('service_busy', 0))
+                  - int((prev.get('counters') or {}).get('service_busy', 0)))
+    gauges = cur.get('gauges') or {}
+    queue_depth = float(gauges.get('service_queue_depth', 0.0))
+    workers = max(1.0, float(gauges.get('service_workers', 1.0)))
+    admission = float(gauges.get('service_admission_window', 0.0))
+    client_window = float(gauges.get('service_client_window', admission))
+    if busy_delta > 0 and queue_depth <= 2 * workers:
+        # clients throttled below fleet capacity. The common fleet has every
+        # client AT the admission cap (hello without a window = follow the
+        # cap) — the client-window knob is pinned there, so the cap itself is
+        # the knob to raise (follow-the-cap clients are lifted with it and
+        # adopt it via the accept/busy piggyback).
+        if client_window < admission and 'service_client_window' in ids:
+            return 'service_client_window'
+        if 'service_admission_window' in ids:
+            return 'service_admission_window'
+    if queue_depth > 8 * workers and 'service_admission_window' in ids:
+        return 'service_admission_window'
+    return None
 
 
 class Dispatcher(object):
@@ -645,7 +750,14 @@ class Dispatcher(object):
     messages on a daemon thread, and translates scheduler decisions into
     ``work`` sends. All socket use stays on the dispatcher thread (ROUTER
     sends are not thread-safe); :meth:`state` reads the scheduler snapshot
-    under its own lock from any thread."""
+    under its own lock from any thread.
+
+    ``autotune`` (docs/autotuning.md): ``True`` or an
+    :class:`~petastorm_tpu.autotune.AutotunePolicy` arms the same controller
+    core the reader uses — driven from the pump thread (no extra thread), it
+    retunes the admission window and live per-client in-flight depth from the
+    scheduler's queue-depth/``service_busy`` signals, with the process breaker
+    board as the interlock. Off (None) by default."""
 
     def __init__(self, host: str = '127.0.0.1', port: Optional[int] = None,
                  admission_window: int = DEFAULT_ADMISSION_WINDOW,
@@ -653,7 +765,8 @@ class Dispatcher(object):
                  stale_timeout_s: float = DEFAULT_STALE_TIMEOUT_S,
                  max_item_attempts: int = DEFAULT_MAX_ITEM_ATTEMPTS,
                  item_deadline_s: Optional[float] = None,
-                 client_ttl_s: float = DEFAULT_CLIENT_TTL_S) -> None:
+                 client_ttl_s: float = DEFAULT_CLIENT_TTL_S,
+                 autotune: Any = None) -> None:
         self._host = host
         self._port = port
         self.scheduler = FairShareScheduler(
@@ -667,6 +780,21 @@ class Dispatcher(object):
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
         self._next_stale_check = 0.0
+        self._autotune: Any = None
+        from petastorm_tpu.autotune.policy import resolve_policy
+        autotune_policy = resolve_policy(autotune)
+        if autotune_policy is not None:
+            from petastorm_tpu.autotune.controller import AutotuneController
+            from petastorm_tpu.autotune.knobs import (KnobCatalog,
+                                                      build_service_knobs)
+            scheduler = self.scheduler
+            self._autotune = AutotuneController(
+                KnobCatalog(build_service_knobs(scheduler)),
+                metric_fn=lambda: float(scheduler.items_served),
+                snapshot_fn=scheduler.autotune_snapshot,
+                policy=autotune_policy,
+                choose_fn=choose_service_knob,
+                name='service')
 
     # ------------------------------------------------------------ lifecycle
 
@@ -713,8 +841,12 @@ class Dispatcher(object):
         return 'tcp://{}:{}'.format(self._host, self._port)
 
     def state(self) -> Dict[str, Any]:
-        """The scheduler snapshot (same dict the ``state`` request returns)."""
-        return self.scheduler.state()
+        """The scheduler snapshot (same dict the ``state`` request returns),
+        plus the ``autotune`` controller report when retuning is armed."""
+        state = self.scheduler.state()
+        if self._autotune is not None:
+            state['autotune'] = self._autotune.report()
+        return state
 
     def stop(self) -> None:
         """Stop the pump thread; ``w_stop`` is broadcast to registered
@@ -767,6 +899,14 @@ class Dispatcher(object):
                         logger.exception('dispatcher: dropping malformed '
                                          'worker message')
             self._check_stale()
+            if self._autotune is not None:
+                try:
+                    # window-gated: the controller core decides at most once
+                    # per policy window, the pump just offers it the tick
+                    self._autotune.maybe_step()
+                except Exception:  # noqa: BLE001 - the tuner must never kill the dispatch loop it tunes
+                    logger.exception('dispatcher: autotune step failed; '
+                                     'pump keeps dispatching')
             self._dispatch_ready()
         self._broadcast_stop()
 
@@ -793,12 +933,16 @@ class Dispatcher(object):
                 return
             token = self.scheduler.submit(identity, bytes(frames[2]),
                                           bytes(frames[3]), frames[4])
+            # every submit reply carries the client's CURRENT window so live
+            # clients adopt autotune retuning (a raised window admits more
+            # in-flight work; a lowered one ends the busy churn immediately)
+            window = b'%d' % self.scheduler.client_window(identity)
             if token is None:
                 self._client_socket.send_multipart(
-                    [identity, MSG_BUSY, frames[2]])
+                    [identity, MSG_BUSY, frames[2], window])
             else:
                 self._client_socket.send_multipart(
-                    [identity, MSG_ACCEPT, frames[2]])
+                    [identity, MSG_ACCEPT, frames[2], window])
             return
         if kind == MSG_HELLO and len(frames) >= 5:
             name = bytes(frames[2]).decode('utf-8', 'replace')
@@ -819,7 +963,7 @@ class Dispatcher(object):
                 [identity, MSG_OPENED, frames[2]])
             return
         if kind == MSG_STATE:
-            body = json.dumps(self.scheduler.state()).encode('utf-8')
+            body = json.dumps(self.state()).encode('utf-8')
             self._client_socket.send_multipart([identity, MSG_STATE, body])
             return
         if kind == MSG_SHM_FAIL and len(frames) >= 3:

@@ -47,7 +47,9 @@ class ServiceFleet(object):
     ``cache_dir`` (created when missing) is shared by every worker — the
     fleet-wide warm Arrow-IPC rowgroup cache; None disables the shared cache
     and each client's own cache setting applies. ``shm_results`` enables the
-    one-shot shared-memory result path for co-located clients."""
+    one-shot shared-memory result path for co-located clients. ``autotune``
+    (True or an :class:`~petastorm_tpu.autotune.AutotunePolicy`) arms the
+    dispatcher's closed-loop admission retuning — docs/autotuning.md."""
 
     def __init__(self, workers: int = 2, host: str = '127.0.0.1',
                  port: Optional[int] = None,
@@ -60,7 +62,8 @@ class ServiceFleet(object):
                  quantum: float = DEFAULT_QUANTUM,
                  max_item_attempts: int = DEFAULT_MAX_ITEM_ATTEMPTS,
                  item_deadline_s: Optional[float] = None,
-                 client_ttl_s: float = DEFAULT_CLIENT_TTL_S) -> None:
+                 client_ttl_s: float = DEFAULT_CLIENT_TTL_S,
+                 autotune: Any = None) -> None:
         self._initial_workers = workers
         self._cache_dir = cache_dir
         self._cache_size_limit = cache_size_limit
@@ -70,7 +73,8 @@ class ServiceFleet(object):
             host=host, port=port, admission_window=admission_window,
             quantum=quantum, stale_timeout_s=stale_timeout_s,
             max_item_attempts=max_item_attempts,
-            item_deadline_s=item_deadline_s, client_ttl_s=client_ttl_s)
+            item_deadline_s=item_deadline_s, client_ttl_s=client_ttl_s,
+            autotune=autotune)
         self.processes: List[subprocess.Popen] = []
         self._next_worker_id = 0
         self.service_url: Optional[str] = None
@@ -208,6 +212,11 @@ def serve(argv: Optional[List[str]] = None) -> int:
                              'one rowgroup longer is deregistered and the '
                              'item re-queued (default: off — catches hung '
                              'decodes that keep heartbeating)')
+    parser.add_argument('--autotune', action='store_true',
+                        help='arm the closed-loop service autotuner: retunes '
+                             'the admission window and live per-client '
+                             'in-flight depth from queue-depth/busy signals '
+                             '(docs/autotuning.md)')
     parser.add_argument('--no-shm', action='store_true',
                         help='disable the co-located shared-memory result '
                              'path (TCP frames only)')
@@ -222,7 +231,7 @@ def serve(argv: Optional[List[str]] = None) -> int:
         workers=args.workers, host=args.host, port=args.port,
         cache_dir=args.cache_dir, cache_size_limit=args.cache_size_limit,
         shm_results=not args.no_shm, admission_window=args.admission_window,
-        item_deadline_s=args.item_deadline_s)
+        item_deadline_s=args.item_deadline_s, autotune=args.autotune)
     url = fleet.start()
     print('petastorm-tpu input service running at {} ({} worker(s); '
           'workers register on port {}). Point readers at '

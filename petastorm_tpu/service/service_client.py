@@ -194,6 +194,16 @@ class ServicePool(object):
 
     # ------------------------------------------------------------ messaging
 
+    def _learn_window(self, window: int) -> None:
+        """Adopt the dispatcher-side window piggybacked on accept/busy
+        replies: the service autotuner retunes per-client windows live
+        (docs/autotuning.md), and without re-learning it a raised window
+        could never admit more in-flight work from this client (nor a
+        lowered one end the busy churn before the next hello). Consumer
+        thread only, like every other socket-path mutation here."""
+        if window > 0 and window != self._window:
+            self._window = window
+
     def _await_reply(self, expected_kind: bytes,
                      timeout_s: float) -> Optional[List[bytes]]:
         """Wait for one message of ``expected_kind`` (construction/start
@@ -365,6 +375,8 @@ class ServicePool(object):
             if kind == b'accept':
                 with self._lock:
                     self._await_ack.pop(int(bytes(frames[1])), None)
+                if len(frames) >= 3:
+                    self._learn_window(int(bytes(frames[2])))
                 self._breaker.record_success()
                 continue
             if kind == b'busy':
@@ -374,6 +386,8 @@ class ServicePool(object):
                     self._inflight.discard(token)
                     if token in self._items:
                         self._pending.appendleft(token)
+                if len(frames) >= 3:
+                    self._learn_window(int(bytes(frames[2])))
                 self._busy_until = time.monotonic() + BUSY_BACKOFF_S
                 self._busy_rejections += 1
                 if telemetry_enabled():

@@ -94,11 +94,66 @@ _KNOBS: Dict[str, Any] = {
     'cache_miss': ('first-epoch fills — see rowgroup_read/decode',
                    'cache_miss envelopes the fill work; the leaf ranking names '
                    'the actual cost.'),
+    # ------------------------------------------------- input service (PR 8)
+    # Service-backed readers surface their pressure as COUNTERS/GAUGES, not
+    # stage histograms — these entries feed the counter advisories below
+    # (docs/service.md; the service autotuner turns the same knobs live).
+    'service_busy': ('raise the admission window or add decode workers',
+                     'The dispatcher rejected submits with busy: the '
+                     'per-client in-flight window is full. If the queue is '
+                     'shallow, raise the admission window (serve CLI '
+                     '--admission-window, or Dispatcher(autotune=True) to '
+                     'retune it live); if deep, the fleet is saturated — add '
+                     'workers (ServiceFleet.spawn_worker).'),
+    'service_resubmit': ('co-located shm delivery is flaky — check /dev/shm',
+                         'Items were re-requested after shm segment '
+                         'attach/verify failures: false co-location or an '
+                         'exhausted /dev/shm. Redeliveries are wire-pinned, '
+                         'so throughput degrades to TCP — fix the segment '
+                         'store or run the clients truly co-located.'),
+    'service_queue_depth': ('queue depth exceeds the fleet — add workers',
+                            'Accepted items sit queued behind a saturated '
+                            'worker fleet: admission is not the limit, decode '
+                            'capacity is — add service workers or lower '
+                            'client demand.'),
 }
 
 _DEFAULT_ADVICE = ('inspect the stage histogram',
                    'No canned knob for this stage; inspect its histogram in the '
                    'snapshot and docs/observability.md.')
+
+#: counter names that trigger a service advisory when non-zero in the
+#: snapshot (the service's pressure signals have no latency histogram).
+#: NOTE the semantics follow the snapshot handed in: a cumulative snapshot
+#: (diagnostics dump, the analyze CLI) advises on totals since process start,
+#: a window delta (the autotune controller's snapshot_delta) on fresh
+#: movement only — the 'value' field says how much either way.
+_ADVISORY_COUNTERS = ('service_busy', 'service_resubmit')
+#: gauge names that trigger an advisory when non-zero
+_ADVISORY_GAUGES = ('service_queue_depth',)
+
+
+def _service_advisories(snapshot: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Counter/gauge-driven advice rows for service-backed readers: each
+    non-zero advisory signal yields ``{'signal', 'value', 'recommendation',
+    'detail'}`` from the ``_KNOBS`` map — the canned advice the stage ranking
+    cannot provide for non-histogram pressure."""
+    advisories = []
+    counters = snapshot.get('counters') or {}
+    gauges = snapshot.get('gauges') or {}
+    for name in _ADVISORY_COUNTERS:
+        value = int(counters.get(name, 0) or 0)
+        if value > 0:
+            headline, detail = _KNOBS[name]
+            advisories.append({'signal': name, 'value': value,
+                               'recommendation': headline, 'detail': detail})
+    for name in _ADVISORY_GAUGES:
+        value = float(gauges.get(name, 0) or 0)
+        if value > 0:
+            headline, detail = _KNOBS[name]
+            advisories.append({'signal': name, 'value': value,
+                               'recommendation': headline, 'detail': detail})
+    return advisories
 
 
 def attribute_bottleneck(snapshot: Dict[str, Any],
@@ -107,8 +162,11 @@ def attribute_bottleneck(snapshot: Dict[str, Any],
 
     Returns ``{'total_stage_seconds', 'ranked': [{'stage', 'seconds', 'share',
     'count', 'mean_s'}], 'top_stage', 'top_share', 'recommendation', 'detail',
-    'envelopes': {stage: seconds}}`` — all JSON-safe. An empty snapshot yields
-    ``top_stage=None`` with a no-data recommendation (never raises)."""
+    'envelopes': {stage: seconds}, 'advisories': [...]}`` — all JSON-safe.
+    ``advisories`` carries the counter/gauge-driven service advice rows
+    (``service_busy``/``service_resubmit``/``service_queue_depth`` — pressure
+    that has no latency histogram to rank, docs/service.md). An empty snapshot
+    yields ``top_stage=None`` with a no-data recommendation (never raises)."""
     histograms = snapshot.get('histograms') or {}
     leaves = []
     envelopes = {}
@@ -130,9 +188,11 @@ def attribute_bottleneck(snapshot: Dict[str, Any],
                'count': count,
                'mean_s': round(total / count, 6) if count else 0.0}
               for name, total, count in leaves[:max(top_n, 1)]]
+    advisories = _service_advisories(snapshot)
     if not ranked:
         return {'total_stage_seconds': 0.0, 'ranked': [], 'envelopes': envelopes,
                 'top_stage': None, 'top_share': 0.0,
+                'advisories': advisories,
                 'recommendation': 'no stage timings recorded',
                 'detail': 'The snapshot holds no latency histograms — run an '
                           'instrumented read first (telemetry is on by default; '
@@ -144,6 +204,7 @@ def attribute_bottleneck(snapshot: Dict[str, Any],
             'envelopes': envelopes,
             'top_stage': top['stage'],
             'top_share': top['share'],
+            'advisories': advisories,
             'recommendation': headline,
             'detail': detail}
 
@@ -167,6 +228,10 @@ def format_report(report: Dict[str, Any]) -> str:
         lines.append('  {}'.format(report.get('detail', '')))
     else:
         lines.append('  ' + report.get('recommendation', 'no data'))
+    for advisory in report.get('advisories') or []:
+        lines.append('  [service] {}={:g} -> {}'.format(
+            advisory['signal'], advisory['value'],
+            advisory['recommendation']))
     return '\n'.join(lines)
 
 

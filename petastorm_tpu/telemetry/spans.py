@@ -93,6 +93,7 @@ TRACE_INSTANTS = (
     'breaker_transition',  # a circuit breaker changed state (any process)
     'shm_crc_drop',        # a shm frame failed CRC and was dropped unread (consumer)
     'shm_fallback',        # a result rode the ZMQ wire while the shm ring was enabled
+    'autotune_decision',   # the closed-loop autotuner proposed/committed/reverted/froze a knob change (controller)
 )
 
 

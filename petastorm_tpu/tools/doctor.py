@@ -219,8 +219,14 @@ def check_store_roundtrip(rows=200, workers=2):
             # on_error='retry': the roundtrip doubles as a probe of the resilience
             # path — a flaky local disk shows up as a non-zero retry count in the
             # report rather than an opaque failure (docs/robustness.md).
+            # autotune armed with a long window (docs/autotuning.md): the
+            # roundtrip is far shorter than one control window, so no knob is
+            # ever turned — the block proves the controller wires up (knob
+            # catalog, breaker interlock state) without perturbing the probe.
+            from petastorm_tpu.autotune import AutotunePolicy
             with make_reader(url, workers_count=workers, num_epochs=1,
-                             on_error='retry') as reader:
+                             on_error='retry',
+                             autotune=AutotunePolicy(window_s=3600.0)) as reader:
                 for row in reader:
                     seen.append(int(row.idx))
                     if row.vec[0] != row.idx:
@@ -229,6 +235,7 @@ def check_store_roundtrip(rows=200, workers=2):
                 diag = reader.diagnostics
                 telemetry = reader.telemetry_snapshot()
                 trace = reader.trace_summary()
+                autotune = reader.autotune_report()
             elapsed = time.perf_counter() - start
     finally:
         tracing.set_trace_enabled(trace_was_enabled)
@@ -247,6 +254,9 @@ def check_store_roundtrip(rows=200, workers=2):
             # lifted to report['trace'] by collect_report — the flight-recorder
             # summary of docs/observability.md "Flight recorder"
             'trace': trace,
+            # lifted to report['autotune'] by collect_report — the closed-loop
+            # controller's state (docs/autotuning.md)
+            'autotune': autotune,
             # lifted to report['resilience'] by collect_report — the hang/
             # integrity/breaker view of docs/robustness.md
             'resilience': {
@@ -356,6 +366,12 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
     report['resilience'] = resilience if resilience is not None else {
         'breakers': {}, 'workers_hung_reaped': 0, 'shm_crc_failures': 0,
         'cache_corrupt_entries': 0, 'rowgroups_quarantined': 0}
+    # Autotune block (docs/autotuning.md): the roundtrip controller's state —
+    # knob catalog, decision log, frozen-by-breaker flag. Always present so
+    # --json consumers find one stable key.
+    autotune = report['store_roundtrip'].pop('autotune', None)
+    report['autotune'] = autotune if autotune is not None else {
+        'enabled': False}
     # Static-analysis block (docs/static-analysis.md): does the installed
     # package still satisfy its own data-plane invariants? Always present so
     # --json consumers find one stable key; failures of the analyzer itself
@@ -447,6 +463,21 @@ def _print_human(report):
         print('  resilience: {} — the roundtrip needed hang/corruption '
               'recovery on a local disk; check the hardware'.format(
                   ', '.join('{}={}'.format(k, v) for k, v in sorted(degraded.items()))))
+    autotune = report.get('autotune') or {}
+    if autotune.get('enabled'):
+        decisions = autotune.get('decisions') or []
+        line = '  autotune: {} knob(s) catalogued, {} window(s), {} decision(s)' \
+            .format(len(autotune.get('knobs') or {}),
+                    autotune.get('windows', 0), len(decisions))
+        if decisions:
+            last = decisions[-1]
+            line += '; last: {} {}'.format(last.get('action'),
+                                           last.get('knob') or '')
+        print(line)
+        if autotune.get('frozen_by_breaker'):
+            print('  WARNING: autotune is FROZEN by an open circuit breaker — '
+                  'the controller reverted its last change and will not retune '
+                  'until the board is healthy (docs/autotuning.md)')
     service = report.get('service') or {}
     if service.get('status') == 'ok':
         print('  service: {} — {} worker(s), {} client(s), queue depth {} '

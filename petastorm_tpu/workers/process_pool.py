@@ -533,6 +533,26 @@ class ProcessPool(object):
                           'new_attempt': reaped_attempt + 1})
         self._processes[slot] = self._spawn_worker(slot, generation)
 
+    def set_shm_slot_config(self, slots_per_worker=None, slot_bytes=None):
+        """Bounded runtime update of the shm ring shape — a **deferred** knob
+        (docs/autotuning.md): the live ring is never resized under its workers;
+        the new shape applies to the NEXT ring generation (the next
+        ``start()``, e.g. the next reader built from this configuration).
+        Returns the ``(slots_per_worker, slot_bytes)`` now configured."""
+        if slots_per_worker is not None:
+            slots_per_worker = int(slots_per_worker)
+            if slots_per_worker < 1:
+                raise ValueError('slots_per_worker must be >= 1, got {}'
+                                 .format(slots_per_worker))
+            self._shm_slots_per_worker = slots_per_worker
+        if slot_bytes is not None:
+            slot_bytes = int(slot_bytes)
+            if slot_bytes < 4096:
+                raise ValueError('slot_bytes must be >= 4096, got {}'
+                                 .format(slot_bytes))
+            self._shm_slot_bytes = slot_bytes
+        return self._shm_slots_per_worker, self._shm_slot_bytes
+
     # ----------------------------------------------------------- hang watchdog
 
     def set_hang_result_factory(self, factory):

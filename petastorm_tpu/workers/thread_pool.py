@@ -38,6 +38,11 @@ class WorkerThread(threading.Thread):
             import cProfile
             profiler = cProfile.Profile()
         while True:
+            # Elastic park point (docs/autotuning.md): a worker whose id is
+            # beyond the pool's current active count waits here instead of
+            # pulling work, so set_workers_count can shrink the pool without
+            # killing threads (and grow it again by just notifying).
+            self._pool._await_active(self._worker.worker_id)
             item = self._pool._ventilator_queue.get()
             if item is _STOP_SENTINEL:
                 break
@@ -76,14 +81,28 @@ class ThreadPool(object):
     backpressure (reference: thread_pool.py)."""
 
     def __init__(self, workers_count, results_queue_size=DEFAULT_RESULTS_QUEUE_SIZE,
-                 profiling_enabled=False):
+                 profiling_enabled=False, max_workers_count=None):
+        """``max_workers_count`` bounds runtime growth via
+        :meth:`set_workers_count` (default ``4 * workers_count``) — the elastic
+        worker knob the autotuner turns (docs/autotuning.md)."""
         self._workers_count = workers_count
+        self._max_workers_count = max(int(max_workers_count or 4 * workers_count),
+                                      workers_count)
         self._results_queue = queue.Queue(results_queue_size)
         self._ventilator_queue = queue.Queue()
         self._threads = []
         self._ventilator = None
         self._stopped = threading.Event()
         self.workers_count = workers_count
+        # ------------------------------------------------ elastic grow/park
+        # _active_workers is the number of worker ids allowed to pull work;
+        # threads with a higher id park on _resize_cond (see WorkerThread.run).
+        # Worker construction args are kept so growth past the spawned set can
+        # start fresh threads mid-epoch.
+        self._resize_cond = threading.Condition()
+        self._active_workers = workers_count
+        self._worker_class = None
+        self._worker_args = None
         #: per-worker cProfile, aggregated and logged on join() (reference:
         #: thread_pool.py:41-49,190-198)
         self._profiling_enabled = profiling_enabled
@@ -98,14 +117,49 @@ class ThreadPool(object):
     def start(self, worker_class, worker_args=None, ventilator=None):
         if self._threads:
             raise RuntimeError('ThreadPool already started')
+        self._worker_class = worker_class
+        self._worker_args = worker_args
         for worker_id in range(self._workers_count):
-            worker = worker_class(worker_id, self._put_result, worker_args)
-            thread = WorkerThread(self, worker)
-            self._threads.append(thread)
-            thread.start()
+            self._spawn_worker_thread(worker_id)
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()
+
+    def _spawn_worker_thread(self, worker_id):
+        worker = self._worker_class(worker_id, self._put_result, self._worker_args)
+        thread = WorkerThread(self, worker)
+        self._threads.append(thread)
+        thread.start()
+
+    # ------------------------------------------------------- elastic grow/park
+
+    def _await_active(self, worker_id):
+        """Park the calling worker thread while its id is beyond the active
+        count (and the pool is not stopped) — the shrink half of
+        :meth:`set_workers_count`."""
+        with self._resize_cond:
+            while (worker_id >= self._active_workers
+                   and not self._stopped.is_set()):
+                self._resize_cond.wait(timeout=0.5)
+
+    def set_workers_count(self, value):
+        """Bounded, thread-safe runtime resize of the worker set
+        (docs/autotuning.md): growing beyond the threads already spawned starts
+        fresh worker threads; shrinking parks the excess threads at their next
+        item boundary (an in-progress item always completes — nothing is
+        killed). Clamped to ``[1, max_workers_count]``; returns the applied
+        value. No-op (returning the current count) after ``stop()``."""
+        value = max(1, min(int(value), self._max_workers_count))
+        with self._resize_cond:
+            if self._stopped.is_set() or self._worker_class is None:
+                return self._active_workers
+            spawned = len(self._threads)
+            for worker_id in range(spawned, value):
+                self._spawn_worker_thread(worker_id)
+            self._active_workers = value
+            self.workers_count = value
+            self._resize_cond.notify_all()
+        return value
 
     def ventilate(self, *args, **kwargs):
         """Enqueue one work item (kwargs form is the worker.process signature)."""
@@ -159,6 +213,9 @@ class ThreadPool(object):
 
     def stop(self):
         self._stopped.set()
+        with self._resize_cond:
+            # wake parked (shrunk-away) workers so they can take their sentinel
+            self._resize_cond.notify_all()
         if self._ventilator is not None:
             self._ventilator.stop()
         for _ in self._threads:

@@ -146,6 +146,27 @@ class ConcurrentVentilator(Ventilator):
                 self._in_flight -= 1
             self._item_processed.notify()
 
+    @property
+    def max_in_flight(self):
+        """The current in-flight bound (``max_ventilation_queue_size``)."""
+        with self._lock:
+            return self._max_ventilation_queue_size
+
+    def set_max_in_flight(self, value):
+        """Bounded, thread-safe runtime resize of the in-flight window — the
+        ventilation-depth knob the autotuner turns mid-epoch
+        (docs/autotuning.md). Growing wakes the ventilation thread immediately
+        (it may be parked in the backpressure wait); shrinking simply stops
+        admitting new items until consumption drains below the new bound —
+        items already in flight are never recalled. Returns the applied value."""
+        value = int(value)
+        if value < 1:
+            raise ValueError('max_in_flight must be >= 1, got {}'.format(value))
+        with self._item_processed:
+            self._max_ventilation_queue_size = value
+            self._item_processed.notify_all()
+        return value
+
     def completed(self):
         # All epochs dispatched AND every dispatched item acknowledged (or failed).
         with self._lock:

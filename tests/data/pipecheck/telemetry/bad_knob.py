@@ -1,0 +1,10 @@
+"""Known-bad fixture: autotuner knob ids off the KNOB_IDS catalog."""
+from petastorm_tpu.autotune.knobs import Knob, KnobCatalog
+
+
+def build(catalog: KnobCatalog):
+    catalog.add(Knob('pool_wrokers',  # typo: should be 'pool_workers'
+                     'typo knob', minimum=1.0, maximum=4.0, step=1.0,
+                     cost='cheap', stages=('pool_wait',),
+                     get=lambda: 1.0, apply=lambda v: v))
+    return catalog.knob('ventilator_max_inflight')  # typo: 'ventilator_max_in_flight'

@@ -1,0 +1,10 @@
+"""Known-good fixture: catalog knob ids only."""
+from petastorm_tpu.autotune.knobs import Knob, KnobCatalog
+
+
+def build(catalog: KnobCatalog):
+    catalog.add(Knob('pool_workers',
+                     'elastic worker count', minimum=1.0, maximum=4.0,
+                     step=1.0, cost='moderate', stages=('pool_wait',),
+                     get=lambda: 1.0, apply=lambda v: v))
+    return catalog.knob('ventilator_max_in_flight')

@@ -1,0 +1,6 @@
+"""Suppression fixture: an off-catalog knob id, explicitly allowed."""
+from petastorm_tpu.autotune.knobs import KnobCatalog
+
+
+def lookup(catalog: KnobCatalog):
+    return catalog.knob('experimental_knob')  # pipecheck: disable=telemetry-names -- experiment-local knob, removed with the experiment

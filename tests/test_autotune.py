@@ -1,0 +1,872 @@
+"""Tests for the closed-loop autotuner (petastorm_tpu/autotune/,
+docs/autotuning.md) and the runtime knob mutators it actuates.
+
+Four layers:
+
+- **mutators**: the bounded ``set_*`` surfaces grown for the actuation layer
+  (ventilator in-flight window, thread-pool elastic grow/park, shm ring
+  config, shuffle-buffer threshold, cache modes, service scheduler windows)
+  resize correctly mid-epoch;
+- **controller units**: the hill-climb state machine with a fake clock and
+  scripted telemetry — commit, revert+cooldown+direction-flip, breaker
+  interlock freeze/unfreeze, one-knob-at-a-time, warmup, measure-only;
+- **scripted convergence**: a deterministic simulated pipeline where rows/s is
+  a known function of the knob — the controller started from the degraded
+  value converges to >= the fixed-default rate within a bounded window count;
+- **e2e**: a real reader started with deliberately bad knobs (1 worker,
+  in-flight window 1) self-improves mid-epoch, the disabled path stays
+  byte-identical, and the loader/service integrations register their knobs.
+"""
+import json
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.autotune import (AutotuneController, AutotunePolicy,
+                                    KNOB_IDS, Knob, KnobCatalog,
+                                    build_loader_knobs, build_service_knobs,
+                                    resolve_policy, snapshot_delta)
+from petastorm_tpu.telemetry.registry import SECONDS_UNIT
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def _stage_snapshot(stage, seconds, count=10):
+    """A cumulative telemetry snapshot with one latency stage."""
+    return {'histograms': {stage: {'unit': SECONDS_UNIT, 'count': count,
+                                   'sum': seconds, 'max': seconds}}}
+
+
+class ScriptedPipeline(object):
+    """A deterministic fake pipeline: cumulative rows advance by
+    ``rate_for(knob_value)`` per clock tick; telemetry always blames
+    ``pool_wait`` so the default chooser picks the one knob."""
+
+    def __init__(self, rate_for, initial=1.0, minimum=1.0, maximum=16.0,
+                 step=1.0):
+        self.rate_for = rate_for
+        self.value = initial
+        self.clock_now = 0.0
+        self.rows = 0.0
+        self.cum_seconds = 0.0
+        self.knob = Knob('pool_workers', 'scripted worker count',
+                         minimum=minimum, maximum=maximum, step=step,
+                         cost='cheap', stages=('pool_wait',),
+                         get=lambda: self.value, apply=self._apply)
+
+    def _apply(self, value):
+        self.value = value
+        return value
+
+    def tick(self):
+        """Advance one window: one second of clock, rate_for(value) rows."""
+        self.clock_now += 1.0
+        self.rows += self.rate_for(self.value)
+        self.cum_seconds += 0.5
+
+    def snapshot(self):
+        return _stage_snapshot('pool_wait', self.cum_seconds,
+                               count=int(self.clock_now * 10) + 1)
+
+
+def make_controller(pipeline, policy=None, breakers=None, **kwargs):
+    breakers_fn = breakers if breakers is not None else (lambda: {})
+    return AutotuneController(
+        KnobCatalog([pipeline.knob]),
+        metric_fn=lambda: pipeline.rows,
+        snapshot_fn=pipeline.snapshot,
+        policy=policy or AutotunePolicy(window_s=1.0, warmup_windows=1,
+                                        hold_windows=1, min_improvement=0.02,
+                                        cooldown_windows=3),
+        breaker_snapshot_fn=breakers_fn,
+        clock=lambda: pipeline.clock_now,
+        name='test',
+        **kwargs)
+
+
+def drive(controller, pipeline, windows):
+    decisions = []
+    for _ in range(windows):
+        pipeline.tick()
+        decision = controller.step()
+        if decision is not None:
+            decisions.append(decision)
+    return decisions
+
+
+# ---------------------------------------------------------------- policy
+
+
+def test_resolve_policy_forms():
+    assert resolve_policy(None) is None
+    assert resolve_policy(False) is None
+    assert isinstance(resolve_policy(True), AutotunePolicy)
+    policy = AutotunePolicy(window_s=9.0)
+    assert resolve_policy(policy) is policy
+    with pytest.raises(ValueError):
+        resolve_policy('yes')
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        AutotunePolicy(window_s=0)
+    with pytest.raises(ValueError):
+        AutotunePolicy(min_improvement=-0.1)
+    with pytest.raises(ValueError):
+        AutotunePolicy(cooldown_windows=0)
+
+
+# ---------------------------------------------------------------- knobs
+
+
+def test_knob_rejects_undeclared_id_and_cost():
+    ok = dict(description='x', minimum=0.0, maximum=1.0, step=1.0,
+              cost='cheap', stages=(), get=lambda: 0.0, apply=lambda v: v)
+    with pytest.raises(ValueError):
+        Knob('not_a_knob', **ok)
+    with pytest.raises(ValueError):
+        Knob('pool_workers', **dict(ok, cost='free'))
+    with pytest.raises(ValueError):
+        Knob('pool_workers', **dict(ok, minimum=2.0, maximum=1.0))
+    assert 'pool_workers' in KNOB_IDS
+
+
+def test_catalog_lookup_and_stage_map():
+    knob = Knob('decode_threads', 'x', minimum=1.0, maximum=8.0, step=1.0,
+                cost='cheap', stages=('decode',), get=lambda: 2.0,
+                apply=lambda v: v)
+    catalog = KnobCatalog([knob])
+    assert catalog.knob('decode_threads') is knob
+    assert 'decode_threads' in catalog
+    assert catalog.knobs_for_stage('decode') == [knob]
+    assert catalog.knobs_for_stage('h2d') == []
+    as_dicts = catalog.as_dicts()
+    assert as_dicts['decode_threads']['value'] == 2.0
+    assert as_dicts['decode_threads']['stages'] == ['decode']
+
+
+# ------------------------------------------------------------- mutators
+
+
+def test_ventilator_max_in_flight_resizes_mid_epoch():
+    from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+    ventilated = []
+    vent = ConcurrentVentilator(
+        ventilate_fn=lambda **kw: ventilated.append(kw),
+        items_to_ventilate=[{'i': i} for i in range(10)],
+        iterations=1, max_ventilation_queue_size=1)
+    vent.start()
+    deadline = time.time() + 5
+    while len(ventilated) < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.15)  # the window is 1: nothing further may ventilate
+    assert len(ventilated) == 1
+    assert vent.max_in_flight == 1
+    assert vent.set_max_in_flight(4) == 4
+    deadline = time.time() + 5
+    while len(ventilated) < 4 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(ventilated) == 4  # grew to the new window without any ack
+    with pytest.raises(ValueError):
+        vent.set_max_in_flight(0)
+    vent.stop()
+
+
+class _IdWorker(object):
+    """Records which worker id processed each item (thread-pool tests)."""
+
+    def __init__(self, worker_id, publish_func, args):
+        self.worker_id = worker_id
+        self._publish = publish_func
+        self._sink = args
+
+    def process(self, **kwargs):
+        self._sink.put((self.worker_id, kwargs['i']))
+        self._publish({'worker': self.worker_id, 'i': kwargs['i']})
+
+    def shutdown(self):
+        pass
+
+
+def test_thread_pool_elastic_grow_and_park():
+    from petastorm_tpu.workers.thread_pool import ThreadPool
+    sink = queue.Queue()
+    pool = ThreadPool(1, results_queue_size=1000, max_workers_count=3)
+    pool.start(_IdWorker, sink)
+    assert pool.set_workers_count(100) == 3  # clamped to max_workers_count
+    assert pool.workers_count == 3
+    assert len(pool._threads) == 3  # growth spawned real threads mid-run
+    for i in range(30):
+        pool.ventilate(i=i)
+    seen = [sink.get(timeout=10) for _ in range(30)]
+    assert {i for _, i in seen} == set(range(30))
+    # shrink to 1: parked workers take no further items (a worker already
+    # blocked inside queue.get may grab ONE more item before it reaches the
+    # park point — the park is at the item boundary, nothing is killed)
+    assert pool.set_workers_count(0) == 1  # clamped low
+    for i in range(30, 60):
+        pool.ventilate(i=i)
+    seen = [sink.get(timeout=10) for _ in range(30)]
+    assert {i for _, i in seen} == set(range(30, 60))
+    parked_items = sum(1 for wid, _ in seen if wid != 0)
+    assert parked_items <= 2, seen  # at most one in-flight grab per parked worker
+    pool.stop()
+    pool.join()
+    assert pool._threads == []
+
+
+def test_process_pool_shm_slot_config_is_deferred_and_validated():
+    from petastorm_tpu.workers.process_pool import ProcessPool
+    pool = ProcessPool(2)
+    slots, size = pool.set_shm_slot_config(slots_per_worker=7,
+                                           slot_bytes=1 << 20)
+    assert (slots, size) == (7, 1 << 20)
+    assert pool._shm_slots_per_worker == 7  # applies on next ring generation
+    with pytest.raises(ValueError):
+        pool.set_shm_slot_config(slots_per_worker=0)
+    with pytest.raises(ValueError):
+        pool.set_shm_slot_config(slot_bytes=16)
+
+
+def test_shuffling_buffer_threshold_clamps():
+    from petastorm_tpu.parallel.shuffling_buffer import RandomShufflingBuffer
+    buf = RandomShufflingBuffer(100, 50)
+    assert buf.set_min_after_retrieve(10) == 10
+    assert buf.min_after_retrieve == 10
+    assert buf.set_min_after_retrieve(1000) == 100  # clamped to capacity
+    assert buf.set_min_after_retrieve(-5) == 0
+    buf.add_many({'x': np.arange(20)})
+    buf.set_min_after_retrieve(0)
+    assert buf.can_retrieve(20)  # floor lowered mid-stream
+
+
+def test_cache_bypass_and_writable_hits(tmp_path):
+    from petastorm_tpu.cache import ArrowIpcDiskCache
+    cache = ArrowIpcDiskCache(str(tmp_path / 'c'), 10 << 20)
+    value = {'x': np.arange(8)}
+    fills = [0]
+
+    def fill():
+        fills[0] += 1
+        return value
+
+    cache.get('k', fill)
+    hit = cache.get('k', fill)
+    assert fills[0] == 1
+    assert not hit['x'].flags.writeable  # zero-copy read-only view
+    assert cache.set_writable_hits(True) is True
+    hit = cache.get('k', fill)
+    assert hit['x'].flags.writeable
+    assert cache.set_bypass(True) is True
+    cache.get('k', fill)
+    assert fills[0] == 2  # bypass served a direct fill despite the hot entry
+    assert cache.stats['bypass_reads'] == 1
+    cache.set_bypass(False)
+    cache.get('k', fill)
+    assert fills[0] == 2  # hits serve again
+
+
+def test_decode_threads_knob_gated_to_in_process_pools_and_restores(monkeypatch):
+    """Review hardening: decode_threads exists only where decode runs in THIS
+    process (thread/dummy pools — process-pool workers captured the env at
+    spawn), and its env actuation is undone by restore() so a stopped reader
+    cannot leak its tuned width into later readers in the process."""
+    from types import SimpleNamespace
+    from petastorm_tpu.autotune.knobs import build_reader_knobs
+    from petastorm_tpu.workers.thread_pool import ThreadPool
+    monkeypatch.delenv('PETASTORM_TPU_DECODE_THREADS', raising=False)
+    reader = SimpleNamespace(_ventilator=None, _pool=ThreadPool(2),
+                             is_batched_reader=False, _cache=None,
+                             _transform_spec=None)
+    by_id = {k.knob_id: k for k in build_reader_knobs(reader)}
+    knob = by_id['decode_threads']
+    assert knob.restore is not None
+    # untouched: restore must not stomp state it never changed
+    os.environ['PETASTORM_TPU_DECODE_THREADS'] = '7'
+    knob.restore()
+    assert os.environ['PETASTORM_TPU_DECODE_THREADS'] == '7'
+    del os.environ['PETASTORM_TPU_DECODE_THREADS']
+    # touched: apply writes the env contract, restore puts the world back
+    assert knob.apply(3.0) == 3.0
+    assert os.environ['PETASTORM_TPU_DECODE_THREADS'] == '3'
+    knob.restore()
+    assert 'PETASTORM_TPU_DECODE_THREADS' not in os.environ
+
+    class FakeProcessPool(object):
+        workers_count = 2
+        _shm_slots_per_worker = 2
+        _shm_slot_bytes = 1 << 20
+
+        def set_shm_slot_config(self, **kwargs):
+            return (self._shm_slots_per_worker, self._shm_slot_bytes)
+
+    reader = SimpleNamespace(_ventilator=None, _pool=FakeProcessPool(),
+                             is_batched_reader=False, _cache=None,
+                             _transform_spec=None)
+    ids = [k.knob_id for k in build_reader_knobs(reader)]
+    assert 'decode_threads' not in ids
+    assert 'shm_slots_per_worker' in ids  # the builder still saw the pool
+
+
+def test_explicit_writable_hits_override_is_pinned_not_a_knob(tmp_path):
+    """Review hardening: cache_extra_settings={'writable_hits': ...} is a
+    statement about what the consumer needs — the autotuner must not treat
+    the hit mode as a free knob on such readers."""
+    from types import SimpleNamespace
+    from petastorm_tpu.autotune.knobs import build_reader_knobs
+    from petastorm_tpu.reader import _make_cache
+    pinned = _make_cache('local-disk', str(tmp_path / 'c1'), 10 << 20, 0,
+                         {'writable_hits': True})
+    assert pinned.writable_hits_pinned is True
+    default = _make_cache('local-disk', str(tmp_path / 'c2'), 10 << 20, 0,
+                          None)
+    assert default.writable_hits_pinned is False
+
+    from petastorm_tpu.workers.thread_pool import ThreadPool
+
+    def knob_ids(cache, pool=None):
+        reader = SimpleNamespace(_ventilator=None,
+                                 _pool=pool or ThreadPool(1),
+                                 is_batched_reader=True, _cache=cache,
+                                 _transform_spec=None)
+        return [k.knob_id for k in build_reader_knobs(reader)]
+
+    assert 'cache_writable_hits' not in knob_ids(pinned)
+    assert 'cache_bypass' in knob_ids(pinned)  # only the hit mode is pinned
+    assert 'cache_writable_hits' in knob_ids(default)
+
+    class FakeProcessPool(object):
+        workers_count = 2
+
+    # cache knobs are consumer-side objects: a process-pool reader's workers
+    # hold their own unpickled cache copies, so no cache knob registers there
+    assert knob_ids(default, pool=FakeProcessPool()) == []
+
+
+def test_scheduler_window_mutators():
+    from petastorm_tpu.service.dispatcher import FairShareScheduler
+    sched = FairShareScheduler(admission_window=8, clock=lambda: 0.0)
+    sched.add_client(b'a', 'a', 'host', window=8)
+    sched.add_client(b'b', 'b', 'host', window=4)
+    assert sched.effective_client_window() == 4
+    assert sched.set_admission_window(6) == 6
+    # live clients above the new cap were clamped down, smaller ones kept
+    assert {c.window for c in sched._clients.values()} == {6, 4}
+    assert sched.set_client_windows(10) == 6  # clamped to the admission cap
+    assert {c.window for c in sched._clients.values()} == {6}
+    snapshot = sched.autotune_snapshot()
+    assert snapshot['counters']['service_busy'] == 0
+    assert 'service_queue_depth' in snapshot['gauges']
+    # client_window is what accept/busy replies piggyback so live clients
+    # adopt retuned windows (unknown client -> the admission cap)
+    assert sched.client_window(b'a') == 6
+    assert sched.client_window(b'nobody') == 6
+    # raising the cap lifts clients UP TO their hello request, never past it
+    assert sched.set_admission_window(12) == 12
+    assert {c.window for c in sched._clients.values()} == {8, 4}
+    # a follow-the-cap client (hello'd windowless) rides the cap both ways
+    sched.add_client(b'c', 'c', 'host', window=None)
+    assert sched.client_window(b'c') == 12
+    sched.set_admission_window(20)
+    assert sched.client_window(b'c') == 20
+    assert sched.client_window(b'a') == 8  # still pinned to its request
+
+
+def test_service_pool_learns_window_from_submit_replies():
+    """The client adopts the window the dispatcher piggybacks on accept/busy
+    replies — dispatcher-side retuning must reach the client's self-pacing,
+    else a raised window could never admit more in-flight work."""
+    from petastorm_tpu.service.service_client import ServicePool
+    pool = object.__new__(ServicePool)
+    pool._window = 8
+    pool._learn_window(10)
+    assert pool._window == 10
+    pool._learn_window(6)
+    assert pool._window == 6
+    pool._learn_window(0)  # zero/absent frames never shrink the window away
+    assert pool._window == 6
+
+
+def test_choose_service_knob_signals():
+    from petastorm_tpu.service.dispatcher import choose_service_knob
+    sched_knobs = build_service_knobs(_FakeScheduler())
+    busy_prev = {'counters': {'service_busy': 0}}
+    busy_cur = {'counters': {'service_busy': 3},
+                'gauges': {'service_queue_depth': 1.0, 'service_workers': 2.0,
+                           'service_admission_window': 16.0,
+                           'service_client_window': 8.0}}
+    assert choose_service_knob(busy_prev, busy_cur, 0.0,
+                               sched_knobs) == 'service_client_window'
+    # the common fleet: every client AT the cap (hello'd windowless) — the
+    # client-window knob is pinned there, the cap itself is the one to raise
+    pinned_cur = {'counters': {'service_busy': 3},
+                  'gauges': {'service_queue_depth': 1.0,
+                             'service_workers': 2.0,
+                             'service_admission_window': 16.0,
+                             'service_client_window': 16.0}}
+    assert choose_service_knob(busy_prev, pinned_cur, 0.0,
+                               sched_knobs) == 'service_admission_window'
+    deep_cur = {'counters': {'service_busy': 0},
+                'gauges': {'service_queue_depth': 50.0,
+                           'service_workers': 2.0}}
+    assert choose_service_knob(busy_prev, deep_cur, 0.0,
+                               sched_knobs) == 'service_admission_window'
+    idle_cur = {'counters': {'service_busy': 0},
+                'gauges': {'service_queue_depth': 0.0,
+                           'service_workers': 2.0}}
+    assert choose_service_knob(busy_prev, idle_cur, 0.0, sched_knobs) is None
+
+
+class _FakeScheduler(object):
+    admission_window = 16
+
+    def set_admission_window(self, value):
+        self.admission_window = max(1, value)
+        return self.admission_window
+
+    def set_client_windows(self, value):
+        return min(value, self.admission_window)
+
+    def effective_client_window(self):
+        return self.admission_window
+
+
+# ------------------------------------------------------- controller units
+
+
+def test_hill_climb_commits_when_rate_improves():
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * v)
+    ctl = make_controller(pipe)
+    decisions = drive(ctl, pipe, 8)
+    actions = [d['action'] for d in decisions]
+    assert actions[:2] == ['propose', 'commit']
+    assert pipe.value > 1.0
+    report = ctl.report()
+    assert report['committed'] >= 1
+    assert report['knobs']['pool_workers']['value'] == pipe.value
+
+
+def test_hill_climb_reverts_and_cools_down_without_improvement():
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0)  # knob changes nothing
+    ctl = make_controller(pipe)
+    decisions = drive(ctl, pipe, 6)
+    actions = [d['action'] for d in decisions]
+    assert actions[:2] == ['propose', 'revert']
+    assert pipe.value == 1.0  # restored
+    # cooldown: the next cooldown_windows windows may not re-propose this knob
+    more = drive(ctl, pipe, 2)
+    assert more == []
+    # after cooldown the knob is eligible again, and the failed +1 direction
+    # flipped — at the minimum bound the clamp flips it back up, so the knob
+    # is re-proposed rather than abandoned (hill-climb keeps exploring)
+    more = drive(ctl, pipe, 3)
+    assert [d['action'] for d in more][:1] == ['propose']
+
+
+def test_one_knob_at_a_time_invariant():
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * v)
+    second = Knob('decode_threads', 'second live knob', minimum=1.0,
+                  maximum=8.0, step=1.0, cost='cheap', stages=('pool_wait',),
+                  get=lambda: 1.0, apply=lambda v: v)
+    ctl = make_controller(pipe)
+    ctl.catalog.add(second)
+    decisions = drive(ctl, pipe, 12)
+    pending = 0
+    for decision in decisions:
+        if decision['action'] == 'propose':
+            assert pending == 0, 'second propose while one was in flight'
+            pending = 1
+        elif decision['action'] in ('commit', 'revert'):
+            pending = 0
+    assert any(d['action'] == 'propose' for d in decisions)
+
+
+def test_warmup_windows_make_no_proposals():
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * v)
+    ctl = make_controller(pipe, policy=AutotunePolicy(
+        window_s=1.0, warmup_windows=4, hold_windows=1))
+    assert drive(ctl, pipe, 5) == []  # first sample + 4 warmup windows
+    assert [d['action'] for d in drive(ctl, pipe, 1)] == ['propose']
+
+
+def test_measure_only_policy_never_actuates():
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * v)
+    ctl = make_controller(pipe, policy=AutotunePolicy(
+        window_s=1.0, warmup_windows=0, knob_ids=()))
+    assert drive(ctl, pipe, 10) == []
+    assert pipe.value == 1.0
+    assert ctl.report()['windows'] == 9  # sampled, never turned anything
+
+
+def test_breaker_interlock_freezes_reverts_and_unfreezes():
+    breakers = {'state': {}}
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * v)
+    ctl = make_controller(pipe, breakers=lambda: breakers['state'])
+    decisions = drive(ctl, pipe, 3)  # sample + warmup + propose
+    assert [d['action'] for d in decisions] == ['propose']
+    assert pipe.value == 2.0  # proposal applied, now held
+    breakers['state'] = {'cache:/x': {'state': 'open', 'failures': 5}}
+    pipe.tick()
+    decision = ctl.step()
+    assert decision['action'] == 'freeze'
+    assert pipe.value == 1.0  # held proposal was reverted by the interlock
+    assert ctl.report()['frozen_by_breaker'] is True
+    revert = [d for d in ctl.report()['decisions'] if d['action'] == 'revert']
+    assert revert and 'breaker' in revert[0]['reason']
+    # while open: frozen, no proposals
+    assert drive(ctl, pipe, 3) == []
+    breakers['state'] = {}
+    unfroze = drive(ctl, pipe, 3)
+    assert 'unfreeze' in [d['action'] for d in unfroze]
+    assert ctl.report()['frozen_by_breaker'] is False
+    # and proposals resume after the freeze cooldown
+    assert any(d['action'] == 'propose' for d in drive(ctl, pipe, 6))
+
+
+def test_no_oscillation_under_noise_gate():
+    """Hysteresis: a knob whose effect is below the min_improvement gate is
+    reverted and cooled down — the controller must not flip it repeatedly."""
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 + 0.5 * v)  # ~0.5% gain
+    ctl = make_controller(pipe, policy=AutotunePolicy(
+        window_s=1.0, warmup_windows=1, hold_windows=1, min_improvement=0.05,
+        cooldown_windows=4))
+    decisions = drive(ctl, pipe, 20)
+    changes = [d for d in decisions if d['action'] in ('propose',)]
+    # with a 4-window cooldown after every revert, at most ~1 proposal per 3+4
+    # windows fits in 20 — oscillation would show many more
+    assert len(changes) <= 4
+    assert ctl.report()['committed'] == 0
+    assert pipe.value == 1.0
+
+
+def test_zero_rate_window_never_validates_a_change():
+    """Review hardening: a 0 rows/s baseline collapses the hysteresis gate to
+    0.0 — a window that measured no progress must not commit (and so teach
+    the climb a direction nothing validated)."""
+    pipe = ScriptedPipeline(rate_for=lambda v: 0.0)  # consumer fully stalled
+    ctl = make_controller(pipe)
+    decisions = drive(ctl, pipe, 6)
+    actions = [d['action'] for d in decisions]
+    assert 'commit' not in actions
+    assert 'revert' in actions  # the unmeasured change was rolled back
+    assert pipe.value == 1.0
+
+
+def test_stall_recovery_still_commits():
+    """The flip side of the zero-gate guard: 0 -> positive rows/s commits —
+    a change that unstuck a stalled pipeline is a real improvement."""
+    pipe = ScriptedPipeline(rate_for=lambda v: 0.0 if v <= 1.0 else 200.0)
+    ctl = make_controller(pipe)
+    decisions = drive(ctl, pipe, 6)
+    assert 'commit' in [d['action'] for d in decisions]
+    assert pipe.value >= 2.0  # recovered and kept climbing
+
+
+def test_revert_records_failed_restore_honestly():
+    """Review hardening: when the revert's apply raises (dead target), the
+    decision must state the LIVE value — the proposed one — not claim a
+    rollback that never happened."""
+    calls = []
+
+    def flaky_apply(value):
+        calls.append(value)
+        if len(calls) > 1:
+            raise RuntimeError('target torn down')
+        return value
+
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0)  # no improvement
+    pipe.knob = Knob('pool_workers', 'flaky target', minimum=1.0,
+                     maximum=16.0, step=1.0, cost='cheap',
+                     stages=('pool_wait',), get=lambda: 1.0,
+                     apply=flaky_apply)
+    ctl = make_controller(pipe)
+    decisions = drive(ctl, pipe, 6)
+    revert = [d for d in decisions if d['action'] == 'revert'][0]
+    assert revert['to'] == 2.0  # the live (unrestored) value, not old_value
+    assert 'restore FAILED' in revert['reason']
+
+
+def test_controller_stop_runs_knob_restore_hooks():
+    restored = []
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * v)
+    pipe.knob.restore = lambda: restored.append(True)
+    ctl = make_controller(pipe)
+    ctl.stop()
+    ctl.stop()  # idempotent; hooks must tolerate a second run
+    assert restored == [True, True]
+
+
+def test_scripted_convergence_reaches_fixed_default_rate():
+    """The ISSUE-9 convergence criterion, deterministically: rows/s is a known
+    concave function of the knob (the fixed default 4 is its plateau); the
+    controller starts at the degraded value 1 and must reach >= the
+    fixed-default rate within a bounded number of windows."""
+    default_rate = 100.0 * 4  # rate_for(fixed default 4)
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * min(v, 4.0),
+                            initial=1.0, maximum=16.0)
+    ctl = make_controller(pipe)
+    for window in range(40):
+        pipe.tick()
+        ctl.step()
+        if pipe.rate_for(pipe.value) >= default_rate:
+            break
+    assert pipe.rate_for(pipe.value) >= default_rate, \
+        'did not converge within 40 windows: value={}'.format(pipe.value)
+    assert window < 40
+    # the climb committed its way up (the final step to 4 may still be a
+    # held proposal at break time — the rate criterion above already passed)
+    assert ctl.report()['committed'] >= 2
+
+
+def test_decisions_stream_to_jsonl(tmp_path):
+    from petastorm_tpu.telemetry.export import JsonlEventLogger
+    path = str(tmp_path / 'decisions.jsonl')
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * v)
+    ctl = make_controller(pipe, event_logger=JsonlEventLogger(path,
+                                                              interval_s=0))
+    drive(ctl, pipe, 8)
+    with open(path) as f:
+        records = [json.loads(line) for line in f]
+    assert records, 'no decisions were streamed'
+    assert all(r['event'] == 'autotune_decision' for r in records)
+    assert records[0]['action'] == 'propose'
+    assert records[0]['knob'] == 'pool_workers'
+
+
+def test_interlock_window_emits_both_decisions_to_jsonl(tmp_path):
+    """Decisions are emitted AFTER the controller lock releases (step() may
+    record two in one window: the interlock's revert + freeze) — both must
+    reach the JSONL stream, in order."""
+    from petastorm_tpu.telemetry.export import JsonlEventLogger
+    path = str(tmp_path / 'decisions.jsonl')
+    breakers = {'state': {}}
+    pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * v)
+    ctl = make_controller(pipe, breakers=lambda: breakers['state'],
+                          event_logger=JsonlEventLogger(path, interval_s=0))
+    drive(ctl, pipe, 3)  # sample + warmup + propose (now held)
+    breakers['state'] = {'cache:/x': {'state': 'open'}}
+    pipe.tick()
+    ctl.step()
+    with open(path) as f:
+        actions = [json.loads(line)['action'] for line in f]
+    assert actions == ['propose', 'revert', 'freeze']
+
+
+def test_decisions_stamp_the_flight_recorder():
+    from petastorm_tpu.telemetry import tracing
+    tracing.reset_tracing()
+    tracing.set_trace_enabled(True)
+    try:
+        pipe = ScriptedPipeline(rate_for=lambda v: 100.0 * v)
+        drive(make_controller(pipe), pipe, 4)
+        events = tracing.trace_snapshot().get('events', [])
+    finally:
+        tracing.set_trace_enabled(False)
+        tracing.reset_tracing()
+    instants = [e for e in events if e.get('name') == 'autotune_decision']
+    assert instants, 'no autotune_decision trace instants recorded'
+    assert instants[0]['args']['action'] == 'propose'
+
+
+def test_snapshot_delta_subtracts_cumulative_series():
+    prev = {'histograms': {'decode': {'unit': SECONDS_UNIT, 'count': 10,
+                                      'sum': 1.0, 'max': 0.5}},
+            'counters': {'service_busy': 2}}
+    cur = {'histograms': {'decode': {'unit': SECONDS_UNIT, 'count': 30,
+                                     'sum': 4.0, 'max': 0.5},
+                          'h2d': {'unit': SECONDS_UNIT, 'count': 5,
+                                  'sum': 2.0, 'max': 1.0}},
+           'counters': {'service_busy': 7}, 'gauges': {'depth': 3.0}}
+    delta = snapshot_delta(prev, cur)
+    assert delta['histograms']['decode'] == {'unit': SECONDS_UNIT,
+                                             'count': 20, 'sum': 3.0,
+                                             'max': 0.5}
+    assert delta['histograms']['h2d']['count'] == 5
+    assert delta['counters'] == {'service_busy': 5}
+    assert delta['gauges'] == {'depth': 3.0}
+
+
+# ----------------------------------------------------- analyze advisories
+
+
+def test_analyze_service_advisories():
+    from petastorm_tpu.telemetry.analyze import (attribute_bottleneck,
+                                                 format_report)
+    snapshot = {'histograms': {}, 'counters': {'service_busy': 12},
+                'gauges': {'service_queue_depth': 9.0}}
+    report = attribute_bottleneck(snapshot)
+    signals = {a['signal'] for a in report['advisories']}
+    assert signals == {'service_busy', 'service_queue_depth'}
+    assert all(a['recommendation'] for a in report['advisories'])
+    text = format_report(report)
+    assert '[service]' in text and 'service_busy=12' in text
+
+
+def test_analyze_no_advisories_on_clean_snapshot():
+    from petastorm_tpu.telemetry.analyze import attribute_bottleneck
+    report = attribute_bottleneck(_stage_snapshot('decode', 2.0))
+    assert report['advisories'] == []
+    assert report['top_stage'] == 'decode'
+
+
+# ----------------------------------------------------------------- e2e
+
+
+@pytest.fixture(scope='module')
+def autotune_dataset(tmp_path_factory):
+    """A store big enough that epochs outlast control windows (the session
+    synthetic dataset is 100 rows — an epoch finishes before one window)."""
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_rows
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('AutotuneBench', [
+        UnischemaField('idx', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('vec', np.float32, (256,), NdarrayCodec(), False),
+    ])
+    url = 'file://' + str(tmp_path_factory.mktemp('autotune') / 'dataset')
+    write_rows(url, schema,
+               ({'idx': i, 'vec': np.full(256, i % 97, np.float32)}
+                for i in range(8000)), rowgroup_size_mb=1)
+    return url
+
+
+@pytest.fixture(autouse=True)
+def _restore_decode_threads_env():
+    saved = os.environ.get('PETASTORM_TPU_DECODE_THREADS')
+    yield
+    if saved is None:
+        os.environ.pop('PETASTORM_TPU_DECODE_THREADS', None)
+    else:
+        os.environ['PETASTORM_TPU_DECODE_THREADS'] = saved
+
+
+def test_reader_autotune_off_is_inert(synthetic_dataset):
+    from petastorm_tpu import make_reader
+    with make_reader(synthetic_dataset.url, workers_count=2,
+                     num_epochs=1) as reader:
+        assert reader._autotune is None
+        assert reader.autotune_report() == {'enabled': False}
+        assert 'autotune' not in reader.diagnostics
+        before = (reader._pool.workers_count,
+                  reader._ventilator.max_in_flight)
+        rows = sum(batch.num_rows for batch in reader.iter_columnar())
+        assert rows == len(synthetic_dataset.rows)
+        # no knob mutated when disabled — the seed path byte-identical
+        assert (reader._pool.workers_count,
+                reader._ventilator.max_in_flight) == before
+
+
+def test_reader_autotune_converges_from_degraded_defaults(autotune_dataset):
+    """ISSUE-9 e2e: a reader started with deliberately bad knobs (1 worker,
+    in-flight window 1) and an aggressive policy commits at least one
+    improvement within a bounded number of windows, mid-epoch, while rows
+    keep flowing correctly."""
+    from petastorm_tpu import make_reader
+    policy = AutotunePolicy(window_s=0.15, warmup_windows=1, hold_windows=1,
+                            min_improvement=0.005, cooldown_windows=2)
+    reader = make_reader(autotune_dataset, workers_count=1, num_epochs=None,
+                         autotune=policy)
+    try:
+        reader._ventilator.set_max_in_flight(1)
+        rows = 0
+        deadline = time.time() + 30
+        report = reader.autotune_report()
+        for batch in reader.iter_columnar():
+            assert np.all(batch.columns['vec'][:, 0]
+                          == batch.columns['idx'] % 97)
+            rows += batch.num_rows
+            report = reader.autotune_report()
+            if report['committed'] >= 1 or time.time() > deadline:
+                break
+        assert report['enabled']
+        assert report['committed'] >= 1, report['decisions']
+        # the hill climb moved a degraded knob upward from its floor
+        knobs = report['knobs']
+        assert (knobs['pool_workers']['value'] > 1
+                or knobs['ventilator_max_in_flight']['value'] > 1)
+        assert rows > 0
+        assert 'autotune' in reader.diagnostics
+        assert not report['frozen_by_breaker']
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def test_reader_autotune_knob_catalog_shape(autotune_dataset):
+    """The reader builds the documented knob set for a thread-pool decoding
+    reader (docs/autotuning.md knob table)."""
+    from petastorm_tpu import make_reader
+    reader = make_reader(autotune_dataset, workers_count=2, num_epochs=1,
+                         autotune=AutotunePolicy(window_s=3600.0))
+    try:
+        knobs = reader.autotune_report()['knobs']
+        assert set(knobs) == {'ventilator_max_in_flight', 'pool_workers',
+                              'decode_threads'}
+        for entry in knobs.values():
+            assert entry['min'] <= entry['value'] <= entry['max']
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def test_loader_registers_shuffle_buffer_knob(autotune_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.parallel.loader import JaxDataLoader
+    reader = make_reader(autotune_dataset, workers_count=1, num_epochs=1,
+                         autotune=AutotunePolicy(window_s=3600.0))
+    try:
+        loader = JaxDataLoader(reader, batch_size=32, device_put=False,
+                               shuffling_queue_capacity=256, seed=1)
+        catalog = reader._autotune.catalog
+        assert 'loader_min_after_retrieve' in catalog
+        knob = catalog.knob('loader_min_after_retrieve')
+        assert knob.get() == 128.0  # capacity // 2 default resolved
+        assert knob.apply(32.0) == 32.0
+        assert loader._min_after_retrieve == 32
+        it = iter(loader)
+        first = next(it)
+        assert first  # the live buffer picks up further turns
+        assert knob.apply(0.0) == 0.0
+        assert loader._active_buffer.min_after_retrieve == 0
+        loader.stop()
+        loader.join()
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def test_loader_without_autotune_registers_nothing(autotune_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.parallel.loader import JaxDataLoader
+    with make_reader(autotune_dataset, workers_count=1,
+                     num_epochs=1) as reader:
+        loader = JaxDataLoader(reader, batch_size=32, device_put=False)
+        assert loader._active_buffer is None
+        assert build_loader_knobs(loader) == []  # no shuffling buffer knob
+
+
+def test_dispatcher_autotune_state_block():
+    from petastorm_tpu.service.dispatcher import Dispatcher
+    dispatcher = Dispatcher(autotune=AutotunePolicy(window_s=3600.0))
+    try:
+        dispatcher.start()
+        state = dispatcher.state()
+        assert state['autotune']['enabled']
+        assert set(state['autotune']['knobs']) == {'service_admission_window',
+                                                   'service_client_window'}
+    finally:
+        dispatcher.stop()
+        dispatcher.join()
+
+
+def test_dispatcher_without_autotune_has_no_block():
+    from petastorm_tpu.service.dispatcher import Dispatcher
+    dispatcher = Dispatcher()
+    assert dispatcher._autotune is None
+    assert 'autotune' not in dispatcher.state()

@@ -61,6 +61,14 @@ def test_collect_report_healthy_and_json_clean(capsys, monkeypatch):
     assert trace['dropped_events'] == 0
     assert trace['anomaly_instants'] == []
     assert trace['top_rowgroup_traces']
+    # autotune block (ISSUE 9): one stable key; the roundtrip arms a
+    # long-window controller, so the catalog is live but no knob was turned
+    autotune = report['autotune']
+    assert autotune['enabled'] is True
+    assert autotune['controller'] == 'reader'
+    assert autotune['frozen_by_breaker'] is False
+    assert 'pool_workers' in autotune['knobs']
+    assert autotune['decisions'] == []
 
 
 def test_service_unconfigured_by_default(monkeypatch):
@@ -140,6 +148,37 @@ def test_human_report_warns_on_open_breaker(capsys):
     out = capsys.readouterr().out
     assert 'WARNING: circuit breaker(s) not closed: cache:/tmp/c' in out
     assert 'workers_hung_reaped=2' in out and 'shm_crc_failures=1' in out
+
+
+def test_human_report_autotune_line_and_frozen_warning(capsys):
+    report = {
+        'versions': {'petastorm_tpu': 'x', 'python': 'x', 'jax': 'x',
+                     'pyarrow': 'x'},
+        'backend': {'status': 'down', 'detail': ''},
+        'store_roundtrip': {'status': 'ok', 'rows': 1, 'rows_per_sec': 1.0},
+        'autotune': {'enabled': True, 'windows': 7, 'frozen_by_breaker': True,
+                     'knobs': {'pool_workers': {'value': 2.0}},
+                     'decisions': [{'action': 'freeze', 'knob': None}]},
+        'healthy': True,
+    }
+    doctor._print_human(report)
+    out = capsys.readouterr().out
+    assert 'autotune: 1 knob(s) catalogued, 7 window(s), 1 decision(s)' in out
+    assert 'last: freeze' in out
+    assert 'WARNING: autotune is FROZEN by an open circuit breaker' in out
+
+
+def test_human_report_autotune_disabled_prints_nothing(capsys):
+    report = {
+        'versions': {'petastorm_tpu': 'x', 'python': 'x', 'jax': 'x',
+                     'pyarrow': 'x'},
+        'backend': {'status': 'down', 'detail': ''},
+        'store_roundtrip': {'status': 'failed', 'error': 'x'},
+        'autotune': {'enabled': False},
+        'healthy': False,
+    }
+    doctor._print_human(report)
+    assert 'autotune' not in capsys.readouterr().out
 
 
 def test_json_report_with_unreachable_service_url(capsys):

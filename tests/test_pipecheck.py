@@ -48,6 +48,8 @@ BAD_FIXTURES = [
      ['decodee', 'watchdog_reep']),
     ('telemetry/bad_instant.py', ['telemetry-names'], 2,
      ['watchdog_repa', 'TRACE_INSTANTS', 'decodee']),
+    ('telemetry/bad_knob.py', ['telemetry-names'], 2,
+     ['pool_wrokers', 'KNOB_IDS', 'ventilator_max_inflight']),
     ('clock/bad', ['clock-discipline'], 1, ['time.monotonic']),
     ('exceptions/bad_swallow.py', ['exception-hygiene'], 1, ['swallows']),
     ('exceptions/workers/bad_worker_swallow.py', ['exception-hygiene'], 1,
@@ -73,6 +75,7 @@ BAD_FIXTURES = [
 GOOD_FIXTURES = [
     ('telemetry/good_stage.py', ['telemetry-names']),
     ('telemetry/good_instant.py', ['telemetry-names']),
+    ('telemetry/good_knob.py', ['telemetry-names']),
     ('clock/good', ['clock-discipline']),
     ('exceptions/good_swallow.py', ['exception-hygiene']),
     ('locks/good_lock.py', ['lock-discipline']),
@@ -101,6 +104,7 @@ def test_known_good_fixture_is_clean(path, rules):
 @pytest.mark.parametrize('path,rules', [
     ('telemetry/suppressed_stage.py', ['telemetry-names']),
     ('telemetry/suppressed_instant.py', ['telemetry-names']),
+    ('telemetry/suppressed_knob.py', ['telemetry-names']),
     ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
     ('protocol/service_suppressed_kinds', ['protocol-conformance']),
 ])
@@ -229,6 +233,18 @@ def test_mutation_typo_stage_name_in_worker_span(tmp_path):
     report = run([tmp_path], rules=['telemetry-names'])
     assert len(report.findings) == 1, messages(report)
     assert "'seralize'" in report.findings[0].message
+
+
+def test_mutation_typo_knob_id_in_builder(tmp_path):
+    """Guards the real autotune knob builders (ISSUE 9): a Knob constructed
+    under an id missing from KNOB_IDS must surface (checked against the
+    installed catalog when the analyzed tree does not carry autotune/knobs.py
+    at its canonical path)."""
+    _copy_mutated(PKG / 'autotune' / 'knobs.py', tmp_path / 'knob_builders.py',
+                  "'pool_workers'", "'pool_wrokers'")
+    report = run([tmp_path], rules=['telemetry-names'])
+    text = '\n'.join(messages(report))
+    assert "'pool_wrokers'" in text and 'KNOB_IDS' in text, text
 
 
 def test_mutation_new_zmq_kind_sent_but_not_dispatched(tmp_path):
