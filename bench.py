@@ -155,7 +155,8 @@ _HEADLINE_FALLBACKS = (
 SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
-                 'resilience', 'pipecheck', 'tracing', 'service', 'autotune')
+                 'resilience', 'pipecheck', 'tracing', 'service', 'autotune',
+                 'device_decode')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -164,10 +165,10 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'autotune', 'decode_bench',
-                     'service', 'wire_bench', 'telemetry', 'tracing',
-                     'resilience', 'mnist_scan_stream', 'flash', 'moe',
-                     'imagenet_scan', 'imagenet_stream', 'decode_delta',
+SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'autotune', 'device_decode',
+                     'decode_bench', 'service', 'wire_bench', 'telemetry',
+                     'tracing', 'resilience', 'mnist_scan_stream', 'flash',
+                     'moe', 'imagenet_scan', 'imagenet_stream', 'decode_delta',
                      'bare_reader', 'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
@@ -1803,6 +1804,87 @@ def child_main():
             'autotune_tuned_budget_s': tuned_budget_s,
         })
 
+    def run_device_decode():
+        """Device-resident decode tail (ISSUE 10; docs/performance.md): the
+        DCT image store read twice through JaxDataLoader — host decode (the
+        codec's numpy IDCT in the reader workers) vs ship-raw
+        (``device_decode_fields=['image']``: coefficients upload in the
+        coalesced single transfer, dequant+IDCT runs as a jitted device
+        kernel double-buffered against the consumer). ``h2d_overlap_fraction``
+        is 1 - input_stall_fraction of the ship-raw run: the share of the
+        input pipeline's work (upload + device decode included) hidden behind
+        the consuming loop. On a CPU backend the tail falls back to
+        byte-identical host decode and the line says so honestly
+        (``cpu_fallback=true`` + device_decode_batches=0) — treat those
+        numbers as a fallback-path regression check, not a decode-tail
+        measurement."""
+        section_start = time.monotonic()
+        img_url = imagenet_dataset_url()
+        if not os.path.exists(os.path.join(img_url, '_common_metadata')):
+            log('materializing {} DCT images to {}'.format(IMG_ROWS, img_url))
+            build_imagenet_dataset(img_url)
+        dd_epochs = int(os.environ.get('BENCH_DEVICE_DECODE_EPOCHS', 3))
+
+        def run_epochs(device_fields, label):
+            rates = []
+            stats = {}
+            snapshot = {}
+            for _ in range(dd_epochs):
+                kwargs = {'num_epochs': 1, 'shuffle_row_groups': False,
+                          'workers_count': WORKERS}
+                if device_fields:
+                    kwargs['device_decode_fields'] = device_fields
+                reader = make_reader(img_url, **kwargs)
+                loader = JaxDataLoader(reader, batch_size=IMG_BATCH,
+                                       drop_last=True)
+                start = time.perf_counter()
+                rows = 0
+                for batch in loader:
+                    # synchronize like a train step would: the overlap number
+                    # must measure hidden work, not unsynchronized dispatch
+                    jax.block_until_ready(
+                        jax.tree_util.tree_leaves(batch)[0])
+                    rows += IMG_BATCH
+                rates.append(rows / max(time.perf_counter() - start, 1e-9))
+                stats = loader.stats.as_dict()
+                snapshot = loader.telemetry_snapshot()
+                reader.stop()
+                reader.join()
+                if deadline_exceeded(section_start, len(rates), dd_epochs,
+                                     'device_decode/' + label):
+                    break
+            return sorted(rates)[len(rates) // 2], stats, snapshot
+
+        host_rate, host_stats, _ = run_epochs(None, 'host')
+        raw_rate, raw_stats, raw_snapshot = run_epochs(['image'], 'ship_raw')
+        hist = raw_snapshot.get('histograms', {})
+        cpu_fallback = jax.devices()[0].platform == 'cpu'
+        overlap = 1.0 - raw_stats.get('input_stall_fraction', 0.0)
+        log('device_decode: host {:.1f} rows/s vs ship-raw {:.1f} rows/s '
+            '({} device-decoded / {} fallback batches, {} coalesced uploads, '
+            'overlap {:.2f}){}'.format(
+                host_rate, raw_rate, raw_stats.get('device_decode_batches'),
+                raw_stats.get('device_fallback_batches'),
+                raw_stats.get('coalesced_uploads'), overlap,
+                ' [CPU FALLBACK]' if cpu_fallback else ''))
+        results.update({
+            'device_decode_rows_per_sec': round(raw_rate, 2),
+            'device_decode_host_rows_per_sec': round(host_rate, 2),
+            'device_decode_speedup': round(raw_rate / max(host_rate, 1e-9), 3),
+            'device_decode_h2d_overlap_fraction': round(overlap, 4),
+            'device_decode_batches':
+                int(raw_stats.get('device_decode_batches', 0)),
+            'device_decode_fallback_batches':
+                int(raw_stats.get('device_fallback_batches', 0)),
+            'device_decode_coalesced_uploads':
+                int(raw_stats.get('coalesced_uploads', 0)),
+            'device_decode_stage_present': 'device_decode' in hist,
+            'device_decode_epochs': dd_epochs,
+            # honest provenance: on CPU the tail host-falls-back and the
+            # speedup is a no-op check, not a decode-tail measurement
+            'device_decode_cpu_fallback': cpu_fallback,
+        })
+
     def run_pipecheck():
         """Check phase (host-only, sub-second): the pipecheck static
         data-plane invariant analysis + the mypy-strict ratchet over the
@@ -1870,6 +1952,7 @@ def child_main():
         'pipecheck': run_pipecheck,
         'service': run_service,
         'autotune': run_autotune,
+        'device_decode': run_device_decode,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

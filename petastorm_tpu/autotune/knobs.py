@@ -36,6 +36,8 @@ KNOB_IDS: Tuple[str, ...] = (
     'cache_writable_hits',        # arrow-ipc cache: writable vs zero-copy hits
     'cache_bypass',               # disk cache: direct-fill bypass mode
     'loader_min_after_retrieve',  # loader shuffle-buffer fill threshold
+    'loader_prefetch',            # loader: host-batch prefetch queue depth
+    'loader_device_buffer',       # loader: device decode-tail ring depth
     'service_admission_window',   # dispatcher: per-client admission cap
     'service_client_window',      # dispatcher: live per-client in-flight depth
 )
@@ -291,12 +293,34 @@ def build_reader_knobs(reader: Any) -> List[Knob]:
 
 def build_loader_knobs(loader: Any) -> List[Knob]:
     """Knobs for a live :class:`~petastorm_tpu.parallel.loader.JaxDataLoader`:
-    today the shuffle-buffer fill threshold (``min_after_retrieve``) when a
-    shuffling buffer is configured; lowering it reduces ``shuffle_wait`` at
-    the cost of shallower decorrelation."""
+    the prefetch queue depth and (when the reader ships raw fields) the device
+    decode tail's ring depth — both gated off when ``device_put=False``, where
+    batches never leave the host and neither queue hides device latency — plus
+    the shuffle-buffer fill threshold (``min_after_retrieve``) when a
+    shuffling buffer is configured."""
+    knobs: List[Knob] = []
+    if getattr(loader, '_device_put', False):
+        current_prefetch = float(getattr(loader, 'prefetch', 2))
+        knobs.append(Knob(
+            'loader_prefetch',
+            'host-batch prefetch queue depth (batches in flight ahead of the '
+            'training loop)',
+            minimum=1.0, maximum=max(16.0, current_prefetch * 8), step=1.0,
+            cost='cheap', stages=('shuffle_wait', 'h2d'), unit='batches',
+            get=lambda: float(loader.prefetch),
+            apply=lambda v: float(loader.set_prefetch(int(v)))))
+        if getattr(loader, '_device_stage', None) is not None:
+            knobs.append(Knob(
+                'loader_device_buffer',
+                'device decode-tail ring depth (decode programs dispatched '
+                'ahead of the train step)',
+                minimum=1.0, maximum=16.0, step=1.0, cost='cheap',
+                stages=('d2d_wait', 'h2d'), unit='batches',
+                get=lambda: float(loader.device_buffer_depth),
+                apply=lambda v: float(loader.set_device_buffer_depth(int(v)))))
     capacity = int(getattr(loader, '_shuffling_queue_capacity', 0) or 0)
     if capacity <= 0:
-        return []
+        return knobs
 
     def current() -> float:
         value = getattr(loader, '_min_after_retrieve', None)
@@ -310,12 +334,13 @@ def build_loader_knobs(loader: Any) -> List[Knob]:
             applied = buffer.set_min_after_retrieve(applied)
         return float(applied)
 
-    return [Knob(
+    knobs.append(Knob(
         'loader_min_after_retrieve',
         'shuffle-buffer decorrelation floor (fill threshold before retrieve)',
         minimum=0.0, maximum=float(capacity),
         step=float(max(1, capacity // 8)), cost='cheap',
-        stages=('shuffle_wait',), unit='rows', get=current, apply=apply)]
+        stages=('shuffle_wait',), unit='rows', get=current, apply=apply))
+    return knobs
 
 
 def build_service_knobs(scheduler: Any) -> List[Knob]:

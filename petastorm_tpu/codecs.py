@@ -423,12 +423,13 @@ class NdarrayCodec(FieldCodec):
         return pa.binary()
 
 
-def _npz_npy_payload(blob):
-    """Extract the raw ``.npy`` member bytes out of a ``np.savez_compressed``
-    container WITHOUT ``BytesIO``/``ZipFile`` machinery: parse the single
-    member's zip local-file header and inflate the deflate stream in one raw
-    ``zlib`` call. Returns None for any unexpected layout — callers fall back
-    to ``np.load``."""
+def _npz_raw_member(blob):
+    """Parse the single-member zip container of a ``np.savez_compressed`` blob
+    WITHOUT inflating: returns ``(method, body)`` where ``method`` is the zip
+    compression method (8 = deflate: ``body`` is the raw-deflate stream; 0 =
+    stored: ``body`` is the member's ``.npy`` bytes) — the ship-raw form the
+    device-resident decode tail uploads (docs/performance.md). None for any
+    unexpected container layout — callers must then keep the host decode path."""
     head = bytes(memoryview(blob)[:30])
     if len(head) < 30 or head[:4] != b'PK\x03\x04':
         return None
@@ -438,16 +439,38 @@ def _npz_npy_payload(blob):
     extra_len = int.from_bytes(head[28:30], 'little')
     body = memoryview(blob)[30 + name_len + extra_len:]
     if method == 8:
+        if flags & 0x08:
+            # sizes only in the trailing data descriptor: the deflate stream's
+            # end is self-delimiting, but the body view would include the
+            # descriptor + central directory — the raw-deflate consumer stops
+            # at BFINAL, so the trailing bytes are harmless; still slice off
+            # nothing here (length unknown without inflating).
+            return 8, body
+        size = int.from_bytes(head[18:22], 'little')
+        return 8, body[:size]
+    if method == 0 and not flags & 0x08:
+        size = int.from_bytes(head[18:22], 'little')
+        return 0, body[:size]
+    return None
+
+
+def _npz_npy_payload(blob):
+    """Extract the raw ``.npy`` member bytes out of a ``np.savez_compressed``
+    container WITHOUT ``BytesIO``/``ZipFile`` machinery: the single member's
+    zip local-file header parses through :func:`_npz_raw_member` (the one
+    parser both the host decode and ship-raw paths share) and deflate bodies
+    inflate in one raw ``zlib`` call. Returns None for any unexpected layout —
+    callers fall back to ``np.load``."""
+    parsed = _npz_raw_member(blob)
+    if parsed is None:
+        return None
+    method, body = parsed
+    if method == 8:
         try:
             return zlib.decompressobj(-15).decompress(body)
         except zlib.error:
             return None
-    if method == 0 and not flags & 0x08:
-        # stored uncompressed with a known size (flag bit 3 would mean the size
-        # only lives in a trailing data descriptor — np.load handles that)
-        size = int.from_bytes(head[18:22], 'little')
-        return bytes(body[:size])
-    return None
+    return bytes(body)
 
 
 def _cached_npy_meta(payload, cache):

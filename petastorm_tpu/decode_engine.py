@@ -34,7 +34,9 @@ from typing import (Any, Callable, Dict, List, Mapping, Optional, Sequence,
 import numpy as np
 import pyarrow as pa
 
-from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.codecs import (CompressedNdarrayCodec, DctImageCodec,
+                                  NdarrayCodec, ScalarCodec, _cached_npy_meta,
+                                  _column_blobs, _npz_raw_member)
 from petastorm_tpu.errors import DecodeFieldError
 from petastorm_tpu.predicates import (PredicateBase, in_intersection,
                                       in_negate, in_pseudorandom_split,
@@ -104,6 +106,188 @@ def partition_column(field: Any, value: Any, num_rows: int) -> np.ndarray:
     return np.array([value] * num_rows, dtype=object)
 
 
+# ------------------------------------------------------- ship-raw contract
+# (docs/performance.md "Device-resident decode tail": fields named in
+# make_reader(device_decode_fields=...) skip host decode — their kernels pass
+# the codec payload through in a device-uploadable form, plus small auxiliary
+# columns carrying per-cell metadata the device program needs)
+
+#: auxiliary column suffix: ``(n, 2)`` int32 pre-padding (height, width) of a
+#: raw-shipped DCT field (rows for null cells are ``(0, 0)``)
+RAW_HW_SUFFIX = '__hw'
+#: auxiliary column suffix: ``(n,)`` uint8 per-cell encoding of a raw-shipped
+#: compressed-ndarray field (``RAW_ENC_*`` values)
+RAW_ENC_SUFFIX = '__enc'
+
+#: cell is a raw-deflate stream (inflate, then npy-unpack)
+RAW_ENC_DEFLATE = 0
+#: cell is stored ``.npy`` bytes (header + payload, no compression)
+RAW_ENC_NPY = 1
+#: cell is null (the frame entry is None)
+RAW_ENC_NULL = 2
+
+
+class ShipRawColumns:
+    """Multi-column result of a ship-raw kernel: the field's raw payload column
+    plus its auxiliary metadata columns, merged into the batch by
+    :meth:`DecodePlan.execute` under their own names."""
+
+    __slots__ = ('columns',)
+
+    def __init__(self, columns: Columns) -> None:
+        self.columns = columns
+
+
+def validate_device_field(field: Any) -> None:
+    """Raise ``ValueError`` unless ``field`` can ship raw to the device.
+
+    Supported codecs: :class:`~petastorm_tpu.codecs.DctImageCodec` (coefficients
+    ship, IDCT runs on device), :class:`~petastorm_tpu.codecs.NdarrayCodec`
+    (``.npy`` bytes ship, unpack is a device bitcast) and
+    :class:`~petastorm_tpu.codecs.CompressedNdarrayCodec` (raw deflate frames
+    ship). ``CompressedImageCodec`` is deliberately unsupported: JPEG/PNG
+    entropy decode is bit-serial host work — store images with
+    ``DctImageCodec`` for the device decode tail (the exact-JPEG-vs-DCT-form
+    trade is documented in docs/performance.md)."""
+    codec = field.codec
+    if type(codec) in (DctImageCodec, NdarrayCodec, CompressedNdarrayCodec):
+        return
+    raise ValueError(
+        'Field {!r} has codec {} which cannot ship raw to the device; '
+        'device_decode_fields supports DctImageCodec, NdarrayCodec and '
+        'CompressedNdarrayCodec (store images as DctImageCodec for on-chip '
+        'decode — exact JPEG entropy decode is host-only)'.format(
+            field.name, type(codec).__name__ if codec is not None else None))
+
+
+def _blob_view(blob: Any) -> np.ndarray:
+    """One cell's bytes as a 1-D uint8 view (zero-copy for ndarray views and
+    bytes alike)."""
+    if isinstance(blob, np.ndarray):
+        return blob
+    return np.frombuffer(blob, dtype=np.uint8)
+
+
+def _ship_raw_dct_kernel(name: str, field: Any) -> FieldKernel:
+    """Ship-raw kernel for ``DctImageCodec``: strip the ``DCT1`` header, pass
+    the int16 coefficient blocks through untransformed (ONE slab copy when
+    shapes are uniform, the ragged list contract otherwise) and emit the
+    per-cell pre-padding ``(h, w)`` as the ``__hw`` auxiliary column."""
+    magic = DctImageCodec._MAGIC
+
+    def kernel(table: Any, partition_keys: Mapping[str, Any], num_rows: int) -> Any:
+        blobs = _column_blobs(table.column(name))
+        n = len(blobs)
+        hw = np.zeros((n, 2), dtype=np.int32)
+        header_cache: Dict[bytes, Any] = {}
+        out: Optional[np.ndarray] = None
+        cells: Optional[List[Any]] = None
+        for i, blob in enumerate(blobs):
+            arr: Optional[np.ndarray] = None
+            if blob is not None:
+                view = _blob_view(blob)
+                if bytes(memoryview(view[:4])) != magic:
+                    raise ValueError('field {!r} cell {} is not DCT-coded data'
+                                     .format(name, i))
+                head = bytes(memoryview(view[4:8]))
+                hw[i, 0] = int.from_bytes(head[0:2], 'little')
+                hw[i, 1] = int.from_bytes(head[2:4], 'little')
+                # memoryview: _cached_npy_meta compares byte prefixes, which
+                # an ndarray would broadcast instead of comparing
+                payload = memoryview(view[8:])
+                meta = _cached_npy_meta(payload, header_cache)
+                if meta is None:
+                    raise ValueError('field {!r} cell {} carries an unparseable '
+                                     'coefficient payload'.format(name, i))
+                shape, fortran, dtype, offset = meta
+                if fortran or dtype.hasobject:
+                    raise ValueError('field {!r} cell {} coefficient layout is '
+                                     'not C-contiguous native'.format(name, i))
+                count = int(np.prod(shape, dtype=np.int64))
+                arr = np.frombuffer(payload, dtype=dtype, count=count,
+                                    offset=offset).reshape(shape)
+            if cells is None:
+                if arr is not None:
+                    if out is None and i == 0:
+                        out = np.empty((n,) + arr.shape, dtype=arr.dtype)
+                    if out is not None and arr.shape == out.shape[1:] \
+                            and arr.dtype == out.dtype:
+                        out[i] = arr
+                        continue
+                cells = [out[j] for j in range(i)] if out is not None else []
+            cells.append(None if arr is None else arr.copy())
+        column: Any = out if cells is None else cells
+        return ShipRawColumns({name: column, name + RAW_HW_SUFFIX: hw})
+    return kernel
+
+
+def _ship_raw_npy_kernel(name: str, field: Any) -> FieldKernel:
+    """Ship-raw kernel for ``NdarrayCodec``: the stored ``.npy`` blobs pass
+    through byte-for-byte. Equal-length blobs with one shared header become a
+    ``(n, blob_len)`` uint8 matrix (the device program strips the header with a
+    static slice and bitcasts the payload); anything else stays a list of 1-D
+    uint8 arrays for the loader's host fallback."""
+
+    def kernel(table: Any, partition_keys: Mapping[str, Any], num_rows: int) -> Any:
+        blobs = _column_blobs(table.column(name))
+        n = len(blobs)
+        views = [None if b is None else _blob_view(b) for b in blobs]
+        lengths = {len(v) for v in views if v is not None}
+        if n and not any(v is None for v in views) and len(lengths) == 1:
+            blob_len = lengths.pop()
+            matrix = np.empty((n, blob_len), dtype=np.uint8)
+            for i, view in enumerate(views):
+                matrix[i] = view
+            parsed = _cached_npy_meta(memoryview(matrix[0]), {})
+            if parsed is not None:
+                header_len = parsed[3]
+                header = matrix[0, :header_len]
+                if (matrix[:, :header_len] == header).all():
+                    return matrix
+        return [None if v is None else v.copy() for v in views]
+    return kernel
+
+
+def _ship_raw_deflate_kernel(name: str, field: Any) -> FieldKernel:
+    """Ship-raw kernel for ``CompressedNdarrayCodec``: each cell's zip
+    container is stripped to the raw member — a raw-deflate stream (enc 0) or
+    stored ``.npy`` bytes (enc 1) — with the per-cell encoding in the ``__enc``
+    auxiliary column. No inflate happens here: the loader's device tail
+    inflates stored-block streams on chip and Huffman streams on its own host
+    thread, off the contended worker CPU."""
+
+    def kernel(table: Any, partition_keys: Mapping[str, Any], num_rows: int) -> Any:
+        blobs = _column_blobs(table.column(name))
+        n = len(blobs)
+        enc = np.full(n, RAW_ENC_NULL, dtype=np.uint8)
+        frames: List[Any] = []
+        for i, blob in enumerate(blobs):
+            if blob is None:
+                frames.append(None)
+                continue
+            parsed = _npz_raw_member(blob)
+            if parsed is None:
+                raise ValueError('field {!r} cell {} is not a '
+                                 'savez_compressed container'.format(name, i))
+            method, body = parsed
+            enc[i] = RAW_ENC_NPY if method == 0 else RAW_ENC_DEFLATE
+            frames.append(np.frombuffer(body, dtype=np.uint8).copy())
+        return ShipRawColumns({name: frames, name + RAW_ENC_SUFFIX: enc})
+    return kernel
+
+
+def _ship_raw_kernel(name: str, field: Any) -> FieldKernel:
+    """Dispatch the ship-raw kernel for ``field``'s codec (pre-validated by
+    :func:`validate_device_field`)."""
+    validate_device_field(field)
+    codec_type = type(field.codec)
+    if codec_type is DctImageCodec:
+        return _ship_raw_dct_kernel(name, field)
+    if codec_type is NdarrayCodec:
+        return _ship_raw_npy_kernel(name, field)
+    return _ship_raw_deflate_kernel(name, field)
+
+
 # ----------------------------------------------------------- decode plans
 
 class DecodePlan:
@@ -131,12 +315,18 @@ class DecodePlan:
         columns: Columns = {}
         for name, kernel in self._kernels:
             try:
-                columns[name] = kernel(table, partition_keys, num_rows)
+                result = kernel(table, partition_keys, num_rows)
             except Exception as exc:
                 raise DecodeFieldError(
                     'Failed to decode field {!r} of fragment {!r}: {}'
                     .format(name, fragment_path, exc),
                     field_name=name, fragment_path=fragment_path) from exc
+            if isinstance(result, ShipRawColumns):
+                # ship-raw kernels emit the payload column plus auxiliary
+                # metadata columns under their own (suffixed) names
+                columns.update(result.columns)
+            else:
+                columns[name] = result
         return columns
 
 
@@ -183,19 +373,25 @@ def _partition_kernel(name: str, field: Any) -> FieldKernel:
 
 def compile_decode_plan(schema: Any, field_names: Sequence[str],
                         partition_field_names: Any = (),
-                        decode: bool = True) -> DecodePlan:
+                        decode: bool = True,
+                        device_decode_fields: Any = ()) -> DecodePlan:
     """Compile the per-field kernel chain for one output field set.
 
     Mirrors the worker's historical per-cell branch order exactly: partition
-    keys fill constants; codec fields decode through the codec's whole-column
+    keys fill constants; fields named in ``device_decode_fields`` get ship-raw
+    kernels (payload passes through undecoded for the device decode tail —
+    docs/performance.md); codec fields decode through the codec's whole-column
     kernel (when ``decode``); codec-less tensor fields materialize + cast;
     everything else converts natively."""
     partition_names = set(partition_field_names)
+    device_names = set(device_decode_fields)
     kernels: List[Tuple[str, FieldKernel]] = []
     for name in field_names:
         field = schema.fields.get(name)
         if name in partition_names:
             kernels.append((name, _partition_kernel(name, field)))
+        elif name in device_names and field is not None:
+            kernels.append((name, _ship_raw_kernel(name, field)))
         elif field is not None and field.codec is not None and decode:
             kernels.append((name, _codec_kernel(name, field)))
         elif field is not None and field.shape != () and decode:

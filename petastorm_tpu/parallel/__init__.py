@@ -2,6 +2,7 @@
 and multi-host shard discovery (the TPU-native replacement for the reference's
 pytorch/tf adapter layer + Horovod rank sniffing; SURVEY.md §7.1 item 5)."""
 
+from petastorm_tpu.parallel.device_stage import DeviceTransform  # noqa: F401
 from petastorm_tpu.parallel.inmem_loader import InMemJaxLoader  # noqa: F401
 from petastorm_tpu.parallel.loader import JaxDataLoader, make_jax_loader  # noqa: F401
 

@@ -82,6 +82,12 @@ class InMemJaxLoader(object):
             raise ValueError('num_epochs must be >= 1 or None')
         if partition_spec is not None and mesh is None:
             raise ValueError('partition_spec requires a mesh')
+        if getattr(reader, 'device_decode_fields', None):
+            raise ValueError(
+                'InMemJaxLoader does not support device_decode_fields (the '
+                'fill materializes DECODED host columns); use JaxDataLoader '
+                'for the device-resident decode tail, or drop the knob — '
+                'docs/performance.md "Device-resident decode tail"')
         self.batch_size = batch_size
         self.num_epochs = num_epochs
         self._shuffle = shuffle

@@ -77,13 +77,23 @@ class LoaderStats(object):
     materialized into new host memory per result batch — the number the shm ring
     exists to shrink; a true running mean from the pool's ``wire_bytes_copied``
     histogram, so multi-pool and mixed-transport runs report the stream-wide
-    mean, not the last pool's last value)."""
+    mean, not the last pool's last value).
+
+    Device-resident decode tail (docs/performance.md): ``device_decode_batches``
+    counts batches whose raw-shipped fields decoded as device kernels;
+    ``device_fallback_batches`` counts chunks whose device fields decoded on
+    the host instead (CPU backend, ``device_put=False``, or a per-field
+    fallback) — a capture can PROVE which path ran. ``unpack_cache_evictions``
+    counts compiled coalesced-upload unpack programs evicted from the
+    per-loader LRU: non-zero means the consumer feeds more distinct batch
+    layouts than the cache holds, and uploads are paying re-trace cost."""
 
     _FIELDS = ('batches', 'rows', 'wait_time_s', 'total_time_s',
                'coalesced_uploads', 'per_field_uploads', 'io_retries',
                'rowgroups_quarantined', 'cache_hits', 'cache_misses',
                'shm_batches', 'shm_fallback_batches',
-               'wire_bytes_copied_per_batch')
+               'wire_bytes_copied_per_batch', 'device_decode_batches',
+               'device_fallback_batches', 'unpack_cache_evictions')
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -100,6 +110,9 @@ class LoaderStats(object):
         self.shm_batches = 0
         self.shm_fallback_batches = 0
         self.wire_bytes_copied_per_batch = 0.0
+        self.device_decode_batches = 0
+        self.device_fallback_batches = 0
+        self.unpack_cache_evictions = 0
 
     def add(self, **deltas):
         """Add keyword deltas to counter fields atomically (one lock hold)."""
@@ -178,12 +191,20 @@ class JaxDataLoader(object):
         exposes no user pinned-host-memory control, so a pinned staging buffer
         is not available to us — the packed buffer is the closest equivalent
         (one contiguous region, reused layout).
+    :param device_transforms: ``{field: DeviceTransform}`` on-device augment
+        chains (crop/flip/normalize) for raw-shipped image fields — requires a
+        reader built with ``device_decode_fields`` (docs/performance.md
+        "Device-resident decode tail").
+    :param device_buffer_depth: device batches the decode tail may dispatch
+        ahead of the train step (the prefetch-to-device ring; only meaningful
+        with ``device_decode_fields``).
     """
 
     def __init__(self, reader, batch_size, mesh=None, partition_spec=None,
                  shuffling_queue_capacity=0, min_after_retrieve=None, seed=None,
                  pad_ragged=None, prefetch=2, drop_last=True, device_put=True,
-                 coalesce_fields=None):
+                 coalesce_fields=None, device_transforms=None,
+                 device_buffer_depth=2):
         if batch_size < 1:
             raise ValueError('batch_size must be >= 1')
         self.reader = reader
@@ -226,7 +247,24 @@ class JaxDataLoader(object):
         self._scan_stream_programs = {}
         self._scan_stream_cache_warned = False
         self._coalesce_fields = coalesce_fields
-        self._unpack_programs = {}
+        self._unpack_programs = collections.OrderedDict()
+        # Device-resident decode tail (docs/performance.md): when the reader
+        # ships raw codec payloads, this stage finishes decode (and augment)
+        # as jitted device kernels after the upload; on CPU backends it
+        # decodes on the host byte-identically.
+        self._device_buffer_depth = max(1, int(device_buffer_depth))
+        device_fields = frozenset(getattr(reader, 'device_decode_fields', None)
+                                  or ())
+        if device_fields:
+            from petastorm_tpu.parallel.device_stage import DeviceDecodeStage
+            self._device_stage = DeviceDecodeStage(reader, device_transforms,
+                                                   device_buffer_depth,
+                                                   device_put)
+        else:
+            if device_transforms:
+                raise ValueError('device_transforms requires a reader built '
+                                 'with device_decode_fields')
+            self._device_stage = None
         # Closed-loop autotuning (docs/autotuning.md): when the reader carries
         # a controller (make_reader(autotune=...)), contribute the loader's
         # own knob — the shuffle-buffer fill threshold — to its catalog so the
@@ -423,7 +461,22 @@ class JaxDataLoader(object):
     def _sanitize(self, columns):
         # collate stage: host batch assembly — dtype sanitization + ragged padding
         collate_start = time.perf_counter()
-        out = sanitize_columns(columns, self._pad_ragged, self._device_put)
+        passthrough = frozenset()
+        stage = self._device_stage
+        if stage is not None:
+            # host-mode device fields decode HERE (before sanitize, so
+            # pad_ragged still applies to them); device-mode fields pass
+            # through sanitize raw and decode on chip in _emit
+            dd_start = time.perf_counter()
+            columns, decoded_any = stage.sanitize_decode(columns)
+            if decoded_any:
+                self.stats.add(device_fallback_batches=1)
+                self.observe_traced('device_decode',
+                                    time.perf_counter() - dd_start,
+                                    start_pc=dd_start)
+            passthrough = stage.passthrough_names
+        out = sanitize_columns(columns, self._pad_ragged, self._device_put,
+                               passthrough=passthrough)
         self.observe_traced('collate', time.perf_counter() - collate_start,
                             start_pc=collate_start)
         return out
@@ -432,6 +485,15 @@ class JaxDataLoader(object):
         local_rows = self._batch_cols_rows(columns)
         if self._device_put:
             import jax
+            stage = self._device_stage
+            recipe = None
+            prepare_s = 0.0
+            if stage is not None and not stage.host_mode:
+                # device decode tail, host half: pack/inflate raw payloads
+                # into upload-ready arrays + the static program recipe
+                prep_start = time.perf_counter()
+                columns, recipe = stage.prepare(columns, self._mesh)
+                prepare_s = time.perf_counter() - prep_start
             sharding = self._sharding
             if isinstance(sharding, FieldShardings) and not self._spec_keys_checked:
                 self._spec_keys_checked = True
@@ -452,6 +514,32 @@ class JaxDataLoader(object):
                     self.stats.add(per_field_uploads=1)
             self.observe_traced('h2d', time.perf_counter() - h2d_start,
                                 start_pc=h2d_start)
+            if recipe:
+                # device half: the jitted decode+augment program (async
+                # dispatch — the train step synchronizes), then the
+                # prefetch-to-device ring bound. An EMPTY recipe (every
+                # device field host_only, already decoded in _sanitize) must
+                # not count as a device decode — the stats contract is that a
+                # capture can prove which path ran.
+                finish_start = time.perf_counter()
+                with _trace_span('petastorm_tpu.loader.device_decode'):
+                    batch = stage.finish(batch, recipe)
+                self.stats.add(device_decode_batches=1)
+                self.observe_traced(
+                    'device_decode',
+                    prepare_s + time.perf_counter() - finish_start)
+                waited = stage.throttle(batch)
+                if waited:
+                    self.observe_traced('d2d_wait', waited)
+            elif (stage is not None and stage.host_mode
+                  and stage.has_transforms):
+                # host-mode backends still apply the declared augment chains
+                # (same jitted math, post-upload) — a CPU fallback run must
+                # train on the same data an accelerator run would
+                t_start = time.perf_counter()
+                batch = stage.apply_transforms(batch)
+                self.observe_traced('device_decode',
+                                    time.perf_counter() - t_start)
         else:
             batch = columns
         # Host-local row count travels alongside: with a multi-process mesh the device
@@ -477,14 +565,23 @@ class JaxDataLoader(object):
         parts = [columns[name].view(np.uint8).ravel() for name in names]
         buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
         dev_buf = jax.device_put(buf, sharding)
+        # Small LRU: layouts are stable per stream, but a long-lived loader
+        # iterating readers with varying field sets must not grow this without
+        # bound. A hit moves the program to the MRU end; evictions are counted
+        # in LoaderStats so layout churn is observable, never silent.
         programs = self._unpack_programs
         x64 = bool(jax.config.jax_enable_x64)
         key = (layout, x64)
-        if key not in programs:
+        program = programs.get(key)
+        if program is None:
             if len(programs) >= _UNPACK_CACHE_MAX:
-                programs.pop(next(iter(programs)))
-            programs[key] = jax.jit(_make_unpack(layout, x64))
-        return programs[key](dev_buf)
+                programs.popitem(last=False)
+                self.stats.add(unpack_cache_evictions=1)
+            program = jax.jit(_make_unpack(layout, x64))
+            programs[key] = program
+        else:
+            programs.move_to_end(key)
+        return program(dev_buf)
 
     def _put(self, item, out_queue, stop_event):
         while not stop_event.is_set():
@@ -554,6 +651,16 @@ class JaxDataLoader(object):
             raise ValueError('scan_stream runs to stream end and cannot consume an '
                              'infinite reader (num_epochs=None); give the reader a '
                              'finite num_epochs and call scan_stream per pass')
+        if self._device_stage is not None and (
+                not self._device_stage.host_mode
+                or self._device_stage.has_transforms):
+            raise ValueError('scan_stream does not support on-accelerator '
+                             'device_decode_fields (raw payloads cannot pack '
+                             'into chunk programs) or device_transforms (the '
+                             'chunk path has no augment stage — silently '
+                             'training un-augmented would be worse than '
+                             'refusing); use __iter__, or on a CPU backend '
+                             'drop the transforms')
         if self._in_iter:
             raise RuntimeError('scan_stream cannot run while __iter__ is active: '
                                'both would consume the same reader')
@@ -732,6 +839,46 @@ class JaxDataLoader(object):
                 epoch - self._epochs_delivered: sorted(ids)
                 for epoch, ids in self._delivered_by_epoch.items()},
         }
+
+    # -------------------------------------------------------------- runtime knobs
+
+    def set_prefetch(self, depth):
+        """Runtime-adjust the prefetch queue depth (the autotune knob surface,
+        docs/autotuning.md): applied to the LIVE queue — ``maxsize`` moves
+        under the queue's own mutex and parked producers are woken, so a raise
+        takes effect immediately and a shrink drains as the consumer pops.
+        Returns the applied value."""
+        depth = max(1, int(depth))
+        self._prefetch = depth
+        out_queue = self._queue
+        if out_queue is not None:
+            with out_queue.mutex:
+                out_queue.maxsize = depth
+                out_queue.not_full.notify_all()
+        return depth
+
+    @property
+    def prefetch(self):
+        """The current prefetch queue depth."""
+        return self._prefetch
+
+    def set_device_buffer_depth(self, depth):
+        """Runtime-adjust the device decode tail's prefetch-to-device ring
+        depth (autotune knob; no-op clamp when the loader has no device
+        stage). Returns the applied value."""
+        stage = self._device_stage
+        if stage is None:
+            return max(1, int(depth))
+        return stage.set_depth(depth)
+
+    @property
+    def device_buffer_depth(self):
+        """The device decode tail's ring depth (construction value when no
+        stage exists)."""
+        stage = self._device_stage
+        if stage is None:
+            return self._device_buffer_depth
+        return stage.depth
 
     # ------------------------------------------------------------------ telemetry
 
@@ -951,13 +1098,19 @@ def _make_unpack(layout, x64):
     return unpack
 
 
-def sanitize_columns(columns, pad_ragged, device_put):
+def sanitize_columns(columns, pad_ragged, device_put, passthrough=frozenset()):
     """Dtype sanitization for the device (the analog of the torch/tf sanitizers,
     pytorch.py:40-65 / tf_utils.py:57-96): datetimes -> int64 ns, ragged fields padded
     per ``pad_ragged`` (emitting a ``<field>_len`` mask column), strings/objects rejected
-    with the field named when a device representation is required."""
+    with the field named when a device representation is required. Columns named
+    in ``passthrough`` skip sanitization entirely — raw-shipped payloads (and
+    their auxiliary columns) keep whatever form the ship-raw kernel produced
+    until the device decode tail finishes them (docs/performance.md)."""
     out = {}
     for name, col in columns.items():
+        if name in passthrough:
+            out[name] = col
+            continue
         if name in pad_ragged:
             padded, lengths = _pad_column(col, pad_ragged[name], name)
             out[name] = padded

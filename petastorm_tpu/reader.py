@@ -122,7 +122,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 field_overrides=None, hdfs_driver='libhdfs', on_error='raise',
                 retry_policy=None, shm_transport=None, item_deadline_s=None,
                 heartbeat_interval_s=None, trace=None, service_url=None,
-                autotune=None):
+                autotune=None, device_decode_fields=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -187,7 +187,23 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     Inspect with :meth:`Reader.autotune_report` / ``diagnostics['autotune']``;
     every decision is also an ``autotune_decision`` JSONL/trace event. Off by
     default — with ``autotune`` unset no controller exists and no knob is
-    ever touched."""
+    ever touched.
+
+    Device-resident decode tail (docs/performance.md): ``device_decode_fields``
+    names codec fields whose payloads SKIP host decode — workers pass the
+    compressed/packed bytes through (DCT coefficient blocks for
+    ``DctImageCodec``, raw ``.npy`` bytes for ``NdarrayCodec``, raw deflate
+    frames for ``CompressedNdarrayCodec``) and the
+    :class:`~petastorm_tpu.parallel.loader.JaxDataLoader` decodes them as
+    jitted device kernels after ONE coalesced upload, double-buffered against
+    the train step. Raw-form values reach non-loader consumers as-is; the
+    small ``__hw``/``__enc`` auxiliary metadata columns ride
+    ``iter_columnar`` batches only (the namedtuple row/batch APIs emit schema
+    fields and drop them). On a CPU backend the loader falls back to host
+    decode byte-identically. Unset (default) keeps every
+    path byte-identical to a reader without the knob. Mutually exclusive with
+    ``transform_spec`` (host transforms need decoded values — use the loader's
+    ``device_transforms`` instead) and NGram readers."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -250,7 +266,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   resume_state=resume_state, on_error=on_error,
                   retry_policy=retry_policy,
                   initial_io_retries=construction_retries[0],
-                  autotune=autotune)
+                  autotune=autotune, device_decode_fields=device_decode_fields)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -265,12 +281,18 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       resume_state=None, hdfs_driver='libhdfs', on_error='raise',
                       retry_policy=None, shm_transport=None, item_deadline_s=None,
                       heartbeat_interval_s=None, trace=None, service_url=None,
-                      autotune=None):
+                      autotune=None, device_decode_fields=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
     ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
     ``service_url`` / ``autotune`` behave exactly as in :func:`make_reader`.
+    ``device_decode_fields`` (docs/performance.md "Device-resident decode
+    tail") requires the store's Unischema codec registry: on a Unischema
+    store the named fields ship their raw codec payloads (container stripped)
+    instead of the stored blob values; on a plain Parquet store it raises —
+    there is no codec to interpret the bytes with (use :func:`make_reader`
+    for the full decode tail).
     """
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
@@ -284,12 +306,25 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                                               storage_options=storage_options,
                                               filesystem=filesystem),
         retry_policy, construction_retries)
+    stored_schema = None
     try:
-        dataset_metadata.get_schema(handle)
+        stored_schema = dataset_metadata.get_schema(handle)
         warnings.warn('This store was written with a Unischema; use make_reader to get '
                       'codec-decoded rows. make_batch_reader will emit raw stored values.')
     except MetadataError:
         pass
+    if device_decode_fields:
+        # the batch reader has no codec registry of its own: ship-raw kernels
+        # need the store's Unischema to know each field's payload form
+        if stored_schema is None:
+            raise ValueError(
+                'device_decode_fields requires a Unischema store (the codec '
+                'registry tells the ship-raw kernels what the payload bytes '
+                'are); this store has none — use make_reader on a Unischema '
+                'store instead')
+        batch_schema = stored_schema
+    else:
+        batch_schema = None
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings, cache_format,
                         has_transform=transform_spec is not None)
@@ -314,7 +349,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     else:
         pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
                           shm_transport, item_deadline_s, heartbeat_interval_s)
-    return Reader(dataset_url_or_urls, handle=handle, schema=None,
+    return Reader(dataset_url_or_urls, handle=handle, schema=batch_schema,
                   schema_fields=schema_fields,
                   reader_pool=pool, seed=seed, shuffle_rows=shuffle_rows,
                   shuffle_row_groups=shuffle_row_groups,
@@ -326,7 +361,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   resume_state=resume_state, on_error=on_error,
                   retry_policy=retry_policy,
                   initial_io_retries=construction_retries[0],
-                  autotune=autotune)
+                  autotune=autotune, device_decode_fields=device_decode_fields)
 
 
 class Reader(object):
@@ -340,7 +375,7 @@ class Reader(object):
                  cache=None, transform_spec=None, is_batched_reader=False, decode=True,
                  storage_options=None, filesystem=None, resume_state=None,
                  on_error='raise', retry_policy=None, initial_io_retries=0,
-                 autotune=None):
+                 autotune=None, device_decode_fields=None):
         from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
         retry_policy = resolve_retry_policy(on_error, retry_policy)
         construction_retries = [initial_io_retries]
@@ -429,6 +464,38 @@ class Reader(object):
                 fields_to_read += [f for f in missing if f in schema.fields
                                    or f in partition_names]
 
+        # ------------------------------------------- device-resident decode tail
+        # (docs/performance.md): validate the ship-raw field set up front so a
+        # bad knob fails at construction with a precise message, not inside a
+        # worker process mid-epoch.
+        self.device_decode_fields = frozenset(device_decode_fields or ())
+        if self.device_decode_fields:
+            from petastorm_tpu import decode_engine
+            if ngram is not None:
+                raise ValueError('device_decode_fields is not supported with '
+                                 'NGram readers (windows need decoded values)')
+            if transform_spec is not None:
+                raise ValueError(
+                    'device_decode_fields and transform_spec are mutually '
+                    'exclusive: host transforms need decoded values — declare '
+                    'the augment chain as JaxDataLoader device_transforms '
+                    'instead (docs/performance.md)')
+            missing = sorted(f for f in self.device_decode_fields
+                             if f not in fields_to_read)
+            if missing:
+                raise ValueError('device_decode_fields name fields not in this '
+                                 'read: {}'.format(missing))
+            in_partition = sorted(self.device_decode_fields & partition_names)
+            if in_partition:
+                raise ValueError('device_decode_fields cannot name partition '
+                                 'keys: {}'.format(in_partition))
+            for name in sorted(self.device_decode_fields):
+                field = schema.fields.get(name)
+                if field is None:
+                    raise ValueError('device_decode_fields names field {!r} '
+                                     'which has no schema entry'.format(name))
+                decode_engine.validate_device_field(field)
+
         url_for_factory = dataset_url_or_urls if not isinstance(dataset_url_or_urls, list) \
             else dataset_url_or_urls[0]
         # Workers feed this filesystem into Arrow C++ — unwrap any HA failover proxy
@@ -453,7 +520,8 @@ class Reader(object):
             seed=seed,
             partition_field_names=partition_names,
             on_error=on_error,
-            retry_policy=retry_policy)
+            retry_policy=retry_policy,
+            device_decode_fields=self.device_decode_fields)
         # Single source of truth for the emitted schema: the workers' own derivation.
         self.result_schema = worker_setup.result_schema
 
@@ -1130,7 +1198,10 @@ class _BatchResultsReader(object):
                 if start:
                     batch = _slice_batch(batch, start)
             if batch.num_rows:
-                return self._schema.make_namedtuple(**batch.columns)
+                # restrict to schema fields: ship-raw batches carry auxiliary
+                # __hw/__enc columns the namedtuple has no slots for
+                return self._schema.make_namedtuple(
+                    **{name: batch.columns[name] for name in self._schema.fields})
 
     def reset(self):
         pass

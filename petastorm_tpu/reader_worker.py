@@ -105,12 +105,12 @@ class WorkerSetup(object):
     __slots__ = ('dataset_path_or_paths', 'filesystem_factory', 'schema', 'fields_to_read',
                  'result_schema', 'transform_spec', 'batched_output', 'decode', 'ngram',
                  'cache', 'shuffle_rows', 'seed', 'partition_field_names', 'dataset_token',
-                 'on_error', 'retry_policy')
+                 'on_error', 'retry_policy', 'device_decode_fields')
 
     def __init__(self, dataset_path_or_paths, filesystem_factory, schema, fields_to_read,
                  transform_spec=None, batched_output=False, decode=True, ngram=None,
                  cache=None, shuffle_rows=False, seed=None, partition_field_names=(),
-                 on_error='raise', retry_policy=None):
+                 on_error='raise', retry_policy=None, device_decode_fields=()):
         from petastorm_tpu.resilience import resolve_retry_policy
         self.on_error = on_error
         # One normalization for the whole stack: 'raise' means today's exact behavior
@@ -129,6 +129,9 @@ class WorkerSetup(object):
         self.shuffle_rows = shuffle_rows
         self.seed = seed
         self.partition_field_names = set(partition_field_names)
+        #: fields whose payloads skip host decode and ship raw to the device
+        #: loader (docs/performance.md "Device-resident decode tail")
+        self.device_decode_fields = frozenset(device_decode_fields)
         # Cache key token covers the dataset identity AND the read configuration: two
         # readers with different column sets / decode modes / per-field codec
         # interpretations (field_overrides) sharing one cache_location must never serve
@@ -138,10 +141,18 @@ class WorkerSetup(object):
             (name, str(field.numpy_dtype), str(field.shape),
              str(field.codec.to_config()) if field.codec is not None else 'none')
             for name, field in schema.fields.items() if name in self.fields_to_read)
-        token_src = '{}|{}|{}|{}|{}'.format(dataset_path_or_paths,
-                                            sorted(self.fields_to_read), decode,
-                                            transform_spec is not None,
-                                            field_specs).encode('utf-8')
+        token_parts = '{}|{}|{}|{}|{}'.format(dataset_path_or_paths,
+                                              sorted(self.fields_to_read), decode,
+                                              transform_spec is not None,
+                                              field_specs)
+        if self.device_decode_fields:
+            # part of the cache identity: the cached value is the POST-plan
+            # output, and a raw-shipped column must never be served to a reader
+            # expecting decoded values (or vice versa). Appended only when the
+            # knob is on, so every existing cache keyed by the historical
+            # 5-field token stays warm for readers that never use it.
+            token_parts += '|{}'.format(sorted(self.device_decode_fields))
+        token_src = token_parts.encode('utf-8')
         self.dataset_token = hashlib.md5(token_src).hexdigest()[:16]
         read_view = schema.create_schema_view(
             [re.escape(name) for name in self.fields_to_read]) \
@@ -410,9 +421,12 @@ class RowGroupWorker(WorkerBase):
             with stage_span('decode'):
                 mask = compiled.evaluate(predicate_table)
         else:
+            # predicate evaluation always needs DECODED values, even for
+            # fields that ship raw to the device in the output assembly
             predicate_columns = self._decode_table(predicate_table, partition_keys,
                                                    predicate_fields,
-                                                   fragment_path=fragment_path)
+                                                   fragment_path=fragment_path,
+                                                   ship_raw=False)
             mask = self._evaluate_predicate(worker_predicate, predicate_columns,
                                             predicate_table.num_rows)
         keep = np.nonzero(mask)[0]
@@ -458,28 +472,34 @@ class RowGroupWorker(WorkerBase):
 
     # ---------------------------------------------------------------- decode
 
-    def _decode_table(self, table, partition_keys, field_names, fragment_path=None):
+    def _decode_table(self, table, partition_keys, field_names, fragment_path=None,
+                      ship_raw=True):
         """Arrow table -> {name: ndarray-or-list} of decoded values, through the
         per-schema compiled :class:`~petastorm_tpu.decode_engine.DecodePlan`
         (one whole-column kernel per field, no per-cell dispatch). Codec
         failures are wrapped in :class:`DecodeFieldError` carrying the field
         name and fragment path as structured attributes — a corrupt value names
-        its store location, not just a message."""
-        plan = self._decode_plan(tuple(field_names))
+        its store location, not just a message. ``ship_raw=False`` compiles the
+        plan without the setup's ``device_decode_fields`` (predicate columns
+        must decode fully even when the output ships raw)."""
+        plan = self._decode_plan(tuple(field_names), ship_raw=ship_raw)
         with stage_span('decode'):
             return plan.execute(table, partition_keys or {},
                                 fragment_path=fragment_path)
 
-    def _decode_plan(self, field_names):
+    def _decode_plan(self, field_names, ship_raw=True):
         """Memoized decode-plan compilation for one output field tuple."""
-        plan = self._decode_plans.get(field_names)
+        setup = self._setup
+        device_fields = setup.device_decode_fields if ship_raw else frozenset()
+        key = (field_names, bool(device_fields))
+        plan = self._decode_plans.get(key)
         if plan is None:
-            setup = self._setup
             plan = decode_engine.compile_decode_plan(
                 setup.schema, list(field_names),
                 partition_field_names=setup.partition_field_names,
-                decode=setup.decode)
-            self._decode_plans[field_names] = plan
+                decode=setup.decode,
+                device_decode_fields=device_fields)
+            self._decode_plans[key] = plan
         return plan
 
     # --------------------------------------------------------------- shuffle

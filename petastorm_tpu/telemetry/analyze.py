@@ -94,6 +94,17 @@ _KNOBS: Dict[str, Any] = {
     'cache_miss': ('first-epoch fills — see rowgroup_read/decode',
                    'cache_miss envelopes the fill work; the leaf ranking names '
                    'the actual cost.'),
+    'device_decode': ('decode-tail host half dominates — check inflate share',
+                      'The device decode tail spends host time packing or '
+                      'inflating raw payloads before upload: stored-block '
+                      'frames inflate on chip for free — re-encode stores at '
+                      'zlib level 0, or move huffman-heavy fields back to '
+                      'host decode (docs/performance.md).'),
+    'd2d_wait': ('raise device_buffer_depth (decode-bound device tail)',
+                 'The producer blocks on the prefetch-to-device ring: device '
+                 'decode programs finish slower than batches arrive — raise '
+                 'JaxDataLoader device_buffer_depth so more decode work '
+                 'overlaps the train step, or shrink the augment chain.'),
     # ------------------------------------------------- input service (PR 8)
     # Service-backed readers surface their pressure as COUNTERS/GAUGES, not
     # stage histograms — these entries feed the counter advisories below

@@ -4,7 +4,8 @@ A *stage* is one step a row batch passes through on its way to the device —
 ``fs_open``, ``rowgroup_read``, ``decode``, ``transform``, ``shuffle``,
 ``cache_hit`` / ``cache_miss`` / ``cache_store``, ``serialize``,
 ``shm_slot_wait`` / ``shm_map`` / ``shm_release``, ``shuffle_wait``, ``collate``,
-``h2d`` (the catalog with semantics: docs/observability.md). Worker-side stages
+``h2d``, ``device_decode`` / ``d2d_wait`` (the catalog with semantics:
+docs/observability.md). Worker-side stages
 execute in whatever process the pool runs them in, so their timings cannot be
 written into the consumer's registry directly; instead each worker thread
 accumulates them in a process-local :class:`StageRecorder` and the rowgroup
@@ -53,6 +54,11 @@ STAGES = (
     'shuffle_wait',   # consumer blocked on the loader's prefetch queue (loader)
     'collate',        # host batch assembly / sanitize (loader)
     'h2d',            # host->device upload (loader)
+    'device_decode',  # decode-tail work on raw-shipped fields: pack/inflate +
+                      # jitted device decode dispatch, or the host fallback
+                      # decode (loader; docs/performance.md)
+    'd2d_wait',       # blocked on the prefetch-to-device ring: the oldest
+                      # dispatched device batch had not finished (loader)
 )
 
 #: stages whose span ENVELOPES other recorded stages (cache_miss wraps
