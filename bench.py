@@ -156,7 +156,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
                  'resilience', 'pipecheck', 'tracing', 'service', 'autotune',
-                 'device_decode')
+                 'device_decode', 'observability')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -165,11 +165,11 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'autotune', 'device_decode',
-                     'decode_bench', 'service', 'wire_bench', 'telemetry',
-                     'tracing', 'resilience', 'mnist_scan_stream', 'flash',
-                     'moe', 'imagenet_scan', 'imagenet_stream', 'decode_delta',
-                     'bare_reader', 'mnist_stream')
+SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'autotune',
+                     'device_decode', 'decode_bench', 'service', 'wire_bench',
+                     'telemetry', 'tracing', 'resilience', 'mnist_scan_stream',
+                     'flash', 'moe', 'imagenet_scan', 'imagenet_stream',
+                     'decode_delta', 'bare_reader', 'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
 
@@ -1503,6 +1503,100 @@ def child_main():
             'tracing_process_tracks': len(summary['processes']),
         })
 
+    def run_observability():
+        """Goodput observatory (host-only, fast; docs/observability.md):
+        (1) scrape-while-reading overhead — the same process-pool epoch with
+        a live /metrics endpoint being scraped hard vs no endpoint; the
+        overhead percentage is the BENCH-history guard for the ISSUE-11
+        acceptance (<= 3%); (2) the input-efficiency SLO fields of the
+        scraped epoch; (3) the cost-ledger persist -> reload probe (identical
+        what-if ranking across the roundtrip)."""
+        import urllib.request
+
+        def epoch(metrics_port):
+            reader = make_reader(url, reader_pool_type='process',
+                                 workers_count=min(WORKERS, 2), num_epochs=1,
+                                 shuffle_row_groups=False,
+                                 metrics_port=metrics_port)
+            stop = threading.Event()
+            scrapes = [0]
+            scraper = None
+            if metrics_port is not None:
+                def scrape_loop():
+                    while not stop.is_set():
+                        try:
+                            urllib.request.urlopen(
+                                reader.metrics_url + '/metrics',
+                                timeout=5).read()
+                            scrapes[0] += 1
+                        except Exception:  # noqa: BLE001 - endpoint may be tearing down
+                            pass
+                        time.sleep(0.02)
+                scraper = threading.Thread(target=scrape_loop, daemon=True)
+                scraper.start()
+            rows = 0
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            slo = reader.efficiency_report()
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=5)
+            reader.stop()
+            reader.join()
+            return rows / elapsed, slo, scrapes[0]
+
+        baseline_rate, _, _ = epoch(None)
+        scraped_rate, slo, scrapes = epoch(0)
+        overhead_pct = (baseline_rate - scraped_rate) / baseline_rate * 100.0
+
+        # cost-ledger probe: traced epoch -> ledger -> persist -> reload ->
+        # identical what-if ranking
+        from petastorm_tpu.telemetry import tracing as flight
+        from petastorm_tpu.telemetry.cost_model import CostLedger
+        flight.reset_tracing()
+        flight.set_trace_enabled(True)
+        try:
+            reader = make_reader(url, num_epochs=1, shuffle_row_groups=False)
+            for batch in reader.iter_columnar():
+                pass
+            ledger = reader.cost_ledger()
+            reader.stop()
+            reader.join()
+        finally:
+            flight.set_trace_enabled(False)
+            flight.reset_tracing()
+        ledger_path = os.path.join(tempfile.mkdtemp(prefix='bench_costs_'),
+                                   'ledger.json')
+        ledger.save(ledger_path)
+        reloaded = CostLedger.load(ledger_path)
+        roundtrip_ok = (reloaded.what_if() == ledger.what_if()
+                        and reloaded.ranking(10) == ledger.ranking(10))
+        what_if = ledger.what_if()
+        skew = next((row['skew_p95_over_median'] for row in what_if
+                     if row['scope'] == 'total'), 0.0)
+
+        log('observability: scraped {:.1f} rows/s vs bare {:.1f} rows/s '
+            '({:+.2f}% scrape overhead over {} scrape(s)); efficiency '
+            '{:.1%} (target {:.0%}); cost ledger {} rowgroup(s), persist '
+            'roundtrip {}'.format(
+                scraped_rate, baseline_rate, overhead_pct, scrapes,
+                slo['efficiency'], slo['target_efficiency'], len(ledger),
+                'ok' if roundtrip_ok else 'MISMATCH'))
+        results.update({
+            'observability_scraped_rows_per_sec': round(scraped_rate, 1),
+            'observability_baseline_rows_per_sec': round(baseline_rate, 1),
+            'observability_scrape_overhead_pct': round(overhead_pct, 2),
+            'observability_scrapes': scrapes,
+            'observability_slo_efficiency': slo['efficiency'],
+            'observability_slo_target': slo['target_efficiency'],
+            'observability_slo_met': bool(slo['met']),
+            'observability_cost_rowgroups': len(ledger),
+            'observability_cost_skew_p95_over_median': skew,
+            'observability_cost_persist_roundtrip_ok': bool(roundtrip_ok),
+        })
+
     def run_resilience():
         """Watchdog + CRC clean-path overhead (host-only, fast): the same
         process-pool epoch with every robustness guard off (no heartbeats, no
@@ -1953,6 +2047,7 @@ def child_main():
         'service': run_service,
         'autotune': run_autotune,
         'device_decode': run_device_decode,
+        'observability': run_observability,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

@@ -60,6 +60,11 @@ STAGE_CATALOG_SUFFIX: str = 'telemetry/spans.py'
 #: where the declared quarantine-reason registry lives (path suffix)
 QUARANTINE_REGISTRY_SUFFIX: str = 'resilience.py'
 
+#: where the cost profiler's declared stage tuple lives (path suffix); its
+#: ``COST_STAGES`` entries must be a subset of the spans catalog's ``STAGES``
+#: (telemetry-names rule, docs/observability.md "Cost profiler")
+COST_MODEL_SUFFIX: str = 'telemetry/cost_model.py'
+
 #: where the autotuner's knob-id catalog lives (path suffix); ``Knob(...)``
 #: constructions and ``catalog.knob(...)`` references are checked against its
 #: ``KNOB_IDS`` tuple (telemetry-names rule, docs/autotuning.md)
@@ -85,6 +90,7 @@ class AnalysisConfig:
     stage_catalog_suffix: str = STAGE_CATALOG_SUFFIX
     quarantine_registry_suffix: str = QUARANTINE_REGISTRY_SUFFIX
     knob_catalog_suffix: str = KNOB_CATALOG_SUFFIX
+    cost_model_suffix: str = COST_MODEL_SUFFIX
     strict_flags: Tuple[str, ...] = STRICT_FLAGS
     #: explicit mypy.ini path; None = walk up from the analyzed roots
     mypy_ini_path: Optional[str] = None

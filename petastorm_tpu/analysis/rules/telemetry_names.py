@@ -10,6 +10,11 @@ entry, and no doc row knows about. This rule closes that hole statically:
 - ``<registry>.observe('x', ...)`` → ``x`` in ``STAGES`` or
   ``SIZE_HISTOGRAMS``;
 - ``<registry>.inc('x')`` → ``x`` in ``COUNTERS``;
+- ``<registry>.gauge('x')`` → ``x`` in ``GAUGES`` (the SLO / service gauge
+  surface — docs/observability.md "Efficiency SLOs");
+- ``COST_STAGES`` declared in ``telemetry/cost_model.py`` → every entry in
+  ``STAGES`` (a drifted entry would make the cost profiler silently ingest
+  nothing for it);
 - ``trace_instant('x', ...)`` → ``x`` in ``TRACE_INSTANTS`` (the
   flight-recorder anomaly catalog — docs/observability.md "Flight recorder");
 - ``trace_complete('x', ...)`` → ``x`` in ``STAGES`` (a traced span IS a
@@ -51,11 +56,13 @@ class _Catalog:
 
     def __init__(self, stages: Tuple[str, ...], counters: Tuple[str, ...],
                  size_histograms: Tuple[str, ...],
-                 trace_instants: Tuple[str, ...], origin: str) -> None:
+                 trace_instants: Tuple[str, ...], origin: str,
+                 gauges: Tuple[str, ...] = ()) -> None:
         self.stages = frozenset(stages)
         self.counters = frozenset(counters)
         self.size_histograms = frozenset(size_histograms)
         self.trace_instants = frozenset(trace_instants)
+        self.gauges = frozenset(gauges)
         self.origin = origin
 
 
@@ -74,8 +81,9 @@ def _catalog_from_tree(tree: ast.Module, origin: str) -> Optional[_Catalog]:
     counters = extract_string_tuple(tree, 'COUNTERS') or []
     size_histograms = extract_string_tuple(tree, 'SIZE_HISTOGRAMS') or []
     trace_instants = extract_string_tuple(tree, 'TRACE_INSTANTS') or []
+    gauges = extract_string_tuple(tree, 'GAUGES') or []
     return _Catalog(tuple(stages), tuple(counters), tuple(size_histograms),
-                    tuple(trace_instants), origin)
+                    tuple(trace_instants), origin, gauges=tuple(gauges))
 
 
 _CatalogT = TypeVar('_CatalogT')
@@ -140,11 +148,13 @@ class TelemetryNamesRule(Rule):
     """Flag telemetry names missing from the spans.py catalog (module doc)."""
 
     name = 'telemetry-names'
-    description = ('stage_span/record_stage/observe/inc/trace_complete/'
+    description = ('stage_span/record_stage/observe/inc/gauge/trace_complete/'
                    'trace_instant names must exist in the telemetry catalog '
-                   '(STAGES / COUNTERS / SIZE_HISTOGRAMS / TRACE_INSTANTS in '
-                   'telemetry/spans.py); Knob()/catalog.knob() ids must exist '
-                   'in KNOB_IDS (autotune/knobs.py)')
+                   '(STAGES / COUNTERS / SIZE_HISTOGRAMS / GAUGES / '
+                   'TRACE_INSTANTS in telemetry/spans.py); '
+                   'Knob()/catalog.knob() ids must exist in KNOB_IDS '
+                   '(autotune/knobs.py); the cost profiler\'s COST_STAGES '
+                   '(telemetry/cost_model.py) must be a subset of STAGES')
 
     def check_module(self, module: SourceModule,
                      ctx: AnalysisContext) -> Iterable[Finding]:
@@ -157,6 +167,18 @@ class TelemetryNamesRule(Rule):
         is_knob_catalog_module = module.posix().endswith(
             ctx.config.knob_catalog_suffix)
         findings: List[Finding] = []
+        if module.posix().endswith(ctx.config.cost_model_suffix):
+            # the cost profiler's declared stage tuple must name real stages
+            # — a drifted entry would silently profile nothing
+            declared = extract_string_tuple(module.tree, 'COST_STAGES')
+            for value in declared or ():
+                if value not in catalog.stages:
+                    findings.append(Finding(
+                        self.name, module.display, 1,
+                        'cost-model stage {!r} (COST_STAGES) is not declared '
+                        'in STAGES (catalog: {}) — the profiler would '
+                        'silently ingest no spans for it'.format(
+                            value, catalog.origin)))
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
@@ -187,6 +209,12 @@ class TelemetryNamesRule(Rule):
                 names = literal_str_values(node.args[0])
                 allowed = catalog.counters
                 family = 'COUNTERS'
+            elif attr_name == 'gauge':
+                # <registry>.gauge('x') — the SLO/service gauge surface
+                # (docs/observability.md "Efficiency SLOs")
+                names = literal_str_values(node.args[0])
+                allowed = catalog.gauges
+                family = 'GAUGES'
             elif ((func_name == _KNOB_CTOR or attr_name == _KNOB_CTOR
                    or attr_name == _KNOB_ACCESSOR)
                   and knob_catalog is not None and not is_knob_catalog_module):

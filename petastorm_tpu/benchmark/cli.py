@@ -9,7 +9,11 @@ microbench: per-codec engine-vs-fallback kernel rates + predicate pushdown —
 docs/performance.md "Vectorized decode engine"); ``analyze`` dispatches to
 :mod:`petastorm_tpu.telemetry.analyze` (stage
 time-share ranking + bottleneck-to-knob mapping over a telemetry snapshot /
-JSONL event log — docs/observability.md); ``trace`` dispatches to
+JSONL event log — docs/observability.md); ``costs`` dispatches to
+:mod:`petastorm_tpu.telemetry.cost_model` (per-rowgroup/per-field cost
+profiler: one trace-armed epoch folded into the persistent ledger,
+expensive-rowgroup ranking + what-if rows — docs/observability.md "Cost
+profiler"); ``trace`` dispatches to
 :mod:`petastorm_tpu.telemetry.trace_export` (flight-recorder capture of a real
 read, exported as Chrome-trace/Perfetto JSON — docs/observability.md "Flight
 recorder"); ``pipecheck`` dispatches to
@@ -43,6 +47,9 @@ def main(argv=None):
     if argv and argv[0] == 'analyze':
         from petastorm_tpu.telemetry.analyze import main as analyze_main
         return analyze_main(argv[1:])
+    if argv and argv[0] == 'costs':
+        from petastorm_tpu.telemetry.cost_model import main as costs_main
+        return costs_main(argv[1:])
     if argv and argv[0] == 'trace':
         from petastorm_tpu.telemetry.trace_export import main as trace_main
         return trace_main(argv[1:])

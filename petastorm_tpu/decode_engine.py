@@ -28,6 +28,7 @@ process state beyond an optional decode thread pool owned by the codec layer.
 from __future__ import annotations
 
 import logging
+import time
 from typing import (Any, Callable, Dict, List, Mapping, Optional, Sequence,
                     Set, Tuple)
 
@@ -310,12 +311,22 @@ class DecodePlan:
     def execute(self, table: Any, partition_keys: Optional[Mapping[str, Any]] = None,
                 fragment_path: Optional[str] = None) -> Columns:
         """Run every kernel over ``table`` -> ``{name: ndarray-or-list}``."""
+        from petastorm_tpu.telemetry import tracing as _tracing
         partition_keys = partition_keys or {}
         num_rows = table.num_rows
         columns: Columns = {}
+        # per-field cost spans (telemetry/cost_model.py): only while the
+        # flight recorder is armed — two clock reads per field per rowgroup,
+        # zero cost otherwise
+        traced = _tracing.trace_enabled()
         for name, kernel in self._kernels:
             try:
+                start = time.perf_counter() if traced else 0.0
                 result = kernel(table, partition_keys, num_rows)
+                if traced:
+                    _tracing.trace_complete(
+                        'decode_field', start,
+                        time.perf_counter() - start, args={'field': name})
             except Exception as exc:
                 raise DecodeFieldError(
                     'Failed to decode field {!r} of fragment {!r}: {}'

@@ -198,13 +198,22 @@ class JaxDataLoader(object):
     :param device_buffer_depth: device batches the decode tail may dispatch
         ahead of the train step (the prefetch-to-device ring; only meaningful
         with ``device_decode_fields``).
+    :param metrics_port: attach a live scrape endpoint over
+        :meth:`telemetry_snapshot` (``/metrics`` Prometheus text with the SLO
+        gauges refreshed per scrape, ``/healthz``, ``/vars``); ``0`` binds an
+        ephemeral port (``metrics_url`` names it), None (default) serves
+        nothing — docs/observability.md "Live metrics plane".
+    :param slo_policy: the input-efficiency SLO evaluated by
+        :meth:`efficiency_report` (an
+        :class:`~petastorm_tpu.telemetry.slo.SloPolicy`, a float target, or
+        None = the default 0.9 target).
     """
 
     def __init__(self, reader, batch_size, mesh=None, partition_spec=None,
                  shuffling_queue_capacity=0, min_after_retrieve=None, seed=None,
                  pad_ragged=None, prefetch=2, drop_last=True, device_put=True,
                  coalesce_fields=None, device_transforms=None,
-                 device_buffer_depth=2):
+                 device_buffer_depth=2, metrics_port=None, slo_policy=None):
         if batch_size < 1:
             raise ValueError('batch_size must be >= 1')
         self.reader = reader
@@ -218,6 +227,16 @@ class JaxDataLoader(object):
         from petastorm_tpu.telemetry.export import logger_from_env
         self.telemetry = MetricsRegistry()
         self._telemetry_jsonl = logger_from_env()
+        # Input-efficiency SLO over the whole pipeline (docs/observability.md
+        # "Efficiency SLOs"): shuffle_wait is the loader's primary starvation
+        # stage; breach events are edge-triggered inside the tracker and ride
+        # the loader's JSONL log when one is armed.
+        from petastorm_tpu.telemetry.slo import (SloTracker,
+                                                 resolve_slo_policy, slo_clock)
+        self._started_at = slo_clock()
+        self._slo = SloTracker(resolve_slo_policy(slo_policy),
+                               jsonl=self._telemetry_jsonl)
+        self._metrics_server = None
         self._mesh = mesh
         self._partition_spec = partition_spec
         self._pad_ragged = dict(pad_ragged or {})
@@ -276,6 +295,18 @@ class JaxDataLoader(object):
             from petastorm_tpu.autotune.knobs import build_loader_knobs
             for knob in build_loader_knobs(self):
                 controller.catalog.add(knob)
+        # Live metrics plane (docs/observability.md): one scrape endpoint
+        # over the whole-pipeline snapshot; closed by stop(). Started LAST —
+        # a constructor raise after binding would leak the port and serve a
+        # half-built loader (same ordering contract as Reader.__init__).
+        if metrics_port is not None:
+            from petastorm_tpu.telemetry.http_exporter import MetricsHttpServer
+            self._metrics_server = MetricsHttpServer(
+                snapshot_fn=self._scrape_snapshot,
+                health_fn=lambda: {'batches': self.stats.batches,
+                                   'rows': self.stats.rows},
+                port=int(metrics_port))
+            self._metrics_server.start()
 
     # ------------------------------------------------------------------ sharding
 
@@ -338,7 +369,12 @@ class JaxDataLoader(object):
                 # (clocked on monotonic, so the timeline leg back-dates)
                 self.observe_traced('shuffle_wait', now - wait_start)
                 if self._telemetry_jsonl is not None and self._telemetry_jsonl.due():
-                    self._telemetry_jsonl.emit(self.telemetry_snapshot(),
+                    # one snapshot serves both legs: the periodic interval
+                    # line AND the SLO evaluation (whose ok->breach
+                    # transition appends its own slo_breach line)
+                    snapshot = self.telemetry_snapshot()
+                    self._evaluate_slo(snapshot)
+                    self._telemetry_jsonl.emit(snapshot,
                                                event='loader_interval')
                 last_emit = now
                 self._mark_delivered(local_rows)
@@ -907,9 +943,45 @@ class JaxDataLoader(object):
             return self.telemetry.snapshot()
         return merge_snapshots(self.telemetry.snapshot(), reader_snapshot_fn())
 
+    def _evaluate_slo(self, snapshot):
+        from petastorm_tpu.telemetry.slo import slo_clock
+        return self._slo.evaluate(snapshot, slo_clock() - self._started_at,
+                                  rows=self.stats.rows,
+                                  registry=self.telemetry)
+
+    def efficiency_report(self):
+        """One input-efficiency SLO evaluation over this loader's lifetime
+        (docs/observability.md "Efficiency SLOs"): efficiency in [0, 1]
+        derived from ``shuffle_wait`` (+ ``d2d_wait``) — the seconds the
+        training loop actually sat starved — with goodput-vs-ideal rows/s
+        and edge-triggered breach accounting. Evaluated automatically at
+        every JSONL interval when ``PETASTORM_TPU_TELEMETRY_JSONL`` is armed,
+        and on every ``/metrics`` scrape when ``metrics_port`` is set."""
+        return self._evaluate_slo(self.telemetry_snapshot())
+
+    def _scrape_snapshot(self):
+        """Per-scrape snapshot: built ONCE, SLO-evaluated, fresh ``slo_*``
+        gauges spliced in (same one-snapshot contract as the reader's)."""
+        snapshot = self.telemetry_snapshot()
+        report = self._evaluate_slo(snapshot)
+        gauges = snapshot.setdefault('gauges', {})
+        gauges['slo_efficiency'] = report['efficiency']
+        gauges['slo_target_efficiency'] = report['target_efficiency']
+        return snapshot
+
+    @property
+    def metrics_url(self):
+        """The live scrape endpoint base URL, or None without
+        ``metrics_port`` (docs/observability.md)."""
+        if self._metrics_server is None:
+            return None
+        return self._metrics_server.url
+
     # ------------------------------------------------------------------ lifecycle
 
     def stop(self):
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
         self._stop_event.set()
         self.reader.stop()
 

@@ -122,7 +122,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 field_overrides=None, hdfs_driver='libhdfs', on_error='raise',
                 retry_policy=None, shm_transport=None, item_deadline_s=None,
                 heartbeat_interval_s=None, trace=None, service_url=None,
-                autotune=None, device_decode_fields=None):
+                autotune=None, device_decode_fields=None, metrics_port=None,
+                slo_policy=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -203,7 +204,17 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     decode byte-identically. Unset (default) keeps every
     path byte-identical to a reader without the knob. Mutually exclusive with
     ``transform_spec`` (host transforms need decoded values — use the loader's
-    ``device_transforms`` instead) and NGram readers."""
+    ``device_transforms`` instead) and NGram readers.
+
+    Live metrics plane (docs/observability.md "Live metrics plane"):
+    ``metrics_port`` attaches a scrape endpoint to this reader — ``/metrics``
+    (Prometheus text over :meth:`Reader.telemetry_snapshot`, SLO gauges
+    refreshed per scrape), ``/healthz``, ``/vars``; ``0`` binds an ephemeral
+    port (``Reader.metrics_url`` names it), None (default) serves nothing.
+    ``slo_policy`` sets the input-efficiency SLO
+    (:class:`~petastorm_tpu.telemetry.slo.SloPolicy`, a float target, or
+    None = the default 0.9 target) evaluated by
+    :meth:`Reader.efficiency_report` / ``diagnostics['slo']``."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -266,7 +277,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   resume_state=resume_state, on_error=on_error,
                   retry_policy=retry_policy,
                   initial_io_retries=construction_retries[0],
-                  autotune=autotune, device_decode_fields=device_decode_fields)
+                  autotune=autotune, device_decode_fields=device_decode_fields,
+                  metrics_port=metrics_port, slo_policy=slo_policy)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -281,12 +293,14 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       resume_state=None, hdfs_driver='libhdfs', on_error='raise',
                       retry_policy=None, shm_transport=None, item_deadline_s=None,
                       heartbeat_interval_s=None, trace=None, service_url=None,
-                      autotune=None, device_decode_fields=None):
+                      autotune=None, device_decode_fields=None,
+                      metrics_port=None, slo_policy=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
     ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
-    ``service_url`` / ``autotune`` behave exactly as in :func:`make_reader`.
+    ``service_url`` / ``autotune`` / ``metrics_port`` / ``slo_policy``
+    behave exactly as in :func:`make_reader`.
     ``device_decode_fields`` (docs/performance.md "Device-resident decode
     tail") requires the store's Unischema codec registry: on a Unischema
     store the named fields ship their raw codec payloads (container stripped)
@@ -361,7 +375,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   resume_state=resume_state, on_error=on_error,
                   retry_policy=retry_policy,
                   initial_io_retries=construction_retries[0],
-                  autotune=autotune, device_decode_fields=device_decode_fields)
+                  autotune=autotune, device_decode_fields=device_decode_fields,
+                  metrics_port=metrics_port, slo_policy=slo_policy)
 
 
 class Reader(object):
@@ -375,7 +390,8 @@ class Reader(object):
                  cache=None, transform_spec=None, is_batched_reader=False, decode=True,
                  storage_options=None, filesystem=None, resume_state=None,
                  on_error='raise', retry_policy=None, initial_io_retries=0,
-                 autotune=None, device_decode_fields=None):
+                 autotune=None, device_decode_fields=None, metrics_port=None,
+                 slo_policy=None):
         from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
         retry_policy = resolve_retry_policy(on_error, retry_policy)
         construction_retries = [initial_io_retries]
@@ -409,6 +425,17 @@ class Reader(object):
         # process that touched this reader's rows.
         from petastorm_tpu.telemetry import MetricsRegistry
         self._telemetry = MetricsRegistry()
+        # Input-efficiency SLO (docs/observability.md "Efficiency SLOs"):
+        # windows are measured from construction on the span clock; breach
+        # events are edge-triggered inside the tracker, so polling
+        # diagnostics cannot inflate the count.
+        from petastorm_tpu.telemetry.export import logger_from_env
+        from petastorm_tpu.telemetry.slo import (SloTracker,
+                                                 resolve_slo_policy, slo_clock)
+        self._started_at = slo_clock()
+        self._slo = SloTracker(resolve_slo_policy(slo_policy),
+                               jsonl=logger_from_env())
+        self._metrics_server = None
 
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
@@ -524,6 +551,9 @@ class Reader(object):
             device_decode_fields=self.device_decode_fields)
         # Single source of truth for the emitted schema: the workers' own derivation.
         self.result_schema = worker_setup.result_schema
+        #: the dataset identity the disk cache and the cost ledger key on
+        #: (docs/observability.md "Cost profiler")
+        self.dataset_token = worker_setup.dataset_token
 
         # ------------------------------------------------ rowgroup schedule
         # Under 'skip', permanently unreadable footers (truncated part-files) are
@@ -665,6 +695,18 @@ class Reader(object):
             from petastorm_tpu.autotune.controller import setup_reader_autotune
             self._autotune = setup_reader_autotune(self, autotune_policy)
             self._autotune.start()
+
+        # Live metrics plane (docs/observability.md): one scrape endpoint
+        # over this reader's cross-process snapshot; SLO gauges refresh per
+        # scrape. Started last so a scrape can never observe a half-built
+        # reader; stop() tears it down.
+        if metrics_port is not None:
+            from petastorm_tpu.telemetry.http_exporter import MetricsHttpServer
+            self._metrics_server = MetricsHttpServer(
+                snapshot_fn=self._scrape_snapshot,
+                health_fn=self._scrape_health,
+                port=int(metrics_port))
+            self._metrics_server.start()
 
     # --------------------------------------------------------------- sharding
 
@@ -933,6 +975,78 @@ class Reader(object):
         return merge_snapshots(self._telemetry.snapshot(),
                                pool_registry.snapshot())
 
+    # ------------------------------------------------------- efficiency SLO
+
+    def _evaluate_slo(self, snapshot):
+        from petastorm_tpu.telemetry.slo import slo_clock
+        return self._slo.evaluate(snapshot, slo_clock() - self._started_at,
+                                  rows=self.rows_consumed,
+                                  registry=self._telemetry)
+
+    def efficiency_report(self):
+        """One input-efficiency SLO evaluation over this reader's lifetime
+        (docs/observability.md "Efficiency SLOs"): efficiency in [0, 1]
+        derived from the recorded consumer wait spans (``pool_wait``, plus
+        ``shuffle_wait``/``d2d_wait`` when a loader consumes this reader),
+        the starvation fraction, goodput vs ideal rows/s, and the breach
+        accounting (edge-triggered ``slo_breach`` counter / JSONL event /
+        trace instant on each ok→breach transition). Also under
+        ``diagnostics['slo']``; the ``slo_efficiency`` gauge refreshes in
+        the telemetry registry on every call."""
+        return self._evaluate_slo(self.telemetry_snapshot())
+
+    # --------------------------------------------------------- cost profiler
+
+    def cost_ledger(self, ledger=None):
+        """Fold the flight recorder's per-rowgroup span history for this
+        reader into a :class:`~petastorm_tpu.telemetry.cost_model.CostLedger`
+        (docs/observability.md "Cost profiler"). Requires tracing to have
+        been armed for the read (``trace=True`` / ``PETASTORM_TPU_TRACE=1``)
+        — an unarmed read yields an empty ledger. ``ledger`` continues an
+        existing ledger (same dataset token); the default starts a fresh one
+        keyed by :attr:`dataset_token`. The one-command form is
+        ``petastorm-tpu-throughput costs <dataset_url>``."""
+        from petastorm_tpu.telemetry.cost_model import CostLedger
+        from petastorm_tpu.telemetry.tracing import trace_snapshot
+        if ledger is None:
+            ledger = CostLedger(self.dataset_token)
+        piece_map = {index: (rg.fragment_path, rg.row_group_id)
+                     for index, rg in enumerate(self._shard_row_groups)}
+        ledger.ingest_trace(trace_snapshot(), piece_map)
+        return ledger
+
+    # ------------------------------------------------------- metrics plane
+
+    def _snapshot_with_slo(self):
+        """One telemetry snapshot (built ONCE — the cross-process merge is
+        the expensive half) evaluated against the SLO, with the fresh
+        ``slo_*`` gauges spliced in; returns ``(snapshot, slo_report)``."""
+        snapshot = self.telemetry_snapshot()
+        report = self._evaluate_slo(snapshot)
+        gauges = snapshot.setdefault('gauges', {})
+        gauges['slo_efficiency'] = report['efficiency']
+        gauges['slo_target_efficiency'] = report['target_efficiency']
+        return snapshot, report
+
+    def _scrape_snapshot(self):
+        """The /metrics endpoint's per-scrape snapshot (SLO gauges fresh)."""
+        snapshot, _report = self._snapshot_with_slo()
+        return snapshot
+
+    def _scrape_health(self):
+        """The ``/healthz`` fields for this reader's endpoint."""
+        return {'rows_consumed': self.rows_consumed,
+                'stopped': self._stopped,
+                'rowgroups_quarantined': len(self.quarantine)}
+
+    @property
+    def metrics_url(self):
+        """The live scrape endpoint base URL, or None when the reader was
+        built without ``metrics_port`` (docs/observability.md)."""
+        if self._metrics_server is None:
+            return None
+        return self._metrics_server.url
+
     # --------------------------------------------------------- flight recorder
 
     def dump_trace(self, path=None):
@@ -966,6 +1080,10 @@ class Reader(object):
 
     def stop(self):
         self._stopped = True
+        if self._metrics_server is not None:
+            # the scrape plane goes first: a scrape against a tearing-down
+            # pool would race the very state it reports
+            self._metrics_server.stop()
         if self._autotune is not None:
             # the controller must stop turning knobs before the pool they
             # actuate starts tearing down
@@ -1012,8 +1130,12 @@ class Reader(object):
             breakers['shm_transport'] = shm_breaker
         diag['breakers'] = breakers
         # One cross-process telemetry snapshot (docs/observability.md): per-stage
-        # latency histograms merged from every worker sidecar + the pool registry.
-        diag['telemetry'] = self.telemetry_snapshot()
+        # latency histograms merged from every worker sidecar + the pool
+        # registry — built once and shared with the SLO evaluation (which
+        # splices its fresh gauges back in).
+        snapshot, slo_report = self._snapshot_with_slo()
+        diag['slo'] = slo_report
+        diag['telemetry'] = snapshot
         # Flight-recorder summary, only while tracing is armed (the summary of
         # an empty recorder would just be noise in every dashboard).
         if trace_enabled():

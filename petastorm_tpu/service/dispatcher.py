@@ -65,6 +65,9 @@ MSG_REGISTER, MSG_REGISTERED = b'register', b'registered'
 MSG_W_READY, MSG_WORK, MSG_W_STOP = b'w_ready', b'work', b'w_stop'
 MSG_W_HEARTBEAT, MSG_W_RESULT, MSG_W_RESULT_SHM = (b'w_heartbeat', b'w_result',
                                                    b'w_result_shm')
+#: cumulative worker telemetry snapshot riding the heartbeat socket (the
+#: fleet metrics plane — docs/observability.md "Live metrics plane")
+MSG_W_METRICS = b'w_metrics'
 MSG_W_DONE, MSG_W_ERROR = b'w_done', b'w_error'
 MSG_W_NEED_SETUP, MSG_W_LEAVE = b'w_need_setup', b'w_leave'
 
@@ -675,6 +678,21 @@ class FairShareScheduler(object):
         with self._lock:
             return list(self._workers)
 
+    def worker_id_of(self, key: bytes) -> Optional[int]:
+        """The registered worker id behind a socket identity (None when
+        unknown) — how the dispatcher maps departures onto the fleet
+        metrics-plane entries it should drop."""
+        with self._lock:
+            worker = self._workers.get(key)
+            return worker.descriptor.worker_id if worker is not None else None
+
+    def has_worker_id(self, worker_id: int) -> bool:
+        """True while ``worker_id`` names a REGISTERED worker — the guard
+        that keeps a departed worker's straggler ``w_metrics`` frame from
+        resurrecting its entry on the scrape surface."""
+        with self._lock:
+            return worker_id in self._worker_id_index
+
     def state(self) -> Dict[str, Any]:
         """JSON-safe snapshot: clients (queue depth / in-flight / served /
         fair-share debt), workers (assigned / heartbeat age), and the
@@ -766,9 +784,19 @@ class Dispatcher(object):
                  max_item_attempts: int = DEFAULT_MAX_ITEM_ATTEMPTS,
                  item_deadline_s: Optional[float] = None,
                  client_ttl_s: float = DEFAULT_CLIENT_TTL_S,
-                 autotune: Any = None) -> None:
+                 autotune: Any = None,
+                 metrics_port: Optional[int] = None) -> None:
         self._host = host
         self._port = port
+        # Fleet metrics plane (docs/observability.md "Live metrics plane"):
+        # latest cumulative telemetry snapshot per worker (seq-guarded,
+        # delivered as w_metrics frames on the heartbeat socket), merged at
+        # scrape time into one fleet-wide surface. Guarded by its own lock —
+        # the pump thread writes, the scrape threads read.
+        self._metrics_port = metrics_port
+        self._metrics_server: Any = None
+        self._worker_metrics: Dict[int, Tuple[int, Dict[str, Any]]] = {}
+        self._worker_metrics_lock = threading.Lock()
         self.scheduler = FairShareScheduler(
             admission_window=admission_window, quantum=quantum,
             stale_timeout_s=stale_timeout_s,
@@ -833,6 +861,20 @@ class Dispatcher(object):
         self._thread = threading.Thread(target=self._pump, daemon=True,
                                         name='petastorm-tpu-dispatcher')
         self._thread.start()
+        if self._metrics_port is not None:
+            from petastorm_tpu.telemetry.http_exporter import (
+                MetricsHttpServer, service_state_text)
+            self._metrics_server = MetricsHttpServer(
+                snapshot_fn=self.fleet_metrics_snapshot,
+                labeled_fn=self.worker_metrics_snapshots,
+                label='worker',
+                extra_text_fn=lambda: service_state_text(
+                    self.scheduler.state()),
+                health_fn=lambda: {
+                    'workers': self.scheduler.worker_count(),
+                    'service_url': self.service_url},
+                port=int(self._metrics_port), host=self._host)
+            self._metrics_server.start()
         return self.service_url
 
     @property
@@ -848,9 +890,57 @@ class Dispatcher(object):
             state['autotune'] = self._autotune.report()
         return state
 
+    # -------------------------------------------------------- metrics plane
+
+    def record_worker_metrics(self, worker_id: int, seq: int,
+                              snapshot: Dict[str, Any]) -> None:
+        """Adopt one worker's cumulative telemetry snapshot (``w_metrics``);
+        a stale ``seq`` never rolls a fresher view backwards, and a frame
+        from an UNREGISTERED worker (a departed worker's straggler, same as
+        ``scheduler.heartbeat``'s unknown-id drop) never resurrects a
+        popped entry."""
+        if not self.scheduler.has_worker_id(worker_id):
+            return
+        with self._worker_metrics_lock:
+            current = self._worker_metrics.get(worker_id)
+            if current is not None and current[0] >= seq:
+                return
+            self._worker_metrics[worker_id] = (seq, snapshot)
+
+    def worker_metrics_snapshots(self) -> Dict[str, Dict[str, Any]]:
+        """Latest per-worker snapshots keyed by worker id (the per-worker
+        labeled block of the fleet scrape)."""
+        with self._worker_metrics_lock:
+            return {str(worker_id): snapshot
+                    for worker_id, (_seq, snapshot)
+                    in self._worker_metrics.items()}
+
+    def fleet_metrics_snapshot(self) -> Dict[str, Any]:
+        """ONE fleet-wide registry snapshot: the scheduler's control-signal
+        gauges/counters merged (additively, per worker) with every worker's
+        latest heartbeat snapshot — what ``/metrics`` renders as the
+        aggregate block (docs/observability.md "Live metrics plane")."""
+        from petastorm_tpu.telemetry.registry import merge_snapshots
+        with self._worker_metrics_lock:
+            snapshots = [snapshot for _seq, snapshot
+                         in self._worker_metrics.values()]
+        return merge_snapshots(self.scheduler.autotune_snapshot(), *snapshots)
+
+    @property
+    def metrics_url(self) -> Optional[str]:
+        """The fleet scrape endpoint base URL, or None without
+        ``metrics_port``."""
+        if self._metrics_server is None:
+            return None
+        url: str = self._metrics_server.url
+        return url
+
     def stop(self) -> None:
         """Stop the pump thread; ``w_stop`` is broadcast to registered
         workers from the pump thread on its way out."""
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
         self._stop_event.set()
 
     def join(self, timeout: float = 10.0) -> None:
@@ -989,6 +1079,12 @@ class Dispatcher(object):
             self.scheduler.heartbeat(int(bytes(frames[2])),
                                      int(bytes(frames[3])))
             return
+        if kind == MSG_W_METRICS and len(frames) >= 3:
+            from petastorm_tpu.service.wire import WorkerMetricsUpdate
+            update = WorkerMetricsUpdate.from_bytes(bytes(frames[2]))
+            self.record_worker_metrics(update.worker_id, update.seq,
+                                       update.snapshot)
+            return
         if kind == MSG_W_RESULT and len(frames) >= 4:
             token = int(bytes(frames[2]))
             route = self.scheduler.result_route(token)
@@ -1055,6 +1151,12 @@ class Dispatcher(object):
             [client_key, MSG_ERROR, client_token, blob])
 
     def _depart_worker(self, key: bytes, reason: str) -> None:
+        worker_id = self.scheduler.worker_id_of(key)
+        if worker_id is not None:
+            # the departed worker's series leave the scrape surface with it
+            # (Prometheus convention: absent, not frozen-forever)
+            with self._worker_metrics_lock:
+                self._worker_metrics.pop(worker_id, None)
         failed = self.scheduler.remove_worker(key)
         if failed:
             logger.error('dispatcher: %d item(s) exhausted their attempt '

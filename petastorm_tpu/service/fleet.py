@@ -49,7 +49,12 @@ class ServiceFleet(object):
     and each client's own cache setting applies. ``shm_results`` enables the
     one-shot shared-memory result path for co-located clients. ``autotune``
     (True or an :class:`~petastorm_tpu.autotune.AutotunePolicy`) arms the
-    dispatcher's closed-loop admission retuning — docs/autotuning.md."""
+    dispatcher's closed-loop admission retuning — docs/autotuning.md.
+    ``metrics_port`` attaches the dispatcher's fleet-wide scrape endpoint
+    (``/metrics`` aggregating every worker's heartbeat metric snapshots with
+    per-worker/per-client labels, ``/healthz``, ``/vars``; ``0`` binds an
+    ephemeral port — ``dispatcher.metrics_url`` names it) —
+    docs/observability.md "Live metrics plane"."""
 
     def __init__(self, workers: int = 2, host: str = '127.0.0.1',
                  port: Optional[int] = None,
@@ -63,7 +68,8 @@ class ServiceFleet(object):
                  max_item_attempts: int = DEFAULT_MAX_ITEM_ATTEMPTS,
                  item_deadline_s: Optional[float] = None,
                  client_ttl_s: float = DEFAULT_CLIENT_TTL_S,
-                 autotune: Any = None) -> None:
+                 autotune: Any = None,
+                 metrics_port: Optional[int] = None) -> None:
         self._initial_workers = workers
         self._cache_dir = cache_dir
         self._cache_size_limit = cache_size_limit
@@ -74,7 +80,7 @@ class ServiceFleet(object):
             quantum=quantum, stale_timeout_s=stale_timeout_s,
             max_item_attempts=max_item_attempts,
             item_deadline_s=item_deadline_s, client_ttl_s=client_ttl_s,
-            autotune=autotune)
+            autotune=autotune, metrics_port=metrics_port)
         self.processes: List[subprocess.Popen] = []
         self._next_worker_id = 0
         self.service_url: Optional[str] = None
@@ -220,6 +226,11 @@ def serve(argv: Optional[List[str]] = None) -> int:
     parser.add_argument('--no-shm', action='store_true',
                         help='disable the co-located shared-memory result '
                              'path (TCP frames only)')
+    parser.add_argument('--metrics-port', type=int, default=None,
+                        help='serve the fleet-wide Prometheus scrape '
+                             'endpoint (/metrics, /healthz, /vars) on this '
+                             'port (0 = ephemeral; default: off) — '
+                             'docs/observability.md')
     parser.add_argument('--state-interval', type=float, default=30.0,
                         help='seconds between state summaries (0 = quiet)')
     parser.add_argument('--json', action='store_true',
@@ -231,12 +242,16 @@ def serve(argv: Optional[List[str]] = None) -> int:
         workers=args.workers, host=args.host, port=args.port,
         cache_dir=args.cache_dir, cache_size_limit=args.cache_size_limit,
         shm_results=not args.no_shm, admission_window=args.admission_window,
-        item_deadline_s=args.item_deadline_s, autotune=args.autotune)
+        item_deadline_s=args.item_deadline_s, autotune=args.autotune,
+        metrics_port=args.metrics_port)
     url = fleet.start()
     print('petastorm-tpu input service running at {} ({} worker(s); '
           'workers register on port {}). Point readers at '
           'make_reader(..., service_url={!r}); Ctrl-C stops the fleet.'
           .format(url, args.workers, args.port + 1, url))
+    if fleet.dispatcher.metrics_url is not None:
+        print('fleet metrics: {}/metrics (Prometheus text), /healthz, /vars'
+              .format(fleet.dispatcher.metrics_url))
     try:
         while True:
             time.sleep(args.state_interval or 3600.0)

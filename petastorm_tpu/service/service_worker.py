@@ -31,7 +31,17 @@ clients cannot leak ``/dev/shm``.
 
 Heartbeats ride a private DEALER socket (``w_heartbeat`` sequence stamps, the
 PR-4 liveness model): the dispatcher detects stamp *change* on its own clock
-and deregisters a worker whose stamp stalls, re-queuing its in-flight items."""
+and deregisters a worker whose stamp stalls, re-queuing its in-flight items.
+
+Fleet metrics plane (docs/observability.md "Live metrics plane"): every few
+heartbeats the same socket also carries a ``w_metrics`` frame — the worker's
+CUMULATIVE telemetry registry snapshot
+(:class:`~petastorm_tpu.service.wire.WorkerMetricsUpdate`). The worker's
+registry is a consumer-side TEE of the stage-time sidecars each published
+batch already carries (the client still gets its own copy untouched), so the
+dispatcher's scrape surface shows real per-worker decode/read histograms
+without any extra instrumentation on the hot path. Cumulative + seq-guarded:
+a dropped or reordered update costs freshness, never correctness."""
 
 from __future__ import annotations
 
@@ -46,12 +56,16 @@ import traceback
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from petastorm_tpu.service.wire import (ShmResultDescriptor, WorkerDescriptor,
-                                        host_token)
+                                        WorkerMetricsUpdate, host_token)
 
 logger = logging.getLogger(__name__)
 
 #: memoized per-setup runtimes kept per worker (old clients evict LRU)
 _SETUP_CACHE_LIMIT = 8
+#: heartbeats between ``w_metrics`` snapshots (snapshots are a few hundred
+#: bytes, but there is no point re-shipping an unchanged registry every
+#: 0.5s stamp)
+_METRICS_EVERY_N_BEATS = 4
 #: seconds an unclaimed one-shot shm segment survives before the janitor
 #: unlinks it (covers dropped duplicate results and departed clients)
 _SHM_GRACE_S = 60.0
@@ -70,11 +84,15 @@ def _watch_parent(parent_pid: int) -> None:
 
 
 def _heartbeat_loop(stop_event: threading.Event, context: Any, endpoint: str,
-                    worker_id: int, interval_s: float) -> None:
+                    worker_id: int, interval_s: float,
+                    metrics_snapshot_fn: Optional[Callable[[], Dict[str, Any]]]
+                    = None) -> None:
     """Stamp liveness on a PRIVATE DEALER socket (ZMQ sockets are not
     thread-safe — the main thread owns the work socket). Dropped sends are
     fine: the dispatcher only needs *some* stamp to land inside its (much
-    longer) staleness window."""
+    longer) staleness window. Every ``_METRICS_EVERY_N_BEATS`` stamps the
+    same socket also carries the worker's cumulative telemetry snapshot as a
+    ``w_metrics`` frame (module docstring) — best-effort like the stamps."""
     import zmq
     socket = context.socket(zmq.DEALER)
     socket.setsockopt(zmq.SNDHWM, 8)
@@ -89,6 +107,16 @@ def _heartbeat_loop(stop_event: threading.Event, context: Any, endpoint: str,
                     [b'w_heartbeat', b'%d' % worker_id, b'%d' % seq],
                     zmq.NOBLOCK)
             except Exception:  # noqa: BLE001 - liveness must never kill a worker
+                pass
+            if (metrics_snapshot_fn is None
+                    or seq % _METRICS_EVERY_N_BEATS != 1):
+                continue
+            try:
+                update = WorkerMetricsUpdate(worker_id, seq,
+                                             metrics_snapshot_fn())
+                socket.send_multipart([b'w_metrics', update.to_bytes()],
+                                      zmq.NOBLOCK)
+            except Exception:  # noqa: BLE001 - the metrics plane must never kill a worker
                 pass
     finally:
         socket.close(linger=0)
@@ -246,13 +274,20 @@ def main(bootstrap_path: str) -> None:
         if kind == b'registered':
             registered = True
 
+    # Fleet metrics plane (module docstring): this worker's registry TEEs
+    # the stage-time sidecars of every published batch (merge_stage_times is
+    # read-only over the sidecar dict — the owning client's copy is
+    # untouched) and ships cumulative snapshots on the heartbeat socket.
+    from petastorm_tpu.telemetry import MetricsRegistry
+    worker_metrics = MetricsRegistry()
+
     heartbeat_stop = threading.Event()
     heartbeat_thread: Optional[threading.Thread] = None
     if heartbeat_interval_s > 0:
         heartbeat_thread = threading.Thread(
             target=_heartbeat_loop,
             args=(heartbeat_stop, context, endpoint, worker_id,
-                  heartbeat_interval_s),
+                  heartbeat_interval_s, worker_metrics.snapshot),
             daemon=True)
         heartbeat_thread.start()
 
@@ -266,6 +301,9 @@ def main(bootstrap_path: str) -> None:
 
     def publish(result: Any) -> None:
         from petastorm_tpu.telemetry.spans import stage_span
+        stage_times = getattr(result, 'telemetry', None)
+        if stage_times:
+            worker_metrics.merge_stage_times(stage_times)
         with stage_span('serialize'):
             frames = current_serializer[0].serialize(result)
         if shm_publisher is not None and current_colocated[0]:

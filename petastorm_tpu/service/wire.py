@@ -17,6 +17,12 @@ holds what is genuinely shared and structural:
   co-located clients: segment name, per-frame lengths, and a CRC-32 of the
   payload (:func:`petastorm_tpu.workers.integrity.payload_checksum`) verified
   before deserialization, exactly like the in-process shm ring's frames.
+- :class:`WorkerMetricsUpdate` — the fleet metrics-plane piggyback
+  (docs/observability.md "Live metrics plane"): a worker's CUMULATIVE
+  telemetry registry snapshot riding its heartbeat socket as ``w_metrics``
+  frames; the dispatcher keeps the latest per worker (``seq``-guarded) and
+  merges them at scrape time, so a dropped update loses freshness, never
+  data.
 
 Both descriptors serialize via ``to_bytes``/``from_bytes`` JSON specs —
 pipecheck cross-checks the written and read key sets the same way it does for
@@ -158,3 +164,35 @@ class ShmResultDescriptor(object):
         crc = spec['crc']
         return cls(name=str(spec['name']), frame_lengths=lengths,
                    crc=int(crc) if crc is not None else None)
+
+
+class WorkerMetricsUpdate(object):
+    """One worker's cumulative telemetry snapshot for the fleet metrics
+    plane (``w_metrics`` message body — module docstring). ``seq`` orders
+    updates so a late-delivered older snapshot can never roll a worker's
+    fleet view backwards."""
+
+    __slots__ = ('worker_id', 'seq', 'snapshot')
+
+    def __init__(self, worker_id: int, seq: int,
+                 snapshot: Dict[str, Any]) -> None:
+        self.worker_id = worker_id
+        self.seq = seq
+        self.snapshot = snapshot
+
+    def to_bytes(self) -> bytes:
+        """JSON spec for the ``w_metrics`` message."""
+        spec: Dict[str, Any] = {
+            'worker_id': self.worker_id,
+            'seq': self.seq,
+            'snapshot': self.snapshot,
+        }
+        return json.dumps(spec).encode('utf-8')
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> 'WorkerMetricsUpdate':
+        """Decode a :meth:`to_bytes` spec."""
+        spec = json.loads(blob.decode('utf-8'))
+        snapshot = spec['snapshot']
+        return cls(worker_id=int(spec['worker_id']), seq=int(spec['seq']),
+                   snapshot=dict(snapshot) if snapshot else {})

@@ -12,7 +12,17 @@ The subsystem has four layers:
   ``telemetry`` sidecar on the results channel (like ``cache_hit``) and merge
   into the consumer-side registry, so ONE snapshot covers every process.
 - :mod:`~petastorm_tpu.telemetry.export` — Prometheus text exposition and a
-  periodic JSONL event log.
+  periodic JSONL event log (dual-clock ``ts_unix``/``ts_mono`` stamps).
+- :mod:`~petastorm_tpu.telemetry.http_exporter` — the live metrics plane: a
+  stdlib HTTP scrape endpoint (``/metrics`` Prometheus text, ``/healthz``,
+  ``/vars``) attachable to readers, loaders and the service dispatcher
+  (``make_reader(metrics_port=)``, ``serve --metrics-port``).
+- :mod:`~petastorm_tpu.telemetry.slo` — input-efficiency SLOs: starvation
+  fraction / goodput-vs-ideal from the recorded wait-stage spans, with
+  edge-triggered ``slo_breach`` accounting.
+- :mod:`~petastorm_tpu.telemetry.cost_model` — the persistent per-rowgroup /
+  per-field cost profiler fed by the flight recorder
+  (``petastorm-tpu-throughput costs``).
 - :mod:`~petastorm_tpu.telemetry.tracing` /
   :mod:`~petastorm_tpu.telemetry.trace_export` — the flight recorder: a
   bounded per-process ring buffer of span/instant events tagged with the

@@ -168,7 +168,8 @@ def _service_advisories(snapshot: Dict[str, Any]) -> List[Dict[str, Any]]:
 
 
 def attribute_bottleneck(snapshot: Dict[str, Any],
-                         top_n: int = 5) -> Dict[str, Any]:
+                         top_n: int = 5,
+                         cost_ledger: Any = None) -> Dict[str, Any]:
     """Rank leaf stages by total-time share and name the knob for the top one.
 
     Returns ``{'total_stage_seconds', 'ranked': [{'stage', 'seconds', 'share',
@@ -177,7 +178,13 @@ def attribute_bottleneck(snapshot: Dict[str, Any],
     ``advisories`` carries the counter/gauge-driven service advice rows
     (``service_busy``/``service_resubmit``/``service_queue_depth`` — pressure
     that has no latency histogram to rank, docs/service.md). An empty snapshot
-    yields ``top_stage=None`` with a no-data recommendation (never raises)."""
+    yields ``top_stage=None`` with a no-data recommendation (never raises).
+
+    ``cost_ledger`` (a
+    :class:`~petastorm_tpu.telemetry.cost_model.CostLedger`, optional) adds
+    ``what_if`` rows — "if every rowgroup above the p95 cost dropped to the
+    median, total <scope> time −X%": the per-rowgroup skew exposure the stage
+    ranking cannot see (docs/observability.md "Cost profiler")."""
     histograms = snapshot.get('histograms') or {}
     leaves = []
     envelopes = {}
@@ -200,10 +207,12 @@ def attribute_bottleneck(snapshot: Dict[str, Any],
                'mean_s': round(total / count, 6) if count else 0.0}
               for name, total, count in leaves[:max(top_n, 1)]]
     advisories = _service_advisories(snapshot)
+    what_if = list(cost_ledger.what_if()) if cost_ledger is not None else []
     if not ranked:
         return {'total_stage_seconds': 0.0, 'ranked': [], 'envelopes': envelopes,
                 'top_stage': None, 'top_share': 0.0,
                 'advisories': advisories,
+                'what_if': what_if,
                 'recommendation': 'no stage timings recorded',
                 'detail': 'The snapshot holds no latency histograms — run an '
                           'instrumented read first (telemetry is on by default; '
@@ -216,6 +225,7 @@ def attribute_bottleneck(snapshot: Dict[str, Any],
             'top_stage': top['stage'],
             'top_share': top['share'],
             'advisories': advisories,
+            'what_if': what_if,
             'recommendation': headline,
             'detail': detail}
 
@@ -243,6 +253,8 @@ def format_report(report: Dict[str, Any]) -> str:
         lines.append('  [service] {}={:g} -> {}'.format(
             advisory['signal'], advisory['value'],
             advisory['recommendation']))
+    for row in report.get('what_if') or []:
+        lines.append('  [what-if] {}'.format(row['detail']))
     return '\n'.join(lines)
 
 
@@ -260,10 +272,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help='print one machine-readable JSON line instead')
     parser.add_argument('--top', type=int, default=5,
                         help='stages to rank (default 5)')
+    parser.add_argument('--costs', default=None, metavar='LEDGER',
+                        help='a persisted cost ledger '
+                             '(petastorm-tpu-throughput costs) to derive '
+                             'what-if rows from')
     args = parser.parse_args(argv)
     from petastorm_tpu.telemetry.export import load_snapshot
     snapshot = load_snapshot(args.snapshot_path)
-    report = attribute_bottleneck(snapshot, top_n=args.top)
+    cost_ledger = None
+    if args.costs:
+        from petastorm_tpu.telemetry.cost_model import CostLedger
+        cost_ledger = CostLedger.load(args.costs)
+    report = attribute_bottleneck(snapshot, top_n=args.top,
+                                  cost_ledger=cost_ledger)
     if args.json:
         print(json.dumps(report))
     else:

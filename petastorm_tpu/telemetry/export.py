@@ -15,16 +15,54 @@ import os
 import re
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from petastorm_tpu.telemetry.registry import (DEFAULT_NUM_BUCKETS,
                                               bucket_upper_bound)
 
 _NAME_SANITIZE = re.compile(r'[^a-zA-Z0-9_:]')
+#: the full legal Prometheus metric-name grammar — what every emitted name
+#: must match after sanitization (first char may not be a digit)
+METRIC_NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Map an arbitrary metric id onto the legal Prometheus name grammar
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``: every illegal character becomes ``_`` and a
+    leading digit (or empty name) gets a ``_`` prefix — so a stage/knob id
+    containing ``.``/``-``/spaces or starting with a digit degrades to an ugly
+    but VALID name instead of an exposition the scraper rejects."""
+    sanitized = _NAME_SANITIZE.sub('_', name)
+    if not sanitized or sanitized[0].isdigit():
+        sanitized = '_' + sanitized
+    return sanitized
 
 
 def _metric_name(prefix: str, name: str) -> str:
-    return _NAME_SANITIZE.sub('_', '{}_{}'.format(prefix, name))
+    return sanitize_metric_name('{}_{}'.format(prefix, name)
+                                if prefix else name)
+
+
+def _series_labels(name: str, metric: str, prefix: str,
+                   labels: Optional[Dict[str, str]]) -> Dict[str, str]:
+    """The label set every series of this metric carries: the caller's
+    ``labels`` plus a ``raw_name`` label whenever the metric id itself is
+    not already a legal Prometheus name (``.``/``-``/spaces, a leading
+    digit) — the original id must stay queryable after sanitization."""
+    out = dict(labels or {})
+    if sanitize_metric_name(name) != name:
+        out['raw_name'] = name
+    return out
+
+
+def _format_labels(labels: Dict[str, str]) -> str:
+    """``{k="v",...}`` rendering (empty string for no labels), values escaped
+    per the exposition format."""
+    if not labels:
+        return ''
+    return '{{{}}}'.format(','.join(
+        '{}="{}"'.format(key, escape_label_value(value))
+        for key, value in sorted(labels.items())))
 
 
 def _format_value(value: float) -> str:
@@ -55,8 +93,41 @@ def _help_line(metric: str, kind: str, name: str) -> str:
         metric, kind, _escape_help(name))
 
 
+def _render_histogram_series(lines: List[str], metric: str,
+                             hist: Dict[str, Any],
+                             labels: Dict[str, str]) -> None:
+    """Append one label-set's cumulative ``_bucket``/``_sum``/``_count``
+    series for ``metric`` (HELP/TYPE are the caller's job — they must appear
+    exactly once per metric name across all label sets)."""
+    unit = float(hist.get('unit', 1e-6))
+    buckets = {int(k): int(v) for k, v in (hist.get('buckets') or {}).items()}
+    cumulative = 0
+    top = max(buckets) if buckets else -1
+    # finite buckets only — the histogram's last bucket IS +Inf, which the
+    # unconditional line below emits exactly once (duplicate le="+Inf"
+    # series make scrapers reject the whole exposition)
+    for idx in range(min(top + 1, DEFAULT_NUM_BUCKETS - 1)):
+        cumulative += buckets.get(idx, 0)
+        le = bucket_upper_bound(idx, unit)
+        bucket_labels = dict(labels)
+        bucket_labels['le'] = _format_value(le)
+        lines.append('{}_bucket{} {}'.format(
+            metric, _format_labels(bucket_labels), cumulative))
+    inf_labels = dict(labels)
+    inf_labels['le'] = '+Inf'
+    lines.append('{}_bucket{} {}'.format(
+        metric, _format_labels(inf_labels),
+        int(hist.get('count', cumulative))))
+    suffix = _format_labels(labels)
+    lines.append('{}_sum{} {}'.format(
+        metric, suffix, _format_value(float(hist.get('sum', 0.0)))))
+    lines.append('{}_count{} {}'.format(metric, suffix,
+                                        int(hist.get('count', 0))))
+
+
 def to_prometheus_text(snapshot: Dict[str, Any],
-                       prefix: str = 'petastorm_tpu') -> str:
+                       prefix: str = 'petastorm_tpu',
+                       labels: Optional[Dict[str, str]] = None) -> str:
     """Render a registry snapshot in the Prometheus text exposition format.
 
     Every metric emits a ``# HELP``/``# TYPE`` pair. Histograms emit the
@@ -64,43 +135,86 @@ def to_prometheus_text(snapshot: Dict[str, Any],
     ``_count``; bucket boundaries come from the histogram's power-of-two layout
     (``le`` values are in the histogram's base unit — seconds for latency
     stages). Counters map to ``counter``, gauges to ``gauge``. Metric names are
-    sanitized to the legal charset and label values / HELP text escaped per the
-    exposition format (backslash, quote, newline — :func:`escape_label_value`),
-    so a pathological stage name degrades to an ugly series, never to an
-    exposition the scraper rejects."""
-    lines = []
+    sanitized onto the legal grammar ``[a-zA-Z_:][a-zA-Z0-9_:]*``
+    (:func:`sanitize_metric_name`); whenever sanitization changed the id, the
+    original rides a ``raw_name`` label so it stays queryable. Label values /
+    HELP text are escaped per the exposition format (backslash, quote,
+    newline — :func:`escape_label_value`), so a pathological stage name
+    degrades to an ugly series, never to an exposition the scraper rejects.
+    ``labels`` (optional) is stamped onto every series — the per-worker /
+    per-client labeling hook of the fleet scrape surface."""
+    lines: List[str] = []
     for name, value in sorted((snapshot.get('counters') or {}).items()):
+        metric = _metric_name(prefix, name)
+        series = _format_labels(_series_labels(name, metric, prefix, labels))
+        lines.append(_help_line(metric, 'counter', name))
+        lines.append('# TYPE {} counter'.format(metric))
+        lines.append('{}{} {}'.format(metric, series, _format_value(value)))
+    for name, value in sorted((snapshot.get('gauges') or {}).items()):
+        metric = _metric_name(prefix, name)
+        series = _format_labels(_series_labels(name, metric, prefix, labels))
+        lines.append(_help_line(metric, 'gauge', name))
+        lines.append('# TYPE {} gauge'.format(metric))
+        lines.append('{}{} {}'.format(metric, series, _format_value(value)))
+    for name, hist in sorted((snapshot.get('histograms') or {}).items()):
+        metric = _metric_name(prefix, name)
+        lines.append(_help_line(metric, 'histogram', name))
+        lines.append('# TYPE {} histogram'.format(metric))
+        _render_histogram_series(lines, metric, hist,
+                                 _series_labels(name, metric, prefix, labels))
+    return '\n'.join(lines) + '\n'
+
+
+def to_prometheus_text_labeled(snapshots: Dict[str, Dict[str, Any]],
+                               label: str,
+                               prefix: str = 'petastorm_tpu') -> str:
+    """Render several registry snapshots as ONE exposition where every series
+    carries ``{label="<key>"}`` — the fleet scrape's per-worker block
+    (docs/observability.md "Live metrics plane").
+
+    Unlike calling :func:`to_prometheus_text` once per snapshot, metric names
+    are grouped: each emits exactly one ``# HELP``/``# TYPE`` pair followed by
+    one series (or bucket family) per label value, because a repeated TYPE
+    line for the same metric name makes scrapers reject the exposition."""
+    counters: Dict[str, List[str]] = {}
+    gauges: Dict[str, List[str]] = {}
+    histograms: Dict[str, List[str]] = {}
+    for key in sorted(snapshots):
+        snapshot = snapshots[key] or {}
+        for name in snapshot.get('counters') or {}:
+            counters.setdefault(name, []).append(key)
+        for name in snapshot.get('gauges') or {}:
+            gauges.setdefault(name, []).append(key)
+        for name in snapshot.get('histograms') or {}:
+            histograms.setdefault(name, []).append(key)
+    lines: List[str] = []
+    for name in sorted(counters):
         metric = _metric_name(prefix, name)
         lines.append(_help_line(metric, 'counter', name))
         lines.append('# TYPE {} counter'.format(metric))
-        lines.append('{} {}'.format(metric, _format_value(value)))
-    for name, value in sorted((snapshot.get('gauges') or {}).items()):
+        for key in counters[name]:
+            series = _series_labels(name, metric, prefix, {label: key})
+            lines.append('{}{} {}'.format(
+                metric, _format_labels(series),
+                _format_value(snapshots[key]['counters'][name])))
+    for name in sorted(gauges):
         metric = _metric_name(prefix, name)
         lines.append(_help_line(metric, 'gauge', name))
         lines.append('# TYPE {} gauge'.format(metric))
-        lines.append('{} {}'.format(metric, _format_value(value)))
-    for name, hist in sorted((snapshot.get('histograms') or {}).items()):
+        for key in gauges[name]:
+            series = _series_labels(name, metric, prefix, {label: key})
+            lines.append('{}{} {}'.format(
+                metric, _format_labels(series),
+                _format_value(snapshots[key]['gauges'][name])))
+    for name in sorted(histograms):
         metric = _metric_name(prefix, name)
-        unit = float(hist.get('unit', 1e-6))
         lines.append(_help_line(metric, 'histogram', name))
         lines.append('# TYPE {} histogram'.format(metric))
-        buckets = {int(k): int(v) for k, v in (hist.get('buckets') or {}).items()}
-        cumulative = 0
-        top = max(buckets) if buckets else -1
-        # finite buckets only — the histogram's last bucket IS +Inf, which the
-        # unconditional line below emits exactly once (duplicate le="+Inf"
-        # series make scrapers reject the whole exposition)
-        for idx in range(min(top + 1, DEFAULT_NUM_BUCKETS - 1)):
-            cumulative += buckets.get(idx, 0)
-            le = bucket_upper_bound(idx, unit)
-            lines.append('{}_bucket{{le="{}"}} {}'.format(
-                metric, escape_label_value(_format_value(le)), cumulative))
-        lines.append('{}_bucket{{le="+Inf"}} {}'.format(
-            metric, int(hist.get('count', cumulative))))
-        lines.append('{}_sum {}'.format(metric,
-                                        _format_value(float(hist.get('sum', 0.0)))))
-        lines.append('{}_count {}'.format(metric, int(hist.get('count', 0))))
-    return '\n'.join(lines) + '\n'
+        for key in histograms[name]:
+            series = _series_labels(name, metric, prefix, {label: key})
+            _render_histogram_series(
+                lines, metric, snapshots[key]['histograms'][name], series)
+    return '\n'.join(lines) + '\n' if lines else ''
 
 
 class JsonlEventLogger(object):
@@ -153,11 +267,21 @@ class JsonlEventLogger(object):
 
     def emit(self, snapshot: Dict[str, Any], event: str = 'snapshot',
              **extra: Any) -> bool:
-        """Append one JSONL record unconditionally; returns success."""
+        """Append one JSONL record unconditionally; returns success.
+
+        Dual-clock convention (docs/observability.md): every record carries
+        BOTH ``ts_unix`` (``time.time()`` — aligns the stream with external
+        monitoring systems that live on the wall clock) and ``ts_mono``
+        (``time.perf_counter()`` — the same monotonic timebase the flight
+        recorder's ``ts_us`` stamps use, so a JSONL record can be placed on a
+        trace timeline without wall-clock skew). ``ts`` is kept as an alias of
+        ``ts_unix`` for pre-existing consumers."""
         if self._failed:
             return False
-        record = {'ts': time.time(), 'event': event, 'pid': os.getpid(),
-                  'telemetry': snapshot}
+        now_unix = time.time()
+        record = {'ts': now_unix, 'ts_unix': now_unix,
+                  'ts_mono': time.perf_counter(), 'event': event,
+                  'pid': os.getpid(), 'telemetry': snapshot}
         record.update(extra)
         line = json.dumps(record) + '\n'
         with self._lock:

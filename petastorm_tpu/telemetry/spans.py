@@ -59,6 +59,10 @@ STAGES = (
                       # decode (loader; docs/performance.md)
     'd2d_wait',       # blocked on the prefetch-to-device ring: the oldest
                       # dispatched device batch had not finished (loader)
+    'decode_field',   # ONE field's kernel inside 'decode' — emitted to the
+                      # flight-recorder timeline only (never a histogram),
+                      # and only while tracing is armed: the per-field leg of
+                      # the cost profiler (telemetry/cost_model.py)
 )
 
 #: stages whose span ENVELOPES other recorded stages (cache_miss wraps
@@ -77,6 +81,9 @@ COUNTERS = (
     'shm_crc_fail',    # a shm frame failed CRC verification (pool)
     'service_busy',    # the input service rejected a submit (admission control)
     'service_resubmit',  # a service item was re-requested (lost shm segment)
+    'slo_breach',      # input-efficiency fell below the SLO target (edge-
+                       # triggered: one count per ok->breach transition —
+                       # telemetry/slo.py, docs/observability.md)
 )
 
 #: declared size histograms (``registry.observe(name, n, unit=BYTES_UNIT)``
@@ -100,6 +107,21 @@ TRACE_INSTANTS = (
     'shm_crc_drop',        # a shm frame failed CRC and was dropped unread (consumer)
     'shm_fallback',        # a result rode the ZMQ wire while the shm ring was enabled
     'autotune_decision',   # the closed-loop autotuner proposed/committed/reverted/froze a knob change (controller)
+    'slo_breach',          # input-efficiency fell below the SLO target (consumer; telemetry/slo.py)
+)
+
+#: declared gauge ids (``registry.gauge(name)`` call sites with literal
+#: names, plus the service scheduler's snapshot gauges) — same catalog
+#: contract as COUNTERS: pipecheck's telemetry-names rule rejects a
+#: ``gauge('x')`` of a name not listed here
+GAUGES = (
+    'slo_efficiency',          # latest evaluated input efficiency [0,1] (slo.py)
+    'slo_target_efficiency',   # the SLO target the efficiency is held against
+    'service_queue_depth',       # accepted items queued fleet-wide (dispatcher)
+    'service_ready_workers',     # idle decode workers (dispatcher)
+    'service_workers',           # registered decode workers (dispatcher)
+    'service_admission_window',  # per-client in-flight cap (dispatcher)
+    'service_client_window',     # smallest live client window (dispatcher)
 )
 
 

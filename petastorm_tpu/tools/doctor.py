@@ -236,6 +236,7 @@ def check_store_roundtrip(rows=200, workers=2):
                 telemetry = reader.telemetry_snapshot()
                 trace = reader.trace_summary()
                 autotune = reader.autotune_report()
+                slo = reader.efficiency_report()
             elapsed = time.perf_counter() - start
     finally:
         tracing.set_trace_enabled(trace_was_enabled)
@@ -257,6 +258,9 @@ def check_store_roundtrip(rows=200, workers=2):
             # lifted to report['autotune'] by collect_report — the closed-loop
             # controller's state (docs/autotuning.md)
             'autotune': autotune,
+            # lifted to report['slo'] by collect_report — the input-efficiency
+            # SLO evaluation of docs/observability.md "Efficiency SLOs"
+            'slo': slo,
             # lifted to report['resilience'] by collect_report — the hang/
             # integrity/breaker view of docs/robustness.md
             'resilience': {
@@ -372,6 +376,11 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
     autotune = report['store_roundtrip'].pop('autotune', None)
     report['autotune'] = autotune if autotune is not None else {
         'enabled': False}
+    # Input-efficiency SLO block (docs/observability.md "Efficiency SLOs"):
+    # the roundtrip reader's efficiency-vs-target evaluation. Always present
+    # so --json consumers find one stable key.
+    slo = report['store_roundtrip'].pop('slo', None)
+    report['slo'] = slo if slo is not None else {'evaluated': False}
     # Static-analysis block (docs/static-analysis.md): does the installed
     # package still satisfy its own data-plane invariants? Always present so
     # --json consumers find one stable key; failures of the analyzer itself
@@ -435,6 +444,19 @@ def _print_human(report):
         print('  telemetry: top stage {} ({:.0%} of {:.3f}s stage time) -> {}'
               .format(b['top_stage'], b['top_share'],
                       b.get('total_stage_seconds', 0.0), b['recommendation']))
+    slo = report.get('slo') or {}
+    if slo.get('evaluated'):
+        print('  input efficiency: {:.1%} (target {:.0%}; consumer waited '
+              '{:.3f}s of {:.3f}s)'.format(
+                  slo.get('efficiency', 0.0),
+                  slo.get('target_efficiency', 0.0),
+                  slo.get('wait_seconds', 0.0), slo.get('elapsed_s', 0.0)))
+        if slo.get('breached'):
+            print('  WARNING: input efficiency is BELOW the SLO target — '
+                  'the consumer sat starved {:.0%} of the time; see the '
+                  'telemetry bottleneck line for the knob to turn '
+                  '(docs/observability.md "Efficiency SLOs")'.format(
+                      slo.get('starvation_fraction', 0.0)))
     trace = report.get('trace') or {}
     if trace.get('events'):
         anomalies = trace.get('anomaly_instants') or []

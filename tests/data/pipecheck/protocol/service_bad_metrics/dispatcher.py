@@ -1,0 +1,17 @@
+"""Known-bad fixture (dispatcher side): dispatches on a metrics kind no
+peer ever sends (renamed producer), while the worker's ``w_metrics`` and
+``w_heartbeat`` frames have no dispatch arm here."""
+
+MSG_W_METRICZ = b'w_metricz'  # typo: the worker sends b'w_metrics'
+
+
+def handle_worker(worker_socket):
+    frames = worker_socket.recv_multipart()
+    kind = bytes(frames[1])
+    if kind == MSG_W_METRICZ:
+        return frames[2]
+    return None
+
+
+def dispatch(worker_socket, identity, token, blob):
+    worker_socket.send_multipart([identity, b'work', token, blob])

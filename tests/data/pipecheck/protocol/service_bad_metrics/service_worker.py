@@ -1,0 +1,15 @@
+"""Known-bad fixture (worker side): sends a metrics kind the dispatcher
+fixture never dispatches on."""
+
+
+def heartbeat(socket, worker_id, seq, blob):
+    socket.send_multipart([b'w_heartbeat', worker_id, seq])
+    socket.send_multipart([b'w_metrics', blob])  # nobody dispatches this
+
+
+def loop(socket):
+    frames = socket.recv_multipart()
+    kind = frames[0]
+    if kind == b'work':
+        return frames[1:]
+    return None

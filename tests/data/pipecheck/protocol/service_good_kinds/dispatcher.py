@@ -2,6 +2,7 @@
 client fixtures' kinds."""
 
 MSG_W_RESULT, MSG_W_DONE, MSG_WORK = b'w_result', b'w_done', b'work'
+MSG_W_METRICS = b'w_metrics'
 
 
 def handle_worker(worker_socket, client_socket):
@@ -10,6 +11,8 @@ def handle_worker(worker_socket, client_socket):
     if kind == MSG_W_RESULT:
         client_socket.send_multipart([frames[0], b'result'] + frames[2:])
         return True
+    if kind == MSG_W_METRICS:
+        return frames[2]
     if kind == MSG_W_DONE:
         return None
     return None

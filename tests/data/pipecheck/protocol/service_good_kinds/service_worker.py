@@ -7,6 +7,10 @@ def publish(socket, token, frames):
     socket.send_multipart([b'w_done', token])
 
 
+def heartbeat_metrics(socket, blob):
+    socket.send_multipart([b'w_metrics', blob])
+
+
 def loop(socket):
     frames = socket.recv_multipart()
     kind = frames[0]

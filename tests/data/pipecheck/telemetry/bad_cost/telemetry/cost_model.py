@@ -1,0 +1,3 @@
+"""Known-bad fixture: a COST_STAGES entry that names no real stage."""
+
+COST_STAGES = ('rowgroup_reed', 'decode')  # typo: should be 'rowgroup_read'

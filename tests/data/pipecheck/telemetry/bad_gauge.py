@@ -1,0 +1,6 @@
+"""Known-bad fixture: gauge ids missing from the GAUGES catalog."""
+
+
+def work(registry):
+    registry.gauge('slo_efficienzy').set(0.5)  # typo: should be 'slo_efficiency'
+    registry.gauge('service_queue_depht').set(3.0)  # typo: 'service_queue_depth'

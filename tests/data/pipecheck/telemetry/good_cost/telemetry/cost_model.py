@@ -1,0 +1,3 @@
+"""Known-good fixture: COST_STAGES is a subset of the STAGES catalog."""
+
+COST_STAGES = ('rowgroup_read', 'decode')

@@ -1,0 +1,7 @@
+"""Known-good fixture: catalog gauge ids only."""
+
+
+def work(registry, value):
+    registry.gauge('slo_efficiency').set(value)
+    registry.gauge('slo_target_efficiency').set(0.9)
+    registry.gauge('service_queue_depth').set(3.0)

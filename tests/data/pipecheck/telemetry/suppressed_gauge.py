@@ -1,0 +1,5 @@
+"""Suppression fixture: an off-catalog gauge id, explicitly allowed."""
+
+
+def work(registry):
+    registry.gauge('experimental_gauge').set(1.0)  # pipecheck: disable=telemetry-names -- experiment-local gauge, removed with the experiment

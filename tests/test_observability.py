@@ -1,0 +1,594 @@
+"""Goodput observatory tests (ISSUE 11, docs/observability.md): the live
+metrics plane (HTTP scrape endpoint + fleet-wide aggregation over heartbeat
+metric snapshots), the input-efficiency SLOs, and the persistent
+per-rowgroup cost profiler — plus the satellite fixes (metric-name
+sanitization, dual-clock JSONL stamps, 3-process ``merge_snapshots``
+coverage)."""
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import write_rows
+from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry.cost_model import (CostLedger,
+                                                default_ledger_path,
+                                                percentile)
+from petastorm_tpu.telemetry.export import (METRIC_NAME_RE, JsonlEventLogger,
+                                            sanitize_metric_name,
+                                            to_prometheus_text,
+                                            to_prometheus_text_labeled)
+from petastorm_tpu.telemetry.http_exporter import (MetricsHttpServer,
+                                                   service_state_text)
+from petastorm_tpu.telemetry.registry import (MetricsRegistry,
+                                              merge_snapshots)
+from petastorm_tpu.telemetry.slo import (SloPolicy, SloTracker,
+                                         efficiency_from_snapshot,
+                                         resolve_slo_policy)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+#: a Prometheus exposition sample line: name[{labels}] value
+_SAMPLE_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \S+$')
+
+
+def _assert_valid_exposition(text):
+    """Every line is a comment or a grammatical sample line, and no metric
+    name repeats its # TYPE header (scrapers reject duplicates)."""
+    seen_types = set()
+    for line in text.rstrip('\n').splitlines():
+        if line.startswith('# TYPE '):
+            name = line.split()[2]
+            assert name not in seen_types, 'duplicate TYPE for ' + name
+            seen_types.add(name)
+            continue
+        if line.startswith('#'):
+            continue
+        assert _SAMPLE_LINE.match(line), 'bad exposition line: ' + repr(line)
+
+
+def _get(url):
+    return urllib.request.urlopen(url, timeout=10).read().decode('utf-8')
+
+
+def _store(tmp_path, rows=100, rows_per_file=None, with_vec=False):
+    fields = [UnischemaField('idx', np.int64, (), ScalarCodec(pa.int64()),
+                             False)]
+    if with_vec:
+        fields.append(UnischemaField('vec', np.float32, (8,), NdarrayCodec(),
+                                     False))
+    schema = Unischema('ObsProbe', fields)
+    url = 'file://' + str(tmp_path)
+
+    def rows_iter():
+        for i in range(rows):
+            row = {'idx': i}
+            if with_vec:
+                row['vec'] = np.full(8, i, np.float32)
+            yield row
+    kwargs = {'rowgroup_size_mb': 1}
+    if rows_per_file:
+        kwargs['rows_per_file'] = rows_per_file
+    write_rows(url, schema, rows_iter(), **kwargs)
+    return url
+
+
+# ---------------------------------------------------------------------------
+# satellite: metric-name sanitization
+# ---------------------------------------------------------------------------
+
+def test_sanitize_pathological_metric_ids():
+    for raw in ('rowgroup.read', '9weird-stage', 'a b/c', '', ':colon',
+                'knob-id.v2', '99'):
+        assert METRIC_NAME_RE.match(sanitize_metric_name(raw)), raw
+
+
+def test_prometheus_text_pathological_ids_keep_raw_name_label():
+    snapshot = {
+        'counters': {'rowgroup.read-v2': 3},
+        'gauges': {'9stage': 1.5},
+        'histograms': {'weird stage': {'unit': 1e-6, 'count': 1, 'sum': 0.5,
+                                       'max': 0.5, 'buckets': {'0': 1}}},
+    }
+    text = to_prometheus_text(snapshot)
+    _assert_valid_exposition(text)
+    assert 'petastorm_tpu_rowgroup_read_v2{raw_name="rowgroup.read-v2"} 3' \
+        in text
+    assert 'petastorm_tpu_9stage{raw_name="9stage"} 1.5' in text
+    assert 'raw_name="weird stage"' in text
+    # clean ids carry no raw_name label
+    clean = to_prometheus_text({'counters': {'decode_total': 1}})
+    assert 'raw_name' not in clean
+
+
+def test_prometheus_text_labeled_groups_type_blocks():
+    snap_a = {'counters': {'items': 1},
+              'histograms': {'decode': {'unit': 1e-6, 'count': 1, 'sum': 0.1,
+                                        'max': 0.1, 'buckets': {'0': 1}}},
+              'gauges': {}}
+    snap_b = {'counters': {'items': 5}, 'histograms': {}, 'gauges': {}}
+    text = to_prometheus_text_labeled({'0': snap_a, '1': snap_b}, 'worker',
+                                      prefix='petastorm_tpu_worker')
+    _assert_valid_exposition(text)
+    assert text.count('# TYPE petastorm_tpu_worker_items counter') == 1
+    assert 'petastorm_tpu_worker_items{worker="0"} 1' in text
+    assert 'petastorm_tpu_worker_items{worker="1"} 5' in text
+    assert 'petastorm_tpu_worker_decode_count{worker="0"} 1' in text
+    # empty input renders an empty exposition, not a stray newline
+    assert to_prometheus_text_labeled({}, 'worker') == ''
+
+
+# ---------------------------------------------------------------------------
+# satellite: dual-clock JSONL stamps
+# ---------------------------------------------------------------------------
+
+def test_jsonl_records_carry_dual_clock_stamps(tmp_path):
+    path = str(tmp_path / 'events.jsonl')
+    logger = JsonlEventLogger(path, interval_s=0.0)
+    before_unix, before_mono = time.time(), time.perf_counter()
+    assert logger.emit({'histograms': {}}, event='snapshot')
+    after_unix, after_mono = time.time(), time.perf_counter()
+    record = json.loads(open(path).read().splitlines()[0])
+    assert before_unix <= record['ts_unix'] <= after_unix
+    assert before_mono <= record['ts_mono'] <= after_mono
+    # the historical alias stays for pre-existing consumers
+    assert record['ts'] == record['ts_unix']
+
+
+# ---------------------------------------------------------------------------
+# satellite: merge_snapshots across >= 3 simulated processes
+# ---------------------------------------------------------------------------
+
+def test_merge_snapshots_three_processes_mismatched_buckets():
+    """Fleet aggregation folds >=3 per-process snapshots with mismatched
+    histogram bucket layouts (a bigger ring's indices clamp into the last
+    bucket) and duplicate counter names — counts and sums must stay exact."""
+    reg_a = MetricsRegistry()
+    for value in (1e-6, 1e-3):
+        reg_a.observe('decode', value)
+    reg_a.inc('service_busy', 2)
+    snap_a = reg_a.snapshot()
+
+    reg_b = MetricsRegistry()
+    reg_b.observe('decode', 5e-2)
+    reg_b.inc('service_busy', 3)
+    snap_b = reg_b.snapshot()
+
+    # process C: a (hypothetical) 64-bucket layout — indices far past the
+    # 32-bucket receiver must clamp into the top bucket, never be lost
+    snap_c = {
+        'histograms': {'decode': {'unit': 1e-6, 'count': 4, 'sum': 10.0,
+                                  'max': 9.0,
+                                  'buckets': {'10': 2, '40': 1, '63': 1}}},
+        'counters': {'service_busy': 5, 'service_resubmit': 1},
+        'gauges': {'service_queue_depth': 7.0},
+    }
+
+    merged = merge_snapshots(snap_a, snap_b, None, snap_c)
+    hist = merged['histograms']['decode']
+    assert hist['count'] == 2 + 1 + 4
+    assert abs(hist['sum'] - (1e-6 + 1e-3 + 5e-2 + 10.0)) < 1e-9
+    assert hist['max'] == 9.0
+    assert sum(hist['buckets'].values()) >= hist['count']
+    assert all(int(k) <= 31 for k in hist['buckets'])
+    assert merged['counters']['service_busy'] == 2 + 3 + 5
+    assert merged['counters']['service_resubmit'] == 1
+    assert merged['gauges']['service_queue_depth'] == 7.0
+
+
+# ---------------------------------------------------------------------------
+# efficiency SLOs
+# ---------------------------------------------------------------------------
+
+def _wait_snapshot(shuffle_wait=0.0, pool_wait=0.0, d2d_wait=0.0, h2d=0.0):
+    hists = {}
+    for name, total in (('shuffle_wait', shuffle_wait),
+                        ('pool_wait', pool_wait), ('d2d_wait', d2d_wait),
+                        ('h2d', h2d)):
+        if total:
+            hists[name] = {'unit': 1e-6, 'count': 1, 'sum': total,
+                           'max': total, 'buckets': {'31': 1}}
+    return {'histograms': hists, 'counters': {}, 'gauges': {}}
+
+
+def test_efficiency_math_prefers_shuffle_wait_over_pool_wait():
+    # both present: shuffle_wait is the training-loop-facing stage; summing
+    # both would double-count one stall observed at two layers
+    report = efficiency_from_snapshot(
+        _wait_snapshot(shuffle_wait=2.0, pool_wait=1.5, d2d_wait=0.5,
+                       h2d=0.25), elapsed_s=10.0, rows=1000)
+    assert report['primary_wait_stage'] == 'shuffle_wait'
+    assert report['wait_seconds'] == pytest.approx(2.5)
+    assert report['starvation_fraction'] == pytest.approx(0.25)
+    assert report['efficiency'] == pytest.approx(0.75)
+    assert report['h2d_seconds'] == pytest.approx(0.25)
+    assert report['goodput_rows_per_sec'] == pytest.approx(100.0)
+    assert report['ideal_rows_per_sec'] == pytest.approx(1000 / 7.5,
+                                                         abs=1e-3)
+    # goodput / ideal == efficiency (the same number, two framings)
+    assert (report['goodput_rows_per_sec'] / report['ideal_rows_per_sec']
+            == pytest.approx(report['efficiency'], abs=1e-4))
+
+
+def test_efficiency_falls_back_to_pool_wait_without_a_loader():
+    report = efficiency_from_snapshot(_wait_snapshot(pool_wait=4.0),
+                                      elapsed_s=8.0)
+    assert report['primary_wait_stage'] == 'pool_wait'
+    assert report['efficiency'] == pytest.approx(0.5)
+
+
+def test_slo_policy_resolution_and_validation():
+    assert resolve_slo_policy(None).target_efficiency == 0.9
+    assert resolve_slo_policy(0.5).target_efficiency == 0.5
+    policy = SloPolicy(target_efficiency=0.8, min_elapsed_s=0.0)
+    assert resolve_slo_policy(policy) is policy
+    with pytest.raises(ValueError):
+        SloPolicy(target_efficiency=1.5)
+    with pytest.raises(ValueError):
+        resolve_slo_policy('0.9')
+
+
+def test_slo_breaches_are_edge_triggered(tmp_path):
+    jsonl_path = str(tmp_path / 'slo.jsonl')
+    tracker = SloTracker(SloPolicy(target_efficiency=0.9, min_elapsed_s=0.0),
+                         jsonl=JsonlEventLogger(jsonl_path, interval_s=0.0))
+    registry = MetricsRegistry()
+    bad = _wait_snapshot(shuffle_wait=5.0)
+    good = _wait_snapshot(shuffle_wait=0.1)
+
+    tracing.reset_tracing()
+    tracing.set_trace_enabled(True)
+    try:
+        assert tracker.evaluate(bad, 10.0, registry=registry)['breached']
+        assert tracker.evaluate(bad, 10.0, registry=registry)['breached']
+        assert tracker.breaches == 1  # still in breach: no second count
+        assert not tracker.evaluate(good, 10.0, registry=registry)['breached']
+        assert tracker.evaluate(bad, 10.0, registry=registry)['breached']
+        assert tracker.breaches == 2  # recovered, then breached again
+        instants = [e for e in tracing.trace_snapshot()['events']
+                    if e['name'] == 'slo_breach']
+        assert len(instants) == 2
+    finally:
+        tracing.set_trace_enabled(False)
+        tracing.reset_tracing()
+    snap = registry.snapshot()
+    assert snap['counters']['slo_breach'] == 2
+    assert snap['gauges']['slo_target_efficiency'] == 0.9
+    assert snap['gauges']['slo_efficiency'] == pytest.approx(0.5)
+    events = [json.loads(line) for line in open(jsonl_path)]
+    assert [e['event'] for e in events] == ['slo_breach', 'slo_breach']
+    assert all('ts_mono' in e for e in events)
+
+
+def test_slo_short_window_reports_but_never_breaches():
+    tracker = SloTracker(SloPolicy(target_efficiency=0.9, min_elapsed_s=5.0))
+    report = tracker.evaluate(_wait_snapshot(shuffle_wait=0.9), 1.0)
+    assert not report['evaluated']
+    assert not report['breached']
+    assert tracker.breaches == 0
+
+
+# ---------------------------------------------------------------------------
+# live metrics plane: the HTTP exporter
+# ---------------------------------------------------------------------------
+
+def test_http_exporter_serves_metrics_healthz_vars():
+    snapshot = {'counters': {'items': 7}, 'gauges': {},
+                'histograms': {'decode': {'unit': 1e-6, 'count': 1,
+                                          'sum': 0.5, 'max': 0.5,
+                                          'buckets': {'0': 1}}}}
+    with MetricsHttpServer(
+            snapshot_fn=lambda: snapshot,
+            labeled_fn=lambda: {'3': {'counters': {'items': 2}}},
+            health_fn=lambda: {'rows': 42}) as server:
+        assert server.port > 0
+        text = _get(server.url + '/metrics')
+        _assert_valid_exposition(text)
+        assert 'petastorm_tpu_items 7' in text
+        assert 'petastorm_tpu_worker_items{worker="3"} 2' in text
+        health = json.loads(_get(server.url + '/healthz'))
+        assert health == {'status': 'ok', 'rows': 42}
+        varsdoc = json.loads(_get(server.url + '/vars'))
+        assert varsdoc['snapshot'] == snapshot
+        assert varsdoc['labeled']['worker']['3']['counters']['items'] == 2
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _get(server.url + '/nope')
+        assert exc_info.value.code == 404
+    server.stop()  # idempotent
+
+
+def test_http_exporter_broken_snapshot_fn_answers_500():
+    def boom():
+        raise RuntimeError('broken snapshot')
+    with MetricsHttpServer(snapshot_fn=boom) as server:
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _get(server.url + '/metrics')
+        assert exc_info.value.code == 500
+        # the endpoint survives: healthz still answers
+        assert json.loads(_get(server.url + '/healthz'))['status'] == 'ok'
+
+
+def test_service_state_text_renders_client_and_worker_gauges():
+    text = service_state_text({
+        'clients': [{'name': 'r-1', 'queued': 2, 'in_flight': 3,
+                     'served': 10, 'window': 16}],
+        'workers': [{'worker_id': 0, 'assigned': 1,
+                     'heartbeat_age_s': 0.25}],
+    })
+    _assert_valid_exposition(text)
+    assert 'petastorm_tpu_service_client_queued{client="r-1"} 2' in text
+    assert 'petastorm_tpu_service_worker_assigned{worker="0"} 1' in text
+    assert ('petastorm_tpu_service_worker_heartbeat_age_seconds{worker="0"} '
+            '0.25') in text
+    assert service_state_text({}) == ''
+
+
+# ---------------------------------------------------------------------------
+# reader + loader integration
+# ---------------------------------------------------------------------------
+
+def test_reader_metrics_endpoint_and_slo(tmp_path):
+    url = _store(tmp_path / 'store', rows=100)
+    with make_reader(url, num_epochs=1, metrics_port=0) as reader:
+        rows = sum(1 for _ in reader)
+        assert rows == 100
+        body = _get(reader.metrics_url + '/metrics')
+        _assert_valid_exposition(body)
+        assert 'petastorm_tpu_decode_count' in body
+        assert 'petastorm_tpu_slo_efficiency' in body
+        report = reader.efficiency_report()
+        assert 0.0 <= report['efficiency'] <= 1.0
+        # consistency with the recorded wait spans: the report's wait is
+        # exactly the snapshot's pool_wait sum (the reader's primary stage)
+        snapshot = reader.telemetry_snapshot()
+        pool_wait = snapshot['histograms'].get('pool_wait', {}).get('sum', 0.0)
+        assert report['wait_seconds'] == pytest.approx(pool_wait, abs=1e-4)
+        assert report['efficiency'] == pytest.approx(
+            1.0 - min(pool_wait / report['elapsed_s'], 1.0), abs=1e-3)
+        diag = reader.diagnostics
+        assert diag['slo']['target_efficiency'] == 0.9
+        metrics_url = reader.metrics_url
+    # stop() tears the endpoint down
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(metrics_url + '/healthz', timeout=2)
+
+
+def test_reader_without_metrics_port_serves_nothing(tmp_path):
+    url = _store(tmp_path / 'store', rows=20)
+    with make_reader(url, num_epochs=1) as reader:
+        assert reader.metrics_url is None
+        sum(1 for _ in reader)
+
+
+def test_loader_efficiency_report(tmp_path):
+    from petastorm_tpu.parallel.loader import JaxDataLoader
+    url = _store(tmp_path / 'store', rows=64)
+    reader = make_reader(url, num_epochs=1)
+    loader = JaxDataLoader(reader, batch_size=16, device_put=False,
+                           metrics_port=0)
+    try:
+        batches = sum(1 for _ in loader)
+        assert batches == 4
+        report = loader.efficiency_report()
+        assert report['primary_wait_stage'] == 'shuffle_wait'
+        assert 0.0 <= report['efficiency'] <= 1.0
+        body = _get(loader.metrics_url + '/metrics')
+        assert 'petastorm_tpu_shuffle_wait_count' in body
+    finally:
+        loader.stop()
+        reader.join()
+
+
+# ---------------------------------------------------------------------------
+# cost profiler
+# ---------------------------------------------------------------------------
+
+def test_percentile_nearest_rank_deterministic():
+    values = [1.0, 2.0, 3.0, 4.0, 100.0]
+    assert percentile(values, 0.5) == 3.0
+    assert percentile(values, 0.95) == 100.0
+    assert percentile([], 0.5) == 0.0
+
+
+def test_cost_ledger_ingest_ranking_what_if_and_persistence(tmp_path):
+    ledger = CostLedger('token01')
+    piece_map = {0: ('a.parquet', 0), 1: ('b.parquet', 0), 2: ('c.parquet', 0)}
+
+    def span(piece, name, dur_s, field=None):
+        return {'pid': 1, 'tid': 1, 'ts_us': 0.0, 'dur_us': dur_s * 1e6,
+                'ph': 'X', 'name': name, 'ctx': [0, piece, 0],
+                'args': {'field': field} if field else None}
+
+    events = [
+        span(0, 'rowgroup_read', 0.010), span(0, 'decode', 0.010),
+        span(0, 'decode_field', 0.008, field='image'),
+        span(1, 'rowgroup_read', 0.010), span(1, 'decode', 0.010),
+        span(2, 'rowgroup_read', 0.200), span(2, 'decode', 1.000),
+        span(2, 'decode_field', 0.900, field='image'),
+        # noise the ledger must ignore: instants, unmapped pieces, other stages
+        {'pid': 1, 'tid': 1, 'ts_us': 0.0, 'dur_us': 0.0, 'ph': 'i',
+         'name': 'quarantine', 'ctx': [0, 0, 0], 'args': None},
+        span(7, 'decode', 5.0),
+        span(0, 'shuffle', 5.0),
+    ]
+    ingested = ledger.ingest_trace({'events': events}, piece_map)
+    assert ingested == 8
+    assert len(ledger) == 3
+    ranking = ledger.ranking(2)
+    assert ranking[0]['rowgroup'] == 'c.parquet#0'
+    assert ranking[0]['seconds'] == pytest.approx(1.2)
+    assert ranking[0]['top_fields'][0] == {'field': 'image', 'seconds': 0.9}
+    what_if = ledger.what_if()
+    assert what_if, 'expected what-if rows'
+    by_scope = {row['scope']: row for row in what_if}
+    # total: costs [0.02, 0.02, 1.2] -> p95 = 1.2, median = 0.02:
+    # capping the outlier at the median saves (1.24 - 0.06) / 1.24
+    assert by_scope['total']['saving_fraction'] == pytest.approx(
+        (1.24 - 0.06) / 1.24, abs=1e-3)
+    assert by_scope['total']['skew_p95_over_median'] == pytest.approx(60.0)
+
+    # persistence: atomic save -> reload -> identical what-if ranking
+    path = str(tmp_path / 'ledger.json')
+    ledger.save(path)
+    assert not [name for name in os.listdir(str(tmp_path))
+                if '.tmp.' in name], 'temp file leaked'
+    reloaded = CostLedger.load(path)
+    assert reloaded.to_dict() == ledger.to_dict()
+    assert reloaded.what_if() == what_if
+    assert reloaded.ranking(3) == ledger.ranking(3)
+
+    # merge is additive and token-guarded
+    reloaded.merge(ledger)
+    assert reloaded.total_seconds() == pytest.approx(
+        2 * ledger.total_seconds())
+    with pytest.raises(ValueError):
+        reloaded.merge(CostLedger('other_token'))
+
+
+def test_default_ledger_path_rules(tmp_path):
+    assert default_ledger_path('file:///data/set', 'tok') == \
+        '/data/set/_petastorm_tpu_costs_tok.json'
+    assert default_ledger_path('/data/set', 'tok') == \
+        '/data/set/_petastorm_tpu_costs_tok.json'
+    assert default_ledger_path('s3://bucket/set', 'tok') is None
+    assert default_ledger_path('s3://bucket/set', 'tok',
+                               cache_location=str(tmp_path)) == \
+        os.path.join(str(tmp_path), '_petastorm_tpu_costs_tok.json')
+
+
+def test_reader_cost_ledger_from_traced_read(tmp_path):
+    url = _store(tmp_path / 'store', rows=100, rows_per_file=25,
+                 with_vec=True)
+    tracing.reset_tracing()
+    with make_reader(url, num_epochs=1, trace=True,
+                     shuffle_row_groups=False) as reader:
+        for _ in reader.iter_columnar():
+            pass
+        ledger = reader.cost_ledger()
+        token = reader.dataset_token
+    tracing.set_trace_enabled(False)
+    tracing.reset_tracing()
+    assert ledger.dataset_token == token
+    assert len(ledger) == 4  # 4 part files -> 4 rowgroups
+    assert ledger.total_seconds() > 0
+    # per-field decode costs arrived from the decode plan's traced kernels
+    fields = {f['field'] for row in ledger.ranking(4)
+              for f in row['top_fields']}
+    assert 'vec' in fields
+
+
+def test_costs_cli_persists_and_reports(tmp_path, capsys):
+    from petastorm_tpu.telemetry.cost_model import main as costs_main
+    url = _store(tmp_path / 'store', rows=50, rows_per_file=25)
+    ledger_path = str(tmp_path / 'costs.json')
+    assert costs_main([url, '--ledger', ledger_path, '--workers', '1',
+                       '--json']) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['rowgroups'] == 2
+    assert doc['ledger_path'] == ledger_path
+    first_total = doc['total_seconds']
+    # second run merges into the persisted ledger (cost history accumulates)
+    assert costs_main([url, '--ledger', ledger_path, '--workers', '1',
+                       '--json']) == 0
+    doc2 = json.loads(capsys.readouterr().out)
+    assert doc2['rowgroups'] == 2
+    assert doc2['total_seconds'] > first_total
+    # --no-read inspects without profiling
+    assert costs_main([url, '--ledger', ledger_path, '--no-read']) == 0
+    out = capsys.readouterr().out
+    assert 'per-rowgroup cost ledger' in out
+
+
+def test_attribute_bottleneck_grows_what_if_rows():
+    from petastorm_tpu.telemetry.analyze import (attribute_bottleneck,
+                                                 format_report)
+    ledger = CostLedger('tok')
+    events = [{'pid': 1, 'tid': 1, 'ts_us': 0.0, 'dur_us': 1e6, 'ph': 'X',
+               'name': 'decode', 'ctx': [0, 0, 0], 'args': None}]
+    ledger.ingest_trace({'events': events}, {0: ('a.parquet', 0)})
+    snapshot = _wait_snapshot(pool_wait=1.0)
+    report = attribute_bottleneck(snapshot, cost_ledger=ledger)
+    assert report['what_if']
+    assert 'what-if' in format_report(report)
+    assert attribute_bottleneck(snapshot)['what_if'] == []
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics plane (dispatcher + workers + reader)
+# ---------------------------------------------------------------------------
+
+def test_dispatcher_worker_metrics_seq_guard_and_departure():
+    from petastorm_tpu.service.dispatcher import Dispatcher
+    from petastorm_tpu.service.wire import WorkerDescriptor
+    dispatcher = Dispatcher()
+    # an unregistered worker's frame is dropped (departed-worker straggler)
+    dispatcher.record_worker_metrics(0, 1, {'counters': {'items': 1}})
+    assert dispatcher.worker_metrics_snapshots() == {}
+    dispatcher.scheduler.add_worker(
+        b'w0', WorkerDescriptor(worker_id=0, pid=1, host='h'))
+    dispatcher.record_worker_metrics(0, 2, {'counters': {'items': 5}})
+    dispatcher.record_worker_metrics(0, 1, {'counters': {'items': 1}})
+    assert dispatcher.worker_metrics_snapshots()['0']['counters']['items'] \
+        == 5
+    merged = dispatcher.fleet_metrics_snapshot()
+    assert merged['counters']['items'] == 5
+    assert 'service_workers' in merged['gauges']
+    # departure drops the entry, and a straggler frame cannot resurrect it
+    dispatcher._depart_worker(b'w0', reason='left')
+    assert dispatcher.worker_metrics_snapshots() == {}
+    dispatcher.record_worker_metrics(0, 3, {'counters': {'items': 9}})
+    assert dispatcher.worker_metrics_snapshots() == {}
+
+
+def test_fleet_scrape_surface_acceptance(tmp_path):
+    """Acceptance: a live fleet (dispatcher + 2 workers + 1 reader) serves
+    valid Prometheus text on /metrics including per-worker-labeled fleet
+    metrics aggregated from heartbeat deltas."""
+    from petastorm_tpu.service.fleet import ServiceFleet
+    url = _store(tmp_path / 'store', rows=200, rows_per_file=25)
+    with ServiceFleet(workers=2, metrics_port=0,
+                      heartbeat_interval_s=0.2) as fleet:
+        metrics_url = fleet.dispatcher.metrics_url
+        assert metrics_url is not None
+        with make_reader(url, service_url=fleet.service_url,
+                         num_epochs=1) as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == 200
+            # the workers ship their registry snapshots every few heartbeats
+            deadline = time.monotonic() + 30
+            body = ''
+            while time.monotonic() < deadline:
+                body = _get(metrics_url + '/metrics')
+                if 'petastorm_tpu_worker_decode_count{' in body:
+                    break
+                time.sleep(0.25)
+            _assert_valid_exposition(body)
+            # fleet-wide aggregate (merged worker snapshots + scheduler gauges)
+            assert 'petastorm_tpu_decode_count' in body
+            assert 'petastorm_tpu_service_workers 2' in body
+            # per-worker labeled series
+            assert re.search(
+                r'petastorm_tpu_worker_decode_count\{worker="\d+"\}', body)
+            # per-client labeled state gauges (the reader is still connected)
+            assert 'petastorm_tpu_service_client_served{client=' in body
+        health = json.loads(_get(metrics_url + '/healthz'))
+        assert health['workers'] == 2
+        varsdoc = json.loads(_get(metrics_url + '/vars'))
+        assert set(varsdoc['labeled']['worker']) <= {'0', '1'}
+        # a killed worker's series leave the scrape surface
+        fleet.kill_worker(0)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if len(fleet.dispatcher.worker_metrics_snapshots()) <= 1:
+                break
+            time.sleep(0.25)
+        assert len(fleet.dispatcher.worker_metrics_snapshots()) <= 1

@@ -50,6 +50,10 @@ BAD_FIXTURES = [
      ['watchdog_repa', 'TRACE_INSTANTS', 'decodee']),
     ('telemetry/bad_knob.py', ['telemetry-names'], 2,
      ['pool_wrokers', 'KNOB_IDS', 'ventilator_max_inflight']),
+    ('telemetry/bad_gauge.py', ['telemetry-names'], 2,
+     ['slo_efficienzy', 'GAUGES', 'service_queue_depht']),
+    ('telemetry/bad_cost/telemetry/cost_model.py', ['telemetry-names'], 1,
+     ['rowgroup_reed', 'COST_STAGES']),
     ('clock/bad', ['clock-discipline'], 1, ['time.monotonic']),
     ('exceptions/bad_swallow.py', ['exception-hygiene'], 1, ['swallows']),
     ('exceptions/workers/bad_worker_swallow.py', ['exception-hygiene'], 1,
@@ -70,12 +74,16 @@ BAD_FIXTURES = [
      ["b'w_result_v2'", "b'w_result'"]),
     ('protocol/service_bad_descriptor/wire.py', ['protocol-conformance'], 2,
      ["'host'", "'hostname'"]),
+    ('protocol/service_bad_metrics', ['protocol-conformance'], 2,
+     ["b'w_metrics'", "b'w_metricz'"]),
 ]
 
 GOOD_FIXTURES = [
     ('telemetry/good_stage.py', ['telemetry-names']),
     ('telemetry/good_instant.py', ['telemetry-names']),
     ('telemetry/good_knob.py', ['telemetry-names']),
+    ('telemetry/good_gauge.py', ['telemetry-names']),
+    ('telemetry/good_cost/telemetry/cost_model.py', ['telemetry-names']),
     ('clock/good', ['clock-discipline']),
     ('exceptions/good_swallow.py', ['exception-hygiene']),
     ('locks/good_lock.py', ['lock-discipline']),
@@ -105,6 +113,7 @@ def test_known_good_fixture_is_clean(path, rules):
     ('telemetry/suppressed_stage.py', ['telemetry-names']),
     ('telemetry/suppressed_instant.py', ['telemetry-names']),
     ('telemetry/suppressed_knob.py', ['telemetry-names']),
+    ('telemetry/suppressed_gauge.py', ['telemetry-names']),
     ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
     ('protocol/service_suppressed_kinds', ['protocol-conformance']),
 ])
