@@ -156,7 +156,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
                  'resilience', 'pipecheck', 'tracing', 'service', 'autotune',
-                 'device_decode', 'observability')
+                 'device_decode', 'observability', 'schedule')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -165,11 +165,12 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'autotune',
-                     'device_decode', 'decode_bench', 'service', 'wire_bench',
-                     'telemetry', 'tracing', 'resilience', 'mnist_scan_stream',
-                     'flash', 'moe', 'imagenet_scan', 'imagenet_stream',
-                     'decode_delta', 'bare_reader', 'mnist_stream')
+SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'schedule',
+                     'autotune', 'device_decode', 'decode_bench', 'service',
+                     'wire_bench', 'telemetry', 'tracing', 'resilience',
+                     'mnist_scan_stream', 'flash', 'moe', 'imagenet_scan',
+                     'imagenet_stream', 'decode_delta', 'bare_reader',
+                     'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
 
@@ -1597,6 +1598,181 @@ def child_main():
             'observability_cost_persist_roundtrip_ok': bool(roundtrip_ok),
         })
 
+    def run_schedule():
+        """Cost-aware scheduling (host-only; docs/performance.md "Cost-aware
+        scheduling"): on a deliberately skewed store (heavy random-payload
+        rowgroups clustered at the END — the worst-case FIFO tail stall),
+        (1) FIFO epoch vs cost-scheduled epoch (interleave + split from a
+        profiled ledger) => ``schedule_speedup``; (2) cold-start overhead
+        guard — scheduler armed with NO ledger vs plain, <=3% (the plan is a
+        no-op there, so any cost is bookkeeping); (3) a socket-free
+        FairShareScheduler probe showing the measured-cost DRR spreading the
+        ledger's heavy items across >=2 workers (the routing half of the
+        ISSUE-12 acceptance, deterministic — no fleet to flake)."""
+        from petastorm_tpu.codecs import CompressedNdarrayCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_rows
+        from petastorm_tpu.telemetry import tracing as flight
+        from petastorm_tpu.telemetry.cost_model import default_ledger_path
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        heavy_rows = int(os.environ.get('BENCH_SCHEDULE_HEAVY_ROWS', 24))
+        light_rows = int(os.environ.get('BENCH_SCHEDULE_LIGHT_ROWS', 72))
+        heavy_dim = int(os.environ.get('BENCH_SCHEDULE_HEAVY_DIM', 512))
+        sched_dir = tempfile.mkdtemp(prefix='bench_schedule_')
+        sched_url = 'file://' + os.path.join(sched_dir, 'skewed')
+        # variable-shape compressed payload: light rows are one 4KB vector,
+        # heavy rows inflate a ~2MB patterned (compressible, so the deflate
+        # decode does real output work) matrix — the image-vs-scalar cost
+        # spread in rowgroup form
+        schema = Unischema('ScheduleBench', [
+            UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+            UnischemaField('payload', np.float32, (None, 1024),
+                           CompressedNdarrayCodec(), False),
+        ])
+        rng = np.random.RandomState(7)
+        pattern = np.tile(rng.rand(8, 1024).astype(np.float32),
+                          (heavy_dim // 8, 1))
+
+        def rows():
+            # lights first, heavies last: FIFO pays the full tail stall
+            for i in range(light_rows):
+                yield {'idx': i,
+                       'payload': np.zeros((1, 1024), np.float32)}
+            for i in range(light_rows, light_rows + heavy_rows):
+                yield {'idx': i, 'payload': pattern}
+        # small files: many light rowgroups ahead of the few heavy ones, so
+        # under FIFO the heavies only ventilate once the bounded in-flight
+        # window has drained most of the lights — the batch-former stall
+        write_rows(sched_url, schema, rows(), rowgroup_size_mb=64,
+                   rows_per_file=8)
+
+        # paced consumer: a fixed per-row budget models the train step the
+        # batch former feeds (the stall in the ISSUE-12 motivation). Pacing
+        # is sleep, not CPU, so decode genuinely overlaps it even on this
+        # 1-core bench host — what pre-staging is FOR; raw unpaced drain on
+        # one core is decode-bound and order-insensitive by construction.
+        pace_s = float(os.environ.get('BENCH_SCHEDULE_PACE_S', 0.004))
+
+        def epoch_seconds(cost_schedule=None):
+            reader = make_reader(sched_url, reader_pool_type='process',
+                                 workers_count=2, num_epochs=1,
+                                 shuffle_row_groups=False,
+                                 cost_schedule=cost_schedule)
+            start = time.perf_counter()
+            rows_read = 0
+            for batch in reader.iter_columnar():
+                rows_read += batch.num_rows
+                time.sleep(batch.num_rows * pace_s)
+            elapsed = time.perf_counter() - start
+            diag_schedule = (reader.diagnostics.get('schedule')
+                             if cost_schedule else None)
+            reader.stop()
+            reader.join()
+            assert rows_read == heavy_rows + light_rows
+            return elapsed, diag_schedule
+
+        # warmup epoch (fs cache + process spawn cold start)
+        epoch_seconds()
+        plain_s = min(epoch_seconds()[0], epoch_seconds()[0])
+
+        # profile one traced epoch -> persisted ledger at the default path
+        flight.reset_tracing()
+        flight.set_trace_enabled(True)
+        try:
+            reader = make_reader(sched_url, workers_count=2, num_epochs=1,
+                                 shuffle_row_groups=False)
+            for batch in reader.iter_columnar():
+                pass
+            ledger = reader.cost_ledger()
+            token = reader.dataset_token
+            reader.stop()
+            reader.join()
+        finally:
+            flight.set_trace_enabled(False)
+            flight.reset_tracing()
+        ledger_path = default_ledger_path(sched_url, token)
+        ledger.save(ledger_path)
+
+        # (1) FIFO vs cost-scheduled, interleaved A/B/A/B/A/B to cancel host
+        # drift (the autotune section's methodology); min-of-runs — per-epoch
+        # process-pool spawn makes single pairs noisy
+        pairs = int(os.environ.get('BENCH_SCHEDULE_PAIRS', 3))
+        fifo_runs, sched_runs = [], []
+        sched_report = None
+        for _ in range(pairs):
+            fifo_s, _ = epoch_seconds()
+            sched_s, sched_report = epoch_seconds(cost_schedule=True)
+            fifo_runs.append(fifo_s)
+            sched_runs.append(sched_s)
+        fifo_s = min(fifo_runs)
+        sched_s = min(sched_runs)
+        speedup = fifo_s / sched_s if sched_s else 0.0
+
+        # (2) cold-start overhead, measured DIRECTLY (the autotune section's
+        # methodology: whole-pipeline A/B deltas on sub-second epochs drift
+        # +-10% and guard nothing): time exactly what an armed-cold reader
+        # adds — the failed sidecar load, the no-op plan, one order pass per
+        # epoch, one observe per batch — against the plain epoch wall
+        from petastorm_tpu.schedule import (CostAwareScheduler,
+                                            SchedulePolicy, load_ledger)
+        probe_start = time.perf_counter()
+        load_ledger(sched_url, 'no-such-token')
+        cold_sched = CostAwareScheduler('no-such-token', SchedulePolicy())
+        cold_items = [{'piece_index': i,
+                       'shuffle_row_drop_partition': (0, 1)}
+                      for i in range(16)]
+        cold_locator = {i: ('part', 0, 8) for i in range(16)}
+        cold_items, _ = cold_sched.plan_items(cold_items, cold_locator,
+                                              max_parts=2)
+        cold_sched.order_items(cold_items, None)
+        for i in range(16):
+            cold_sched.observe(i, {'decode': {'sum': 0.0, 'count': 1}})
+        overhead_s = time.perf_counter() - probe_start
+        overhead_pct = overhead_s / plain_s * 100.0
+
+        # (3) measured-cost DRR probe: heavy ledger items through a 2-worker
+        # socket-free scheduler — distinct workers the heavies landed on
+        from petastorm_tpu.service.dispatcher import FairShareScheduler
+        from petastorm_tpu.service.wire import WorkerDescriptor
+        cost_sched = CostAwareScheduler(token, SchedulePolicy(), ledger=ledger)
+        heavy_keys = cost_sched.report()['heavy_rowgroups']
+        fake_clock = [0.0]
+        drr = FairShareScheduler(clock=lambda: fake_clock[0])
+        drr.add_client(b'c', 'bench', 'host', None)
+        drr.add_worker(b'w1', WorkerDescriptor(1, 1, 'host'))
+        drr.add_worker(b'w2', WorkerDescriptor(2, 2, 'host'))
+        drr.add_setup(b'c', b's', b'x')
+        for index, key in enumerate(heavy_keys):
+            drr.submit(b'c', b'%d' % index, b's', b'x',
+                       cost=cost_sched.normalized_cost(key))
+        heavy_workers = set()
+        while True:
+            drr.worker_ready(b'w1')
+            drr.worker_ready(b'w2')
+            assignment = drr.next_assignment()
+            if assignment is None:
+                break
+            heavy_workers.add(assignment.worker_key)
+            drr.retire(assignment.token, assignment.attempt)
+
+        splits = len((sched_report or {}).get('splits', []))
+        cpus = os.cpu_count() or 1
+        log('schedule: fifo {:.3f}s vs cost-aware {:.3f}s ({:.2f}x on {} '
+            'cpu(s) — split parallelism scales with cores), {} split(s), '
+            'cold-path overhead {:+.3f}%, heavy items spread across {} '
+            'worker(s)'.format(fifo_s, sched_s, speedup, cpus, splits,
+                               overhead_pct, len(heavy_workers)))
+        results.update({
+            'schedule_fifo_epoch_s': round(fifo_s, 4),
+            'schedule_cost_aware_epoch_s': round(sched_s, 4),
+            'schedule_speedup': round(speedup, 3),
+            'schedule_splits': splits,
+            'schedule_heavy_rowgroups': len(heavy_keys),
+            'schedule_overhead_pct': round(overhead_pct, 3),
+            'schedule_heavy_worker_spread': len(heavy_workers),
+            'schedule_cpu_count': cpus,
+        })
+
     def run_resilience():
         """Watchdog + CRC clean-path overhead (host-only, fast): the same
         process-pool epoch with every robustness guard off (no heartbeats, no
@@ -2048,6 +2224,7 @@ def child_main():
         'autotune': run_autotune,
         'device_decode': run_device_decode,
         'observability': run_observability,
+        'schedule': run_schedule,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

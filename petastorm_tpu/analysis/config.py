@@ -38,8 +38,13 @@ DESCRIPTOR_FILES: Tuple[str, ...] = ('shm_ring.py', 'wire.py')
 #: modules under the injectable-clock discipline: direct ``time.time()`` /
 #: ``time.monotonic()`` / ``time.perf_counter()`` calls are findings — retry,
 #: backoff, deadline and breaker arithmetic must flow through the injected
-#: ``clock``/``sleep`` callables so tests stay deterministic (PR-4 discipline)
-CLOCK_DISCIPLINED_FILES: Tuple[str, ...] = ('resilience.py',)
+#: ``clock``/``sleep`` callables so tests stay deterministic (PR-4
+#: discipline). ``cost_schedule.py`` is here for a sharper reason: the
+#: cost-aware schedule must be a pure function of (ledger, policy, seed) —
+#: a wall-clock read anywhere in it would make epoch order irreproducible
+#: (docs/performance.md "Cost-aware scheduling").
+CLOCK_DISCIPLINED_FILES: Tuple[str, ...] = ('resilience.py',
+                                            'cost_schedule.py')
 
 #: directory name marking worker/data-plane process code, where the
 #: exception-hygiene bar is highest: a broad except that can swallow needs an
@@ -50,7 +55,7 @@ WORKER_DIR: str = 'workers'
 #: ``raise BaseException(...)`` are findings (use the errors.py taxonomy)
 DATAPATH_FILES: Tuple[str, ...] = ('reader_worker.py', 'reader.py',
                                    'cache.py', 'fs_utils.py',
-                                   'resilience.py')
+                                   'resilience.py', 'cost_schedule.py')
 
 #: where the telemetry stage/counter catalog lives (path suffix); the rule
 #: falls back to the installed ``petastorm_tpu.telemetry.spans`` when the

@@ -40,6 +40,7 @@ KNOB_IDS: Tuple[str, ...] = (
     'loader_device_buffer',       # loader: device decode-tail ring depth
     'service_admission_window',   # dispatcher: per-client admission cap
     'service_client_window',      # dispatcher: live per-client in-flight depth
+    'schedule_interleave',        # cost-aware heavy/light ventilation interleave
 )
 
 #: actuation costs: ``cheap`` knobs act instantly, ``moderate`` knobs take a
@@ -273,6 +274,24 @@ def build_reader_knobs(reader: Any) -> List[Knob]:
             stages=('cache_hit',), unit='flag',
             get=lambda: float(bool(cache.bypass)),
             apply=lambda v: float(cache.set_bypass(v >= 0.5))))
+    scheduler = getattr(reader, '_cost_scheduler', None)
+    if (scheduler is not None and hasattr(scheduler, 'set_interleave')
+            and getattr(scheduler, 'live_reorder', False)):
+        # the cost-aware interleave half is a live toggle (next epoch
+        # reorder); splits are frozen at construction — they shaped the
+        # work-item list — so only the interleave is hill-climbable, and
+        # only on readers that actually reorder each epoch (live_reorder:
+        # a static-order reader never reads the toggle again, and the
+        # controller must not hill-climb a dead knob). The breaker board
+        # interlocks this knob like every other (docs/autotuning.md).
+        knobs.append(Knob(
+            'schedule_interleave',
+            'cost-balanced heavy/light ventilation interleave '
+            '(0=plain order, 1=interleaved)',
+            minimum=0.0, maximum=1.0, step=1.0, cost='cheap',
+            stages=('pool_wait', 'shuffle_wait'), unit='flag',
+            get=lambda: float(bool(scheduler.interleave)),
+            apply=lambda v: float(scheduler.set_interleave(v >= 0.5))))
     if (cache is not None and hasattr(cache, 'set_writable_hits')
             and getattr(reader, '_transform_spec', None) is None
             and not getattr(cache, 'writable_hits_pinned', False)):

@@ -123,7 +123,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 retry_policy=None, shm_transport=None, item_deadline_s=None,
                 heartbeat_interval_s=None, trace=None, service_url=None,
                 autotune=None, device_decode_fields=None, metrics_port=None,
-                slo_policy=None):
+                slo_policy=None, cost_schedule=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -214,7 +214,21 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     ``slo_policy`` sets the input-efficiency SLO
     (:class:`~petastorm_tpu.telemetry.slo.SloPolicy`, a float target, or
     None = the default 0.9 target) evaluated by
-    :meth:`Reader.efficiency_report` / ``diagnostics['slo']``."""
+    :meth:`Reader.efficiency_report` / ``diagnostics['slo']``.
+
+    Cost-aware scheduling (docs/performance.md "Cost-aware scheduling"):
+    ``cost_schedule`` consumes the persisted per-rowgroup cost ledger
+    (``petastorm-tpu-throughput costs``) to interleave heavy and light
+    rowgroups deterministically (same seed + same ledger => same order on
+    every pool), split oversized rowgroups into sub-range work items, and
+    pre-stage predicted-slow items — ``True`` (default policy), a
+    :class:`~petastorm_tpu.schedule.SchedulePolicy`, or a ledger path
+    string. With no persisted ledger the read is byte-identical to an
+    unscheduled reader (cold start) while live cost observations accumulate
+    and persist at ``stop()`` for the next run. Unset (None, the default)
+    builds no scheduler and keeps every path byte-identical. Not compatible
+    with ``resume_state`` (a re-planned schedule would shift the
+    checkpoint's item coordinates)."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -278,7 +292,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   retry_policy=retry_policy,
                   initial_io_retries=construction_retries[0],
                   autotune=autotune, device_decode_fields=device_decode_fields,
-                  metrics_port=metrics_port, slo_policy=slo_policy)
+                  metrics_port=metrics_port, slo_policy=slo_policy,
+                  cost_schedule=cost_schedule)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -294,13 +309,13 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       retry_policy=None, shm_transport=None, item_deadline_s=None,
                       heartbeat_interval_s=None, trace=None, service_url=None,
                       autotune=None, device_decode_fields=None,
-                      metrics_port=None, slo_policy=None):
+                      metrics_port=None, slo_policy=None, cost_schedule=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
     ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
-    ``service_url`` / ``autotune`` / ``metrics_port`` / ``slo_policy``
-    behave exactly as in :func:`make_reader`.
+    ``service_url`` / ``autotune`` / ``metrics_port`` / ``slo_policy`` /
+    ``cost_schedule`` behave exactly as in :func:`make_reader`.
     ``device_decode_fields`` (docs/performance.md "Device-resident decode
     tail") requires the store's Unischema codec registry: on a Unischema
     store the named fields ship their raw codec payloads (container stripped)
@@ -376,7 +391,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   retry_policy=retry_policy,
                   initial_io_retries=construction_retries[0],
                   autotune=autotune, device_decode_fields=device_decode_fields,
-                  metrics_port=metrics_port, slo_policy=slo_policy)
+                  metrics_port=metrics_port, slo_policy=slo_policy,
+                  cost_schedule=cost_schedule)
 
 
 class Reader(object):
@@ -391,7 +407,7 @@ class Reader(object):
                  storage_options=None, filesystem=None, resume_state=None,
                  on_error='raise', retry_policy=None, initial_io_retries=0,
                  autotune=None, device_decode_fields=None, metrics_port=None,
-                 slo_policy=None):
+                 slo_policy=None, cost_schedule=None):
         from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
         retry_policy = resolve_retry_policy(on_error, retry_policy)
         construction_retries = [initial_io_retries]
@@ -625,6 +641,61 @@ class Reader(object):
                     'shuffle_row_drop_partition': (drop_part, shuffle_row_drop_partitions),
                 })
 
+        # -------------------------------------------- cost-aware scheduling
+        # (docs/performance.md "Cost-aware scheduling"): load the persisted
+        # per-rowgroup cost ledger, split oversized rowgroups into sub-range
+        # work items, and pick the epoch ventilation order — all frozen here
+        # (pure function of ledger + seed), so the order never depends on
+        # runtime timing. Unset => nothing is built, every path byte-identical.
+        #: piece index -> (fragment_path, row_group_id), incl. the virtual
+        #: pieces of split rowgroups — what cost_ledger() attributes with
+        self._piece_locator = {index: (rg.fragment_path, rg.row_group_id)
+                               for index, rg in enumerate(shard_row_groups)}
+        self._cost_scheduler = None
+        order_fn = None
+        from petastorm_tpu.schedule import resolve_schedule_policy
+        schedule_policy = resolve_schedule_policy(cost_schedule)
+        if schedule_policy is not None:
+            if resume_state is not None:
+                raise ValueError(
+                    'cost_schedule cannot be combined with resume_state: a '
+                    're-planned schedule (ledger-driven splits) would shift '
+                    'the work-item coordinates the checkpoint refers to — '
+                    'resume without cost_schedule')
+            from petastorm_tpu.schedule import CostAwareScheduler, load_ledger
+            url_for_ledger = dataset_url_or_urls if not isinstance(
+                dataset_url_or_urls, list) else dataset_url_or_urls[0]
+            ledger, ledger_path = load_ledger(
+                url_for_ledger, self.dataset_token,
+                cache_location=getattr(cache, '_path', None),
+                ledger_path=schedule_policy.ledger_path)
+            self._cost_scheduler = CostAwareScheduler(
+                self.dataset_token, schedule_policy, ledger=ledger,
+                ledger_path=ledger_path)
+            locator = {index: (rg.fragment_path, rg.row_group_id,
+                               rg.row_group_num_rows)
+                       for index, rg in enumerate(shard_row_groups)}
+            # NGram windows span rows — interleave applies, splitting never.
+            # Split parts cap at the pool's worker count (sub-ranges re-pay
+            # the rowgroup read, so parts beyond the parallelism are
+            # overhead), floored at 2: even a 1-worker pool benefits from a
+            # p99 rowgroup publishing incrementally, and the floor keeps the
+            # plan identical across equally-shaped pool/service topologies.
+            items, _virtual = self._cost_scheduler.plan_items(
+                items, locator, allow_split=ngram is None,
+                max_parts=max(2, int(getattr(reader_pool, 'workers_count',
+                                             1) or 1)))
+            # ONE source of truth for piece->rowgroup attribution (virtual
+            # split pieces included): the scheduler's own plan map
+            self._piece_locator = self._cost_scheduler.piece_locator()
+            if shuffle_row_groups:
+                order_fn = self._cost_scheduler.order_items
+                self._cost_scheduler.live_reorder = True
+            else:
+                # no per-epoch shuffle: one static cost-balanced order,
+                # identical every epoch (the FIFO analog of the seeded path)
+                items = self._cost_scheduler.order_items(items, None)
+
         # ---------------------------------------------- checkpoint / resume
         # Consumption is tracked at work-item (rowgroup x drop-partition) granularity:
         # every item yields exactly one ColumnarBatch, tagged with its absolute epoch and
@@ -663,8 +734,15 @@ class Reader(object):
             skip_ids_by_iteration=skip_by_iteration,
             item_id_fn=_item_id,
             reset_iterations=num_epochs,
-            tag_epoch=True)
+            tag_epoch=True,
+            order_fn=order_fn)
         self._pool = reader_pool
+        if (self._cost_scheduler is not None
+                and hasattr(reader_pool, 'set_cost_hint_fn')):
+            # service path: ship the measured cost with every submit so the
+            # dispatcher's DRR charges real cost and routes heavy items to
+            # the least-loaded workers (docs/performance.md)
+            reader_pool.set_cost_hint_fn(self._cost_scheduler.cost_hint_for)
         if on_error == 'skip' and hasattr(reader_pool, 'set_hang_result_factory'):
             # Per-item-deadline watchdog hook (docs/robustness.md): when the pool
             # reaps a hung worker, the overdue rowgroup is quarantined — an empty
@@ -828,6 +906,13 @@ class Reader(object):
             # cross-process span merge: the sidecar is a {stage: hist_snapshot}
             # dict (additive, so respawned workers merge like any other)
             self._telemetry.merge_stage_times(stage_times)
+            if self._cost_scheduler is not None:
+                # live cost feed (docs/performance.md "Cost-aware scheduling"):
+                # a batch's sidecar holds the stage time of (mostly) its own
+                # rowgroup — fold it into the live ledger persisted at stop()
+                scheduled_id = getattr(batch, 'item_id', None)
+                if scheduled_id is not None:
+                    self._cost_scheduler.observe(scheduled_id[1], stage_times)
         breakers = getattr(batch, 'breakers', None)
         if breakers:
             with self._accounting_lock:
@@ -902,6 +987,21 @@ class Reader(object):
         replays from that window (window-exact under a seeded shuffle, since the
         per-piece window order is then reproducible).
         """
+        if (self._cost_scheduler is not None
+                and self._cost_scheduler.split_count):
+            # A split plan's work items carry row_range coordinates a resumed
+            # reader cannot reconstruct (resume rejects cost_schedule, and an
+            # unscheduled resume would match a parent piece id against the
+            # unsplit item — silently skipping the rowgroup's other
+            # sub-ranges). Refuse loudly rather than emit a checkpoint that
+            # loses rows. Interleave-only plans (no splits) checkpoint fine:
+            # their item coordinates are identical to an unscheduled reader's.
+            raise ValueError(
+                'state_dict() is not supported on a cost-scheduled reader '
+                'whose plan split rowgroups ({} split(s)): the sub-range '
+                'work-item coordinates cannot be resumed. Checkpoint with '
+                'cost_schedule disabled, or a SchedulePolicy(split=False).'
+                .format(self._cost_scheduler.split_count))
         cursor = None
         if isinstance(self._results_reader, (_RowResultsReader, _NGramResultsReader)):
             # NGram: the work-item unit is identical; the cursor's row index counts
@@ -1010,9 +1110,9 @@ class Reader(object):
         from petastorm_tpu.telemetry.tracing import trace_snapshot
         if ledger is None:
             ledger = CostLedger(self.dataset_token)
-        piece_map = {index: (rg.fragment_path, rg.row_group_id)
-                     for index, rg in enumerate(self._shard_row_groups)}
-        ledger.ingest_trace(trace_snapshot(), piece_map)
+        # the piece locator covers the virtual pieces of split rowgroups too,
+        # so a scheduled read attributes sub-range costs to the parent rowgroup
+        ledger.ingest_trace(trace_snapshot(), dict(self._piece_locator))
         return ledger
 
     # ------------------------------------------------------- metrics plane
@@ -1088,6 +1188,14 @@ class Reader(object):
             # the controller must stop turning knobs before the pool they
             # actuate starts tearing down
             self._autotune.stop()
+        if self._cost_scheduler is not None:
+            # hand this run's live cost observations to the next one
+            # (best-effort: a read must never fail over its bookkeeping)
+            try:
+                self._cost_scheduler.persist()
+            except Exception:  # noqa: BLE001 - ledger persistence is advisory; the read itself already succeeded
+                logger.warning('could not persist the cost ledger',
+                               exc_info=True)
         self._pool.stop()
 
     def join(self):
@@ -1144,6 +1252,9 @@ class Reader(object):
         # diagnostics stay byte-identical to the seed.
         if self._autotune is not None:
             diag['autotune'] = self._autotune.report()
+        # Cost-aware schedule block only when armed, same contract.
+        if self._cost_scheduler is not None:
+            diag['schedule'] = self._cost_scheduler.report()
         return diag
 
     def __enter__(self):

@@ -197,7 +197,8 @@ class RowGroupWorker(WorkerBase):
         self.publish_func(payload)
 
     def process(self, piece_index, fragment_path, row_group_id, partition_keys=None,
-                worker_predicate=None, shuffle_row_drop_partition=(0, 1), epoch_index=0):
+                worker_predicate=None, shuffle_row_drop_partition=(0, 1), epoch_index=0,
+                row_range=None):
         # Causal trace context (docs/observability.md "Flight recorder"): every
         # span/instant this thread records while the item is processed — publish
         # and serialize included, they run inside this call — is tagged
@@ -209,12 +210,14 @@ class RowGroupWorker(WorkerBase):
         try:
             return self._process_item(piece_index, fragment_path, row_group_id,
                                       partition_keys, worker_predicate,
-                                      shuffle_row_drop_partition, epoch_index)
+                                      shuffle_row_drop_partition, epoch_index,
+                                      row_range)
         finally:
             clear_trace_context()
 
     def _process_item(self, piece_index, fragment_path, row_group_id, partition_keys,
-                      worker_predicate, shuffle_row_drop_partition, epoch_index):
+                      worker_predicate, shuffle_row_drop_partition, epoch_index,
+                      row_range=None):
         setup = self._setup
         # (absolute_epoch, piece, drop_partition): the epoch tag lets the reader attribute
         # this result to the right epoch even when completions interleave across an epoch
@@ -258,6 +261,11 @@ class RowGroupWorker(WorkerBase):
             return result
 
         if setup.ngram is not None:
+            if row_range is not None:
+                # the scheduler never splits NGram readers (windows span rows);
+                # a range reaching this path is a wiring bug, not a data fault
+                raise ValueError('row_range sub-range items are not supported '
+                                 'on the NGram path')
             try:
                 payload = with_retry(lambda: self._process_ngram(
                     piece_index, fragment_path, row_group_id, partition_keys,
@@ -280,7 +288,8 @@ class RowGroupWorker(WorkerBase):
 
             def load():
                 return self._load_and_decode(fragment_path, row_group_id, partition_keys,
-                                             worker_predicate, shuffle_row_drop_partition)
+                                             worker_predicate, shuffle_row_drop_partition,
+                                             row_range=row_range)
 
             cache_hit = None
             if predicate_token is None:
@@ -291,6 +300,12 @@ class RowGroupWorker(WorkerBase):
                 cache_key = '{}:{}:{}:{}:{}'.format(
                     setup.dataset_token, fragment_path, row_group_id,
                     shuffle_row_drop_partition, predicate_token)
+                if row_range is not None:
+                    # a sub-range item caches its own slice; appended only when
+                    # the scheduler split this rowgroup, so every whole-rowgroup
+                    # key (and cache already on disk) stays exactly as before
+                    cache_key += ':rr{}-{}'.format(int(row_range[0]),
+                                                   int(row_range[1]))
                 filled = [False]
 
                 def fill():
@@ -364,7 +379,8 @@ class RowGroupWorker(WorkerBase):
                 if name not in self._setup.partition_field_names]
 
     def _load_and_decode(self, fragment_path, row_group_id, partition_keys,
-                         worker_predicate, shuffle_row_drop_partition):
+                         worker_predicate, shuffle_row_drop_partition,
+                         row_range=None):
         setup = self._setup
         all_fields = setup.fields_to_read
         if worker_predicate is not None:
@@ -383,6 +399,15 @@ class RowGroupWorker(WorkerBase):
         # py_dict_reader_worker.py:290-306).
         part_index, num_parts = shuffle_row_drop_partition
         base_indices = np.arange(num_rows) if keep_indices is None else np.asarray(keep_indices)
+        if row_range is not None:
+            # Sub-range work item (docs/performance.md "Cost-aware scheduling"):
+            # restrict to the PHYSICAL row positions [start, stop) before the
+            # drop-partition split, so the scheduler's sub-ranges of one
+            # rowgroup partition its rows exactly (predicate filtering
+            # composes: keep_indices are physical positions too).
+            start, stop = int(row_range[0]), int(row_range[1])
+            base_indices = base_indices[(base_indices >= start)
+                                        & (base_indices < stop)]
         if num_parts > 1:
             selected = np.array_split(base_indices, num_parts)[part_index]
         else:

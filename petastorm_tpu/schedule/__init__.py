@@ -1,0 +1,18 @@
+"""Cost-aware sample scheduling (docs/performance.md "Cost-aware
+scheduling"): consume the persisted per-rowgroup
+:class:`~petastorm_tpu.telemetry.cost_model.CostLedger` to interleave heavy
+and light rowgroups deterministically, split oversized rowgroups into
+sub-range work items, pre-stage predicted-slow items, and price service
+submits for the dispatcher's measured-cost DRR. Armed with
+``make_reader(cost_schedule=...)``; off by default (byte-identical path)."""
+
+from petastorm_tpu.schedule.cost_schedule import (MAX_COST_HINT,
+                                                  MIN_COST_HINT,
+                                                  CostAwareScheduler,
+                                                  SchedulePolicy, load_ledger,
+                                                  plan_preview,
+                                                  resolve_schedule_policy)
+
+__all__ = ['CostAwareScheduler', 'SchedulePolicy', 'load_ledger',
+           'plan_preview', 'resolve_schedule_policy', 'MIN_COST_HINT',
+           'MAX_COST_HINT']

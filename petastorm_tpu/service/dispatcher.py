@@ -41,12 +41,14 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import math
 import pickle
 import threading
 import time
 from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
 
-from petastorm_tpu.service.wire import WorkerDescriptor
+from petastorm_tpu.service.wire import (MAX_COST_HINT, MIN_COST_HINT,
+                                        WorkerDescriptor, decode_cost)
 
 logger = logging.getLogger(__name__)
 
@@ -73,9 +75,23 @@ MSG_W_NEED_SETUP, MSG_W_LEAVE = b'w_need_setup', b'w_leave'
 
 #: default per-client in-flight window (queued + assigned) before ``busy``
 DEFAULT_ADMISSION_WINDOW = 16
-#: default DRR quantum (work items per scheduling visit; items are rowgroups,
-#: so unit cost is the right granularity)
+#: default DRR quantum (deficit credit per scheduling visit). Items are
+#: charged their MEASURED cost when the submit carries a cost hint from the
+#: client's cost-aware scheduler (docs/performance.md "Cost-aware
+#: scheduling") and unit cost otherwise — so with hints, a client burning
+#: heavy rowgroups is served proportionally fewer of them per round.
 DEFAULT_QUANTUM = 1.0
+#: clamp for submit cost hints: one pathological ledger entry must neither
+#: monopolize the deficit budget nor make an item effectively free. The
+#: bounds are wire.py's MIN_COST_HINT/MAX_COST_HINT (aliased above): a
+#: two-sided wire contract — the client scheduler prices into the SAME
+#: range, and one shared constant keeps the sides from drifting apart.
+MIN_ITEM_COST = MIN_COST_HINT
+MAX_ITEM_COST = MAX_COST_HINT
+#: a (clamped, median-relative) item cost at or above this routes via the
+#: least-loaded ready worker instead of FIFO — heavy rowgroups spread across
+#: the fleet instead of piling onto whichever worker asked first
+HEAVY_ITEM_COST = 2.0
 #: how long a worker's heartbeat stamp may go unchanged before it counts as
 #: departed (floored at 4x its own declared heartbeat interval, the same
 #: jitter margin the in-process watchdog enforces)
@@ -123,7 +139,7 @@ class _WorkerState(object):
     """Dispatcher-side record of one registered decode worker."""
 
     __slots__ = ('key', 'descriptor', 'assigned', 'known_setups',
-                 'hb_seq', 'hb_changed_at')
+                 'hb_seq', 'hb_changed_at', 'cost_in_flight', 'cost_served')
 
     def __init__(self, key: bytes, descriptor: WorkerDescriptor,
                  now: float) -> None:
@@ -133,16 +149,20 @@ class _WorkerState(object):
         self.known_setups: Set[bytes] = set()
         self.hb_seq = -1
         self.hb_changed_at = now
+        #: measured cost currently assigned / retired on this worker — the
+        #: least-loaded routing signal for heavy items (module constants)
+        self.cost_in_flight = 0.0
+        self.cost_served = 0.0
 
 
 class _TokenState(object):
     """One submitted work item, alive until done-acked (or failed)."""
 
     __slots__ = ('token', 'client_key', 'client_token', 'setup_id', 'blob',
-                 'attempt', 'worker_key', 'delivered', 'shm_ok')
+                 'attempt', 'worker_key', 'delivered', 'shm_ok', 'cost')
 
     def __init__(self, token: int, client_key: bytes, client_token: bytes,
-                 setup_id: bytes, blob: bytes) -> None:
+                 setup_id: bytes, blob: bytes, cost: float = 1.0) -> None:
         self.token = token
         self.client_key = client_key
         self.client_token = client_token
@@ -151,6 +171,9 @@ class _TokenState(object):
         self.attempt = 0
         self.worker_key: Optional[bytes] = None
         self.delivered = False
+        #: measured (median-relative) cost charged by the DRR; 1.0 when the
+        #: submit carried no hint — the historical uniform-unit behavior
+        self.cost = cost
         #: cleared on the first shm delivery failure (``shm_fail``): the
         #: redelivery must ride plain wire frames — a false co-location match
         #: (same hostname, different namespaces) would otherwise loop forever
@@ -350,9 +373,11 @@ class FairShareScheduler(object):
                 client.last_seen = self._clock()
 
     def submit(self, client_key: bytes, client_token: bytes, setup_id: bytes,
-               blob: bytes) -> Optional[int]:
+               blob: bytes, cost: float = 1.0) -> Optional[int]:
         """Admission-checked submit: returns the global token, or None when
-        the client's window is full (the caller replies ``busy``)."""
+        the client's window is full (the caller replies ``busy``). ``cost``
+        is the client's measured-cost hint (clamped; 1.0 = the historical
+        uniform unit) — what the DRR charges and the heavy-routing keys on."""
         with self._lock:
             client = self._clients.get(client_key)
             if client is None:
@@ -364,8 +389,9 @@ class FairShareScheduler(object):
                 return None
             token = self._next_token
             self._next_token += 1
+            cost = max(MIN_ITEM_COST, min(MAX_ITEM_COST, float(cost)))
             self._tokens[token] = _TokenState(token, client_key, client_token,
-                                              setup_id, blob)
+                                              setup_id, blob, cost=cost)
             client.queue.append(token)
             if client.key not in self._active:
                 self._active.append(client.key)
@@ -480,14 +506,20 @@ class FairShareScheduler(object):
         ready worker for it, or None when either side is empty.
 
         Each visit to the head-of-rotation client serves it if its deficit
-        covers one item, else tops the deficit up by ``quantum`` and rotates —
-        so over any window, every client with pending work is served in
-        proportion to its quantum, regardless of submit rate (deficit round
-        robin with unit item cost)."""
+        covers its head item's MEASURED cost, else tops the deficit up by
+        ``quantum`` and rotates — so over any window, every client with
+        pending work is served in proportion to its quantum, and a client
+        burning heavy rowgroups is served proportionally fewer of them
+        (deficit round robin; unit cost when no submit hint was shipped —
+        the historical behavior). Heavy items (cost >= ``HEAVY_ITEM_COST``)
+        route via the least-loaded ready worker instead of FIFO."""
         with self._lock:
             if not self._ready_workers:
                 return None
-            guard = 2 * len(self._active) + 1
+            # a heavy head item needs up to ceil(MAX/quantum) deficit top-ups;
+            # the guard must allow that many full rotations before giving up
+            guard = ((1 + int(math.ceil(MAX_ITEM_COST / self.quantum)))
+                     * (len(self._active) + 1))
             while self._active and guard > 0:
                 guard -= 1
                 key = self._active[0]
@@ -497,28 +529,30 @@ class FairShareScheduler(object):
                     if client is not None:
                         client.deficit = 0.0
                     continue
-                if client.deficit < 1.0:
+                state = self._tokens.get(client.queue[0])
+                if state is None:  # superseded while queued
+                    client.queue.popleft()
+                    continue
+                cost = state.cost
+                if client.deficit < cost:
                     client.deficit += self.quantum
-                    if client.deficit < 1.0:
+                    if client.deficit < cost:
                         self._active.rotate(-1)
                         continue
-                worker_key = self._pick_worker()
+                worker_key = self._pick_worker(cost)
                 if worker_key is None:
                     return None
-                client.deficit -= 1.0
+                client.deficit -= cost
                 token = client.queue.popleft()
                 if not client.queue:
                     self._active.popleft()
                     client.deficit = 0.0
                 else:
                     self._active.rotate(-1)
-                state = self._tokens.get(token)
-                if state is None:  # superseded while queued
-                    self._ready_workers.appendleft(worker_key)
-                    continue
                 worker = self._workers[worker_key]
                 state.worker_key = worker_key
                 worker.assigned.add(token)
+                worker.cost_in_flight += cost
                 client.assigned.add(token)
                 self._assign_time[token] = self._clock()
                 colocated = (worker.descriptor.shm_results
@@ -537,7 +571,26 @@ class FairShareScheduler(object):
                                   setup_blob)
             return None
 
-    def _pick_worker(self) -> Optional[bytes]:
+    def _pick_worker(self, cost: float = 1.0) -> Optional[bytes]:
+        """The ready worker for one item: FIFO for ordinary items (the
+        historical order), least-loaded — smallest (in-flight cost, retired
+        cost) — for heavy ones, so consecutive heavy rowgroups spread across
+        the fleet instead of piling onto whichever worker asked first."""
+        if cost >= HEAVY_ITEM_COST and len(self._ready_workers) > 1:
+            best_key: Optional[bytes] = None
+            best_score: Optional[Tuple[float, float]] = None
+            for key in self._ready_workers:
+                worker = self._workers.get(key)
+                if worker is None:
+                    continue
+                score = (worker.cost_in_flight, worker.cost_served)
+                if best_score is None or score < best_score:
+                    best_key, best_score = key, score
+            if best_key is not None:
+                self._ready_workers.remove(best_key)
+                return best_key
+            self._ready_workers.clear()
+            return None
         while self._ready_workers:
             key = self._ready_workers.popleft()
             if key in self._workers:
@@ -588,6 +641,10 @@ class FairShareScheduler(object):
             if worker is not None:
                 worker.known_setups.clear()
                 worker.assigned.discard(token)
+                state = self._tokens.get(token)
+                if state is not None:
+                    worker.cost_in_flight = max(0.0, worker.cost_in_flight
+                                                - state.cost)
             return self._bump_or_requeue(token)
 
     # --------------------------------------------------------- result flow
@@ -629,6 +686,9 @@ class FairShareScheduler(object):
                 worker = self._workers.get(state.worker_key)
                 if worker is not None:
                     worker.assigned.discard(token)
+                    worker.cost_in_flight = max(0.0, worker.cost_in_flight
+                                                - state.cost)
+                    worker.cost_served += state.cost
 
     def fail(self, token: int) -> Optional[Tuple[bytes, bytes]]:
         """Terminal worker error for an item: retire it and return the owning
@@ -645,6 +705,8 @@ class FairShareScheduler(object):
                 worker = self._workers.get(state.worker_key)
                 if worker is not None:
                     worker.assigned.discard(token)
+                    worker.cost_in_flight = max(0.0, worker.cost_in_flight
+                                                - state.cost)
             if client is None:
                 return None
             return state.client_key, state.client_token
@@ -664,6 +726,8 @@ class FairShareScheduler(object):
                 worker = self._workers.get(state.worker_key)
                 if worker is not None:
                     worker.assigned.discard(token)
+                    worker.cost_in_flight = max(0.0, worker.cost_in_flight
+                                                - state.cost)
             return self._bump_or_requeue(token)
 
     # ------------------------------------------------------------ snapshot
@@ -707,6 +771,8 @@ class FairShareScheduler(object):
                     'shm_results': w.descriptor.shm_results,
                     'assigned': len(w.assigned),
                     'heartbeat_age_s': round(now - w.hb_changed_at, 3),
+                    'cost_in_flight': round(w.cost_in_flight, 3),
+                    'cost_served': round(w.cost_served, 3),
                 } for w in self._workers.values()],
                 'clients': [{
                     'name': c.name,
@@ -1021,8 +1087,13 @@ class Dispatcher(object):
                 self._client_socket.send_multipart(
                     [identity, MSG_REJOIN, frames[2]])
                 return
+            # optional 6th frame: the client scheduler's measured-cost hint
+            # (docs/performance.md "Cost-aware scheduling"); absent => 1.0,
+            # the historical uniform unit cost
+            cost = decode_cost(bytes(frames[5])) if len(frames) >= 6 else 1.0
             token = self.scheduler.submit(identity, bytes(frames[2]),
-                                          bytes(frames[3]), frames[4])
+                                          bytes(frames[3]), frames[4],
+                                          cost=cost)
             # every submit reply carries the client's CURRENT window so live
             # clients adopt autotune retuning (a raised window admits more
             # in-flight work; a lowered one ends the busy churn immediately)

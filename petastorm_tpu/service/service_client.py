@@ -49,7 +49,7 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 from petastorm_tpu.errors import TransientIOError
 from petastorm_tpu.service.wire import (ShmResultDescriptor, client_endpoint,
-                                        host_token)
+                                        encode_cost, host_token)
 from petastorm_tpu.telemetry.registry import (MetricsRegistry,
                                               telemetry_enabled)
 from petastorm_tpu.workers import EmptyResultError, TimeoutWaitingForResultError
@@ -149,6 +149,12 @@ class ServicePool(object):
         #: token -> dilled kwargs; kept until the result is delivered so the
         #: item can be re-armed after transport failures
         self._items: Dict[int, bytes] = {}
+        #: optional measured-cost pricer installed by a cost-scheduled reader
+        #: (docs/performance.md "Cost-aware scheduling"); None => submits
+        #: carry no cost frame, the dispatcher charges the uniform unit
+        self._cost_hint_fn: Optional[Any] = None
+        #: token -> cost hint, dropped with the item
+        self._item_costs: Dict[int, float] = {}
         self._pending: Deque[int] = collections.deque()
         #: tokens submitted and not yet resolved by a result
         self._inflight: Set[int] = set()
@@ -241,6 +247,14 @@ class ServicePool(object):
             self._ventilator = ventilator
             self._ventilator.start()
 
+    def set_cost_hint_fn(self, fn: Any) -> None:
+        """Install the reader's cost pricer: ``fn(item_kwargs) -> float``
+        (median-relative measured cost). Every later submit ships the hint
+        so the dispatcher's DRR charges real cost and routes heavy items
+        least-loaded (docs/performance.md "Cost-aware scheduling"). Call
+        before ``start`` — pricing is read on the ventilation path."""
+        self._cost_hint_fn = fn
+
     def ventilate(self, **kwargs: Any) -> None:
         """Enqueue one work item locally; the consumer thread submits it to
         the dispatcher inside ``get_results`` (single-threaded socket use)."""
@@ -248,10 +262,21 @@ class ServicePool(object):
             raise RuntimeError('ServicePool is stopped')
         import dill
         blob = dill.dumps(kwargs)
+        cost: Optional[float] = None
+        if self._cost_hint_fn is not None:
+            try:
+                cost = float(self._cost_hint_fn(kwargs))
+            except Exception:  # noqa: BLE001 - a broken pricer must not drop the work item; it just rides uncosted
+                logger.warning('cost hint fn failed for piece %r; submitting '
+                               'uncosted', kwargs.get('piece_index'),
+                               exc_info=True)
+                cost = None
         with self._lock:
             token = self._next_token
             self._next_token += 1
             self._items[token] = blob
+            if cost is not None:
+                self._item_costs[token] = cost
             self._pending.append(token)
 
     # -------------------------------------------------------------- submits
@@ -275,8 +300,11 @@ class ServicePool(object):
                     continue
                 self._inflight.add(token)
                 self._await_ack[token] = now + self._response_timeout_s
-            self._socket.send_multipart(
-                [b'submit', b'%d' % token, self._setup_id, blob])
+                cost = self._item_costs.get(token)
+            frames = [b'submit', b'%d' % token, self._setup_id, blob]
+            if cost is not None:
+                frames.append(encode_cost(cost))
+            self._socket.send_multipart(frames)
 
     def _check_unacked(self) -> None:
         """Re-arm submits the dispatcher never acknowledged and record the
@@ -440,6 +468,7 @@ class ServicePool(object):
                 self._results_dropped += 1
                 return False
             del self._items[token]
+            self._item_costs.pop(token, None)
             self._inflight.discard(token)
             self._await_ack.pop(token, None)
         if self._ventilator is not None:

@@ -79,6 +79,36 @@ def worker_endpoint(service_url: str) -> str:
     return 'tcp://{}:{}'.format(host, port + WORKER_PORT_OFFSET)
 
 
+#: clamp for submit cost hints, shared by BOTH sides of the wire: the client
+#: scheduler prices items into this range and the dispatcher re-clamps and
+#: sizes its DRR guard from the same bound — one constant, so the two sides
+#: cannot drift apart (docs/performance.md "Cost-aware scheduling")
+MIN_COST_HINT = 0.25
+MAX_COST_HINT = 4.0
+
+
+def encode_cost(cost: float) -> bytes:
+    """Wire form of a ``submit``'s measured-cost hint (docs/performance.md
+    "Cost-aware scheduling"): the client's cost-aware scheduler prices each
+    work item in median-relative units and the dispatcher's DRR charges that
+    instead of a uniform unit cost. Plain decimal text, like the token and
+    attempt frames."""
+    return ('%.6f' % float(cost)).encode('ascii')
+
+
+def decode_cost(blob: bytes, default: float = 1.0) -> float:
+    """Parse a :func:`encode_cost` frame; a malformed or non-positive value
+    degrades to ``default`` (uniform cost) — a bad hint must never reject
+    the work item it rides on."""
+    try:
+        cost = float(blob)
+    except ValueError:
+        return default
+    if not cost > 0.0:
+        return default
+    return cost
+
+
 def host_token() -> str:
     """Co-location token compared between a client's hello and a worker's
     registration: equal tokens mean same host, so the one-shot shm result

@@ -208,6 +208,25 @@ def attribute_bottleneck(snapshot: Dict[str, Any],
               for name, total, count in leaves[:max(top_n, 1)]]
     advisories = _service_advisories(snapshot)
     what_if = list(cost_ledger.what_if()) if cost_ledger is not None else []
+    for row in what_if:
+        # exploitable per-rowgroup skew: the stage ranking cannot see it, and
+        # the fix is a knob, not a code change — say so
+        # (docs/performance.md "Cost-aware scheduling")
+        if (row.get('scope') == 'total'
+                and float(row.get('skew_p95_over_median', 1.0)) >= 2.0
+                and float(row.get('saving_fraction', 0.0)) >= 0.05):
+            advisories.append({
+                'signal': 'cost_skew_p95_over_median',
+                'value': float(row['skew_p95_over_median']),
+                'recommendation': 'enable cost-aware scheduling '
+                                  '(make_reader(cost_schedule=True))',
+                'detail': 'Per-rowgroup decode cost is skewed {}x '
+                          '(p95/median); the cost-aware scheduler would '
+                          'interleave, split and pre-stage the heavy '
+                          'rowgroups from this ledger — preview with '
+                          'petastorm-tpu-throughput costs --json.'
+                          .format(row['skew_p95_over_median'])})
+            break
     if not ranked:
         return {'total_stage_seconds': 0.0, 'ranked': [], 'envelopes': envelopes,
                 'top_stage': None, 'top_share': 0.0,

@@ -24,8 +24,10 @@ Consumers:
 - ``analyze.attribute_bottleneck(snapshot, cost_ledger=...)`` — the
   bottleneck report grows ``what_if`` rows ("if every rowgroup above the p95
   cost dropped to the median, total decode time −X%");
-- a future cost-aware scheduler reads the persisted ledger as-is
-  (ROADMAP.md).
+- the cost-aware scheduler (``petastorm_tpu/schedule/``) reads the persisted
+  ledger as-is: ``make_reader(cost_schedule=True)`` interleaves, splits and
+  routes from it (docs/performance.md "Cost-aware scheduling"), and the
+  ``costs --json`` output carries a ``schedule_preview`` of the plan.
 
 ``COST_STAGES`` declares which stage spans feed the ledger; pipecheck's
 telemetry-names rule checks it against the ``STAGES`` catalog so the
@@ -57,10 +59,13 @@ LEDGER_BASENAME = '_petastorm_tpu_costs_{token}.json'
 
 def percentile(sorted_values: List[float], q: float) -> float:
     """Deterministic nearest-rank percentile over an ASCENDING-sorted list
-    (``q`` in [0, 1]; empty input -> 0.0). Nearest-rank (not interpolated)
-    so persist → reload → recompute is bit-identical."""
+    (``q`` clamped into [0, 1]; empty input -> 0.0). Nearest-rank (not
+    interpolated) so persist → reload → recompute is bit-identical, and the
+    rank is double-clamped so a tiny population (a 1-entry ledger) can never
+    IndexError."""
     if not sorted_values:
         return 0.0
+    q = min(1.0, max(0.0, float(q)))
     rank = min(len(sorted_values) - 1,
                max(0, int(math.ceil(q * len(sorted_values))) - 1))
     return sorted_values[rank]
@@ -239,6 +244,19 @@ class CostLedger(object):
         for scope, costs in scopes:
             values = sorted(v for v in costs.values() if v > 0.0)
             if not values:
+                if not costs:
+                    continue
+                # every entry recorded zero for this scope (e.g. a ledger from
+                # a clock too coarse to time a trivial stage): emit an honest
+                # flat row — skew 1.0, nothing to save — instead of silently
+                # dropping the scope (or dividing by a zero median)
+                rows.append({
+                    'scope': scope, 'rowgroups': len(costs),
+                    'total_s': 0.0, 'median_s': 0.0, 'p95_s': 0.0,
+                    'skew_p95_over_median': 1.0, 'saving_fraction': 0.0,
+                    'detail': 'no {} cost recorded — flat distribution, '
+                              'nothing to reschedule'.format(scope),
+                })
                 continue
             total = sum(values)
             median = percentile(values, 0.5)
@@ -256,8 +274,11 @@ class CostLedger(object):
                 'total_s': round(total, 6),
                 'median_s': round(median, 6),
                 'p95_s': round(p95, 6),
+                # a flat (all-equal, incl. single-rowgroup) distribution is
+                # skew 1.0 by definition — never NaN/0.0, so dashboards can
+                # alert on "skew > threshold" without special cases
                 'skew_p95_over_median': round(p95 / median, 3)
-                if median else 0.0,
+                if median > 0.0 else 1.0,
                 'saving_fraction': round(saving, 4),
                 'detail': 'if every rowgroup above the p95 {} cost dropped '
                           'to the median, total {} time -{:.1%}'.format(
@@ -410,12 +431,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                                        workers=args.workers,
                                        ledger_path=args.ledger)
     if args.json:
+        # schedule_preview: what the cost-aware scheduler WOULD do with this
+        # ledger (planned interleave order + split decisions) so operators
+        # can inspect the plan without running an epoch
+        # (docs/performance.md "Cost-aware scheduling")
+        from petastorm_tpu.schedule import plan_preview
         print(json.dumps({'ledger_path': path,
                           'dataset_token': ledger.dataset_token,
                           'rowgroups': len(ledger),
                           'total_seconds': round(ledger.total_seconds(), 6),
                           'ranking': ledger.ranking(args.top),
-                          'what_if': ledger.what_if()}))
+                          'what_if': ledger.what_if(),
+                          'schedule_preview': plan_preview(ledger)}))
     else:
         print(format_cost_report(ledger, top_n=args.top))
         print('ledger: {}'.format(path))

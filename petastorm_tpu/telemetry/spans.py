@@ -108,6 +108,7 @@ TRACE_INSTANTS = (
     'shm_fallback',        # a result rode the ZMQ wire while the shm ring was enabled
     'autotune_decision',   # the closed-loop autotuner proposed/committed/reverted/froze a knob change (controller)
     'slo_breach',          # input-efficiency fell below the SLO target (consumer; telemetry/slo.py)
+    'schedule_plan',       # the cost-aware scheduler planned one epoch's ventilation order (ventilator thread; schedule/cost_schedule.py)
 )
 
 #: declared gauge ids (``registry.gauge(name)`` call sites with literal

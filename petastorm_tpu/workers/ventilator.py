@@ -42,7 +42,8 @@ class ConcurrentVentilator(Ventilator):
     def __init__(self, ventilate_fn, items_to_ventilate, iterations=1,
                  max_ventilation_queue_size=None, randomize_item_order=False,
                  random_seed=None, pre_shuffle_count=0, skip_ids_by_iteration=None,
-                 item_id_fn=None, reset_iterations=None, tag_epoch=False):
+                 item_id_fn=None, reset_iterations=None, tag_epoch=False,
+                 order_fn=None):
         """Resume-from-checkpoint support: the RNG stream is advanced by
         ``pre_shuffle_count`` epoch-shuffles (reproducing the item order of the epoch
         being resumed); items whose ``item_id_fn(item)`` appears in
@@ -54,7 +55,10 @@ class ConcurrentVentilator(Ventilator):
         epochs even when completions interleave across an epoch boundary.
         ``reset_iterations`` is what :meth:`reset` restores (defaults to ``iterations``;
         a resumed reader passes its full ``num_epochs`` so reset keeps its documented
-        meaning)."""
+        meaning). ``order_fn(items, random_state) -> items`` replaces the plain seeded
+        shuffle at every reorder point (epoch starts and resume pre-shuffles) — the
+        cost-aware scheduler's hook (docs/performance.md "Cost-aware scheduling");
+        None (default) keeps the byte-identical ``random_state.shuffle`` path."""
         super().__init__(ventilate_fn)
         if iterations is not None and (not isinstance(iterations, int) or iterations < 1):
             raise ValueError('iterations must be a positive integer or None, got {!r}'
@@ -68,9 +72,10 @@ class ConcurrentVentilator(Ventilator):
                                             or len(self._items_to_ventilate) or 1)
         self._randomize_item_order = randomize_item_order
         self._random_state = np.random.RandomState(random_seed)
+        self._order_fn = order_fn
         if randomize_item_order:
             for _ in range(pre_shuffle_count):
-                self._random_state.shuffle(self._items_to_ventilate)
+                self._reorder()
         self._skip_ids_by_iteration = {int(k): set(v)
                                        for k, v in (skip_ids_by_iteration or {}).items()}
         self._item_id_fn = item_id_fn or (lambda item: None)
@@ -99,9 +104,19 @@ class ConcurrentVentilator(Ventilator):
                                         name='petastorm-tpu-ventilator')
         self._thread.start()
 
+    def _reorder(self):
+        """One epoch reorder: the custom ``order_fn`` when set (it receives the
+        RNG and consumes its stream exactly like the plain path), else the
+        reference's in-place seeded shuffle."""
+        if self._order_fn is not None:
+            self._items_to_ventilate = list(
+                self._order_fn(self._items_to_ventilate, self._random_state))
+        else:
+            self._random_state.shuffle(self._items_to_ventilate)
+
     def _ventilate(self):
         if self._randomize_item_order:
-            self._random_state.shuffle(self._items_to_ventilate)
+            self._reorder()
         while not self._stop_requested.is_set():
             if self._completed.is_set():
                 return
@@ -138,7 +153,7 @@ class ConcurrentVentilator(Ventilator):
                         self._completed.set()
                         return
                 if self._randomize_item_order:
-                    self._random_state.shuffle(self._items_to_ventilate)
+                    self._reorder()
 
     def processed_item(self):
         with self._item_processed:

@@ -1,0 +1,727 @@
+"""Cost-aware sample scheduling (docs/performance.md "Cost-aware
+scheduling"): scheduler units (interleave/split/pre-stage determinism, cost
+hints), the measured-cost DRR upgrade in the service dispatcher, ventilation
+determinism across every pool path, the no-ledger byte-identical regression
+pin, the cost-ledger tiny/flat edge cases, the `schedule_interleave` knob,
+and the `costs --json` schedule preview."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.schedule import (MAX_COST_HINT, MIN_COST_HINT,
+                                    CostAwareScheduler, SchedulePolicy,
+                                    load_ledger, plan_preview,
+                                    resolve_schedule_policy)
+from petastorm_tpu.service.dispatcher import (HEAVY_ITEM_COST,
+                                              FairShareScheduler)
+from petastorm_tpu.service.wire import (WorkerDescriptor, decode_cost,
+                                        encode_cost)
+from petastorm_tpu.telemetry.cost_model import (CostLedger,
+                                                default_ledger_path,
+                                                percentile)
+
+from test_common import create_test_dataset
+
+
+# --------------------------------------------------------------- helpers
+
+def build_ledger(token, costs, stage='decode'):
+    """A CostLedger with one ``stage`` cell per ``{rowgroup_key: seconds}``."""
+    ledger = CostLedger(token)
+    for key, seconds in costs.items():
+        entry = ledger._entry(key)
+        entry['stages'][stage] = {'count': 1, 'sum_s': float(seconds),
+                                  'max_s': float(seconds)}
+    return ledger
+
+
+def make_items(n, drop_parts=1):
+    return [{'piece_index': piece,
+             'fragment_path': 'frag.parquet',
+             'row_group_id': piece,
+             'shuffle_row_drop_partition': (drop, drop_parts)}
+            for piece in range(n) for drop in range(drop_parts)]
+
+
+def make_locator(n, rows=10):
+    return {piece: ('frag.parquet', piece, rows) for piece in range(n)}
+
+
+def scheduler_for(costs, policy=None, token='tok'):
+    ledger = build_ledger(token, costs) if costs else None
+    return CostAwareScheduler(token, policy or SchedulePolicy(),
+                              ledger=ledger)
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp('schedule') / 'dataset')
+    rows = create_test_dataset(url, num_rows=50)
+    return {'url': url, 'rows': rows}
+
+
+def read_item_order(url, ledger_expected=False, **kwargs):
+    """One epoch's batch item_ids in arrival order (+ the schedule report)."""
+    order = []
+    with make_reader(url, num_epochs=1, **kwargs) as reader:
+        for batch in reader.iter_columnar(include_empty=True):
+            order.append(batch.item_id)
+        report = reader.diagnostics.get('schedule')
+    if ledger_expected:
+        assert report is not None and not report['cold_start']
+    return order, report
+
+
+def profiled_ledger(url, scale_piece_to=None):
+    """Trace one epoch into a ledger; optionally inflate one rowgroup's
+    decode cost so interleave/split decisions trigger deterministically."""
+    from petastorm_tpu.telemetry import tracing
+    tracing.reset_tracing()
+    tracing.set_trace_enabled(True)
+    try:
+        with make_reader(url, workers_count=1, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            for _ in reader.iter_columnar():
+                pass
+            ledger = reader.cost_ledger()
+            token = reader.dataset_token
+    finally:
+        tracing.set_trace_enabled(False)
+        tracing.reset_tracing()
+    if scale_piece_to is not None:
+        key = sorted(ledger._entries)[0]
+        total = sum(cell['sum_s'] for entry in ledger._entries.values()
+                    for cell in entry['stages'].values())
+        cell = ledger._entries[key]['stages'].setdefault(
+            'decode', {'count': 1, 'sum_s': 0.0, 'max_s': 0.0})
+        cell['sum_s'] = scale_piece_to * max(total, 1e-3)
+    return ledger, token
+
+
+# ---------------------------------------------------------------- policy
+
+def test_resolve_policy_forms():
+    assert resolve_schedule_policy(None) is None
+    assert resolve_schedule_policy(False) is None
+    assert resolve_schedule_policy(True) == SchedulePolicy()
+    policy = SchedulePolicy(split=False)
+    assert resolve_schedule_policy(policy) is policy
+    assert resolve_schedule_policy('/x/ledger.json').ledger_path == \
+        '/x/ledger.json'
+    with pytest.raises(TypeError):
+        resolve_schedule_policy(3)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        SchedulePolicy(heavy_skew=1.0)
+    with pytest.raises(ValueError):
+        SchedulePolicy(split_threshold=1.5)  # < heavy_skew
+    with pytest.raises(ValueError):
+        SchedulePolicy(split_max=1)
+    with pytest.raises(ValueError):
+        SchedulePolicy(min_split_rows=0)
+
+
+# ------------------------------------------------------------ interleave
+
+def test_order_deterministic_same_seed_same_ledger():
+    costs = {'frag.parquet#{}'.format(i): (0.5 if i == 3 else 0.01)
+             for i in range(8)}
+    orders = []
+    for _ in range(2):
+        sched = scheduler_for(costs)
+        items, _ = sched.plan_items(make_items(8), make_locator(8),
+                                    max_parts=1)
+        ordered = sched.order_items(items, np.random.RandomState(11))
+        orders.append([item['piece_index'] for item in ordered])
+    assert orders[0] == orders[1]
+
+
+def test_order_no_ledger_bit_identical_to_plain_shuffle():
+    """Cold scheduler == the plain seeded shuffle, element for element (the
+    byte-identical no-ledger contract)."""
+    sched = scheduler_for(None)
+    items, _ = sched.plan_items(make_items(9), make_locator(9))
+    ordered = sched.order_items(list(items), np.random.RandomState(23))
+    expected = list(make_items(9))
+    np.random.RandomState(23).shuffle(expected)
+    assert [i['piece_index'] for i in ordered] == \
+        [i['piece_index'] for i in expected]
+
+
+def test_interleave_spreads_and_prestages_heavies():
+    costs = {'frag.parquet#{}'.format(i): 0.01 for i in range(12)}
+    costs['frag.parquet#10'] = 0.30   # heaviest
+    costs['frag.parquet#11'] = 0.20
+    sched = scheduler_for(costs, SchedulePolicy(split=False))
+    items, _ = sched.plan_items(make_items(12), make_locator(12))
+    ordered = sched.order_items(items, None)
+    pieces = [item['piece_index'] for item in ordered]
+    # pre-stage: the single heaviest rowgroup ventilates FIRST
+    assert pieces[0] == 10
+    # spread: the two heavies sit in different halves of the epoch
+    positions = sorted(pieces.index(p) for p in (10, 11))
+    assert positions[0] < len(pieces) // 2 <= positions[1]
+
+
+def test_interleave_toggle_restores_plain_order():
+    costs = {'frag.parquet#{}'.format(i): (1.0 if i == 0 else 0.01)
+             for i in range(6)}
+    sched = scheduler_for(costs, SchedulePolicy(split=False))
+    items, _ = sched.plan_items(make_items(6), make_locator(6))
+    assert sched.set_interleave(False) is False
+    plain = sched.order_items(list(items), np.random.RandomState(5))
+    expected = list(items)
+    np.random.RandomState(5).shuffle(expected)
+    assert [i['piece_index'] for i in plain] == \
+        [i['piece_index'] for i in expected]
+    sched.set_interleave(True)
+    interleaved = sched.order_items(list(items), np.random.RandomState(5))
+    assert interleaved[0]['piece_index'] == 0  # heavy pre-staged again
+
+
+# ----------------------------------------------------------------- split
+
+def test_split_plan_ranges_exhaustive_and_costed():
+    costs = {'frag.parquet#{}'.format(i): (0.9 if i == 2 else 0.01)
+             for i in range(5)}
+    sched = scheduler_for(costs)
+    items, virtual = sched.plan_items(make_items(5), make_locator(5, rows=10),
+                                      max_parts=4)
+    split_items = [item for item in items
+                   if item.get('row_range') is not None]
+    parts = len(split_items)
+    assert parts >= 2
+    # contiguous, exhaustive partition of the 10 rows
+    ranges = sorted(tuple(item['row_range']) for item in split_items)
+    assert ranges[0][0] == 0 and ranges[-1][1] == 10
+    for (a, b), (c, d) in zip(ranges, ranges[1:]):
+        assert b == c and b > a
+    # virtual pieces locate back to the parent rowgroup, cost divides
+    for piece in virtual:
+        assert virtual[piece] == ('frag.parquet', 2)
+    whole = sched.normalized_cost('frag.parquet#2')
+    for item in split_items:
+        assert sched._piece_costs[item['piece_index']] == \
+            pytest.approx(max(whole / parts,
+                              SchedulePolicy().heavy_skew))
+    assert sched.report()['splits'][0]['parts'] == parts
+
+
+def test_split_parts_keep_heavy_status():
+    """A rowgroup just past the split threshold must not demote its parts
+    below heavy_skew — that would drop exactly the targeted rowgroups out
+    of interleave/pre-stage/least-loaded routing."""
+    costs = {'frag.parquet#{}'.format(i): 1.0 for i in range(5)}
+    costs['frag.parquet#0'] = 4.5  # in the demotion band: 4.5/3 parts = 1.5
+    sched = scheduler_for(costs)
+    items, _ = sched.plan_items(make_items(5), make_locator(5, rows=12),
+                                max_parts=4)
+    split_pieces = [item['piece_index'] for item in items
+                    if item.get('row_range')]
+    assert len(split_pieces) >= 2
+    policy = SchedulePolicy()
+    for piece in split_pieces:
+        assert sched._piece_costs[piece] >= policy.heavy_skew
+        assert sched.cost_hint_for({'piece_index': piece}) >= \
+            policy.heavy_skew
+    # ...and the interleave therefore still pre-stages a split part first
+    ordered = sched.order_items(items, None)
+    assert ordered[0]['piece_index'] in split_pieces
+
+
+def test_split_respects_caps():
+    costs = {'frag.parquet#0': 5.0, 'frag.parquet#1': 0.01,
+             'frag.parquet#2': 0.01}
+    # worker-count cap: sub-ranges re-pay the rowgroup read
+    sched = scheduler_for(costs)
+    items, _ = sched.plan_items(make_items(3), make_locator(3), max_parts=2)
+    assert sum(1 for i in items if i.get('row_range')) == 2
+    # row floor: a rowgroup too small to split stays whole
+    sched = scheduler_for(costs, SchedulePolicy(min_split_rows=8))
+    items, _ = sched.plan_items(make_items(3), make_locator(3, rows=10))
+    assert not any(i.get('row_range') for i in items)
+    # allow_split=False (the NGram path) never splits
+    sched = scheduler_for(costs)
+    items, _ = sched.plan_items(make_items(3), make_locator(3),
+                                allow_split=False)
+    assert not any(i.get('row_range') for i in items)
+
+
+def test_cost_hint_clamped():
+    costs = {'frag.parquet#0': 100.0, 'frag.parquet#1': 0.001,
+             'frag.parquet#2': 1.0, 'frag.parquet#3': 1.0,
+             'frag.parquet#4': 1.0}
+    sched = scheduler_for(costs, SchedulePolicy(split=False))
+    sched.plan_items(make_items(5), make_locator(5))
+    assert sched.cost_hint_for({'piece_index': 0}) == MAX_COST_HINT
+    assert sched.cost_hint_for({'piece_index': 1}) == MIN_COST_HINT
+    assert sched.cost_hint_for({'piece_index': 99}) == 1.0
+    assert scheduler_for(None).cost_hint_for({'piece_index': 0}) == 1.0
+
+
+# -------------------------------------------------- live feed + persist
+
+def test_observe_and_persist_roundtrip(tmp_path):
+    path = str(tmp_path / 'ledger.json')
+    sched = CostAwareScheduler('tok', SchedulePolicy(), ledger=None,
+                               ledger_path=path)
+    sched.plan_items(make_items(2), make_locator(2))
+    sched.observe(0, {'decode': {'sum': 0.25, 'count': 1},
+                      'rowgroup_read': {'sum': 0.05, 'count': 1},
+                      'transform': {'sum': 9.0, 'count': 1}})  # not a COST stage
+    sched.observe(1, {'decode': {'sum': 0.01, 'count': 1}})
+    assert sched.persist() == path
+    reloaded = CostLedger.load(path)
+    assert reloaded.dataset_token == 'tok'
+    assert reloaded.rowgroup_cost('frag.parquet#0') == pytest.approx(0.30)
+    assert reloaded.rowgroup_cost('frag.parquet#1') == pytest.approx(0.01)
+    # second run merges additively into the same sidecar
+    sched2 = CostAwareScheduler('tok', SchedulePolicy(), ledger=reloaded,
+                                ledger_path=path)
+    sched2.plan_items(make_items(2), make_locator(2))
+    sched2.observe(0, {'decode': {'sum': 0.10, 'count': 1}})
+    assert sched2.persist() == path
+    assert CostLedger.load(path).rowgroup_cost('frag.parquet#0') == \
+        pytest.approx(0.40)
+    # nothing observed -> nothing written
+    sched3 = CostAwareScheduler('tok', SchedulePolicy(),
+                                ledger_path=str(tmp_path / 'other.json'))
+    assert sched3.persist() is None
+
+
+def test_persist_drains_no_double_merge(tmp_path):
+    """Reader.stop may run twice (stop() + __exit__): the second persist
+    must not fold the same observations into the sidecar again."""
+    path = str(tmp_path / 'ledger.json')
+    sched = CostAwareScheduler('tok', SchedulePolicy(), ledger_path=path)
+    sched.plan_items(make_items(1), make_locator(1))
+    sched.observe(0, {'decode': {'sum': 0.2, 'count': 1, 'max': 0.2}})
+    assert sched.persist() == path
+    assert sched.persist() is None  # drained
+    assert CostLedger.load(path).rowgroup_cost('frag.parquet#0') == \
+        pytest.approx(0.2)
+
+
+def test_live_ledger_max_is_span_max_not_run_total(tmp_path):
+    """max_s must be the largest SINGLE span (CostLedger.merge keeps
+    max(max_s) — an accumulated total would poison the sidecar forever)."""
+    path = str(tmp_path / 'ledger.json')
+    sched = CostAwareScheduler('tok', SchedulePolicy(), ledger_path=path)
+    sched.plan_items(make_items(1), make_locator(1))
+    for _ in range(10):
+        sched.observe(0, {'decode': {'sum': 0.1, 'count': 1, 'max': 0.1}})
+    cell = sched.live_ledger()._entries['frag.parquet#0']['stages']['decode']
+    assert cell['sum_s'] == pytest.approx(1.0)
+    assert cell['max_s'] == pytest.approx(0.1)
+
+
+def test_load_ledger_degrades_to_cold(tmp_path):
+    missing, path = load_ledger(str(tmp_path), 'tok')
+    assert missing is None and path is not None
+    # token mismatch -> cold, not an error
+    build_ledger('other', {'k#0': 1.0}).save(path)
+    ledger, _ = load_ledger(str(tmp_path), 'tok')
+    assert ledger is None
+    # corrupt sidecar -> cold, not an error
+    with open(path, 'w') as f:
+        f.write('{not json')
+    ledger, _ = load_ledger(str(tmp_path), 'tok')
+    assert ledger is None
+
+
+# ------------------------------------------- cost-ledger edge cases (sat)
+
+def test_percentile_tiny_and_clamped():
+    assert percentile([], 0.95) == 0.0
+    assert percentile([3.0], 0.95) == 3.0
+    assert percentile([3.0], 0.5) == 3.0
+    assert percentile([1.0, 2.0], 1.5) == 2.0   # q clamped high
+    assert percentile([1.0, 2.0], -1.0) == 1.0  # q clamped low
+
+
+def test_what_if_single_rowgroup_flat_skew():
+    ledger = build_ledger('tok', {'frag#0': 0.5})
+    rows = ledger.what_if()
+    total = next(row for row in rows if row['scope'] == 'total')
+    assert total['skew_p95_over_median'] == 1.0
+    assert total['saving_fraction'] == 0.0
+
+
+def test_what_if_all_equal_costs_skew_is_one():
+    ledger = build_ledger('tok', {'frag#{}'.format(i): 0.2
+                                  for i in range(4)})
+    for row in ledger.what_if():
+        assert row['skew_p95_over_median'] == 1.0
+        assert row['saving_fraction'] == 0.0
+
+
+def test_what_if_all_zero_costs_no_nan_no_crash():
+    ledger = build_ledger('tok', {'frag#0': 0.0, 'frag#1': 0.0})
+    rows = ledger.what_if()
+    total = next(row for row in rows if row['scope'] == 'total')
+    assert total['skew_p95_over_median'] == 1.0
+    assert total['total_s'] == 0.0
+    # ranking on the same degenerate ledger must not divide by zero either
+    assert ledger.ranking(5)[0]['share'] == 0.0
+
+
+# ----------------------------------------------------- measured-cost DRR
+
+class TestMeasuredCostDrr(object):
+    def _scheduler(self, **kwargs):
+        self.now = [0.0]
+        kwargs.setdefault('clock', lambda: self.now[0])
+        return FairShareScheduler(**kwargs)
+
+    def _drain(self, sched, workers, retire=True):
+        served = []
+        while True:
+            for key in workers:
+                sched.worker_ready(key)
+            assignment = sched.next_assignment()
+            if assignment is None:
+                return served
+            served.append(assignment)
+            if retire:
+                sched.retire(assignment.token, assignment.attempt)
+
+    def test_cost_frame_roundtrip_and_clamp(self):
+        assert decode_cost(encode_cost(2.5)) == 2.5
+        assert decode_cost(b'garbage') == 1.0
+        assert decode_cost(b'-3.0') == 1.0
+        sched = self._scheduler()
+        sched.add_client(b'c', 'c', 'h', None)
+        sched.add_worker(b'w', WorkerDescriptor(1, 1, 'h'))
+        token = sched.submit(b'c', b'0', b's', b'x', cost=100.0)
+        assert sched._tokens[token].cost == 4.0   # MAX_ITEM_COST clamp
+        token = sched.submit(b'c', b'1', b's', b'x')
+        assert sched._tokens[token].cost == 1.0   # no hint = uniform
+
+    def test_heavy_items_spread_across_workers(self):
+        """ISSUE-12 acceptance: measured-cost routing lands consecutive
+        heavy items on >= 2 distinct workers (FIFO ready order would not)."""
+        sched = self._scheduler()
+        sched.add_client(b'c', 'c', 'h', None)
+        sched.add_worker(b'w1', WorkerDescriptor(1, 1, 'h'))
+        sched.add_worker(b'w2', WorkerDescriptor(2, 2, 'h'))
+        for i in range(4):
+            sched.submit(b'c', b'%d' % i, b's', b'x',
+                         cost=HEAVY_ITEM_COST + 1.0)
+        served = self._drain(sched, (b'w1', b'w2'))
+        assert len(served) == 4
+        by_worker = {}
+        for assignment in served:
+            by_worker.setdefault(assignment.worker_key, 0)
+            by_worker[assignment.worker_key] += 1
+        assert len(by_worker) == 2
+        assert set(by_worker.values()) == {2}
+
+    def test_drr_serves_light_client_proportionally_more(self):
+        """A client of cost-4 items gets ~1 item per 4 cost-1 items of its
+        neighbor — measured-cost deficits, not per-item fairness."""
+        sched = self._scheduler()
+        sched.add_client(b'heavy', 'h', 'h', None)
+        sched.add_client(b'light', 'l', 'h', None)
+        sched.add_worker(b'w', WorkerDescriptor(1, 1, 'h'))
+        for i in range(4):
+            sched.submit(b'heavy', b'h%d' % i, b's', b'x', cost=4.0)
+        for i in range(16):
+            sched.submit(b'light', b'l%d' % i, b's', b'x', cost=1.0)
+        served = self._drain(sched, (b'w',))
+        # first 10 servings: the light client dominates 4:1 by item count
+        head = served[:10]
+        light = sum(1 for a in head if a.token >= 4)
+        heavy = len(head) - light
+        assert light >= 3 * heavy > 0
+        assert len(served) == 20  # everything drains eventually
+
+    def test_cost_accounting_survives_requeue_and_death(self):
+        sched = self._scheduler()
+        sched.add_client(b'c', 'c', 'h', None)
+        sched.add_worker(b'w1', WorkerDescriptor(1, 1, 'h'))
+        sched.submit(b'c', b'0', b's', b'x', cost=3.0)
+        sched.worker_ready(b'w1')
+        assignment = sched.next_assignment()
+        worker = sched._workers[b'w1']
+        assert worker.cost_in_flight == pytest.approx(3.0)
+        sched.requeue_token(assignment.token)
+        assert worker.cost_in_flight == 0.0
+        # redelivery to a fresh worker, then retire
+        sched.add_worker(b'w2', WorkerDescriptor(2, 2, 'h'))
+        sched.worker_ready(b'w2')
+        redelivered = sched.next_assignment()
+        assert redelivered is not None
+        sched.retire(redelivered.token, redelivered.attempt)
+        w2 = sched._workers[b'w2']
+        assert w2.cost_in_flight == 0.0
+        assert w2.cost_served == pytest.approx(3.0)
+        state = sched.state()
+        assert all('cost_served' in row for row in state['workers'])
+
+    def test_uniform_cost_path_unchanged(self):
+        """No hints anywhere: strict alternation between equally-backlogged
+        clients, exactly the PR-8 behavior."""
+        sched = self._scheduler()
+        sched.add_client(b'a', 'a', 'h', None)
+        sched.add_client(b'b', 'b', 'h', None)
+        sched.add_worker(b'w', WorkerDescriptor(1, 1, 'h'))
+        for i in range(4):
+            sched.submit(b'a', b'a%d' % i, b's', b'x')
+            sched.submit(b'b', b'b%d' % i, b's', b'x')
+        served = self._drain(sched, (b'w',))
+        owners = [a.token % 2 for a in served]
+        assert owners[:6] in ([0, 1, 0, 1, 0, 1], [1, 0, 1, 0, 1, 0])
+
+
+# ----------------------------------------------------- e2e: reader paths
+
+def test_no_ledger_order_pinned_and_identical_to_plain(dataset):
+    plain, _ = read_item_order(dataset['url'], reader_pool_type='dummy',
+                               shuffle_row_groups=True, seed=17)
+    cold, report = read_item_order(dataset['url'], reader_pool_type='dummy',
+                                   shuffle_row_groups=True, seed=17,
+                                   cost_schedule=True)
+    assert cold == plain
+    assert report['cold_start'] and not report['splits']
+    # regression pin: the exact seeded permutation of the piece indexes
+    pieces = sorted({piece for _epoch, piece, _drop in plain})
+    expected = list(pieces)
+    np.random.RandomState(17).shuffle(expected)
+    assert [piece for _epoch, piece, _drop in plain] == expected
+
+
+def test_scheduled_order_identical_across_pools(dataset):
+    """Same seed + same ledger => identical ventilation order on the
+    dummy, thread and process pool paths (1 worker each: arrival order IS
+    ventilation order)."""
+    ledger, token = profiled_ledger(dataset['url'], scale_piece_to=50.0)
+    path = default_ledger_path(dataset['url'], token)
+    ledger.save(path)
+    try:
+        orders = {}
+        for pool in ('dummy', 'thread', 'process'):
+            order, report = read_item_order(
+                dataset['url'], reader_pool_type=pool, workers_count=1,
+                shuffle_row_groups=True, seed=29, cost_schedule=True,
+                ledger_expected=True)
+            assert report['splits'], pool
+            orders[pool] = order
+        assert orders['dummy'] == orders['thread'] == orders['process']
+        # and NOT the plain shuffle: the interleave genuinely reordered
+        plain, _ = read_item_order(dataset['url'], reader_pool_type='dummy',
+                                   workers_count=1, shuffle_row_groups=True,
+                                   seed=29)
+        assert orders['dummy'] != plain
+    finally:
+        os.remove(path)
+
+
+def test_scheduled_service_path_order_and_rows(dataset):
+    """The service path ventilates in the same planned order (1-worker
+    fleet: strict FIFO through the DRR) with cost hints on the wire, and
+    every row arrives exactly once."""
+    zmq = pytest.importorskip('zmq')  # noqa: F841 - service transport needs it
+    from petastorm_tpu.service.fleet import ServiceFleet
+    ledger, token = profiled_ledger(dataset['url'], scale_piece_to=50.0)
+    path = default_ledger_path(dataset['url'], token)
+    ledger.save(path)
+    try:
+        expected, _ = read_item_order(
+            dataset['url'], reader_pool_type='dummy', workers_count=1,
+            shuffle_row_groups=True, seed=31, cost_schedule=True,
+            ledger_expected=True)
+        with ServiceFleet(workers=1) as fleet:
+            ids = []
+            got_rows = []
+            with make_reader(dataset['url'], service_url=fleet.service_url,
+                             num_epochs=1, shuffle_row_groups=True, seed=31,
+                             cost_schedule=True) as reader:
+                for batch in reader.iter_columnar(include_empty=True):
+                    ids.append(batch.item_id)
+                    if batch.num_rows:
+                        got_rows.extend(np.asarray(batch.columns['id']).tolist())
+                report = reader.diagnostics['schedule']
+        assert report['splits']
+        assert ids == expected
+        assert sorted(got_rows) == sorted(r['id'] for r in dataset['rows'])
+    finally:
+        os.remove(path)
+
+
+def test_split_rows_exact_with_predicate(dataset):
+    """Sub-range items compose with the two-phase predicate load: the
+    scheduled read returns exactly the rows the plain predicate read does."""
+    from petastorm_tpu.predicates import in_lambda
+    predicate = in_lambda(['id'], lambda id: id % 3 == 0)
+    ledger, token = profiled_ledger(dataset['url'], scale_piece_to=50.0)
+    path = default_ledger_path(dataset['url'], token)
+    ledger.save(path)
+    try:
+        def rows_of(**kwargs):
+            got = []
+            with make_reader(dataset['url'], reader_pool_type='dummy',
+                             num_epochs=1, shuffle_row_groups=False,
+                             predicate=predicate, **kwargs) as reader:
+                for batch in reader.iter_columnar():
+                    got.extend(np.asarray(batch.columns['id']).tolist())
+            return got
+        plain = rows_of()
+        scheduled = rows_of(cost_schedule=True)
+        assert sorted(scheduled) == sorted(plain)
+        assert plain  # the predicate actually selected something
+    finally:
+        os.remove(path)
+
+
+def test_multi_epoch_scheduled_orders_recorded(dataset):
+    ledger, token = profiled_ledger(dataset['url'], scale_piece_to=50.0)
+    path = default_ledger_path(dataset['url'], token)
+    ledger.save(path)
+    try:
+        with make_reader(dataset['url'], reader_pool_type='dummy',
+                         num_epochs=2, shuffle_row_groups=True, seed=3,
+                         cost_schedule=True) as reader:
+            for _ in reader.iter_columnar():
+                pass
+            report = reader.diagnostics['schedule']
+        assert len(report['epoch_orders']) == 2
+        # seeded per-epoch reshuffle: epochs differ, both interleaved
+        assert report['epoch_orders'][0] != report['epoch_orders'][1]
+    finally:
+        os.remove(path)
+
+
+def test_state_dict_blocked_only_under_splits(dataset):
+    """A split plan's checkpoint cannot be resumed (sub-range coordinates);
+    refuse loudly. Interleave-only and cold plans checkpoint fine."""
+    ledger, token = profiled_ledger(dataset['url'], scale_piece_to=50.0)
+    path = default_ledger_path(dataset['url'], token)
+    ledger.save(path)
+    try:
+        with make_reader(dataset['url'], reader_pool_type='dummy',
+                         num_epochs=1, shuffle_row_groups=False,
+                         cost_schedule=True) as reader:
+            assert reader.diagnostics['schedule']['splits']
+            with pytest.raises(ValueError, match='split'):
+                reader.state_dict()
+            for _ in reader.iter_columnar():
+                pass
+        with make_reader(dataset['url'], reader_pool_type='dummy',
+                         num_epochs=1, shuffle_row_groups=False,
+                         cost_schedule=SchedulePolicy(split=False)) as reader:
+            assert reader.state_dict()['items_per_epoch'] > 0
+            for _ in reader.iter_columnar():
+                pass
+    finally:
+        os.remove(path)
+
+
+def test_cost_schedule_rejects_resume_state(dataset):
+    with make_reader(dataset['url'], num_epochs=2,
+                     reader_pool_type='dummy') as reader:
+        for _ in reader.iter_columnar():
+            break
+        state = reader.state_dict()
+    with pytest.raises(ValueError, match='resume_state'):
+        make_reader(dataset['url'], num_epochs=2, reader_pool_type='dummy',
+                    resume_state=state, cost_schedule=True)
+
+
+def test_live_feed_persists_ledger_for_next_run(dataset, tmp_path):
+    """Cold-start reader observes real sidecar costs and persists them at
+    stop(); the next reader schedules from them (warm)."""
+    path = str(tmp_path / 'live_ledger.json')
+    _order, report = read_item_order(
+        dataset['url'], reader_pool_type='dummy', shuffle_row_groups=False,
+        cost_schedule=SchedulePolicy(ledger_path=path))
+    assert report['cold_start']
+    assert report['live_observations'] > 0
+    assert os.path.exists(path)
+    _order, report = read_item_order(
+        dataset['url'], reader_pool_type='dummy', shuffle_row_groups=False,
+        cost_schedule=SchedulePolicy(ledger_path=path), ledger_expected=True)
+    assert not report['cold_start']
+    assert report['ledger_rowgroups'] > 0
+
+
+# ------------------------------------------------------------------ knob
+
+def test_schedule_interleave_knob(dataset):
+    from petastorm_tpu.autotune.knobs import build_reader_knobs
+    ledger, token = profiled_ledger(dataset['url'], scale_piece_to=50.0)
+    path = default_ledger_path(dataset['url'], token)
+    ledger.save(path)
+    try:
+        with make_reader(dataset['url'], reader_pool_type='dummy',
+                         num_epochs=1, shuffle_row_groups=True, seed=1,
+                         cost_schedule=True) as reader:
+            knobs = {knob.knob_id: knob
+                     for knob in build_reader_knobs(reader)}
+            knob = knobs['schedule_interleave']
+            assert knob.get() == 1.0
+            assert knob.apply(0.0) == 0.0
+            assert reader._cost_scheduler.interleave is False
+            assert knob.apply(1.0) == 1.0
+            for _ in reader.iter_columnar():
+                pass
+    finally:
+        os.remove(path)
+
+
+def test_unscheduled_reader_has_no_schedule_knob(dataset):
+    from petastorm_tpu.autotune.knobs import build_reader_knobs
+    with make_reader(dataset['url'], reader_pool_type='dummy',
+                     num_epochs=1) as reader:
+        ids = [knob.knob_id for knob in build_reader_knobs(reader)]
+        assert 'schedule_interleave' not in ids
+        assert 'schedule' not in reader.diagnostics
+        for _ in reader.iter_columnar():
+            pass
+
+
+def test_static_order_reader_has_no_interleave_knob(dataset):
+    """shuffle_row_groups=False plans ONE static order — set_interleave
+    would never be read again, so the knob must not be offered to the
+    controller (it would hill-climb a dead toggle)."""
+    from petastorm_tpu.autotune.knobs import build_reader_knobs
+    with make_reader(dataset['url'], reader_pool_type='dummy',
+                     num_epochs=1, shuffle_row_groups=False,
+                     cost_schedule=True) as reader:
+        ids = [knob.knob_id for knob in build_reader_knobs(reader)]
+        assert 'schedule_interleave' not in ids
+        for _ in reader.iter_columnar():
+            pass
+
+
+# --------------------------------------------------------------- preview
+
+def test_plan_preview_cold_and_skewed():
+    cold = plan_preview(CostLedger('tok'))
+    assert cold['cold_start'] and cold['splits'] == []
+    skewed = plan_preview(build_ledger('tok', {
+        'frag#{}'.format(i): (2.0 if i == 0 else 0.02) for i in range(6)}))
+    assert not skewed['cold_start']
+    assert skewed['interleave_order'][0] == 'frag#0'
+    assert skewed['heavy'] == ['frag#0']
+    assert skewed['splits'][0]['rowgroup'] == 'frag#0'
+    assert skewed['splits'][0]['parts'] >= 2
+
+
+def test_costs_cli_json_has_schedule_preview(tmp_path, capsys):
+    from petastorm_tpu.telemetry.cost_model import main as costs_main
+    path = str(tmp_path / 'ledger.json')
+    build_ledger('tok', {'frag#{}'.format(i): (1.0 if i == 0 else 0.01)
+                         for i in range(5)}).save(path)
+    assert costs_main(['ignored-url', '--no-read', '--ledger', path,
+                       '--json']) == 0
+    doc = json.loads(capsys.readouterr().out)
+    preview = doc['schedule_preview']
+    assert preview['rowgroups'] == 5
+    assert preview['interleave_order'][0] == 'frag#0'
+    assert preview['splits'] and preview['policy']['split_threshold'] == 4.0
