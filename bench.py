@@ -156,7 +156,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
                  'resilience', 'pipecheck', 'tracing', 'service', 'autotune',
-                 'device_decode', 'observability', 'schedule')
+                 'device_decode', 'observability', 'schedule', 'lineage')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -165,12 +165,12 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'schedule',
-                     'autotune', 'device_decode', 'decode_bench', 'service',
-                     'wire_bench', 'telemetry', 'tracing', 'resilience',
-                     'mnist_scan_stream', 'flash', 'moe', 'imagenet_scan',
-                     'imagenet_stream', 'decode_delta', 'bare_reader',
-                     'mnist_stream')
+SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'lineage',
+                     'schedule', 'autotune', 'device_decode', 'decode_bench',
+                     'service', 'wire_bench', 'telemetry', 'tracing',
+                     'resilience', 'mnist_scan_stream', 'flash', 'moe',
+                     'imagenet_scan', 'imagenet_stream', 'decode_delta',
+                     'bare_reader', 'mnist_stream')
 assert sorted(SECTION_RUN_ORDER) == sorted(SECTION_NAMES)
 
 
@@ -1598,6 +1598,66 @@ def child_main():
             'observability_cost_persist_roundtrip_ok': bool(roundtrip_ok),
         })
 
+    def run_lineage():
+        """Sample-lineage audit plane (host-only, fast; docs/observability.md
+        "Sample lineage & determinism audit"): (1) recording-overhead guard —
+        a lineage-armed process-pool epoch (manifest written) vs a bare one,
+        min-of-3 interleaved pairs to cancel shared-host drift; the overhead
+        percentage is the BENCH-history guard for the ISSUE-13 acceptance
+        (<= 3%); (2) pool-parity probe — the dummy-pool digest of the same
+        seed must equal the process-pool digest; (3) a manifest verify
+        roundtrip (dry replay, zero data re-read)."""
+        from petastorm_tpu.telemetry.lineage import (LineagePolicy,
+                                                     verify_manifest)
+        lineage_dir = tempfile.mkdtemp(prefix='bench_lineage_')
+        manifest = os.path.join(lineage_dir, 'manifest.jsonl')
+
+        def epoch(lineage, pool='process'):
+            reader = make_reader(url, reader_pool_type=pool,
+                                 workers_count=min(WORKERS, 2), num_epochs=1,
+                                 seed=13, shuffle_row_groups=True,
+                                 lineage=lineage)
+            rows = 0
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            digest = reader.order_digest()
+            report = (reader.diagnostics.get('lineage')
+                      if lineage is not None else None)
+            reader.stop()
+            reader.join()
+            return rows / elapsed, digest, report
+
+        bare_rates, armed_rates = [], []
+        digest = report = None
+        for _ in range(3):  # interleaved pairs: shared-host drift cancels
+            bare_rates.append(epoch(None)[0])
+            rate, digest, report = epoch(
+                LineagePolicy(manifest_path=manifest))
+            armed_rates.append(rate)
+        bare_rate = max(bare_rates)
+        armed_rate = max(armed_rates)
+        overhead_pct = (bare_rate - armed_rate) / bare_rate * 100.0
+        dummy_digest = epoch(LineagePolicy(manifest=False), pool='dummy')[1]
+        verify = verify_manifest(manifest, dataset_url=url)
+        log('lineage: armed {:.1f} rows/s vs bare {:.1f} rows/s ({:+.2f}% '
+            'recording overhead); digest {}… over {} item(s), pool parity '
+            '{}, divergence {}, dry-replay verify {}'.format(
+                armed_rate, bare_rate, overhead_pct, (digest or '')[:12],
+                report['items_folded'], 'ok' if digest == dummy_digest
+                else 'MISMATCH', report['divergence'],
+                'ok' if verify['ok'] else 'FAIL({})'.format(verify['reason'])))
+        results.update({
+            'lineage_armed_rows_per_sec': round(armed_rate, 1),
+            'lineage_bare_rows_per_sec': round(bare_rate, 1),
+            'lineage_overhead_pct': round(overhead_pct, 2),
+            'lineage_items_folded': report['items_folded'],
+            'lineage_divergence': report['divergence'],
+            'lineage_pool_parity_ok': bool(digest == dummy_digest),
+            'lineage_verify_ok': bool(verify['ok']),
+        })
+
     def run_schedule():
         """Cost-aware scheduling (host-only; docs/performance.md "Cost-aware
         scheduling"): on a deliberately skewed store (heavy random-payload
@@ -2225,6 +2285,7 @@ def child_main():
         'device_decode': run_device_decode,
         'observability': run_observability,
         'schedule': run_schedule,
+        'lineage': run_lineage,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

@@ -276,7 +276,14 @@ def build_reader_knobs(reader: Any) -> List[Knob]:
             apply=lambda v: float(cache.set_bypass(v >= 0.5))))
     scheduler = getattr(reader, '_cost_scheduler', None)
     if (scheduler is not None and hasattr(scheduler, 'set_interleave')
-            and getattr(scheduler, 'live_reorder', False)):
+            and getattr(scheduler, 'live_reorder', False)
+            and getattr(reader, '_lineage', None) is None):
+        # With the lineage audit armed the knob is PINNED: the manifest
+        # header froze this run's schedule plan, and a mid-run interleave
+        # flip would make `lineage verify` diagnose divergence on an order
+        # the controller legitimately produced (docs/observability.md
+        # "Sample lineage & determinism audit"). Reproducibility-audited
+        # runs trade this one knob away by construction.
         # the cost-aware interleave half is a live toggle (next epoch
         # reorder); splits are frozen at construction — they shaped the
         # work-item list — so only the interleave is hill-climbable, and

@@ -13,7 +13,11 @@ JSONL event log — docs/observability.md); ``costs`` dispatches to
 :mod:`petastorm_tpu.telemetry.cost_model` (per-rowgroup/per-field cost
 profiler: one trace-armed epoch folded into the persistent ledger,
 expensive-rowgroup ranking + what-if rows — docs/observability.md "Cost
-profiler"); ``trace`` dispatches to
+profiler"); ``lineage`` dispatches to
+:mod:`petastorm_tpu.telemetry.lineage` (sample-lineage audit: record a
+lineage-armed epoch, dry-replay-verify a recorded manifest, or diff two
+recorded runs to the first divergent step — docs/observability.md "Sample
+lineage & determinism audit"); ``trace`` dispatches to
 :mod:`petastorm_tpu.telemetry.trace_export` (flight-recorder capture of a real
 read, exported as Chrome-trace/Perfetto JSON — docs/observability.md "Flight
 recorder"); ``pipecheck`` dispatches to
@@ -50,6 +54,9 @@ def main(argv=None):
     if argv and argv[0] == 'costs':
         from petastorm_tpu.telemetry.cost_model import main as costs_main
         return costs_main(argv[1:])
+    if argv and argv[0] == 'lineage':
+        from petastorm_tpu.telemetry.lineage import main as lineage_main
+        return lineage_main(argv[1:])
     if argv and argv[0] == 'trace':
         from petastorm_tpu.telemetry.trace_export import main as trace_main
         return trace_main(argv[1:])

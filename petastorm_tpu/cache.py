@@ -156,6 +156,13 @@ class LocalDiskCache(CacheBase):
         self._lock = threading.Lock()
         self._breaker = self._default_breaker()
 
+    @property
+    def state_home(self):
+        """The cache root directory — the per-dataset local-state home the
+        cost ledger and lineage manifest sidecars default into
+        (``petastorm_tpu.dataset_state.cache_state_home``)."""
+        return self._path
+
     def _key_path(self, key):
         digest = hashlib.sha1(str(key).encode('utf-8')).hexdigest()
         return os.path.join(self._path, digest[:2], digest + self._SUFFIX)

@@ -1,0 +1,94 @@
+"""Shared dataset-identity and local-sidecar-state helpers.
+
+Three subsystems keep small per-dataset state next to the data: the rowgroup
+cache keys every entry by a *dataset token* (``WorkerSetup``), the cost
+profiler persists its ledger sidecar in the dataset's *local state home*
+(``telemetry/cost_model.py``), and the lineage audit plane keeps its batch
+manifest there too (``telemetry/lineage.py``). Before this module each of
+them re-derived the same two facts — "what is this read's identity?" and
+"where does its local state live?" — independently; this is the ONE
+definition all of them call (docs/observability.md "Cost profiler" /
+"Sample lineage & determinism audit").
+
+Derivations, not policy: callers still decide what to store and when — this
+module only answers *token* and *path* questions, deterministically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Iterable, Optional, Sequence, Tuple
+
+#: field-spec row: ``(name, numpy_dtype, shape, codec_config)`` — all
+#: stringified by the caller so the token hash never depends on object repr
+#: details of live codec instances
+FieldSpec = Tuple[str, str, str, str]
+
+
+def derive_dataset_token(dataset_path_or_paths: Any,
+                         fields_to_read: Sequence[str],
+                         decode: bool,
+                         has_transform: bool,
+                         field_specs: Iterable[FieldSpec],
+                         device_decode_fields: Iterable[str] = ()) -> str:
+    """The 16-hex-char identity of one (dataset, read configuration) pair.
+
+    Covers the dataset location AND the read configuration: two readers with
+    different column sets / decode modes / per-field codec interpretations
+    (``field_overrides``) sharing one cache_location must never serve each
+    other's entries, and a cost/lineage sidecar recorded under one
+    configuration must never be consumed under another. Codec configs are
+    part of the identity because cached values are the POST-decode output.
+
+    ``device_decode_fields`` is appended only when non-empty, so every
+    existing cache keyed by the historical 5-part token stays warm for
+    readers that never use the device-decode knob.
+    """
+    token_parts = '{}|{}|{}|{}|{}'.format(dataset_path_or_paths,
+                                          sorted(fields_to_read), decode,
+                                          has_transform,
+                                          sorted(field_specs))
+    device_fields = sorted(device_decode_fields)
+    if device_fields:
+        token_parts += '|{}'.format(device_fields)
+    return hashlib.md5(token_parts.encode('utf-8')).hexdigest()[:16]
+
+
+def local_state_home(dataset_url_or_path: str,
+                     cache_location: Optional[str] = None) -> Optional[str]:
+    """The directory holding a dataset's local sidecar state: the disk-cache
+    directory when one is configured (it already is the per-dataset local
+    state home), else the dataset directory itself for a LOCAL store
+    (``file://`` or a bare path); None for remote stores with no cache —
+    the caller must then require an explicit path."""
+    if cache_location:
+        return cache_location
+    path = dataset_url_or_path
+    if path.startswith('file://'):
+        path = path[len('file://'):]
+    if '://' in path:
+        return None
+    return path
+
+
+def sidecar_path(dataset_url_or_path: str, basename: str,
+                 cache_location: Optional[str] = None) -> Optional[str]:
+    """Where a named sidecar file lives for one dataset:
+    ``local_state_home(...)/basename``, or None when the dataset has no
+    local state home (remote store, no cache)."""
+    home = local_state_home(dataset_url_or_path, cache_location)
+    if home is None:
+        return None
+    return os.path.join(home, basename)
+
+
+def cache_state_home(cache: Any) -> Optional[str]:
+    """The per-dataset local-state directory a cache object provides:
+    its ``state_home`` (the disk caches' root directory), or None for
+    NullCache / non-disk caches. The one accessor readers use instead of
+    poking cache internals."""
+    home = getattr(cache, 'state_home', None)
+    if home is None:
+        return None
+    return str(home)

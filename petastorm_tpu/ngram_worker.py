@@ -24,15 +24,16 @@ class NGramWindows(object):
     pieces still publish (empty ``starts``) solely to carry it. ``retries`` /
     ``quarantine`` are the resilience sidecar, ``telemetry`` the stage-span
     sidecar, ``breakers`` the circuit-breaker sidecar, ``trace`` the
-    flight-recorder sidecar — same contracts as
+    flight-recorder sidecar, ``lineage`` the sampled content-fingerprint
+    sidecar — same contracts as
     :class:`~petastorm_tpu.reader_worker.ColumnarBatch` (docs/robustness.md,
     docs/observability.md)."""
 
     __slots__ = ('columns', 'starts', 'item_id', 'retries', 'quarantine',
-                 'telemetry', 'breakers', 'trace')
+                 'telemetry', 'breakers', 'trace', 'lineage')
 
     def __init__(self, columns, starts, item_id=None, retries=0, quarantine=None,
-                 telemetry=None, breakers=None, trace=None):
+                 telemetry=None, breakers=None, trace=None, lineage=None):
         self.columns = columns
         self.starts = starts
         self.item_id = item_id
@@ -41,6 +42,7 @@ class NGramWindows(object):
         self.telemetry = telemetry
         self.breakers = breakers
         self.trace = trace
+        self.lineage = lineage
 
     def __len__(self):
         return len(self.starts)

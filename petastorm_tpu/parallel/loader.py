@@ -263,6 +263,11 @@ class JaxDataLoader(object):
         self._delivered_by_epoch = {}
         self._spec_keys_checked = False
         self._scan_stream_used = False
+        # Sample-lineage step stamping (docs/observability.md "Sample
+        # lineage"): the reader's recorder (when armed) learns which
+        # training step each manifest record lands under — cumulative across
+        # re-iterations, like stats.batches.
+        self._lineage_steps = 0
         self._scan_stream_programs = {}
         self._scan_stream_cache_warned = False
         self._coalesce_fields = coalesce_fields
@@ -378,6 +383,12 @@ class JaxDataLoader(object):
                                                event='loader_interval')
                 last_emit = now
                 self._mark_delivered(local_rows)
+                self._lineage_steps += 1
+                lineage = getattr(self.reader, '_lineage', None)
+                if lineage is not None:
+                    # step-stamp the audit plane: manifest records written
+                    # from here on carry this training step
+                    lineage.stamp_step(self._lineage_steps)
                 yield batch
         finally:
             self._stop_event.set()

@@ -123,7 +123,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 retry_policy=None, shm_transport=None, item_deadline_s=None,
                 heartbeat_interval_s=None, trace=None, service_url=None,
                 autotune=None, device_decode_fields=None, metrics_port=None,
-                slo_policy=None, cost_schedule=None):
+                slo_policy=None, cost_schedule=None, lineage=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -228,7 +228,21 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     and persist at ``stop()`` for the next run. Unset (None, the default)
     builds no scheduler and keeps every path byte-identical. Not compatible
     with ``resume_state`` (a re-planned schedule would shift the
-    checkpoint's item coordinates)."""
+    checkpoint's item coordinates).
+
+    Sample-lineage audit (docs/observability.md "Sample lineage &
+    determinism audit"): ``lineage`` arms the
+    :class:`~petastorm_tpu.telemetry.lineage.LineageRecorder` — a chained
+    order digest over every delivered item's ``(epoch, fragment, rowgroup,
+    row_range, drop, rows)`` identity (:meth:`Reader.order_digest`;
+    identical across dummy/thread/process/service pools for the same seed,
+    invariant under worker respawns), optional sampled content fingerprints,
+    and a bounded batch-manifest JSONL next to the dataset that
+    ``petastorm-tpu-throughput lineage verify`` replays without reading
+    data. ``True`` (default policy), a manifest path string, or a
+    :class:`~petastorm_tpu.telemetry.lineage.LineagePolicy`; digest state
+    rides ``state_dict()`` so save/resume folds to the same digest. Unset
+    (None, the default) records nothing."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -293,7 +307,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   initial_io_retries=construction_retries[0],
                   autotune=autotune, device_decode_fields=device_decode_fields,
                   metrics_port=metrics_port, slo_policy=slo_policy,
-                  cost_schedule=cost_schedule)
+                  cost_schedule=cost_schedule, lineage=lineage)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -309,13 +323,15 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       retry_policy=None, shm_transport=None, item_deadline_s=None,
                       heartbeat_interval_s=None, trace=None, service_url=None,
                       autotune=None, device_decode_fields=None,
-                      metrics_port=None, slo_policy=None, cost_schedule=None):
+                      metrics_port=None, slo_policy=None, cost_schedule=None,
+                      lineage=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
     ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
     ``service_url`` / ``autotune`` / ``metrics_port`` / ``slo_policy`` /
-    ``cost_schedule`` behave exactly as in :func:`make_reader`.
+    ``cost_schedule`` / ``lineage`` behave exactly as in
+    :func:`make_reader`.
     ``device_decode_fields`` (docs/performance.md "Device-resident decode
     tail") requires the store's Unischema codec registry: on a Unischema
     store the named fields ship their raw codec payloads (container stripped)
@@ -392,7 +408,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   initial_io_retries=construction_retries[0],
                   autotune=autotune, device_decode_fields=device_decode_fields,
                   metrics_port=metrics_port, slo_policy=slo_policy,
-                  cost_schedule=cost_schedule)
+                  cost_schedule=cost_schedule, lineage=lineage)
 
 
 class Reader(object):
@@ -407,7 +423,7 @@ class Reader(object):
                  storage_options=None, filesystem=None, resume_state=None,
                  on_error='raise', retry_policy=None, initial_io_retries=0,
                  autotune=None, device_decode_fields=None, metrics_port=None,
-                 slo_policy=None, cost_schedule=None):
+                 slo_policy=None, cost_schedule=None, lineage=None):
         from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
         retry_policy = resolve_retry_policy(on_error, retry_policy)
         construction_retries = [initial_io_retries]
@@ -452,6 +468,14 @@ class Reader(object):
         self._slo = SloTracker(resolve_slo_policy(slo_policy),
                                jsonl=logger_from_env())
         self._metrics_server = None
+        # Sample-lineage audit plane (docs/observability.md): the policy is
+        # resolved up front (its fingerprint sampling knob ships to workers
+        # in the WorkerSetup); the recorder itself is built after the work
+        # plan is frozen, so its manifest header can record the exact
+        # reproduction config.
+        from petastorm_tpu.telemetry.lineage import resolve_lineage_policy
+        self._lineage = None
+        self._lineage_policy = resolve_lineage_policy(lineage)
 
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
@@ -564,7 +588,10 @@ class Reader(object):
             partition_field_names=partition_names,
             on_error=on_error,
             retry_policy=retry_policy,
-            device_decode_fields=self.device_decode_fields)
+            device_decode_fields=self.device_decode_fields,
+            lineage_fingerprint_every=(self._lineage_policy.fingerprint_every
+                                       if self._lineage_policy is not None
+                                       else 0))
         # Single source of truth for the emitted schema: the workers' own derivation.
         self.result_schema = worker_setup.result_schema
         #: the dataset identity the disk cache and the cost ledger key on
@@ -662,12 +689,13 @@ class Reader(object):
                     're-planned schedule (ledger-driven splits) would shift '
                     'the work-item coordinates the checkpoint refers to — '
                     'resume without cost_schedule')
+            from petastorm_tpu.dataset_state import cache_state_home
             from petastorm_tpu.schedule import CostAwareScheduler, load_ledger
             url_for_ledger = dataset_url_or_urls if not isinstance(
                 dataset_url_or_urls, list) else dataset_url_or_urls[0]
             ledger, ledger_path = load_ledger(
                 url_for_ledger, self.dataset_token,
-                cache_location=getattr(cache, '_path', None),
+                cache_location=cache_state_home(cache),
                 ledger_path=schedule_policy.ledger_path)
             self._cost_scheduler = CostAwareScheduler(
                 self.dataset_token, schedule_policy, ledger=ledger,
@@ -711,6 +739,7 @@ class Reader(object):
         skip_by_iteration = None
         pre_shuffles = 0
         self._resume_fast_forward = {}
+        self._resume_lineage = None
         if resume_state is not None:
             self._load_resume_state(resume_state)
             pre_shuffles = self._epochs_consumed
@@ -722,9 +751,69 @@ class Reader(object):
                     raise ValueError(
                         'resume_state shows all {} epochs already consumed'.format(num_epochs))
 
+        # ------------------------------------------------- lineage recorder
+        # (docs/observability.md "Sample lineage & determinism audit"): built
+        # once the work plan is frozen — the manifest header written here is
+        # the exact reproduction record the dry replay verifier consumes.
+        if self._lineage_policy is not None:
+            from petastorm_tpu.dataset_state import cache_state_home
+            from petastorm_tpu.telemetry.lineage import (LineageRecorder,
+                                                         build_manifest_logger,
+                                                         canonical_identity)
+            url_for_state = dataset_url_or_urls if not isinstance(
+                dataset_url_or_urls, list) else dataset_url_or_urls[0]
+            manifest_jsonl, manifest_path = build_manifest_logger(
+                self._lineage_policy, url_for_state, self.dataset_token,
+                cache_state_home(cache))
+            self._lineage = LineageRecorder(
+                self.dataset_token, self._lineage_policy,
+                jsonl=manifest_jsonl, manifest_path=manifest_path,
+                registry=self._telemetry,
+                resume_state=self._resume_lineage)
+            header = {
+                'dataset_url': str(url_for_state),
+                'seed': seed,
+                'shuffle_row_groups': bool(shuffle_row_groups),
+                'num_epochs': num_epochs,
+                'pre_shuffles': pre_shuffles,
+                'resumed': resume_state is not None,
+                'cur_shard': cur_shard, 'shard_count': shard_count,
+                'shard_seed': shard_seed,
+                'drop_partitions': shuffle_row_drop_partitions,
+                'items_per_epoch': len(items),
+                # construction-order item list: what each epoch's reorder
+                # permutes — [piece, fragment, rowgroup, row_range, drop],
+                # coerced through the same canonicalization deliveries fold
+                # with so replay and recording can never disagree on types
+                'items': [[int(item['piece_index'])] + canonical_identity(
+                    0, item['fragment_path'], item['row_group_id'],
+                    item.get('row_range'),
+                    item['shuffle_row_drop_partition'][0])[1:]
+                    for item in items],
+                # the sharded enumeration for the zero-read dataset
+                # cross-check (footer metadata only)
+                'shard_rowgroups': [
+                    [str(rg.fragment_path),
+                     int(rg.row_group_id)
+                     if rg.row_group_id is not None else None,
+                     int(rg.row_group_num_rows)]
+                    for rg in shard_row_groups],
+                'quarantined_fragments': sorted(
+                    record.fragment_path
+                    for record in construction_quarantine),
+                'schedule': (self._cost_scheduler.plan_fingerprint()
+                             if self._cost_scheduler is not None else None),
+            }
+            if skip_by_iteration:
+                header['skip_by_iteration'] = {
+                    str(k): sorted(list(item) for item in v)
+                    for k, v in skip_by_iteration.items()}
+            self._lineage.write_header(header)
+
         max_in_flight = getattr(reader_pool, 'workers_count', 1) + _VENTILATE_EXTRA_ROWGROUPS
         self._ventilator = ConcurrentVentilator(
-            ventilate_fn=_traced_ventilate(reader_pool.ventilate),
+            ventilate_fn=_traced_ventilate(reader_pool.ventilate,
+                                           self._lineage),
             items_to_ventilate=items,
             iterations=iterations,
             max_ventilation_queue_size=max_in_flight,
@@ -861,7 +950,8 @@ class Reader(object):
                     cache_hit=getattr(batch, 'cache_hit', None),
                     telemetry=getattr(batch, 'telemetry', None),
                     breakers=getattr(batch, 'breakers', None),
-                    trace=getattr(batch, 'trace', None))
+                    trace=getattr(batch, 'trace', None),
+                    lineage=getattr(batch, 'lineage', None))
             self._note_item_consumed(batch)
             if self._resume_fast_forward and batch.item_id is not None:
                 # Honor a row_cursor from a row-path checkpoint: skip the rows that
@@ -926,6 +1016,14 @@ class Reader(object):
         item_id = getattr(batch, 'item_id', None)
         if item_id is None:
             return
+        if self._lineage is not None:
+            # lineage delivery accounting (docs/observability.md "Sample
+            # lineage"): exactly one deliver per work item on every pool —
+            # the recorder folds it at its ventilation-order slot
+            self._lineage.deliver(
+                item_id, getattr(batch, 'num_rows', 0) or 0,
+                fingerprint=getattr(batch, 'lineage', None),
+                quarantined=record is not None)
         epoch, piece, drop = item_id
         if trace_enabled():
             # consumer-side anchor of the rowgroup's trace: present on every
@@ -955,6 +1053,10 @@ class Reader(object):
         self._consumed_by_epoch = {
             self._epochs_consumed + int(offset): {tuple(item) for item in ids}
             for offset, ids in state['consumed_by_epoch'].items()}
+        # lineage digest continuity (docs/observability.md): the chain value
+        # + pending suffix saved by state_dict(), handed to the recorder so
+        # the resumed run folds to the same digest as an uninterrupted one
+        self._resume_lineage = state.get('lineage')
         cursor = state.get('row_cursor')
         if cursor is not None:
             # Replay the mid-batch position: the item is NOT in the consumed sets (its
@@ -1002,6 +1104,12 @@ class Reader(object):
                 'work-item coordinates cannot be resumed. Checkpoint with '
                 'cost_schedule disabled, or a SchedulePolicy(split=False).'
                 .format(self._cost_scheduler.split_count))
+        lineage_state = None
+        if self._lineage is not None:
+            # taken OUTSIDE the accounting lock (the recorder has its own);
+            # state_dict runs on the consuming thread between next() calls,
+            # so no deliver can interleave with this snapshot
+            lineage_state = self._lineage.state_dict()
         cursor = None
         if isinstance(self._results_reader, (_RowResultsReader, _NGramResultsReader)):
             # NGram: the work-item unit is identical; the cursor's row index counts
@@ -1026,6 +1134,11 @@ class Reader(object):
                 state['row_cursor'] = {'epoch_offset': epoch - self._epochs_consumed,
                                        'piece': piece, 'drop': drop,
                                        'next_row': next_row}
+            if lineage_state is not None:
+                # the chained-digest state (docs/observability.md "Sample
+                # lineage"): a resumed reader seeded with it folds to the
+                # exact digest of an uninterrupted run
+                state['lineage'] = lineage_state
             return state
 
     @property
@@ -1115,6 +1228,19 @@ class Reader(object):
         ledger.ingest_trace(trace_snapshot(), dict(self._piece_locator))
         return ledger
 
+    # ------------------------------------------------------- lineage audit
+
+    def order_digest(self):
+        """The chained sample-lineage order digest over every item delivered
+        so far (docs/observability.md "Sample lineage & determinism audit"):
+        a hex string identical across dummy/thread/process/service pools for
+        the same seed + shard config + schedule plan, and invariant under
+        worker respawns/redeliveries. None when the reader was built without
+        ``lineage``."""
+        if self._lineage is None:
+            return None
+        return self._lineage.order_digest()
+
     # ------------------------------------------------------- metrics plane
 
     def _snapshot_with_slo(self):
@@ -1126,6 +1252,13 @@ class Reader(object):
         gauges = snapshot.setdefault('gauges', {})
         gauges['slo_efficiency'] = report['efficiency']
         gauges['slo_target_efficiency'] = report['target_efficiency']
+        if self._lineage is not None:
+            # the /metrics view of the audit plane: fold progress + reorder-
+            # buffer depth (the lineage_divergence counter rides the
+            # registry's counters like any other)
+            lineage = self._lineage.report()
+            gauges['lineage_items_folded'] = lineage['items_folded']
+            gauges['lineage_pending_items'] = lineage['pending_items']
         return snapshot, report
 
     def _scrape_snapshot(self):
@@ -1196,6 +1329,10 @@ class Reader(object):
             except Exception:  # noqa: BLE001 - ledger persistence is advisory; the read itself already succeeded
                 logger.warning('could not persist the cost ledger',
                                exc_info=True)
+        if self._lineage is not None:
+            # flush the final manifest record (idempotent; the JSONL logger
+            # swallows its own write failures)
+            self._lineage.close()
         self._pool.stop()
 
     def join(self):
@@ -1255,6 +1392,9 @@ class Reader(object):
         # Cost-aware schedule block only when armed, same contract.
         if self._cost_scheduler is not None:
             diag['schedule'] = self._cost_scheduler.report()
+        # Lineage audit block only when armed, same contract.
+        if self._lineage is not None:
+            diag['lineage'] = self._lineage.report()
         return diag
 
     def __enter__(self):
@@ -1270,19 +1410,29 @@ def _item_id(item):
     return (item['piece_index'], item['shuffle_row_drop_partition'][0])
 
 
-def _traced_ventilate(pool_ventilate):
+def _traced_ventilate(pool_ventilate, lineage=None):
     """Wrap a pool's ``ventilate`` so each work item's birth lands on the
     flight-recorder timeline (docs/observability.md "Flight recorder"): the
     ``ventilate`` instant is the causal origin of a rowgroup's trace — the
     ``(epoch, rowgroup)`` context every later span inherits starts here. One
-    enabled-check per item when tracing is off."""
+    enabled-check per item when tracing is off.
+
+    ``lineage`` (a :class:`~petastorm_tpu.telemetry.lineage.LineageRecorder`)
+    additionally records each item's EXPECTED position: ventilation order is
+    the fold order of the chained order digest, which is why the digest is
+    identical across pools whose completion order is not."""
     def ventilate(**kwargs):
-        if trace_enabled():
-            piece = kwargs.get('piece_index')
-            if piece is not None:
-                trace_instant('ventilate',
-                              ctx=(int(kwargs.get('epoch_index', 0)),
-                                   int(piece), 0))
+        piece = kwargs.get('piece_index')
+        if trace_enabled() and piece is not None:
+            trace_instant('ventilate',
+                          ctx=(int(kwargs.get('epoch_index', 0)),
+                               int(piece), 0))
+        if lineage is not None and piece is not None:
+            lineage.expect(int(kwargs.get('epoch_index', 0)), int(piece),
+                           int(kwargs['shuffle_row_drop_partition'][0]),
+                           str(kwargs.get('fragment_path', '')),
+                           kwargs.get('row_group_id'),
+                           kwargs.get('row_range'))
         pool_ventilate(**kwargs)
     return ventilate
 

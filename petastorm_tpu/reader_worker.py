@@ -81,13 +81,22 @@ class ColumnarBatch(object):
     (``{'pid', 'events', 'dropped'}`` from
     :func:`~petastorm_tpu.telemetry.tracing.drain_trace_events`), or None when
     tracing is off — how worker-side timeline events reach the consumer's
-    recorder so one ``Reader.dump_trace()`` spans every process."""
+    recorder so one ``Reader.dump_trace()`` spans every process.
+
+    ``lineage`` is the sample-lineage sidecar (docs/observability.md "Sample
+    lineage & determinism audit"): the producing worker's sampled content
+    fingerprint (``{'crc32', 'fields'}`` from
+    :func:`~petastorm_tpu.telemetry.lineage.content_fingerprint`), or None
+    when sampling is off / this piece was not sampled — computed where the
+    batch is PRODUCED (in-process, spawned, or service-fleet worker) so a
+    bit flipped anywhere downstream shows up as a cross-run mismatch."""
 
     __slots__ = ('columns', 'num_rows', 'item_id', 'retries', 'quarantine',
-                 'cache_hit', 'telemetry', 'breakers', 'trace')
+                 'cache_hit', 'telemetry', 'breakers', 'trace', 'lineage')
 
     def __init__(self, columns, num_rows, item_id=None, retries=0, quarantine=None,
-                 cache_hit=None, telemetry=None, breakers=None, trace=None):
+                 cache_hit=None, telemetry=None, breakers=None, trace=None,
+                 lineage=None):
         self.columns = columns
         self.num_rows = num_rows
         self.item_id = item_id
@@ -97,6 +106,7 @@ class ColumnarBatch(object):
         self.telemetry = telemetry
         self.breakers = breakers
         self.trace = trace
+        self.lineage = lineage
 
 
 class WorkerSetup(object):
@@ -105,12 +115,14 @@ class WorkerSetup(object):
     __slots__ = ('dataset_path_or_paths', 'filesystem_factory', 'schema', 'fields_to_read',
                  'result_schema', 'transform_spec', 'batched_output', 'decode', 'ngram',
                  'cache', 'shuffle_rows', 'seed', 'partition_field_names', 'dataset_token',
-                 'on_error', 'retry_policy', 'device_decode_fields')
+                 'on_error', 'retry_policy', 'device_decode_fields',
+                 'lineage_fingerprint_every')
 
     def __init__(self, dataset_path_or_paths, filesystem_factory, schema, fields_to_read,
                  transform_spec=None, batched_output=False, decode=True, ngram=None,
                  cache=None, shuffle_rows=False, seed=None, partition_field_names=(),
-                 on_error='raise', retry_policy=None, device_decode_fields=()):
+                 on_error='raise', retry_policy=None, device_decode_fields=(),
+                 lineage_fingerprint_every=0):
         from petastorm_tpu.resilience import resolve_retry_policy
         self.on_error = on_error
         # One normalization for the whole stack: 'raise' means today's exact behavior
@@ -132,28 +144,24 @@ class WorkerSetup(object):
         #: fields whose payloads skip host decode and ship raw to the device
         #: loader (docs/performance.md "Device-resident decode tail")
         self.device_decode_fields = frozenset(device_decode_fields)
-        # Cache key token covers the dataset identity AND the read configuration: two
-        # readers with different column sets / decode modes / per-field codec
-        # interpretations (field_overrides) sharing one cache_location must never serve
-        # each other's entries. Codec configs are part of the identity because the
-        # cached value is the POST-decode output.
-        field_specs = sorted(
+        #: sample-lineage content-fingerprint cadence (docs/observability.md
+        #: "Sample lineage"): pieces with ``piece_index % N == 0`` hash their
+        #: column buffers into the batch's ``lineage`` sidecar; 0 = off.
+        #: A pure function of the piece identity, so every pool and the
+        #: service fleet sample the SAME pieces.
+        self.lineage_fingerprint_every = int(lineage_fingerprint_every)
+        # Cache key token covers the dataset identity AND the read configuration
+        # (the ONE shared derivation — dataset_state.derive_dataset_token — that
+        # the cache, the cost ledger and the lineage manifest all key on).
+        field_specs = [
             (name, str(field.numpy_dtype), str(field.shape),
              str(field.codec.to_config()) if field.codec is not None else 'none')
-            for name, field in schema.fields.items() if name in self.fields_to_read)
-        token_parts = '{}|{}|{}|{}|{}'.format(dataset_path_or_paths,
-                                              sorted(self.fields_to_read), decode,
-                                              transform_spec is not None,
-                                              field_specs)
-        if self.device_decode_fields:
-            # part of the cache identity: the cached value is the POST-plan
-            # output, and a raw-shipped column must never be served to a reader
-            # expecting decoded values (or vice versa). Appended only when the
-            # knob is on, so every existing cache keyed by the historical
-            # 5-field token stays warm for readers that never use it.
-            token_parts += '|{}'.format(sorted(self.device_decode_fields))
-        token_src = token_parts.encode('utf-8')
-        self.dataset_token = hashlib.md5(token_src).hexdigest()[:16]
+            for name, field in schema.fields.items() if name in self.fields_to_read]
+        from petastorm_tpu.dataset_state import derive_dataset_token
+        self.dataset_token = derive_dataset_token(
+            dataset_path_or_paths, self.fields_to_read, decode,
+            transform_spec is not None, field_specs,
+            self.device_decode_fields)
         read_view = schema.create_schema_view(
             [re.escape(name) for name in self.fields_to_read]) \
             if self.fields_to_read else schema
@@ -280,6 +288,9 @@ class RowGroupWorker(WorkerBase):
             # the reader's consumption accounting stays exact (same contract as the
             # row path's empty ColumnarBatch below).
             payload.retries = retry_cell[0]
+            payload.lineage = self._lineage_fingerprint(piece_index,
+                                                        payload.columns,
+                                                        len(payload.starts))
             self._publish(payload)
             return
 
@@ -341,7 +352,21 @@ class RowGroupWorker(WorkerBase):
                                         cache_hit=cache_hit))
             return
         self._publish(ColumnarBatch(columns, num_rows, item_id=item_id,
-                                    retries=retry_cell[0], cache_hit=cache_hit))
+                                    retries=retry_cell[0], cache_hit=cache_hit,
+                                    lineage=self._lineage_fingerprint(
+                                        piece_index, columns, num_rows)))
+
+    def _lineage_fingerprint(self, piece_index, columns, num_rows):
+        """The sampled content-CRC sidecar for one produced batch
+        (docs/observability.md "Sample lineage"): computed when the setup's
+        cadence selects this piece, None otherwise. Sampling keys on the
+        piece identity, never on worker-local counters, so every
+        pool/transport fingerprints the same pieces."""
+        every = self._setup.lineage_fingerprint_every
+        if not every or not num_rows or piece_index % every != 0:
+            return None
+        from petastorm_tpu.telemetry.lineage import content_fingerprint
+        return content_fingerprint(columns)
 
     def _publish_quarantined(self, exc, item_id, piece_index, fragment_path,
                              row_group_id, retries):

@@ -460,6 +460,26 @@ class CostAwareScheduler(object):
         """How many rowgroups the plan split (frozen at construction)."""
         return len(self._splits)
 
+    def plan_fingerprint(self) -> Dict[str, Any]:
+        """The frozen plan as a JSON-safe reproduction record: everything a
+        dry replay needs to re-derive this scheduler's epoch orders without
+        the ledger file (the lineage manifest header embeds it —
+        docs/observability.md "Sample lineage & determinism audit"). A
+        cost-ledger delta between two runs shows up as a difference here,
+        which is how ``lineage diff`` attributes a reordered interleave to
+        the schedule plan."""
+        with self._lock:
+            interleave = self._interleave
+        return {'cold_start': self._median <= 0.0,
+                'interleave': interleave,
+                'prestage': self.policy.prestage,
+                'heavy_skew': self.policy.heavy_skew,
+                'policy': self.policy.as_dict(),
+                'piece_costs': {str(piece): round(cost, 6)
+                                for piece, cost
+                                in sorted(self._piece_costs.items())},
+                'splits': [dict(row) for row in self._splits]}
+
     def piece_locator(self) -> Dict[int, Tuple[str, Any]]:
         """``{piece_index: (fragment_path, row_group_id)}`` covering every
         planned piece INCLUDING the virtual sub-range pieces — the one map

@@ -74,20 +74,14 @@ def percentile(sorted_values: List[float], q: float) -> float:
 def default_ledger_path(dataset_url_or_path: str, dataset_token: str,
                         cache_location: Optional[str] = None
                         ) -> Optional[str]:
-    """Where the ledger sidecar lives: next to the disk cache when one is
-    configured (the cache directory already is the per-dataset local state
-    home), else next to a LOCAL dataset (``file://`` or a bare path); None
-    for remote stores with no cache — the caller must pass an explicit
-    path."""
-    basename = LEDGER_BASENAME.format(token=dataset_token)
-    if cache_location:
-        return os.path.join(cache_location, basename)
-    path = dataset_url_or_path
-    if path.startswith('file://'):
-        path = path[len('file://'):]
-    if '://' in path:
-        return None
-    return os.path.join(path, basename)
+    """Where the ledger sidecar lives: the dataset's local state home
+    (:func:`petastorm_tpu.dataset_state.sidecar_path` — next to the disk
+    cache when one is configured, else next to a LOCAL dataset); None for
+    remote stores with no cache — the caller must pass an explicit path."""
+    from petastorm_tpu.dataset_state import sidecar_path
+    return sidecar_path(dataset_url_or_path,
+                        LEDGER_BASENAME.format(token=dataset_token),
+                        cache_location)
 
 
 class CostLedger(object):

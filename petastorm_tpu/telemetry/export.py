@@ -15,7 +15,7 @@ import os
 import re
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from petastorm_tpu.telemetry.registry import (DEFAULT_NUM_BUCKETS,
                                               bucket_upper_bound)
@@ -230,17 +230,24 @@ class JsonlEventLogger(object):
 
     ``max_bytes`` (default None = unbounded, the prior behavior) caps the log
     file: when appending a line would push it past the cap, the current file
-    rotates to ``<path>.1`` (replacing any previous ``.1``) and a fresh file
-    starts — a week-long run driven by ``PETASTORM_TPU_TELEMETRY_JSONL`` keeps
-    at most ``2 * max_bytes`` on disk instead of filling it. Env form:
-    ``PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES`` (read by
+    rotates to ``<path>.1`` and a fresh file starts — a week-long run driven
+    by ``PETASTORM_TPU_TELEMETRY_JSONL`` keeps bounded disk instead of
+    filling it. ``max_rotations`` (default 1, the prior behavior) is how many
+    rotated generations survive: each rotation shifts the chain
+    ``<path>.1 -> <path>.2 -> ... -> <path>.N`` (the oldest falls off), so a
+    long-running manifest log keeps ``(max_rotations + 1) * max_bytes`` of
+    history instead of losing everything but one generation. Env forms:
+    ``PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES`` /
+    ``PETASTORM_TPU_TELEMETRY_JSONL_ROTATIONS`` (read by
     :func:`logger_from_env`)."""
 
     def __init__(self, path: str, interval_s: float = 10.0,
-                 max_bytes: Optional[int] = None) -> None:
+                 max_bytes: Optional[int] = None,
+                 max_rotations: int = 1) -> None:
         self._path = path
         self._interval_s = float(interval_s)
         self._max_bytes = int(max_bytes) if max_bytes else None
+        self._max_rotations = max(1, int(max_rotations))
         self._lock = threading.Lock()
         self._next_emit = 0.0
         self._failed = False
@@ -301,10 +308,11 @@ class JsonlEventLogger(object):
 
     def _maybe_rotate(self, incoming_bytes: int) -> None:
         """Size-capped rotation (caller holds the lock): when the pending line
-        would push the file past ``max_bytes``, the current file becomes
-        ``<path>.1`` (one generation kept — atomic ``os.replace``). A missing
-        file counts as size 0; other stat errors fall through to the append,
-        whose own failure path disables the logger."""
+        would push the file past ``max_bytes``, the generation chain shifts —
+        ``.{N-1} -> .N`` (oldest dropped), down to the current file becoming
+        ``.1`` — each link an atomic ``os.replace``. A missing file counts as
+        size 0; other stat errors fall through to the append, whose own
+        failure path disables the logger."""
         if self._max_bytes is None:
             return
         try:
@@ -313,23 +321,47 @@ class JsonlEventLogger(object):
             return  # nothing to rotate (first write, or unstatable path)
         if size + incoming_bytes <= self._max_bytes:
             return
+        for generation in range(self._max_rotations - 1, 0, -1):
+            older = '{}.{}'.format(self._path, generation)
+            if os.path.exists(older):
+                os.replace(older, '{}.{}'.format(self._path, generation + 1))
         os.replace(self._path, self._path + '.1')
+
+
+def env_rotation_settings() -> Tuple[Optional[int], int]:
+    """The ``(max_bytes, max_rotations)`` pair the env configures:
+    ``$PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES`` (default unbounded) arms
+    size-capped rotation, ``$PETASTORM_TPU_TELEMETRY_JSONL_ROTATIONS``
+    (default 1) sets how many rotated generations survive. Shared by
+    :func:`logger_from_env` and the lineage manifest logger, so one env
+    convention bounds every JSONL stream."""
+    raw_cap = os.environ.get('PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES', '')
+    try:
+        max_bytes: Optional[int] = int(raw_cap) if raw_cap else None
+    except ValueError:
+        max_bytes = None
+    raw_rotations = os.environ.get('PETASTORM_TPU_TELEMETRY_JSONL_ROTATIONS',
+                                   '')
+    try:
+        max_rotations = int(raw_rotations) if raw_rotations else 1
+    except ValueError:
+        max_rotations = 1
+    return max_bytes, max_rotations
 
 
 def logger_from_env(interval_s: float = 10.0) -> Optional[JsonlEventLogger]:
     """A :class:`JsonlEventLogger` targeting ``$PETASTORM_TPU_TELEMETRY_JSONL``,
     or None when the variable is unset/empty.
     ``$PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES`` (optional, default unbounded)
-    arms the size-capped ``.1`` rotation for long runs."""
+    arms size-capped rotation and
+    ``$PETASTORM_TPU_TELEMETRY_JSONL_ROTATIONS`` (optional, default 1) sets
+    the surviving generation count (:func:`env_rotation_settings`)."""
     path = os.environ.get('PETASTORM_TPU_TELEMETRY_JSONL')
     if not path:
         return None
-    raw_cap = os.environ.get('PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES', '')
-    try:
-        max_bytes: Optional[int] = int(raw_cap) if raw_cap else None
-    except ValueError:
-        max_bytes = None
-    return JsonlEventLogger(path, interval_s=interval_s, max_bytes=max_bytes)
+    max_bytes, max_rotations = env_rotation_settings()
+    return JsonlEventLogger(path, interval_s=interval_s, max_bytes=max_bytes,
+                            max_rotations=max_rotations)
 
 
 def load_snapshot(path: str) -> Dict[str, Any]:

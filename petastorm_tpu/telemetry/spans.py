@@ -84,6 +84,10 @@ COUNTERS = (
     'slo_breach',      # input-efficiency fell below the SLO target (edge-
                        # triggered: one count per ok->breach transition —
                        # telemetry/slo.py, docs/observability.md)
+    'lineage_divergence',  # a delivered item broke the expected lineage
+                           # stream (unknown/duplicate delivery, resume
+                           # mismatch) — telemetry/lineage.py,
+                           # docs/observability.md "Sample lineage"
 )
 
 #: declared size histograms (``registry.observe(name, n, unit=BYTES_UNIT)``
@@ -109,6 +113,7 @@ TRACE_INSTANTS = (
     'autotune_decision',   # the closed-loop autotuner proposed/committed/reverted/froze a knob change (controller)
     'slo_breach',          # input-efficiency fell below the SLO target (consumer; telemetry/slo.py)
     'schedule_plan',       # the cost-aware scheduler planned one epoch's ventilation order (ventilator thread; schedule/cost_schedule.py)
+    'lineage_divergence',  # a delivered item broke the expected lineage stream (consumer; telemetry/lineage.py)
 )
 
 #: declared gauge ids (``registry.gauge(name)`` call sites with literal
@@ -123,6 +128,10 @@ GAUGES = (
     'service_workers',           # registered decode workers (dispatcher)
     'service_admission_window',  # per-client in-flight cap (dispatcher)
     'service_client_window',     # smallest live client window (dispatcher)
+    'lineage_items_folded',      # items folded into the order digest so far
+                                 # (reader scrape; telemetry/lineage.py)
+    'lineage_pending_items',     # delivered-out-of-order items awaiting
+                                 # their fold slot (reader scrape)
 )
 
 

@@ -224,8 +224,14 @@ def check_store_roundtrip(rows=200, workers=2):
             # ever turned — the block proves the controller wires up (knob
             # catalog, breaker interlock state) without perturbing the probe.
             from petastorm_tpu.autotune import AutotunePolicy
+            # lineage armed manifest-less (docs/observability.md "Sample
+            # lineage"): the block proves the audit plane folds a clean
+            # digest with zero divergence on this install, without leaving
+            # a manifest file in the temp store.
+            from petastorm_tpu.telemetry.lineage import LineagePolicy
             with make_reader(url, workers_count=workers, num_epochs=1,
                              on_error='retry',
+                             lineage=LineagePolicy(manifest=False),
                              autotune=AutotunePolicy(window_s=3600.0)) as reader:
                 for row in reader:
                     seen.append(int(row.idx))
@@ -237,6 +243,7 @@ def check_store_roundtrip(rows=200, workers=2):
                 trace = reader.trace_summary()
                 autotune = reader.autotune_report()
                 slo = reader.efficiency_report()
+                lineage = diag.get('lineage')
             elapsed = time.perf_counter() - start
     finally:
         tracing.set_trace_enabled(trace_was_enabled)
@@ -261,6 +268,9 @@ def check_store_roundtrip(rows=200, workers=2):
             # lifted to report['slo'] by collect_report — the input-efficiency
             # SLO evaluation of docs/observability.md "Efficiency SLOs"
             'slo': slo,
+            # lifted to report['lineage'] by collect_report — the sample-
+            # lineage audit of docs/observability.md "Sample lineage"
+            'lineage': lineage,
             # lifted to report['resilience'] by collect_report — the hang/
             # integrity/breaker view of docs/robustness.md
             'resilience': {
@@ -381,6 +391,12 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
     # so --json consumers find one stable key.
     slo = report['store_roundtrip'].pop('slo', None)
     report['slo'] = slo if slo is not None else {'evaluated': False}
+    # Sample-lineage block (docs/observability.md "Sample lineage &
+    # determinism audit"): the roundtrip reader's order digest + divergence
+    # count. Always present so --json consumers find one stable key.
+    lineage = report['store_roundtrip'].pop('lineage', None)
+    report['lineage'] = lineage if lineage is not None else {
+        'enabled': False}
     # Static-analysis block (docs/static-analysis.md): does the installed
     # package still satisfy its own data-plane invariants? Always present so
     # --json consumers find one stable key; failures of the analyzer itself
@@ -457,6 +473,22 @@ def _print_human(report):
                   'telemetry bottleneck line for the knob to turn '
                   '(docs/observability.md "Efficiency SLOs")'.format(
                       slo.get('starvation_fraction', 0.0)))
+    lineage = report.get('lineage') or {}
+    if lineage.get('enabled'):
+        print('  lineage: digest {}… over {} item(s), {} pending, '
+              '{} divergence event(s)'.format(
+                  (lineage.get('order_digest') or '')[:12],
+                  lineage.get('items_folded', 0),
+                  lineage.get('pending_items', 0),
+                  lineage.get('divergence', 0)))
+        if lineage.get('divergence'):
+            last = lineage.get('last_divergence') or {}
+            print('  WARNING: sample-lineage verification FAILED {} time(s) '
+                  '(last: {} — {}) — the delivered stream broke its expected '
+                  'order; reproducibility is not provable for this run '
+                  '(docs/observability.md "Sample lineage")'.format(
+                      lineage.get('divergence'), last.get('reason'),
+                      last.get('detail')))
     trace = report.get('trace') or {}
     if trace.get('events'):
         anomalies = trace.get('anomaly_instants') or []

@@ -243,6 +243,10 @@ class ArrowIpcSerializer(object):
             # ({'pid', 'events', 'dropped'}, None while tracing is off) merged
             # into the consumer-side recorder for Reader.dump_trace()
             'trace': getattr(obj, 'trace', None),
+            # sample-lineage sidecar (docs/observability.md "Sample
+            # lineage"): the producing worker's sampled content fingerprint
+            # ({'crc32', 'fields'}, None when this piece was not sampled)
+            'lineage': getattr(obj, 'lineage', None),
         }
         ipc_buf, sidecar_blob, _ = encode_columnar(obj.columns, obj.num_rows,
                                                    meta_extra)
@@ -271,7 +275,8 @@ class ArrowIpcSerializer(object):
                              cache_hit=meta.get('cache_hit'),
                              telemetry=meta.get('telemetry'),
                              breakers=meta.get('breakers'),
-                             trace=meta.get('trace'))
+                             trace=meta.get('trace'),
+                             lineage=meta.get('lineage'))
 
 
 def _as_bytes(frame: Frame) -> bytes:

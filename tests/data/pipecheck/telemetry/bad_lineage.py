@@ -1,0 +1,8 @@
+"""Known-bad fixture: lineage telemetry names off the spans.py catalogs."""
+from petastorm_tpu.telemetry.tracing import trace_instant
+
+
+def work(registry):
+    registry.inc('lineage_divergense')  # typo: should be 'lineage_divergence'
+    trace_instant('lineage_divergance')  # typo: should be 'lineage_divergence'
+    registry.gauge('lineage_items_foldd').set(3.0)  # typo: 'lineage_items_folded'

@@ -1,0 +1,9 @@
+"""Known-good fixture: lineage telemetry names straight off the catalogs."""
+from petastorm_tpu.telemetry.tracing import trace_instant
+
+
+def work(registry):
+    registry.inc('lineage_divergence')
+    trace_instant('lineage_divergence')
+    registry.gauge('lineage_items_folded').set(7.0)
+    registry.gauge('lineage_pending_items').set(1.0)

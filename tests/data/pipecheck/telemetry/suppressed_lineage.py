@@ -1,0 +1,5 @@
+"""Suppression fixture: an off-catalog lineage counter, explicitly allowed."""
+
+
+def work(registry):
+    registry.inc('lineage_experiment_total')  # pipecheck: disable=telemetry-names -- experiment-local lineage counter, removed with the experiment

@@ -1,0 +1,583 @@
+"""Sample-lineage audit plane (docs/observability.md "Sample lineage &
+determinism audit"): chained-order-digest units, recorder reorder/divergence
+semantics, digest parity across every pool path and the service fleet,
+respawn/attempt invariance, state_dict save/resume continuity, the dry
+replay verifier + first-divergence differ (attribution + exit codes), and
+the content-fingerprint sampling."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.telemetry.lineage import (ATTRIBUTION_EXIT_CODES,
+                                             EXIT_CONTENT, EXIT_DIVERGED,
+                                             EXIT_ERROR, EXIT_OK,
+                                             EXIT_QUARANTINE,
+                                             EXIT_SCHEDULE_PLAN, EXIT_SEED,
+                                             LineagePolicy, LineageRecorder,
+                                             canonical_identity,
+                                             content_fingerprint,
+                                             diff_manifests, fold_digest,
+                                             genesis_digest, load_manifest,
+                                             main as lineage_main,
+                                             manifest_items,
+                                             resolve_lineage_policy,
+                                             verify_manifest)
+
+from test_common import create_test_dataset
+
+NO_MANIFEST = LineagePolicy(manifest=False)
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp('lineage') / 'dataset')
+    rows = create_test_dataset(url, num_rows=40)
+    return {'url': url, 'rows': rows}
+
+
+def read_digest(url, lineage=NO_MANIFEST, consume='columnar', **kwargs):
+    kwargs.setdefault('num_epochs', 1)
+    kwargs.setdefault('seed', 7)
+    kwargs.setdefault('shuffle_row_groups', True)
+    with make_reader(url, lineage=lineage, **kwargs) as reader:
+        if consume == 'columnar':
+            for _ in reader.iter_columnar(include_empty=True):
+                pass
+        else:
+            for _ in reader:
+                pass
+        report = reader.diagnostics['lineage']
+        return reader.order_digest(), report
+
+
+# ------------------------------------------------------------------- units
+
+def test_fold_digest_deterministic_and_token_scoped():
+    identity = canonical_identity(0, 'a.parquet', 3, None, 0)
+    d1 = fold_digest(genesis_digest('tok'), identity, 10)
+    d2 = fold_digest(genesis_digest('tok'), identity, 10)
+    assert d1 == d2
+    assert d1 != fold_digest(genesis_digest('other'), identity, 10)
+    assert d1 != fold_digest(genesis_digest('tok'), identity, 11)
+    assert d1 != fold_digest(
+        genesis_digest('tok'), canonical_identity(0, 'a.parquet', 3, (0, 5), 0),
+        10)
+
+
+def test_canonical_identity_json_safe():
+    # numpy ints (fragment enumeration) must not poison the JSON manifest
+    identity = canonical_identity(np.int64(1), 'f.parquet', np.int64(2),
+                                  (np.int64(0), np.int64(4)), np.int64(1))
+    assert identity == [1, 'f.parquet', 2, [0, 4], 1]
+    assert json.loads(json.dumps(identity)) == identity
+    assert canonical_identity(0, 'f', None, None, 0)[2] is None
+
+
+def test_resolve_policy_forms(tmp_path):
+    assert resolve_lineage_policy(None) is None
+    assert resolve_lineage_policy(False) is None
+    assert resolve_lineage_policy(True) == LineagePolicy()
+    path = str(tmp_path / 'm.jsonl')
+    assert resolve_lineage_policy(path).manifest_path == path
+    policy = LineagePolicy(fingerprint_every=4)
+    assert resolve_lineage_policy(policy) is policy
+    with pytest.raises(TypeError):
+        resolve_lineage_policy(3.14)
+    with pytest.raises(ValueError):
+        LineagePolicy(fingerprint_every=-1)
+    with pytest.raises(ValueError):
+        LineagePolicy(manifest_every=0)
+
+
+def test_content_fingerprint_array_vs_list_and_corruption():
+    a = {'x': np.arange(12, dtype=np.int32).reshape(3, 4)}
+    b = {'x': np.arange(12, dtype=np.int32).reshape(3, 4)}
+    assert content_fingerprint(a) == content_fingerprint(b)
+    b['x'] = b['x'].copy()
+    b['x'][1, 2] += 1  # one flipped value must change the CRC
+    assert content_fingerprint(a) != content_fingerprint(b)
+    # ragged list columns fingerprint cell-by-cell
+    ragged = {'y': [np.zeros(2), np.ones(3)]}
+    assert content_fingerprint(ragged) == content_fingerprint(
+        {'y': [np.zeros(2), np.ones(3)]})
+    # object cells fall back to a stable repr
+    objs = {'z': np.array(['alpha', 'beta'], dtype=object)}
+    assert content_fingerprint(objs) == content_fingerprint(
+        {'z': np.array(['alpha', 'beta'], dtype=object)})
+
+
+def _expect(recorder, epoch, piece, rows_map=None):
+    recorder.expect(epoch, piece, 0, 'frag.parquet', piece, None)
+
+
+def test_recorder_folds_out_of_order_deliveries():
+    recorder = LineageRecorder('tok', LineagePolicy(manifest=False))
+    for piece in range(4):
+        _expect(recorder, 0, piece)
+    # deliver out of ventilation order: 2, 0, 3, 1
+    recorder.deliver((0, 2, 0), 5)
+    assert recorder.report()['items_folded'] == 0  # blocked on piece 0
+    recorder.deliver((0, 0, 0), 5)
+    assert recorder.report()['items_folded'] == 1  # 2 still waits on 1
+    recorder.deliver((0, 3, 0), 5)
+    recorder.deliver((0, 1, 0), 5)
+    report = recorder.report()
+    assert report['items_folded'] == 4 and report['pending_items'] == 0
+    # the fold ORDER is ventilation order, independent of delivery order
+    expected = genesis_digest('tok')
+    for piece in range(4):
+        expected = fold_digest(
+            expected, canonical_identity(0, 'frag.parquet', piece, None, 0), 5)
+    assert recorder.order_digest() == expected.hex()
+    assert report['divergence'] == 0
+
+
+def test_recorder_divergence_unknown_and_duplicate():
+    recorder = LineageRecorder('tok', LineagePolicy(manifest=False))
+    _expect(recorder, 0, 0)
+    _expect(recorder, 0, 1)
+    recorder.deliver((0, 9, 0), 5)  # never ventilated
+    recorder.deliver((0, 1, 0), 5)  # pending behind piece 0
+    recorder.deliver((0, 1, 0), 5)  # duplicate of a pending item
+    report = recorder.report()
+    assert report['divergence'] == 2
+    assert report['last_divergence']['reason'] == 'duplicate_delivery'
+    # a re-delivery of an already-FOLDED item surfaces as unexpected (the
+    # fold forgets retired keys — bounded memory); still a divergence
+    recorder.deliver((0, 0, 0), 5)
+    assert recorder.report()['items_folded'] == 2
+    recorder.deliver((0, 0, 0), 5)
+    assert recorder.report()['divergence'] == 3
+
+
+def test_recorder_state_roundtrip_mid_stream():
+    recorder = LineageRecorder('tok', LineagePolicy(manifest=False))
+    for piece in range(5):
+        _expect(recorder, 0, piece)
+    recorder.deliver((0, 0, 0), 3)
+    recorder.deliver((0, 2, 0), 3)  # delivered out of order: pending
+    state = recorder.state_dict()
+    # JSON roundtrip: checkpoints cross serialization boundaries
+    state = json.loads(json.dumps(state))
+    resumed = LineageRecorder('tok', LineagePolicy(manifest=False),
+                              resume_state=state)
+    # pieces 1, 3, 4 re-ventilate (2 was delivered=consumed, never again)
+    for piece in (1, 3, 4):
+        _expect(resumed, 0, piece)
+    for piece in (1, 3, 4):
+        resumed.deliver((0, piece, 0), 3)
+    baseline = LineageRecorder('tok', LineagePolicy(manifest=False))
+    for piece in range(5):
+        _expect(baseline, 0, piece)
+    for piece in range(5):
+        baseline.deliver((0, piece, 0), 3)
+    assert resumed.order_digest() == baseline.order_digest()
+    assert resumed.report()['divergence'] == 0
+
+
+def test_recorder_resume_mismatch_is_divergence():
+    recorder = LineageRecorder('tok', LineagePolicy(manifest=False))
+    _expect(recorder, 0, 0)
+    state = recorder.state_dict()
+    resumed = LineageRecorder('tok', LineagePolicy(manifest=False),
+                              resume_state=state)
+    # the resumed ventilator produces a DIFFERENT item where the checkpoint
+    # expected piece 0 — that is exactly the bug this plane exists to catch
+    resumed.expect(0, 5, 0, 'other.parquet', 5, None)
+    assert resumed.report()['divergence'] == 1
+    assert resumed.report()['last_divergence']['reason'] == 'resume_mismatch'
+
+
+# ------------------------------------------------ e2e digest determinism
+
+def test_digest_identical_across_pools(dataset):
+    """Acceptance: same seed => byte-identical order_digest() on the dummy,
+    thread and process pool paths (completion order differs wildly; the
+    ventilation-order fold cancels it)."""
+    digests = {}
+    for pool in ('dummy', 'thread', 'process'):
+        digest, report = read_digest(dataset['url'], reader_pool_type=pool,
+                                     workers_count=2, num_epochs=2)
+        assert report['divergence'] == 0, (pool, report)
+        assert report['pending_items'] == 0
+        digests[pool] = digest
+    assert len(set(digests.values())) == 1, digests
+    # a different seed is a different stream
+    other, _ = read_digest(dataset['url'], reader_pool_type='dummy',
+                           workers_count=2, num_epochs=2, seed=8)
+    assert other != digests['dummy']
+
+
+def test_digest_identical_on_service_fleet(dataset):
+    """Acceptance: a 2-worker service fleet folds the same digest as the
+    in-process pools for the same seed."""
+    pytest.importorskip('zmq')
+    from petastorm_tpu.service.fleet import ServiceFleet
+    local, _ = read_digest(dataset['url'], reader_pool_type='dummy')
+    with ServiceFleet(workers=2) as fleet:
+        served, report = read_digest(dataset['url'],
+                                     service_url=fleet.service_url)
+    assert served == local
+    assert report['divergence'] == 0
+
+
+def test_digest_row_path_matches_columnar_path(dataset):
+    columnar, _ = read_digest(dataset['url'], reader_pool_type='dummy')
+    row, _ = read_digest(dataset['url'], reader_pool_type='dummy',
+                         consume='rows')
+    assert row == columnar
+
+
+@pytest.mark.faultinject
+def test_digest_invariant_under_worker_kill_respawn(dataset):
+    """A SIGKILLed worker's in-flight item is re-ventilated by the pool and
+    redelivered under a bumped attempt — the identity is attempt-free, so
+    the digest must not move."""
+    import signal
+
+    from petastorm_tpu.workers.process_pool import ProcessPool
+    clean, _ = read_digest(dataset['url'], reader_pool_type='dummy', seed=5)
+    pool = ProcessPool(2)
+    with make_reader(dataset['url'], reader_pool=pool, seed=5, num_epochs=1,
+                     lineage=NO_MANIFEST) as reader:
+        stream = reader.iter_columnar(include_empty=True)
+        next(stream)
+        os.kill(pool._processes[0].pid, signal.SIGKILL)
+        for _ in stream:
+            pass
+        killed = reader.order_digest()
+        respawned = pool.diagnostics['workers_respawned']
+        divergence = reader.diagnostics['lineage']['divergence']
+    assert respawned >= 1
+    assert killed == clean
+    assert divergence == 0
+
+
+def test_digest_continuity_across_save_resume(dataset):
+    """Acceptance satellite: a mid-epoch state_dict checkpoint + resume
+    folds to the exact digest of an uninterrupted run (chain value +
+    pending suffix ride the checkpoint)."""
+    with make_reader(dataset['url'], reader_pool_type='dummy', seed=11,
+                     num_epochs=2, lineage=NO_MANIFEST) as reader:
+        for _ in reader:
+            pass
+        baseline = reader.order_digest()
+    first = make_reader(dataset['url'], reader_pool_type='dummy', seed=11,
+                        num_epochs=2, lineage=NO_MANIFEST)
+    rows_before = 0
+    for _ in first:
+        rows_before += 1
+        if rows_before == 55:  # mid-epoch-2, mid-batch
+            break
+    state = first.state_dict()
+    first.stop()
+    first.join()
+    assert 'lineage' in state
+    state = json.loads(json.dumps(state))  # checkpoints serialize
+    with make_reader(dataset['url'], reader_pool_type='dummy', seed=11,
+                     num_epochs=2, lineage=NO_MANIFEST,
+                     resume_state=state) as reader:
+        for _ in reader:
+            pass
+        resumed = reader.order_digest()
+        report = reader.diagnostics['lineage']
+    assert resumed == baseline
+    assert report['divergence'] == 0
+
+
+def test_disarmed_reader_is_untouched(dataset):
+    with make_reader(dataset['url'], reader_pool_type='dummy',
+                     num_epochs=1) as reader:
+        for _ in reader.iter_columnar():
+            pass
+        assert reader.order_digest() is None
+        assert 'lineage' not in reader.diagnostics
+        assert 'lineage' not in reader.state_dict()
+    assert not [name for name in os.listdir(dataset['url'])
+                if 'lineage' in name]
+
+
+def test_batch_reader_and_scrape_gauges(dataset):
+    from petastorm_tpu.reader import make_batch_reader
+    with pytest.warns(UserWarning):
+        reader = make_batch_reader(dataset['url'], lineage=NO_MANIFEST,
+                                   num_epochs=1, seed=3)
+    with reader:
+        for _ in reader.iter_columnar():
+            pass
+        digest = reader.order_digest()
+        snapshot = reader._scrape_snapshot()
+    assert digest is not None
+    assert snapshot['gauges']['lineage_items_folded'] > 0
+    assert snapshot['gauges']['lineage_pending_items'] == 0
+
+
+def test_loader_step_stamping(dataset):
+    from petastorm_tpu.parallel.loader import JaxDataLoader
+    with make_reader(dataset['url'], reader_pool_type='dummy', num_epochs=1,
+                     schema_fields=['id'], lineage=NO_MANIFEST) as reader:
+        loader = JaxDataLoader(reader, batch_size=8, device_put=False,
+                               drop_last=False)
+        batches = sum(1 for _ in loader)
+        assert batches > 0
+        assert reader.diagnostics['lineage']['step'] == batches
+
+
+# ----------------------------------------------------- verify / diff CLI
+
+def record_manifest(url, manifest, seed=29, fingerprint_every=0, **kwargs):
+    policy = LineagePolicy(manifest_path=manifest,
+                           fingerprint_every=fingerprint_every)
+    digest, report = read_digest(url, lineage=policy, seed=seed, **kwargs)
+    assert report['divergence'] == 0
+    return digest
+
+
+def test_verify_passes_on_recorded_run(dataset, tmp_path, capsys):
+    """Acceptance: ``lineage verify`` re-derives the stream from the header
+    (seed + shard config + schedule plan + quarantine ledger) and the store's
+    footer metadata — zero data re-read — and confirms the recorded digest."""
+    manifest = str(tmp_path / 'run.jsonl')
+    digest = record_manifest(dataset['url'], manifest)
+    result = verify_manifest(manifest, dataset_url=dataset['url'])
+    assert result['ok'], result
+    assert result['order_digest'] == digest
+    assert result['exit_code'] == EXIT_OK
+    # the CLI form (distinct exit codes are the contract scripts consume)
+    code = lineage_main(['verify', dataset['url'], '--manifest', manifest,
+                         '--json'])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert code == EXIT_OK and out['ok']
+
+
+def test_verify_catches_tampered_manifest(dataset, tmp_path):
+    manifest = str(tmp_path / 'run.jsonl')
+    record_manifest(dataset['url'], manifest)
+    lines = open(manifest).read().splitlines()
+    tampered = []
+    for line in lines:
+        record = json.loads(line)
+        if record['event'] == 'lineage_manifest' and record['items']:
+            record['items'][0][5] = int(record['items'][0][5]) + 1  # rows
+        tampered.append(json.dumps(record))
+    open(manifest, 'w').write('\n'.join(tampered) + '\n')
+    result = verify_manifest(manifest, dataset_url=dataset['url'])
+    assert not result['ok'] and result['reason'] == 'chain_mismatch'
+    assert result['exit_code'] == EXIT_DIVERGED
+
+
+def test_verify_catches_reordered_stream(dataset, tmp_path):
+    """A manifest whose chain is self-consistent but whose ORDER does not
+    derive from the recorded (seed, schedule) replays as divergent."""
+    manifest = str(tmp_path / 'run.jsonl')
+    record_manifest(dataset['url'], manifest)
+    segments = load_manifest(manifest)
+    header = segments[-1]['header']
+    items = manifest_items(segments[-1])
+    items[0], items[1] = items[1], items[0]  # swap the first two deliveries
+    # re-chain so only the ORDER is wrong, not the digest arithmetic
+    digest = bytes.fromhex(header['genesis'])
+    prev = digest
+    for row in items:
+        digest = fold_digest(digest, row[:5], int(row[5]))
+    record = {'event': 'lineage_manifest', 'first_seq': 0, 'step': 0,
+              'prev_digest': prev.hex(), 'digest': digest.hex(),
+              'items': items}
+    with open(manifest, 'w') as f:
+        f.write(json.dumps(dict(header, event='lineage_header')) + '\n')
+        f.write(json.dumps(record) + '\n')
+    result = verify_manifest(manifest, dataset_url=dataset['url'])
+    assert not result['ok'] and result['reason'] == 'order_divergence'
+    assert result['divergent_step'] == 0
+
+
+def test_verify_refuses_seedless_shuffle_as_unverifiable(dataset, tmp_path):
+    """seed=None shuffles with fresh OS entropy: the order is real but not
+    re-derivable — verify must say 'unverifiable' (exit 2), never diagnose
+    a false divergence on a healthy run."""
+    manifest = str(tmp_path / 'seedless.jsonl')
+    policy = LineagePolicy(manifest_path=manifest)
+    _digest, report = read_digest(dataset['url'], lineage=policy, seed=None)
+    assert report['divergence'] == 0
+    result = verify_manifest(manifest, dataset_url=dataset['url'])
+    assert not result['ok']
+    assert result['reason'] == 'seedless_shuffle'
+    assert result['exit_code'] == EXIT_ERROR
+
+
+def test_interleave_knob_pinned_when_lineage_armed(dataset):
+    """The schedule_interleave autotune knob is pinned on lineage-armed
+    readers: the manifest header froze the plan, and a mid-run interleave
+    flip would make verify diagnose divergence on a legitimate order."""
+    from petastorm_tpu.autotune.knobs import build_reader_knobs
+    with make_reader(dataset['url'], reader_pool_type='dummy', num_epochs=1,
+                     seed=3, shuffle_row_groups=True,
+                     cost_schedule=True) as reader:
+        unaudited = {knob.knob_id for knob in build_reader_knobs(reader)}
+        for _ in reader.iter_columnar():
+            pass
+    with make_reader(dataset['url'], reader_pool_type='dummy', num_epochs=1,
+                     seed=3, shuffle_row_groups=True, cost_schedule=True,
+                     lineage=NO_MANIFEST) as reader:
+        audited = {knob.knob_id for knob in build_reader_knobs(reader)}
+        for _ in reader.iter_columnar():
+            pass
+    assert 'schedule_interleave' in unaudited
+    assert 'schedule_interleave' not in audited
+    assert unaudited - audited == {'schedule_interleave'}
+
+
+def test_verify_headerless_manifest_errors(tmp_path):
+    manifest = str(tmp_path / 'orphan.jsonl')
+    with open(manifest, 'w') as f:
+        f.write(json.dumps({'event': 'lineage_manifest', 'first_seq': 4,
+                            'step': 0, 'prev_digest': '00' * 16,
+                            'digest': '00' * 16, 'items': []}) + '\n')
+    assert verify_manifest(manifest)['exit_code'] == EXIT_ERROR
+
+
+def test_diff_identical_and_seed_attribution(dataset, tmp_path):
+    m_a = str(tmp_path / 'a.jsonl')
+    m_b = str(tmp_path / 'b.jsonl')
+    m_c = str(tmp_path / 'c.jsonl')
+    record_manifest(dataset['url'], m_a, seed=29)
+    record_manifest(dataset['url'], m_b, seed=29)
+    record_manifest(dataset['url'], m_c, seed=30)
+    same = diff_manifests(m_a, m_b)
+    assert same['identical'] and same['exit_code'] == EXIT_OK
+    diff = diff_manifests(m_a, m_c)
+    assert not diff['identical']
+    assert diff['attribution'] == 'seed'
+    assert diff['exit_code'] == EXIT_SEED
+    assert diff['first_divergent_step'] is not None
+
+
+def test_diff_attributes_ledger_delta_to_schedule_plan(dataset, tmp_path):
+    """Acceptance: mutate the cost ledger between two recorded runs (the
+    interleave reorders) — ``lineage diff`` reports the first divergent step
+    attributed to the schedule plan, with its distinct exit code."""
+    from petastorm_tpu.telemetry import tracing
+    from petastorm_tpu.telemetry.cost_model import default_ledger_path
+    tracing.reset_tracing()
+    tracing.set_trace_enabled(True)
+    try:
+        with make_reader(dataset['url'], workers_count=1, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            for _ in reader.iter_columnar():
+                pass
+            ledger = reader.cost_ledger()
+            token = reader.dataset_token
+    finally:
+        tracing.set_trace_enabled(False)
+        tracing.reset_tracing()
+    keys = sorted(ledger._entries)
+    total = max(sum(cell['sum_s'] for entry in ledger._entries.values()
+                    for cell in entry['stages'].values()), 1e-3)
+
+    def set_heavy(key, scale):
+        for other in keys:
+            cell = ledger._entries[other]['stages'].setdefault(
+                'decode', {'count': 1, 'sum_s': 0.0, 'max_s': 0.0})
+            cell['sum_s'] = scale * total if other == key else 1e-4
+    ledger_path = default_ledger_path(dataset['url'], token)
+    m_a = str(tmp_path / 'a.jsonl')
+    m_b = str(tmp_path / 'b.jsonl')
+    try:
+        set_heavy(keys[0], 50.0)
+        ledger.save(ledger_path)
+        digest_a = record_manifest(dataset['url'], m_a, cost_schedule=True)
+        assert verify_manifest(m_a, dataset_url=dataset['url'])['ok']
+        set_heavy(keys[-1], 80.0)  # the ledger delta reorders the interleave
+        ledger.save(ledger_path)
+        digest_b = record_manifest(dataset['url'], m_b, cost_schedule=True)
+    finally:
+        os.remove(ledger_path)
+    assert digest_a != digest_b
+    result = diff_manifests(m_a, m_b)
+    assert result['attribution'] == 'schedule_plan', result
+    assert result['exit_code'] == EXIT_SCHEDULE_PLAN
+    assert result['first_divergent_step'] is not None
+
+
+def test_diff_attributes_content_corruption(dataset, tmp_path):
+    """Same order, different bytes: sampled fingerprints catch what the
+    order digest cannot, and diff attributes it to content."""
+    m_a = str(tmp_path / 'a.jsonl')
+    m_b = str(tmp_path / 'b.jsonl')
+    record_manifest(dataset['url'], m_a, fingerprint_every=1)
+    record_manifest(dataset['url'], m_b, fingerprint_every=1)
+    assert diff_manifests(m_a, m_b)['identical']  # same data, same CRCs
+    # simulate silent corruption: one recorded fingerprint flips
+    lines = [json.loads(line) for line in open(m_b).read().splitlines()]
+    flipped = False
+    for record in lines:
+        if record['event'] == 'lineage_manifest':
+            for row in record['items']:
+                if row[6] is not None and not flipped:
+                    row[6] = int(row[6]) ^ 0xDEAD
+                    flipped = True
+    assert flipped
+    open(m_b, 'w').write('\n'.join(json.dumps(r) for r in lines) + '\n')
+    result = diff_manifests(m_a, m_b)
+    assert result['attribution'] == 'content'
+    assert result['exit_code'] == EXIT_CONTENT
+
+
+def test_diff_attributes_quarantine_delta(tmp_path):
+    """Header quarantine deltas attribute divergence to the quarantine
+    subsystem (a fragment skipped at enumeration shifts every later item)."""
+    def write(path, quarantined, items):
+        header = {'event': 'lineage_header', 'seed': 1, 'dataset_token': 't',
+                  'genesis': genesis_digest('t').hex(),
+                  'shuffle_row_groups': False, 'num_epochs': 1,
+                  'drop_partitions': 1, 'items': items,
+                  'quarantined_fragments': quarantined}
+        digest = genesis_digest('t')
+        rows = []
+        for item in items:
+            digest = fold_digest(digest,
+                                 canonical_identity(0, item[1], item[2],
+                                                    item[3], item[4]), 5)
+            rows.append(canonical_identity(0, item[1], item[2], item[3],
+                                           item[4]) + [5, None, 0])
+        record = {'event': 'lineage_manifest', 'first_seq': 0, 'step': 0,
+                  'prev_digest': genesis_digest('t').hex(),
+                  'digest': digest.hex(), 'items': rows}
+        with open(path, 'w') as f:
+            f.write(json.dumps(header) + '\n')
+            f.write(json.dumps(record) + '\n')
+    m_a = str(tmp_path / 'a.jsonl')
+    m_b = str(tmp_path / 'b.jsonl')
+    write(m_a, [], [[0, 'f0', 0, None, 0], [1, 'f1', 0, None, 0]])
+    write(m_b, ['f0'], [[0, 'f1', 0, None, 0]])
+    result = diff_manifests(m_a, m_b)
+    assert result['attribution'] == 'quarantine'
+    assert result['exit_code'] == EXIT_QUARANTINE
+
+
+def test_fingerprints_sampled_and_identical_across_pools(dataset, tmp_path):
+    """fingerprint_every=1 hashes every piece in the PRODUCING worker; the
+    CRCs ride the sidecar and agree across pool paths."""
+    m_thread = str(tmp_path / 'thread.jsonl')
+    m_process = str(tmp_path / 'process.jsonl')
+    record_manifest(dataset['url'], m_thread, fingerprint_every=1,
+                    reader_pool_type='thread', workers_count=2)
+    record_manifest(dataset['url'], m_process, fingerprint_every=1,
+                    reader_pool_type='process', workers_count=2)
+    crc_thread = [row[6] for row in manifest_items(load_manifest(m_thread)[-1])]
+    crc_process = [row[6]
+                   for row in manifest_items(load_manifest(m_process)[-1])]
+    assert any(crc is not None for crc in crc_thread)
+    assert crc_thread == crc_process
+    assert diff_manifests(m_thread, m_process)['identical']
+
+
+def test_attribution_exit_codes_are_distinct():
+    codes = [code for name, code in ATTRIBUTION_EXIT_CODES.items()
+             if name != 'unknown']
+    assert len(set(codes)) == len(codes)
+    assert ATTRIBUTION_EXIT_CODES['identical'] == 0

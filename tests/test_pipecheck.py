@@ -52,6 +52,9 @@ BAD_FIXTURES = [
      ['pool_wrokers', 'KNOB_IDS', 'ventilator_max_inflight']),
     ('telemetry/bad_gauge.py', ['telemetry-names'], 2,
      ['slo_efficienzy', 'GAUGES', 'service_queue_depht']),
+    ('telemetry/bad_lineage.py', ['telemetry-names'], 3,
+     ['lineage_divergense', 'COUNTERS', 'lineage_divergance',
+      'TRACE_INSTANTS', 'lineage_items_foldd', 'GAUGES']),
     ('telemetry/bad_cost/telemetry/cost_model.py', ['telemetry-names'], 1,
      ['rowgroup_reed', 'COST_STAGES']),
     ('clock/bad', ['clock-discipline'], 1, ['time.monotonic']),
@@ -83,6 +86,7 @@ GOOD_FIXTURES = [
     ('telemetry/good_instant.py', ['telemetry-names']),
     ('telemetry/good_knob.py', ['telemetry-names']),
     ('telemetry/good_gauge.py', ['telemetry-names']),
+    ('telemetry/good_lineage.py', ['telemetry-names']),
     ('telemetry/good_cost/telemetry/cost_model.py', ['telemetry-names']),
     ('clock/good', ['clock-discipline']),
     ('exceptions/good_swallow.py', ['exception-hygiene']),
@@ -114,6 +118,7 @@ def test_known_good_fixture_is_clean(path, rules):
     ('telemetry/suppressed_instant.py', ['telemetry-names']),
     ('telemetry/suppressed_knob.py', ['telemetry-names']),
     ('telemetry/suppressed_gauge.py', ['telemetry-names']),
+    ('telemetry/suppressed_lineage.py', ['telemetry-names']),
     ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
     ('protocol/service_suppressed_kinds', ['protocol-conformance']),
 ])

@@ -288,6 +288,45 @@ def test_jsonl_logger_max_bytes_rotation(tmp_path):
     assert len(open(path2).read().strip().splitlines()) == 5
 
 
+def test_jsonl_logger_rotation_chain(tmp_path, monkeypatch):
+    """Satellite (ISSUE 13): ``max_rotations`` keeps a ``.1 -> .N`` chain of
+    rotated generations (oldest dropped off the end), so a long-running
+    manifest log retains history instead of keeping exactly one ``.1``;
+    ``PETASTORM_TPU_TELEMETRY_JSONL_ROTATIONS`` configures it from the env."""
+    from petastorm_tpu.telemetry.export import env_rotation_settings
+    line_bytes = len(json.dumps({'ts': 0.0, 'event': 'e', 'pid': 0,
+                                 'telemetry': {}, 'n': 0})) + 40
+    path = str(tmp_path / 'chain.jsonl')
+    logger = JsonlEventLogger(path, interval_s=0, max_bytes=line_bytes,
+                              max_rotations=3)
+    for n in range(6):  # every line rotates: 6 writes -> live + .1/.2/.3
+        assert logger.emit({}, event='e', n=n)
+    assert os.path.exists(path + '.1')
+    assert os.path.exists(path + '.2')
+    assert os.path.exists(path + '.3')
+    assert not os.path.exists(path + '.4')  # the chain is bounded
+    # generation order: live file holds the newest line, .3 the oldest kept
+    def seq(p):
+        return [json.loads(ln)['n'] for ln in open(p).read().splitlines()]
+    assert seq(path) == [5]
+    assert seq(path + '.1') == [4]
+    assert seq(path + '.2') == [3]
+    assert seq(path + '.3') == [2]  # n=0,1 fell off the end
+    # default stays the prior single-.1 behavior
+    path2 = str(tmp_path / 'single.jsonl')
+    logger2 = JsonlEventLogger(path2, interval_s=0, max_bytes=line_bytes)
+    for n in range(4):
+        assert logger2.emit({}, event='e', n=n)
+    assert os.path.exists(path2 + '.1')
+    assert not os.path.exists(path2 + '.2')
+    # env plumbing
+    monkeypatch.setenv('PETASTORM_TPU_TELEMETRY_JSONL_MAX_BYTES', '123')
+    monkeypatch.setenv('PETASTORM_TPU_TELEMETRY_JSONL_ROTATIONS', '7')
+    assert env_rotation_settings() == (123, 7)
+    monkeypatch.setenv('PETASTORM_TPU_TELEMETRY_JSONL_ROTATIONS', 'junk')
+    assert env_rotation_settings()[1] == 1
+
+
 def test_prometheus_no_duplicate_inf_bucket():
     """An observation clamped into the LAST bucket must not yield two
     le=\"+Inf\" series (scrapers reject duplicate series)."""
