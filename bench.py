@@ -47,6 +47,7 @@ tag so historical ``vs_baseline`` ratios stay interpretable (ADVICE.md round 1).
 Extra diagnostics go to stderr only.
 """
 
+import glob
 import json
 import os
 import subprocess
@@ -156,7 +157,8 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'mnist_inmem', 'imagenet_stream', 'imagenet_scan', 'decode_delta',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
                  'resilience', 'pipecheck', 'tracing', 'service', 'autotune',
-                 'device_decode', 'observability', 'schedule', 'lineage')
+                 'device_decode', 'observability', 'schedule', 'lineage',
+                 'incidents')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -165,7 +167,8 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # then the sections with the least prior hardware evidence, and the
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
-SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'lineage',
+SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'incidents',
+                     'lineage',
                      'schedule', 'autotune', 'device_decode', 'decode_bench',
                      'service', 'wire_bench', 'telemetry', 'tracing',
                      'resilience', 'mnist_scan_stream', 'flash', 'moe',
@@ -217,6 +220,58 @@ def normalize_headline(result):
     result.setdefault('vs_baseline',
                       round(result['value'] / REFERENCE_BASELINE_ROWS_PER_SEC, 3))
     return result
+
+
+# Rate-shaped result keys: higher is better, so a relative DROP beyond the
+# threshold is a regression. Overhead/stall keys are excluded on purpose —
+# they hover near zero, where relative deltas are pure noise.
+_RATE_KEY_MARKERS = ('_per_sec', '_speedup')
+
+
+def newest_bench_baseline(bench_dir=None):
+    """Path of the newest committed ``BENCH_*.json`` (mtime, name tiebreak),
+    or None when no prior round exists."""
+    bench_dir = bench_dir or os.path.dirname(os.path.abspath(__file__))
+    paths = glob.glob(os.path.join(bench_dir, 'BENCH_*.json'))
+    if not paths:
+        return None
+    return max(paths, key=lambda p: (os.path.getmtime(p), p))
+
+
+def compare_to_baseline(new, old, threshold_pct=10.0):
+    """Diff this run's rate-shaped metrics against a prior round's bench JSON
+    and return ``[{'key', 'old', 'new', 'drop_pct'}, ...]`` for every drop
+    beyond ``threshold_pct`` — the warn-only per-run perf-drift line.
+
+    Accepts either a bare results dict or the driver's ``{'parsed': {...}}``
+    wrapper for ``old``. Cross-platform pairs (a TPU run against a CPU
+    fallback round, or vice versa) compare to nothing: every number would
+    shift by an order of magnitude and the list would be pure noise."""
+    parsed = old.get('parsed') if isinstance(old, dict) else None
+    if isinstance(parsed, dict):
+        old = parsed
+    if not isinstance(old, dict):
+        return []
+    if (new.get('platform') and old.get('platform')
+            and new['platform'] != old['platform']):
+        return []
+    regressions = []
+    for key in sorted(new):
+        if not any(marker in key for marker in _RATE_KEY_MARKERS):
+            continue
+        new_value, old_value = new.get(key), old.get(key)
+        if (isinstance(new_value, bool) or isinstance(old_value, bool)
+                or not isinstance(new_value, (int, float))
+                or not isinstance(old_value, (int, float))):
+            continue
+        if old_value <= 0:
+            continue  # placeholder zeros / failed sections compare to nothing
+        drop_pct = (old_value - new_value) / old_value * 100.0
+        if drop_pct > threshold_pct:
+            regressions.append({'key': key, 'old': old_value,
+                                'new': new_value,
+                                'drop_pct': round(drop_pct, 1)})
+    return regressions
 
 
 def dataset_url():
@@ -519,6 +574,24 @@ def orchestrate():
         return
     if 'platform' not in result:
         log('WARNING: child JSON carries no platform field')
+    # Perf-drift line (warn-only): diff rate metrics against the newest
+    # committed round so a >10% drop is visible in THIS run's artifact — the
+    # exit code never changes, the driver decides what to do with it.
+    baseline_path = newest_bench_baseline()
+    if baseline_path is not None:
+        try:
+            with open(baseline_path) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as exc:
+            log('baseline compare: unreadable {}: {!r}'.format(
+                baseline_path, exc))
+        else:
+            result['baseline_compared'] = os.path.basename(baseline_path)
+            result['regressions'] = compare_to_baseline(result, baseline)
+            for reg in result['regressions']:
+                log('WARNING: {} regressed {:.1f}% vs {} ({} -> {})'.format(
+                    reg['key'], reg['drop_pct'], result['baseline_compared'],
+                    reg['old'], reg['new']))
     # Salvaged partials come from PARTIAL_JSON lines emitted BEFORE the child's final
     # normalization — enforce the one-JSON-line contract ({metric, value, unit,
     # vs_baseline}) here for every path. Printed unconditionally: the final line
@@ -1658,6 +1731,114 @@ def child_main():
             'lineage_verify_ok': bool(verify['ok']),
         })
 
+    def run_incidents():
+        """Incident autopsy plane (host-only, fast; docs/observability.md
+        "Incident autopsy plane"): (1) capture-overhead guard — an
+        incidents-armed process-pool epoch (recorder wired, no edge fires)
+        vs a bare one, min-of-3 interleaved pairs; the overhead percentage
+        is the BENCH-history guard for the ISSUE-15 acceptance (<= 3%);
+        (2) capture probe — a forced breaker closed->open edge on an armed
+        dummy-pool reader retains exactly one bundle (the re-trip inside the
+        refill window is rate-limited) whose autopsy ranks storage-path
+        first with its exit code; (3) retention probe — max_bundles + 1
+        triggers on an injected clock retain exactly max_bundles, oldest
+        evicted."""
+        from petastorm_tpu.resilience import default_board
+        from petastorm_tpu.telemetry.incident import (EXIT_CODES,
+                                                      IncidentPolicy,
+                                                      IncidentRecorder,
+                                                      analyze_bundle,
+                                                      scan_bundles)
+        incident_root = tempfile.mkdtemp(prefix='bench_incidents_')
+
+        def epoch(incidents):
+            reader = make_reader(url, reader_pool_type='process',
+                                 workers_count=min(WORKERS, 2), num_epochs=1,
+                                 seed=13, shuffle_row_groups=True,
+                                 incidents=incidents)
+            rows = 0
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            reader.stop()
+            reader.join()
+            return rows / elapsed
+
+        armed_policy = IncidentPolicy(
+            home=os.path.join(incident_root, 'armed'))
+        bare_rates, armed_rates = [], []
+        for _ in range(3):  # interleaved pairs: shared-host drift cancels
+            bare_rates.append(epoch(None))
+            armed_rates.append(epoch(armed_policy))
+        bare_rate = max(bare_rates)
+        armed_rate = max(armed_rates)
+        overhead_pct = (bare_rate - armed_rate) / bare_rate * 100.0
+
+        # capture probe: the acceptance (b) path — forced breaker trip on an
+        # armed reader => exactly one bundle, second edge rate-limited,
+        # autopsy ranks the trigger's cause class first
+        probe_home = os.path.join(incident_root, 'probe')
+        reader = make_reader(url, reader_pool_type='dummy', num_epochs=1,
+                             incidents=IncidentPolicy(home=probe_home))
+        for _ in reader.iter_columnar():
+            break
+        breaker = default_board().breaker('bench_incident_probe',
+                                          failure_threshold=1)
+        breaker.record_failure()  # closed -> open: the captured edge
+        breaker.reset()           # open -> closed: no capture (not an open)
+        breaker.record_failure()  # second edge inside refill: rate-limited
+        probe = reader.incident_report() or {}
+        reader.stop()
+        reader.join()
+        breaker.reset()  # don't leak an open breaker into later sections
+        bundles = scan_bundles(probe_home)
+        autopsy = analyze_bundle(bundles[0]['path']) if bundles else {}
+        capture_ok = (probe.get('captured') == 1
+                      and probe.get('rate_limited', 0) >= 1
+                      and len(bundles) == 1
+                      and autopsy.get('top_cause') == 'storage-path'
+                      and autopsy.get('exit_code')
+                      == EXIT_CODES['storage-path'])
+
+        # retention probe: provably bounded — max_bundles + 1 captures on an
+        # injected clock (every trigger gets a fresh token) keep exactly
+        # max_bundles, and the survivor set is the NEWEST ones
+        fake = {'now': 0.0}
+        retention_policy = IncidentPolicy(
+            home=os.path.join(incident_root, 'retention'), max_bundles=3,
+            refill_interval_s=1.0)
+        recorder = IncidentRecorder(retention_policy.home, retention_policy,
+                                    clock=lambda: fake['now'])
+        for i in range(retention_policy.max_bundles + 1):
+            fake['now'] += retention_policy.refill_interval_s
+            recorder.trigger('slo_breach', args={'probe': i})
+        retained = scan_bundles(retention_policy.home)
+        recorder.close()
+        retention_ok = (len(retained) == retention_policy.max_bundles
+                        and all(entry['bundle'] > 'incident-00000'
+                                for entry in retained))
+
+        log('incidents: armed {:.1f} rows/s vs bare {:.1f} rows/s ({:+.2f}% '
+            'capture-plane overhead); probe capture {} (captured={} '
+            'rate_limited={} top={} exit={}), retention {} ({} of {} kept '
+            'after {} triggers)'.format(
+                armed_rate, bare_rate, overhead_pct,
+                'ok' if capture_ok else 'FAIL', probe.get('captured'),
+                probe.get('rate_limited'), autopsy.get('top_cause'),
+                autopsy.get('exit_code'), 'ok' if retention_ok else 'FAIL',
+                len(retained), retention_policy.max_bundles,
+                retention_policy.max_bundles + 1))
+        results.update({
+            'incidents_armed_rows_per_sec': round(armed_rate, 1),
+            'incidents_bare_rows_per_sec': round(bare_rate, 1),
+            'incidents_overhead_pct': round(overhead_pct, 2),
+            'incidents_capture_ok': bool(capture_ok),
+            'incidents_rate_limited': int(probe.get('rate_limited', 0)),
+            'incidents_autopsy_exit_code': autopsy.get('exit_code'),
+            'incidents_retention_ok': bool(retention_ok),
+        })
+
     def run_schedule():
         """Cost-aware scheduling (host-only; docs/performance.md "Cost-aware
         scheduling"): on a deliberately skewed store (heavy random-payload
@@ -2286,6 +2467,7 @@ def child_main():
         'observability': run_observability,
         'schedule': run_schedule,
         'lineage': run_lineage,
+        'incidents': run_incidents,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

@@ -20,7 +20,10 @@ recorded runs to the first divergent step — docs/observability.md "Sample
 lineage & determinism audit"); ``trace`` dispatches to
 :mod:`petastorm_tpu.telemetry.trace_export` (flight-recorder capture of a real
 read, exported as Chrome-trace/Perfetto JSON — docs/observability.md "Flight
-recorder"); ``pipecheck`` dispatches to
+recorder"); ``autopsy`` dispatches to
+:mod:`petastorm_tpu.telemetry.incident` (ranked probable-cause postmortem
+over a captured incident bundle, exit-coded by top cause —
+docs/observability.md "Incident autopsy plane"); ``pipecheck`` dispatches to
 :mod:`petastorm_tpu.analysis` (AST-based data-plane invariant analyzer —
 docs/static-analysis.md); ``serve`` dispatches to
 :mod:`petastorm_tpu.service.fleet` (disaggregated input service: dispatcher +
@@ -60,6 +63,9 @@ def main(argv=None):
     if argv and argv[0] == 'trace':
         from petastorm_tpu.telemetry.trace_export import main as trace_main
         return trace_main(argv[1:])
+    if argv and argv[0] == 'autopsy':
+        from petastorm_tpu.telemetry.incident import main as autopsy_main
+        return autopsy_main(argv[1:])
     if argv and argv[0] == 'pipecheck':
         from petastorm_tpu.analysis.cli import main as pipecheck_main
         return pipecheck_main(argv[1:])

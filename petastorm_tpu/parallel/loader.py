@@ -207,13 +207,23 @@ class JaxDataLoader(object):
         :meth:`efficiency_report` (an
         :class:`~petastorm_tpu.telemetry.slo.SloPolicy`, a float target, or
         None = the default 0.9 target).
+    :param incidents: arm the incident autopsy plane at the loader layer
+        (``True`` or an
+        :class:`~petastorm_tpu.telemetry.incident.IncidentPolicy`) — an SLO
+        breach of the WHOLE pipeline (training loop starved) or a breaker
+        trip captures a black-box bundle over the merged loader+reader
+        telemetry; when the reader already carries its own recorder
+        (``make_reader(incidents=...)``) the loader reuses it instead of
+        building a second one — docs/observability.md "Incident autopsy
+        plane".
     """
 
     def __init__(self, reader, batch_size, mesh=None, partition_spec=None,
                  shuffling_queue_capacity=0, min_after_retrieve=None, seed=None,
                  pad_ragged=None, prefetch=2, drop_last=True, device_put=True,
                  coalesce_fields=None, device_transforms=None,
-                 device_buffer_depth=2, metrics_port=None, slo_policy=None):
+                 device_buffer_depth=2, metrics_port=None, slo_policy=None,
+                 incidents=None):
         if batch_size < 1:
             raise ValueError('batch_size must be >= 1')
         self.reader = reader
@@ -300,6 +310,34 @@ class JaxDataLoader(object):
             from petastorm_tpu.autotune.knobs import build_loader_knobs
             for knob in build_loader_knobs(self):
                 controller.catalog.add(knob)
+        # Incident autopsy plane (docs/observability.md "Incident autopsy
+        # plane"): a reader-owned recorder is reused (the loader's SLO edge
+        # joins its triggers); otherwise incidents= builds a loader-owned one
+        # over the merged whole-pipeline evidence.
+        from petastorm_tpu.telemetry.incident import resolve_incident_policy
+        self._incidents = getattr(reader, '_incidents', None)
+        self._owns_incidents = False
+        incident_policy = resolve_incident_policy(incidents)
+        if incident_policy is not None and self._incidents is None:
+            from petastorm_tpu.resilience import default_board
+            from petastorm_tpu.telemetry.incident import (
+                IncidentRecorder, default_incident_home)
+            self._incidents = IncidentRecorder(
+                default_incident_home(None), incident_policy,
+                registry=self.telemetry)
+            self._owns_incidents = True
+            self._incidents.add_source('metrics', self.telemetry_snapshot)
+            self._incidents.add_source(
+                'slo', lambda: self._evaluate_slo(self.telemetry_snapshot()))
+            self._incidents.add_source(
+                'config', lambda: {'batch_size': self.batch_size,
+                                   'prefetch': self._prefetch,
+                                   'drop_last': self._drop_last,
+                                   'reader': type(reader).__name__})
+            default_board().observe_transitions(
+                self._incidents.on_breaker_transition)
+        if self._incidents is not None:
+            self._slo.observe_breaches(self._on_slo_breach)
         # Live metrics plane (docs/observability.md): one scrape endpoint
         # over the whole-pipeline snapshot; closed by stop(). Started LAST —
         # a constructor raise after binding would leak the port and serve a
@@ -976,9 +1014,29 @@ class JaxDataLoader(object):
         snapshot = self.telemetry_snapshot()
         report = self._evaluate_slo(snapshot)
         gauges = snapshot.setdefault('gauges', {})
-        gauges['slo_efficiency'] = report['efficiency']
+        if report['efficiency'] is not None:
+            gauges['slo_efficiency'] = report['efficiency']
         gauges['slo_target_efficiency'] = report['target_efficiency']
         return snapshot
+
+    def _on_slo_breach(self, report):
+        """Loader SLO ok→breach edge → one ``slo_breach`` incident (the
+        training loop itself sat starved past the target)."""
+        if self._incidents is not None:
+            self._incidents.trigger(
+                'slo_breach',
+                args={'efficiency': report.get('efficiency'),
+                      'target': report.get('target_efficiency'),
+                      'wait_seconds': report.get('wait_seconds'),
+                      'layer': 'loader'})
+
+    def incident_report(self):
+        """The attached incident recorder's summary (loader-owned or the
+        reader's — docs/observability.md "Incident autopsy plane"); None
+        when neither layer armed ``incidents``."""
+        if self._incidents is None:
+            return None
+        return self._incidents.report()
 
     @property
     def metrics_url(self):
@@ -993,6 +1051,9 @@ class JaxDataLoader(object):
     def stop(self):
         if self._metrics_server is not None:
             self._metrics_server.stop()
+        if self._owns_incidents and self._incidents is not None:
+            # reader-owned recorders are the reader's to close
+            self._incidents.close()
         self._stop_event.set()
         self.reader.stop()
 

@@ -123,7 +123,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 retry_policy=None, shm_transport=None, item_deadline_s=None,
                 heartbeat_interval_s=None, trace=None, service_url=None,
                 autotune=None, device_decode_fields=None, metrics_port=None,
-                slo_policy=None, cost_schedule=None, lineage=None):
+                slo_policy=None, cost_schedule=None, lineage=None,
+                incidents=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -242,7 +243,21 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     data. ``True`` (default policy), a manifest path string, or a
     :class:`~petastorm_tpu.telemetry.lineage.LineagePolicy`; digest state
     rides ``state_dict()`` so save/resume folds to the same digest. Unset
-    (None, the default) records nothing."""
+    (None, the default) records nothing.
+
+    Incident autopsy plane (docs/observability.md "Incident autopsy
+    plane"): ``incidents`` arms an edge-triggered black-box recorder
+    (:class:`~petastorm_tpu.telemetry.incident.IncidentRecorder`) — when a
+    failure edge fires (breaker trip, hang-watchdog reap, quarantine, shm
+    CRC drop, SLO breach, lineage divergence) the recorder atomically writes
+    a bundle directory holding the drained trace ring, the full telemetry
+    snapshot, breaker/quarantine/cost/lineage state and config provenance,
+    rate-limited per trigger kind and retention-bounded. Inspect with
+    ``petastorm-tpu-throughput autopsy <bundle>`` (ranked probable-cause
+    report) and :meth:`Reader.incident_report` / ``diagnostics
+    ['incidents']``. ``True`` (default policy), or an
+    :class:`~petastorm_tpu.telemetry.incident.IncidentPolicy`. Unset (None,
+    the default) builds no recorder and keeps every path byte-identical."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -307,7 +322,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   initial_io_retries=construction_retries[0],
                   autotune=autotune, device_decode_fields=device_decode_fields,
                   metrics_port=metrics_port, slo_policy=slo_policy,
-                  cost_schedule=cost_schedule, lineage=lineage)
+                  cost_schedule=cost_schedule, lineage=lineage,
+                  incidents=incidents)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -324,13 +340,13 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       heartbeat_interval_s=None, trace=None, service_url=None,
                       autotune=None, device_decode_fields=None,
                       metrics_port=None, slo_policy=None, cost_schedule=None,
-                      lineage=None):
+                      lineage=None, incidents=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
     ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
     ``service_url`` / ``autotune`` / ``metrics_port`` / ``slo_policy`` /
-    ``cost_schedule`` / ``lineage`` behave exactly as in
+    ``cost_schedule`` / ``lineage`` / ``incidents`` behave exactly as in
     :func:`make_reader`.
     ``device_decode_fields`` (docs/performance.md "Device-resident decode
     tail") requires the store's Unischema codec registry: on a Unischema
@@ -408,7 +424,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   initial_io_retries=construction_retries[0],
                   autotune=autotune, device_decode_fields=device_decode_fields,
                   metrics_port=metrics_port, slo_policy=slo_policy,
-                  cost_schedule=cost_schedule, lineage=lineage)
+                  cost_schedule=cost_schedule, lineage=lineage,
+                  incidents=incidents)
 
 
 class Reader(object):
@@ -423,7 +440,8 @@ class Reader(object):
                  storage_options=None, filesystem=None, resume_state=None,
                  on_error='raise', retry_policy=None, initial_io_retries=0,
                  autotune=None, device_decode_fields=None, metrics_port=None,
-                 slo_policy=None, cost_schedule=None, lineage=None):
+                 slo_policy=None, cost_schedule=None, lineage=None,
+                 incidents=None):
         from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
         retry_policy = resolve_retry_policy(on_error, retry_policy)
         construction_retries = [initial_io_retries]
@@ -476,6 +494,17 @@ class Reader(object):
         from petastorm_tpu.telemetry.lineage import resolve_lineage_policy
         self._lineage = None
         self._lineage_policy = resolve_lineage_policy(lineage)
+        # Incident autopsy plane (docs/observability.md "Incident autopsy
+        # plane"): policy resolved up front, the recorder itself is built
+        # after the pool starts — its evidence sources (cost/lineage/
+        # autotune) must exist before the first edge can fire.
+        from petastorm_tpu.telemetry.incident import resolve_incident_policy
+        self._incidents = None
+        self._incident_policy = resolve_incident_policy(incidents)
+        # edge-detection state for the poll-based triggers (all consumed
+        # under _accounting_lock in _note_item_consumed)
+        self._incident_last_divergence = 0
+        self._incident_last_crc_failures = 0
 
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
@@ -863,6 +892,49 @@ class Reader(object):
             self._autotune = setup_reader_autotune(self, autotune_policy)
             self._autotune.start()
 
+        # Incident autopsy plane (docs/observability.md "Incident autopsy
+        # plane"): the black-box recorder subscribes to the failure edges the
+        # pipeline already raises — breaker trips (both this process's board
+        # and the worker-side sidecar states), hang reaps, quarantines, shm
+        # CRC drops, SLO breach edges and lineage divergence — and captures
+        # one rate-limited evidence bundle per edge.
+        if self._incident_policy is not None:
+            from petastorm_tpu.dataset_state import cache_state_home
+            from petastorm_tpu.resilience import default_board
+            from petastorm_tpu.telemetry.incident import (IncidentRecorder,
+                                                          default_incident_home)
+            url_for_incidents = dataset_url_or_urls if not isinstance(
+                dataset_url_or_urls, list) else dataset_url_or_urls[0]
+            self._incidents = IncidentRecorder(
+                default_incident_home(cache_state_home(cache)),
+                self._incident_policy, registry=self._telemetry)
+            self._incidents.add_source('metrics', self.telemetry_snapshot)
+            self._incidents.add_source(
+                'slo', lambda: self._evaluate_slo(self.telemetry_snapshot()))
+            self._incidents.add_source('breakers', self._breaker_evidence)
+            self._incidents.add_source('quarantine', self.quarantine.as_dicts)
+            if self._cost_scheduler is not None:
+                self._incidents.add_source('costs',
+                                           self._cost_scheduler.report)
+            if self._lineage is not None:
+                self._incidents.add_source('lineage', self._lineage.report)
+            if self._autotune is not None:
+                self._incidents.add_source('autotune', self._autotune.report)
+            provenance = {
+                'dataset_url': str(url_for_incidents),
+                'dataset_token': self.dataset_token,
+                'seed': seed, 'num_epochs': num_epochs,
+                'shuffle_row_groups': bool(shuffle_row_groups),
+                'cur_shard': cur_shard, 'shard_count': shard_count,
+                'on_error': on_error,
+                'pool': type(reader_pool).__name__,
+                'items_per_epoch': self._items_per_epoch,
+            }
+            self._incidents.add_source('config', lambda: provenance)
+            default_board().observe_transitions(
+                self._incidents.on_breaker_transition)
+            self._slo.observe_breaches(self._on_slo_breach)
+
         # Live metrics plane (docs/observability.md): one scrape endpoint
         # over this reader's cross-process snapshot; SLO gauges refresh per
         # scrape. Started last so a scrape can never observe a half-built
@@ -980,6 +1052,17 @@ class Reader(object):
         record = getattr(batch, 'quarantine', None)
         if record is not None:
             self.quarantine.add(record)
+            if self._incidents is not None:
+                # black-box capture at the edge: a reaped hang and a skipped
+                # rowgroup are distinct trigger kinds (distinct autopsy
+                # causes), both carrying the (epoch, rowgroup, attempt)
+                # coordinates of the failing item
+                kind = ('watchdog_reap' if record.reason == 'hang'
+                        else 'quarantine')
+                self._incidents.trigger(
+                    kind,
+                    ctx=(record.epoch, record.piece_index, record.attempts),
+                    args=record.as_dict())
         retries = getattr(batch, 'retries', 0)
         if retries:
             with self._accounting_lock:
@@ -1005,14 +1088,38 @@ class Reader(object):
                     self._cost_scheduler.observe(scheduled_id[1], stage_times)
         breakers = getattr(batch, 'breakers', None)
         if breakers:
+            opened = []
             with self._accounting_lock:
+                if self._incidents is not None:
+                    # worker-process breakers arrive as sidecar states, not
+                    # callbacks: detect the closed→open edge against the
+                    # last-seen state before folding the update in
+                    opened = [
+                        (name, state) for name, state in breakers.items()
+                        if state.get('state') == 'open'
+                        and (self._breaker_states.get(name) or {}).get(
+                            'state') != 'open']
                 self._breaker_states.update(breakers)
+            for name, state in opened:
+                self._incidents.trigger(
+                    'breaker_open',
+                    args={'breaker': name, 'snapshot': state})
         trace_sidecar = getattr(batch, 'trace', None)
         if trace_sidecar:
             # flight-recorder merge: the producing process's drained timeline
             # events land in this process's recorder, preserving their pid —
             # one dump_trace() then spans every process
             merge_trace_events(trace_sidecar)
+        if self._incidents is not None:
+            # poll-based edges, O(1) per batch: the process pool's CRC-drop
+            # count and the lineage recorder's divergence count only ever
+            # grow — a delta since the last batch IS the edge
+            crc_failures = getattr(self._pool, '_shm_crc_failures', 0)
+            if crc_failures > self._incident_last_crc_failures:
+                self._incident_last_crc_failures = crc_failures
+                self._incidents.trigger(
+                    'shm_crc_drop',
+                    args={'shm_crc_failures': crc_failures})
         item_id = getattr(batch, 'item_id', None)
         if item_id is None:
             return
@@ -1024,6 +1131,13 @@ class Reader(object):
                 item_id, getattr(batch, 'num_rows', 0) or 0,
                 fingerprint=getattr(batch, 'lineage', None),
                 quarantined=record is not None)
+            if self._incidents is not None:
+                divergences = self._lineage.divergence_count()
+                if divergences > self._incident_last_divergence:
+                    self._incident_last_divergence = divergences
+                    self._incidents.trigger(
+                        'lineage_divergence', ctx=item_id,
+                        args={'divergence_count': divergences})
         epoch, piece, drop = item_id
         if trace_enabled():
             # consumer-side anchor of the rowgroup's trace: present on every
@@ -1241,6 +1355,34 @@ class Reader(object):
             return None
         return self._lineage.order_digest()
 
+    # ----------------------------------------------- incident autopsy plane
+
+    def _breaker_evidence(self):
+        """The bundle's ``breakers`` source: worker-sidecar states merged
+        with this process's board (same merge ``diagnostics`` performs)."""
+        from petastorm_tpu.resilience import default_board
+        with self._accounting_lock:
+            breakers = dict(self._breaker_states)
+        breakers.update(default_board().snapshot())
+        return breakers
+
+    def _on_slo_breach(self, report):
+        """SLO ok→breach edge observer → one ``slo_breach`` incident."""
+        if self._incidents is not None:
+            self._incidents.trigger(
+                'slo_breach',
+                args={'efficiency': report.get('efficiency'),
+                      'target': report.get('target_efficiency'),
+                      'wait_seconds': report.get('wait_seconds')})
+
+    def incident_report(self):
+        """The incident recorder's summary — capture/rate-limit counters and
+        the retained bundle names (docs/observability.md "Incident autopsy
+        plane"); None when the reader was built without ``incidents``."""
+        if self._incidents is None:
+            return None
+        return self._incidents.report()
+
     # ------------------------------------------------------- metrics plane
 
     def _snapshot_with_slo(self):
@@ -1250,7 +1392,8 @@ class Reader(object):
         snapshot = self.telemetry_snapshot()
         report = self._evaluate_slo(snapshot)
         gauges = snapshot.setdefault('gauges', {})
-        gauges['slo_efficiency'] = report['efficiency']
+        if report['efficiency'] is not None:
+            gauges['slo_efficiency'] = report['efficiency']
         gauges['slo_target_efficiency'] = report['target_efficiency']
         if self._lineage is not None:
             # the /metrics view of the audit plane: fold progress + reorder-
@@ -1333,6 +1476,10 @@ class Reader(object):
             # flush the final manifest record (idempotent; the JSONL logger
             # swallows its own write failures)
             self._lineage.close()
+        if self._incidents is not None:
+            # the recorder only detaches its sources — retained bundles are
+            # the whole point and stay on disk for the autopsy CLI
+            self._incidents.close()
         self._pool.stop()
 
     def join(self):
@@ -1395,6 +1542,9 @@ class Reader(object):
         # Lineage audit block only when armed, same contract.
         if self._lineage is not None:
             diag['lineage'] = self._lineage.report()
+        # Incident autopsy block only when armed, same contract.
+        if self._incidents is not None:
+            diag['incidents'] = self._incidents.report()
         return diag
 
     def __enter__(self):

@@ -319,11 +319,17 @@ class CircuitBreaker:
         self._failures = 0
         self._successes = 0
         self._opened_count = 0
+        # transitions queued under the lock, observers notified AFTER release
+        # (an observer reading breaker state back — e.g. the incident
+        # recorder snapshotting the board — must not deadlock)
+        self._pending_notifications: List[Tuple[OnBreakerTransition, str,
+                                                str, str]] = []
 
     def __getstate__(self) -> Dict[str, Any]:
         state = self.__dict__.copy()
         del state['_lock']
         state['_on_transition'] = None  # callbacks are process-local wiring
+        state['_pending_notifications'] = []
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -369,7 +375,22 @@ class CircuitBreaker:
                                 'to_state': new_state})
         callback = self._on_transition
         if callback is not None:
-            callback(self.name, old_state, new_state)
+            # queued, not called: the caller still holds self._lock, and an
+            # observer is allowed to read breaker state back (the incident
+            # recorder snapshots the whole board mid-capture)
+            self._pending_notifications.append(
+                (callback, self.name, old_state, new_state))
+
+    def _notify(self) -> None:
+        # call OUTSIDE self._lock: drain the transition notifications queued
+        # by _transition and deliver them to the observer chain
+        while True:
+            with self._lock:
+                if not self._pending_notifications:
+                    return
+                callback, name, old_state, new_state = \
+                    self._pending_notifications.pop(0)
+            callback(name, old_state, new_state)
 
     def allow(self) -> bool:
         """True when a call may proceed. In the open state this is where the
@@ -379,9 +400,13 @@ class CircuitBreaker:
             if self._state == BREAKER_OPEN:
                 if self._clock() - self._opened_at >= self.recovery_timeout_s:
                     self._transition(BREAKER_HALF_OPEN)
-                    return True
-                return False
-            return True
+                    result = True
+                else:
+                    result = False
+            else:
+                result = True
+        self._notify()
+        return result
 
     def record_success(self) -> None:
         """A guarded call succeeded: reset the failure streak; a half-open probe
@@ -391,6 +416,7 @@ class CircuitBreaker:
             self._consecutive_failures = 0
             if self._state == BREAKER_HALF_OPEN:
                 self._transition(BREAKER_CLOSED)
+        self._notify()
 
     def record_failure(self) -> None:
         """A guarded call failed: trip open after ``failure_threshold``
@@ -403,6 +429,7 @@ class CircuitBreaker:
             elif (self._state == BREAKER_CLOSED
                     and self._consecutive_failures >= self.failure_threshold):
                 self._transition(BREAKER_OPEN)
+        self._notify()
 
     @property
     def state(self) -> str:
@@ -412,7 +439,9 @@ class CircuitBreaker:
             if (self._state == BREAKER_OPEN
                     and self._clock() - self._opened_at >= self.recovery_timeout_s):
                 self._transition(BREAKER_HALF_OPEN)
-            return self._state
+            result = self._state
+        self._notify()
+        return result
 
     @property
     def tripped(self) -> bool:
@@ -430,6 +459,7 @@ class CircuitBreaker:
             self._failures = 0
             self._successes = 0
             self._opened_count = 0
+        self._notify()
 
     def as_dict(self) -> Dict[str, Any]:
         """JSON-safe state for diagnostics / the doctor report."""
@@ -453,6 +483,7 @@ class BreakerBoard:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._breakers: Dict[str, CircuitBreaker] = {}
+        self._observers: List[OnBreakerTransition] = []
 
     def breaker(self, name: str, failure_threshold: int = 5,
                 recovery_timeout_s: float = 30.0,
@@ -463,10 +494,27 @@ class BreakerBoard:
         if existing is not None:
             return existing
         with self._lock:
-            return self._breakers.setdefault(
+            created = name not in self._breakers
+            brk = self._breakers.setdefault(
                 name, CircuitBreaker(name, failure_threshold=failure_threshold,
                                      recovery_timeout_s=recovery_timeout_s,
                                      clock=clock, on_transition=on_transition))
+            observers = list(self._observers) if created else []
+        for callback in observers:
+            brk.observe_transitions(callback)
+        return brk
+
+    def observe_transitions(self, callback: OnBreakerTransition) -> None:
+        """Watch every transition on the board: chains ``callback`` onto each
+        breaker already registered AND onto every breaker created later — the
+        board-level trigger hook the incident recorder subscribes to
+        (telemetry/incident.py; docs/observability.md "Incident autopsy
+        plane"). Observers are process-local, like per-breaker ones."""
+        with self._lock:
+            self._observers.append(callback)
+            breakers = list(self._breakers.values())
+        for brk in breakers:
+            brk.observe_transitions(callback)
 
     def snapshot(self, only_tripped: bool = False) -> Dict[str, Dict[str, Any]]:
         """``{name: breaker.as_dict()}``; ``only_tripped`` keeps the wire

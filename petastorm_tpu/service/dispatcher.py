@@ -70,6 +70,10 @@ MSG_W_HEARTBEAT, MSG_W_RESULT, MSG_W_RESULT_SHM = (b'w_heartbeat', b'w_result',
 #: cumulative worker telemetry snapshot riding the heartbeat socket (the
 #: fleet metrics plane — docs/observability.md "Live metrics plane")
 MSG_W_METRICS = b'w_metrics'
+#: worker-captured incident-bundle reference riding the heartbeat socket
+#: (the fleet incident plane — docs/observability.md "Incident autopsy
+#: plane")
+MSG_W_INCIDENT = b'w_incident'
 MSG_W_DONE, MSG_W_ERROR = b'w_done', b'w_error'
 MSG_W_NEED_SETUP, MSG_W_LEAVE = b'w_need_setup', b'w_leave'
 
@@ -92,6 +96,11 @@ MAX_ITEM_COST = MAX_COST_HINT
 #: least-loaded ready worker instead of FIFO — heavy rowgroups spread across
 #: the fleet instead of piling onto whichever worker asked first
 HEAVY_ITEM_COST = 2.0
+#: same-cause incident references landing within this window collapse into
+#: ONE fleet incident (docs/observability.md "Incident autopsy plane")
+INCIDENT_CORRELATION_WINDOW_S = 30.0
+#: bound on the correlated fleet-incident list kept in ``state()``
+MAX_FLEET_INCIDENTS = 32
 #: how long a worker's heartbeat stamp may go unchanged before it counts as
 #: departed (floored at 4x its own declared heartbeat interval, the same
 #: jitter margin the in-process watchdog enforces)
@@ -851,7 +860,8 @@ class Dispatcher(object):
                  item_deadline_s: Optional[float] = None,
                  client_ttl_s: float = DEFAULT_CLIENT_TTL_S,
                  autotune: Any = None,
-                 metrics_port: Optional[int] = None) -> None:
+                 metrics_port: Optional[int] = None,
+                 incidents: Any = None) -> None:
         self._host = host
         self._port = port
         # Fleet metrics plane (docs/observability.md "Live metrics plane"):
@@ -863,6 +873,31 @@ class Dispatcher(object):
         self._metrics_server: Any = None
         self._worker_metrics: Dict[int, Tuple[int, Dict[str, Any]]] = {}
         self._worker_metrics_lock = threading.Lock()
+        # Fleet incident plane (docs/observability.md "Incident autopsy
+        # plane"): the dispatcher owns its own recorder (stale-worker reaps
+        # and attempt-budget exhaustion are dispatcher-observed edges),
+        # adopts inline bundles shipped by workers as w_incident frames, and
+        # correlates same-cause references across workers into one fleet
+        # incident.
+        self._incident_recorder: Any = None
+        self._incident_registry: Any = None
+        self._worker_incident_seq: Dict[int, int] = {}
+        self._fleet_incidents: List[Dict[str, Any]] = []
+        self._incident_lock = threading.Lock()
+        from petastorm_tpu.telemetry.incident import resolve_incident_policy
+        incident_policy = resolve_incident_policy(incidents)
+        if incident_policy is not None:
+            from petastorm_tpu.telemetry.incident import (
+                IncidentRecorder, default_incident_home)
+            from petastorm_tpu.telemetry.registry import MetricsRegistry
+            self._incident_registry = MetricsRegistry()
+            self._incident_recorder = IncidentRecorder(
+                default_incident_home(None), incident_policy,
+                registry=self._incident_registry)
+            self._incident_recorder.add_source(
+                'service_state', lambda: self.scheduler.state())
+            self._incident_recorder.add_source(
+                'metrics', self.fleet_metrics_snapshot)
         self.scheduler = FairShareScheduler(
             admission_window=admission_window, quantum=quantum,
             stale_timeout_s=stale_timeout_s,
@@ -950,10 +985,13 @@ class Dispatcher(object):
 
     def state(self) -> Dict[str, Any]:
         """The scheduler snapshot (same dict the ``state`` request returns),
-        plus the ``autotune`` controller report when retuning is armed."""
+        plus the ``autotune`` controller report when retuning is armed and
+        the correlated ``incidents`` view when the incident plane is."""
         state = self.scheduler.state()
         if self._autotune is not None:
             state['autotune'] = self._autotune.report()
+        if self._incident_recorder is not None:
+            state['incidents'] = self.incidents_state()
         return state
 
     # -------------------------------------------------------- metrics plane
@@ -984,13 +1022,91 @@ class Dispatcher(object):
     def fleet_metrics_snapshot(self) -> Dict[str, Any]:
         """ONE fleet-wide registry snapshot: the scheduler's control-signal
         gauges/counters merged (additively, per worker) with every worker's
-        latest heartbeat snapshot — what ``/metrics`` renders as the
-        aggregate block (docs/observability.md "Live metrics plane")."""
+        latest heartbeat snapshot — plus the dispatcher-side incident
+        counters when the incident plane is armed — what ``/metrics``
+        renders as the aggregate block (docs/observability.md "Live metrics
+        plane")."""
         from petastorm_tpu.telemetry.registry import merge_snapshots
         with self._worker_metrics_lock:
             snapshots = [snapshot for _seq, snapshot
                          in self._worker_metrics.values()]
+        if self._incident_registry is not None:
+            snapshots.append(self._incident_registry.snapshot())
         return merge_snapshots(self.scheduler.autotune_snapshot(), *snapshots)
+
+    # ------------------------------------------------------- incident plane
+
+    def record_worker_incident(self, worker_id: int, seq: int,
+                               reference: Dict[str, Any]) -> None:
+        """Adopt one worker-shipped incident reference (``w_incident``):
+        unknown-worker stragglers are dropped (same guard as
+        :meth:`record_worker_metrics` — a departed worker's late frame must
+        not resurrect it), a stale ``seq`` is dropped, inline bundles are
+        materialized into the dispatcher's home, and the reference joins the
+        fleet correlation."""
+        if self._incident_recorder is None:
+            return
+        if not self.scheduler.has_worker_id(worker_id):
+            return
+        with self._incident_lock:
+            current = self._worker_incident_seq.get(worker_id)
+            if current is not None and current >= seq:
+                return
+            self._worker_incident_seq[worker_id] = seq
+        adopted = self._incident_recorder.adopt(reference)
+        if adopted is not None:
+            reference = dict(reference, bundle=adopted)
+        self._correlate_incident(worker_id, reference)
+
+    def _correlate_incident(self, worker_id: Optional[int],
+                            reference: Dict[str, Any]) -> None:
+        """Fold one incident reference into the fleet view: same-cause
+        incidents landing within the correlation window collapse into ONE
+        fleet incident spanning every reporting worker — a dataset-wide
+        storage outage reads as one event, not workers-many."""
+        cause = str(reference.get('cause') or 'unknown')
+        kind = str(reference.get('kind') or 'unknown')
+        bundle = reference.get('bundle')
+        now = time.monotonic()
+        with self._incident_lock:
+            for entry in self._fleet_incidents:
+                if (entry['cause'] == cause
+                        and now - entry['_last_monotonic']
+                        <= INCIDENT_CORRELATION_WINDOW_S):
+                    entry['count'] += 1
+                    entry['_last_monotonic'] = now
+                    if kind not in entry['kinds']:
+                        entry['kinds'].append(kind)
+                    if (worker_id is not None
+                            and worker_id not in entry['workers']):
+                        entry['workers'].append(worker_id)
+                    if bundle and len(entry['bundles']) < 8:
+                        entry['bundles'].append(str(bundle))
+                    return
+            self._fleet_incidents.append({
+                'cause': cause, 'kinds': [kind], 'count': 1,
+                'workers': [worker_id] if worker_id is not None else [],
+                'bundles': [str(bundle)] if bundle else [],
+                '_first_monotonic': now, '_last_monotonic': now})
+            del self._fleet_incidents[:-MAX_FLEET_INCIDENTS]
+
+    def incidents_state(self) -> Dict[str, Any]:
+        """The fleet incident view for ``state()``: correlated same-cause
+        groups (ages on the dispatcher's clock) plus the capture/rate-limit
+        counters and the dispatcher's retained-bundle summary."""
+        now = time.monotonic()
+        with self._incident_lock:
+            fleet = [{'cause': entry['cause'], 'kinds': list(entry['kinds']),
+                      'count': entry['count'],
+                      'workers': list(entry['workers']),
+                      'bundles': list(entry['bundles']),
+                      'first_age_s': round(now - entry['_first_monotonic'], 3),
+                      'last_age_s': round(now - entry['_last_monotonic'], 3)}
+                     for entry in self._fleet_incidents]
+        state: Dict[str, Any] = {'fleet': fleet}
+        if self._incident_recorder is not None:
+            state.update(self._incident_recorder.report())
+        return state
 
     @property
     def metrics_url(self) -> Optional[str]:
@@ -1007,6 +1123,8 @@ class Dispatcher(object):
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None
+        if self._incident_recorder is not None:
+            self._incident_recorder.close()
         self._stop_event.set()
 
     def join(self, timeout: float = 10.0) -> None:
@@ -1156,6 +1274,12 @@ class Dispatcher(object):
             self.record_worker_metrics(update.worker_id, update.seq,
                                        update.snapshot)
             return
+        if kind == MSG_W_INCIDENT and len(frames) >= 3:
+            from petastorm_tpu.service.wire import WorkerIncidentUpdate
+            incident = WorkerIncidentUpdate.from_bytes(bytes(frames[2]))
+            self.record_worker_incident(incident.worker_id, incident.seq,
+                                        incident.reference)
+            return
         if kind == MSG_W_RESULT and len(frames) >= 4:
             token = int(bytes(frames[2]))
             route = self.scheduler.result_route(token)
@@ -1220,6 +1344,14 @@ class Dispatcher(object):
             'service dispatcher: attempt budget exhausted'))
         self._client_socket.send_multipart(
             [client_key, MSG_ERROR, client_token, blob])
+        if self._incident_recorder is not None:
+            path = self._incident_recorder.trigger(
+                'service_poison_item',
+                args={'max_item_attempts': self.scheduler.max_item_attempts})
+            if path is not None:
+                self._correlate_incident(
+                    None, {'bundle': path, 'kind': 'service_poison_item',
+                           'cause': 'hang'})
 
     def _depart_worker(self, key: bytes, reason: str) -> None:
         worker_id = self.scheduler.worker_id_of(key)
@@ -1228,6 +1360,18 @@ class Dispatcher(object):
             # (Prometheus convention: absent, not frozen-forever)
             with self._worker_metrics_lock:
                 self._worker_metrics.pop(worker_id, None)
+            with self._incident_lock:
+                self._worker_incident_seq.pop(worker_id, None)
+        if self._incident_recorder is not None and reason == 'went stale':
+            # the dispatcher-side watchdog edge: a worker stopped stamping
+            # (SIGKILL, hang, network partition) and its items re-queue
+            path = self._incident_recorder.trigger(
+                'watchdog_reap',
+                args={'worker_id': worker_id, 'reason': reason})
+            if path is not None:
+                self._correlate_incident(
+                    worker_id, {'bundle': path, 'kind': 'watchdog_reap',
+                                'cause': 'hang'})
         failed = self.scheduler.remove_worker(key)
         if failed:
             logger.error('dispatcher: %d item(s) exhausted their attempt '

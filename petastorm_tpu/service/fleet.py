@@ -54,7 +54,12 @@ class ServiceFleet(object):
     (``/metrics`` aggregating every worker's heartbeat metric snapshots with
     per-worker/per-client labels, ``/healthz``, ``/vars``; ``0`` binds an
     ephemeral port — ``dispatcher.metrics_url`` names it) —
-    docs/observability.md "Live metrics plane"."""
+    docs/observability.md "Live metrics plane". ``incidents`` (True or an
+    :class:`~petastorm_tpu.telemetry.incident.IncidentPolicy`) arms the
+    incident autopsy plane fleet-wide: every worker captures black-box
+    bundles locally and ships references up the heartbeat socket, the
+    dispatcher adopts and correlates them — docs/observability.md
+    "Incident autopsy plane"."""
 
     def __init__(self, workers: int = 2, host: str = '127.0.0.1',
                  port: Optional[int] = None,
@@ -69,18 +74,21 @@ class ServiceFleet(object):
                  item_deadline_s: Optional[float] = None,
                  client_ttl_s: float = DEFAULT_CLIENT_TTL_S,
                  autotune: Any = None,
-                 metrics_port: Optional[int] = None) -> None:
+                 metrics_port: Optional[int] = None,
+                 incidents: Any = None) -> None:
         self._initial_workers = workers
         self._cache_dir = cache_dir
         self._cache_size_limit = cache_size_limit
         self._shm_results = shm_results
         self._heartbeat_interval_s = heartbeat_interval_s
+        self._incidents = incidents
         self.dispatcher = Dispatcher(
             host=host, port=port, admission_window=admission_window,
             quantum=quantum, stale_timeout_s=stale_timeout_s,
             max_item_attempts=max_item_attempts,
             item_deadline_s=item_deadline_s, client_ttl_s=client_ttl_s,
-            autotune=autotune, metrics_port=metrics_port)
+            autotune=autotune, metrics_port=metrics_port,
+            incidents=incidents)
         self.processes: List[subprocess.Popen] = []
         self._next_worker_id = 0
         self.service_url: Optional[str] = None
@@ -123,6 +131,7 @@ class ServiceFleet(object):
             'parent_pid': os.getpid(),
             'cache_dir': self._cache_dir,
             'cache_size_limit': self._cache_size_limit,
+            'incidents': self._incidents,
         }
         fd, path = tempfile.mkstemp(suffix='.petastorm-tpu-service-worker')
         with os.fdopen(fd, 'wb') as f:
@@ -231,6 +240,12 @@ def serve(argv: Optional[List[str]] = None) -> int:
                              'endpoint (/metrics, /healthz, /vars) on this '
                              'port (0 = ephemeral; default: off) — '
                              'docs/observability.md')
+    parser.add_argument('--incidents', action='store_true',
+                        help='arm the fleet-wide incident autopsy plane: '
+                             'workers black-box-capture bundles on failure '
+                             'edges and ship references to the dispatcher, '
+                             'which correlates them into state() — '
+                             'docs/observability.md "Incident autopsy plane"')
     parser.add_argument('--state-interval', type=float, default=30.0,
                         help='seconds between state summaries (0 = quiet)')
     parser.add_argument('--json', action='store_true',
@@ -243,7 +258,7 @@ def serve(argv: Optional[List[str]] = None) -> int:
         cache_dir=args.cache_dir, cache_size_limit=args.cache_size_limit,
         shm_results=not args.no_shm, admission_window=args.admission_window,
         item_deadline_s=args.item_deadline_s, autotune=args.autotune,
-        metrics_port=args.metrics_port)
+        metrics_port=args.metrics_port, incidents=args.incidents or None)
     url = fleet.start()
     print('petastorm-tpu input service running at {} ({} worker(s); '
           'workers register on port {}). Point readers at '

@@ -56,6 +56,7 @@ import traceback
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from petastorm_tpu.service.wire import (ShmResultDescriptor, WorkerDescriptor,
+                                        WorkerIncidentUpdate,
                                         WorkerMetricsUpdate, host_token)
 
 logger = logging.getLogger(__name__)
@@ -86,13 +87,19 @@ def _watch_parent(parent_pid: int) -> None:
 def _heartbeat_loop(stop_event: threading.Event, context: Any, endpoint: str,
                     worker_id: int, interval_s: float,
                     metrics_snapshot_fn: Optional[Callable[[], Dict[str, Any]]]
-                    = None) -> None:
+                    = None,
+                    incident_refs_fn: Optional[
+                        Callable[[], List[Dict[str, Any]]]] = None) -> None:
     """Stamp liveness on a PRIVATE DEALER socket (ZMQ sockets are not
     thread-safe — the main thread owns the work socket). Dropped sends are
     fine: the dispatcher only needs *some* stamp to land inside its (much
     longer) staleness window. Every ``_METRICS_EVERY_N_BEATS`` stamps the
     same socket also carries the worker's cumulative telemetry snapshot as a
-    ``w_metrics`` frame (module docstring) — best-effort like the stamps."""
+    ``w_metrics`` frame (module docstring) — best-effort like the stamps.
+    Each beat also drains ``incident_refs_fn`` (bundle references captured by
+    the worker's incident recorder since the last beat) and ships every
+    reference as its own ``w_incident`` frame (docs/observability.md
+    "Incident autopsy plane")."""
     import zmq
     socket = context.socket(zmq.DEALER)
     socket.setsockopt(zmq.SNDHWM, 8)
@@ -108,13 +115,22 @@ def _heartbeat_loop(stop_event: threading.Event, context: Any, endpoint: str,
                     zmq.NOBLOCK)
             except Exception:  # noqa: BLE001 - liveness must never kill a worker
                 pass
+            if incident_refs_fn is not None:
+                try:
+                    for reference in incident_refs_fn():
+                        update = WorkerIncidentUpdate(worker_id, seq,
+                                                      reference)
+                        socket.send_multipart(
+                            [b'w_incident', update.to_bytes()], zmq.NOBLOCK)
+                except Exception:  # noqa: BLE001 - the incident plane must never kill a worker
+                    pass
             if (metrics_snapshot_fn is None
                     or seq % _METRICS_EVERY_N_BEATS != 1):
                 continue
             try:
-                update = WorkerMetricsUpdate(worker_id, seq,
-                                             metrics_snapshot_fn())
-                socket.send_multipart([b'w_metrics', update.to_bytes()],
+                update_m = WorkerMetricsUpdate(worker_id, seq,
+                                               metrics_snapshot_fn())
+                socket.send_multipart([b'w_metrics', update_m.to_bytes()],
                                       zmq.NOBLOCK)
             except Exception:  # noqa: BLE001 - the metrics plane must never kill a worker
                 pass
@@ -281,13 +297,39 @@ def main(bootstrap_path: str) -> None:
     from petastorm_tpu.telemetry import MetricsRegistry
     worker_metrics = MetricsRegistry()
 
+    # Incident autopsy plane (docs/observability.md): when the fleet arms
+    # incidents, this worker captures bundles locally on its own anomaly
+    # edges (breaker closed->open, quarantined rowgroups) and the heartbeat
+    # thread ships each bundle's compact reference as a ``w_incident`` frame.
+    incident_recorder: Any = None
+    incident_refs_fn: Optional[Callable[[], List[Dict[str, Any]]]] = None
+    incidents = bootstrap.get('incidents')
+    if incidents:
+        from petastorm_tpu.resilience import default_board
+        from petastorm_tpu.telemetry.incident import (IncidentRecorder,
+                                                      default_incident_home,
+                                                      resolve_incident_policy)
+        policy = resolve_incident_policy(incidents)
+        # per-worker subdirectory: co-located workers must not race each
+        # other's bundle sequence numbers in one shared home
+        home = os.path.join(default_incident_home(cache_dir),
+                            'worker-{}'.format(worker_id))
+        incident_recorder = IncidentRecorder(home, policy,
+                                             registry=worker_metrics)
+        incident_recorder.add_source('metrics', worker_metrics.snapshot)
+        incident_recorder.add_source('breakers', default_board().snapshot)
+        default_board().observe_transitions(
+            incident_recorder.on_breaker_transition)
+        incident_refs_fn = incident_recorder.drain_references
+
     heartbeat_stop = threading.Event()
     heartbeat_thread: Optional[threading.Thread] = None
     if heartbeat_interval_s > 0:
         heartbeat_thread = threading.Thread(
             target=_heartbeat_loop,
             args=(heartbeat_stop, context, endpoint, worker_id,
-                  heartbeat_interval_s, worker_metrics.snapshot),
+                  heartbeat_interval_s, worker_metrics.snapshot,
+                  incident_refs_fn),
             daemon=True)
         heartbeat_thread.start()
 
@@ -304,6 +346,17 @@ def main(bootstrap_path: str) -> None:
         stage_times = getattr(result, 'telemetry', None)
         if stage_times:
             worker_metrics.merge_stage_times(stage_times)
+        if incident_recorder is not None:
+            record = getattr(result, 'quarantine', None)
+            if record is not None:
+                # same kind split as Reader._note_item_consumed: a reaped
+                # hang and a skipped rowgroup are distinct autopsy causes
+                trigger_kind = ('watchdog_reap' if record.reason == 'hang'
+                                else 'quarantine')
+                incident_recorder.trigger(
+                    trigger_kind,
+                    ctx=(record.epoch, record.piece_index, record.attempts),
+                    args=record.as_dict())
         with stage_span('serialize'):
             frames = current_serializer[0].serialize(result)
         if shm_publisher is not None and current_colocated[0]:
@@ -389,6 +442,8 @@ def main(bootstrap_path: str) -> None:
     heartbeat_stop.set()
     if heartbeat_thread is not None:
         heartbeat_thread.join(timeout=2 * heartbeat_interval_s + 1)
+    if incident_recorder is not None:
+        incident_recorder.close()
     if shm_publisher is not None:
         shm_publisher.close()
     socket.close(linger=1000)

@@ -226,3 +226,38 @@ class WorkerMetricsUpdate(object):
         snapshot = spec['snapshot']
         return cls(worker_id=int(spec['worker_id']), seq=int(spec['seq']),
                    snapshot=dict(snapshot) if snapshot else {})
+
+
+class WorkerIncidentUpdate(object):
+    """One worker-captured incident-bundle reference for the fleet incident
+    plane (``w_incident`` message body — telemetry/incident.py,
+    docs/observability.md "Incident autopsy plane"). ``reference`` is the
+    :func:`~petastorm_tpu.telemetry.incident.bundle_reference` dict — kind,
+    cause, context, size, and the inlined bundle files when the bundle fit
+    under the shipping cap. ``seq`` orders ships so a late-delivered older
+    incident can never be double-adopted after a newer one."""
+
+    __slots__ = ('worker_id', 'seq', 'reference')
+
+    def __init__(self, worker_id: int, seq: int,
+                 reference: Dict[str, Any]) -> None:
+        self.worker_id = worker_id
+        self.seq = seq
+        self.reference = reference
+
+    def to_bytes(self) -> bytes:
+        """JSON spec for the ``w_incident`` message."""
+        spec: Dict[str, Any] = {
+            'worker_id': self.worker_id,
+            'seq': self.seq,
+            'reference': self.reference,
+        }
+        return json.dumps(spec).encode('utf-8')
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> 'WorkerIncidentUpdate':
+        """Decode a :meth:`to_bytes` spec."""
+        spec = json.loads(blob.decode('utf-8'))
+        reference = spec['reference']
+        return cls(worker_id=int(spec['worker_id']), seq=int(spec['seq']),
+                   reference=dict(reference) if reference else {})

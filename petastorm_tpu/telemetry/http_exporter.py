@@ -87,6 +87,16 @@ class _MetricsRequestHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
+class _ReusableThreadingHTTPServer(ThreadingHTTPServer):
+    """The scrape listener with ``SO_REUSEADDR`` pinned on: a reader that
+    restarts onto the same fixed ``metrics_port`` within the previous
+    socket's TIME_WAIT must bind, not crash the new pipeline. (Ephemeral
+    ``port=0`` binds never collide — ``start()`` returns the kernel's pick
+    and ``url`` names it.)"""
+
+    allow_reuse_address = True
+
+
 class MetricsHttpServer(object):
     """One scrape endpoint over live telemetry callables (module docstring).
 
@@ -126,8 +136,8 @@ class MetricsHttpServer(object):
         (the requested one, or the ephemeral pick for port 0)."""
         if self._server is not None:
             return self.port
-        server = ThreadingHTTPServer((self._host, self._requested_port),
-                                     _MetricsRequestHandler)
+        server = _ReusableThreadingHTTPServer(
+            (self._host, self._requested_port), _MetricsRequestHandler)
         server.daemon_threads = True
         server.owner = self  # type: ignore[attr-defined]
         self._server = server

@@ -36,10 +36,11 @@ scrape (the gauges refresh per scrape).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from petastorm_tpu.telemetry import registry as _registry
 from petastorm_tpu.telemetry import tracing as _tracing
@@ -147,13 +148,22 @@ class SloTracker(object):
     concurrently."""
 
     def __init__(self, policy: Optional[SloPolicy] = None,
-                 jsonl: Optional[JsonlEventLogger] = None) -> None:
+                 jsonl: Optional[JsonlEventLogger] = None,
+                 on_breach: Optional[Callable[[Dict[str, Any]], None]] = None) -> None:
         self.policy = policy if policy is not None else SloPolicy()
         self._jsonl = jsonl
+        self._on_breach = on_breach
         self._lock = threading.Lock()
         self._breaches = 0
         self._evaluations = 0
         self._in_breach = False
+
+    def observe_breaches(self, callback: Callable[[Dict[str, Any]], None]) -> None:
+        """Attach (or replace) the ok→breach edge observer: called once per
+        transition with the full evaluation report, outside the tracker lock
+        — the incident recorder's ``slo_breach`` subscription point
+        (telemetry/incident.py)."""
+        self._on_breach = callback
 
     @property
     def breaches(self) -> int:
@@ -168,13 +178,22 @@ class SloTracker(object):
 
         Adds ``{'target_efficiency', 'met', 'breached', 'evaluated',
         'breaches', 'evaluations'}`` to the :func:`efficiency_from_snapshot`
-        fields. ``evaluated`` is False below ``min_elapsed_s`` (no breach is
-        counted). On an ok→breach transition: ``slo_breach`` counter (in
-        ``registry``), ``slo_breach`` JSONL event, ``slo_breach`` trace
-        instant — once, until the efficiency recovers to the target."""
+        fields. ``evaluated`` is False below ``min_elapsed_s``: the report
+        then carries the explicit not-enough-data shape — ``efficiency``
+        (and ``starvation_fraction``) are ``None``, ``reason`` says
+        ``'not_enough_data'``, no breach is counted and no gauge is set, so
+        a warmup window can never read as a spurious 0.0 efficiency or trip
+        a breach edge. On an ok→breach transition: ``slo_breach`` counter
+        (in ``registry``), ``slo_breach`` JSONL event, ``slo_breach`` trace
+        instant, and the attached breach observer — once, until the
+        efficiency recovers to the target."""
         report = efficiency_from_snapshot(snapshot, elapsed_s, rows=rows)
         target = self.policy.target_efficiency
         evaluated = elapsed_s >= self.policy.min_elapsed_s
+        if not evaluated:
+            report['efficiency'] = None
+            report['starvation_fraction'] = None
+            report['reason'] = 'not_enough_data'
         breached = bool(evaluated and report['efficiency'] < target)
         with self._lock:
             self._evaluations += 1
@@ -194,7 +213,8 @@ class SloTracker(object):
             'evaluations': evaluations,
         })
         if registry is not None and _registry.telemetry_enabled():
-            registry.gauge('slo_efficiency').set(report['efficiency'])
+            if evaluated:
+                registry.gauge('slo_efficiency').set(report['efficiency'])
             registry.gauge('slo_target_efficiency').set(target)
             if is_transition:
                 registry.inc('slo_breach')
@@ -210,6 +230,12 @@ class SloTracker(object):
                                       'target': target,
                                       'wait_seconds': report['wait_seconds'],
                                       'elapsed_s': report['elapsed_s']})
+            if self._on_breach is not None:
+                try:
+                    self._on_breach(dict(report))
+                except Exception:  # noqa: BLE001 - an observer must not break evaluation
+                    logging.getLogger(__name__).exception(
+                        'slo breach observer failed')
         return report
 
 

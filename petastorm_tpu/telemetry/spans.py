@@ -88,6 +88,12 @@ COUNTERS = (
                            # stream (unknown/duplicate delivery, resume
                            # mismatch) — telemetry/lineage.py,
                            # docs/observability.md "Sample lineage"
+    'incidents_captured',      # an incident bundle was written (edge-
+                               # triggered black-box capture —
+                               # telemetry/incident.py, docs/observability.md
+                               # "Incident autopsy plane")
+    'incidents_rate_limited',  # an incident trigger was dropped by the
+                               # per-kind token bucket (telemetry/incident.py)
 )
 
 #: declared size histograms (``registry.observe(name, n, unit=BYTES_UNIT)``
@@ -114,6 +120,7 @@ TRACE_INSTANTS = (
     'slo_breach',          # input-efficiency fell below the SLO target (consumer; telemetry/slo.py)
     'schedule_plan',       # the cost-aware scheduler planned one epoch's ventilation order (ventilator thread; schedule/cost_schedule.py)
     'lineage_divergence',  # a delivered item broke the expected lineage stream (consumer; telemetry/lineage.py)
+    'incident_captured',   # an incident bundle was written at this point on the timeline (telemetry/incident.py)
 )
 
 #: declared gauge ids (``registry.gauge(name)`` call sites with literal

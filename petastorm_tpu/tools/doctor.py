@@ -413,8 +413,29 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
         report['service'] = check_service(service_url)
     except Exception as exc:  # noqa: BLE001 - the report must always complete
         report['service'] = {'status': 'fail', 'detail': repr(exc)}
+    # Incident-bundle block (docs/observability.md "Incident autopsy
+    # plane"): retained black-box bundles in the default incident home (or
+    # PETASTORM_TPU_INCIDENT_HOME) — each one is a captured failure edge
+    # awaiting `petastorm-tpu-throughput autopsy`. Always present so --json
+    # consumers find one stable key.
+    try:
+        report['incidents'] = check_incidents()
+    except Exception as exc:  # noqa: BLE001 - the report must always complete
+        report['incidents'] = {'status': 'fail', 'detail': repr(exc)}
     report['healthy'] = report['store_roundtrip'].get('status') == 'ok'
     return report
+
+
+def check_incidents(home=None):
+    """Scan the incident home for retained bundles (newest first): the
+    doctor's view of the incident autopsy plane — bundle names, trigger
+    kinds and ranked causes, without opening the heavyweight evidence."""
+    from petastorm_tpu.telemetry.incident import (default_incident_home,
+                                                  scan_bundles)
+    home = home or default_incident_home(None)
+    bundles = scan_bundles(home)
+    return {'status': 'ok', 'home': home, 'retained': len(bundles),
+            'bundles': bundles[:8]}
 
 
 def _print_human(report):
@@ -547,6 +568,17 @@ def _print_human(report):
               'with this service_url will fail their hello; is the '
               'dispatcher running? (docs/service.md)'.format(
                   service.get('service_url'), service.get('detail', '')))
+    incidents = report.get('incidents') or {}
+    if incidents.get('retained'):
+        newest = (incidents.get('bundles') or [{}])[0]
+        print('  WARNING: {} incident bundle(s) retained in {} (newest: {} — '
+              'cause {}) — a failure edge black-boxed its evidence; run '
+              '`petastorm-tpu-throughput autopsy {}` for the ranked '
+              'probable-cause report (docs/observability.md "Incident '
+              'autopsy plane")'.format(
+                  incidents.get('retained'), incidents.get('home'),
+                  newest.get('bundle'), newest.get('cause'),
+                  newest.get('path', '<bundle>')))
     pipecheck = report.get('pipecheck') or {}
     if pipecheck.get('status') == 'ok':
         print('  pipecheck: clean — {} files, {} suppression(s) honored '

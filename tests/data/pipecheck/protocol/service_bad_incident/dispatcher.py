@@ -1,0 +1,17 @@
+"""Known-bad fixture (dispatcher side): dispatches on an incident-ref kind no
+peer ever sends (typo'd consumer), while the worker's ``w_incident`` frames
+have no dispatch arm here."""
+
+MSG_W_INCIDNET = b'w_incidnet'  # typo: the worker sends b'w_incident'
+
+
+def handle_worker(worker_socket):
+    frames = worker_socket.recv_multipart()
+    kind = bytes(frames[1])
+    if kind == MSG_W_INCIDNET:
+        return frames[2]
+    return None
+
+
+def dispatch(worker_socket, identity, token, blob):
+    worker_socket.send_multipart([identity, b'work', token, blob])

@@ -1,0 +1,14 @@
+"""Known-bad fixture (worker side): ships an incident-bundle reference on a
+kind the dispatcher fixture never dispatches on."""
+
+
+def ship_incident(socket, worker_id, seq, blob):
+    socket.send_multipart([b'w_incident', worker_id, seq, blob])  # nobody dispatches this
+
+
+def loop(socket):
+    frames = socket.recv_multipart()
+    kind = frames[0]
+    if kind == b'work':
+        return frames[1:]
+    return None

@@ -3,6 +3,7 @@ client fixtures' kinds."""
 
 MSG_W_RESULT, MSG_W_DONE, MSG_WORK = b'w_result', b'w_done', b'work'
 MSG_W_METRICS = b'w_metrics'
+MSG_W_INCIDENT = b'w_incident'
 
 
 def handle_worker(worker_socket, client_socket):
@@ -12,6 +13,8 @@ def handle_worker(worker_socket, client_socket):
         client_socket.send_multipart([frames[0], b'result'] + frames[2:])
         return True
     if kind == MSG_W_METRICS:
+        return frames[2]
+    if kind == MSG_W_INCIDENT:
         return frames[2]
     if kind == MSG_W_DONE:
         return None

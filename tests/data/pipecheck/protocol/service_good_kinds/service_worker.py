@@ -11,6 +11,10 @@ def heartbeat_metrics(socket, blob):
     socket.send_multipart([b'w_metrics', blob])
 
 
+def ship_incident(socket, blob):
+    socket.send_multipart([b'w_incident', blob])
+
+
 def loop(socket):
     frames = socket.recv_multipart()
     kind = frames[0]

@@ -1,0 +1,7 @@
+"""Known-bad fixture: incident-plane telemetry names off the spans.py catalogs."""
+from petastorm_tpu.telemetry.tracing import trace_instant
+
+
+def work(registry):
+    registry.inc('incidents_cpatured')  # typo: should be 'incidents_captured'
+    trace_instant('incident_captrued')  # typo: should be 'incident_captured'

@@ -1,0 +1,8 @@
+"""Known-good fixture: incident-plane telemetry names off the catalogs."""
+from petastorm_tpu.telemetry.tracing import trace_instant
+
+
+def work(registry):
+    registry.inc('incidents_captured')
+    registry.inc('incidents_rate_limited')
+    trace_instant('incident_captured', args={'kind': 'watchdog_reap'})

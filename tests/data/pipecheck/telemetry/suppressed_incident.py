@@ -1,0 +1,5 @@
+"""Suppression fixture: an off-catalog incident counter, explicitly allowed."""
+
+
+def work(registry):
+    registry.inc('incidents_shadow_probe')  # pipecheck: disable=telemetry-names -- shadow-mode capture counter, promoted to the catalog once the probe graduates

@@ -338,7 +338,10 @@ def test_service_state_text_renders_client_and_worker_gauges():
 
 def test_reader_metrics_endpoint_and_slo(tmp_path):
     url = _store(tmp_path / 'store', rows=100)
-    with make_reader(url, num_epochs=1, metrics_port=0) as reader:
+    # min_elapsed_s=0: a fast read must still evaluate (the default 1s
+    # warmup gate withholds the efficiency gauge as not_enough_data)
+    with make_reader(url, num_epochs=1, metrics_port=0,
+                     slo_policy=SloPolicy(min_elapsed_s=0.0)) as reader:
         rows = sum(1 for _ in reader)
         assert rows == 100
         body = _get(reader.metrics_url + '/metrics')
@@ -373,8 +376,11 @@ def test_loader_efficiency_report(tmp_path):
     from petastorm_tpu.parallel.loader import JaxDataLoader
     url = _store(tmp_path / 'store', rows=64)
     reader = make_reader(url, num_epochs=1)
+    # min_elapsed_s=0: evaluate even though 4 batches drain inside the
+    # default 1s warmup gate (which reports not_enough_data, no efficiency)
     loader = JaxDataLoader(reader, batch_size=16, device_put=False,
-                           metrics_port=0)
+                           metrics_port=0,
+                           slo_policy=SloPolicy(min_elapsed_s=0.0))
     try:
         batches = sum(1 for _ in loader)
         assert batches == 4

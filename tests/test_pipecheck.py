@@ -57,6 +57,9 @@ BAD_FIXTURES = [
       'TRACE_INSTANTS', 'lineage_items_foldd', 'GAUGES']),
     ('telemetry/bad_cost/telemetry/cost_model.py', ['telemetry-names'], 1,
      ['rowgroup_reed', 'COST_STAGES']),
+    ('telemetry/bad_incident.py', ['telemetry-names'], 2,
+     ['incidents_cpatured', 'COUNTERS', 'incident_captrued',
+      'TRACE_INSTANTS']),
     ('clock/bad', ['clock-discipline'], 1, ['time.monotonic']),
     ('exceptions/bad_swallow.py', ['exception-hygiene'], 1, ['swallows']),
     ('exceptions/workers/bad_worker_swallow.py', ['exception-hygiene'], 1,
@@ -79,6 +82,8 @@ BAD_FIXTURES = [
      ["'host'", "'hostname'"]),
     ('protocol/service_bad_metrics', ['protocol-conformance'], 2,
      ["b'w_metrics'", "b'w_metricz'"]),
+    ('protocol/service_bad_incident', ['protocol-conformance'], 2,
+     ["b'w_incident'", "b'w_incidnet'"]),
 ]
 
 GOOD_FIXTURES = [
@@ -88,6 +93,7 @@ GOOD_FIXTURES = [
     ('telemetry/good_gauge.py', ['telemetry-names']),
     ('telemetry/good_lineage.py', ['telemetry-names']),
     ('telemetry/good_cost/telemetry/cost_model.py', ['telemetry-names']),
+    ('telemetry/good_incident.py', ['telemetry-names']),
     ('clock/good', ['clock-discipline']),
     ('exceptions/good_swallow.py', ['exception-hygiene']),
     ('locks/good_lock.py', ['lock-discipline']),
@@ -119,6 +125,7 @@ def test_known_good_fixture_is_clean(path, rules):
     ('telemetry/suppressed_knob.py', ['telemetry-names']),
     ('telemetry/suppressed_gauge.py', ['telemetry-names']),
     ('telemetry/suppressed_lineage.py', ['telemetry-names']),
+    ('telemetry/suppressed_incident.py', ['telemetry-names']),
     ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
     ('protocol/service_suppressed_kinds', ['protocol-conformance']),
 ])

@@ -502,10 +502,13 @@ def test_scheduled_order_identical_across_pools(dataset):
     ventilation order)."""
     ledger, token = profiled_ledger(dataset['url'], scale_piece_to=50.0)
     path = default_ledger_path(dataset['url'], token)
-    ledger.save(path)
     try:
         orders = {}
         for pool in ('dummy', 'thread', 'process'):
+            # re-save the pristine ledger each run: stop() persists live
+            # (load-dependent) observations into the sidecar, and "same
+            # ledger" is the premise under test
+            ledger.save(path)
             order, report = read_item_order(
                 dataset['url'], reader_pool_type=pool, workers_count=1,
                 shuffle_row_groups=True, seed=29, cost_schedule=True,
@@ -536,6 +539,11 @@ def test_scheduled_service_path_order_and_rows(dataset):
             dataset['url'], reader_pool_type='dummy', workers_count=1,
             shuffle_row_groups=True, seed=31, cost_schedule=True,
             ledger_expected=True)
+        # restore the pristine ledger: the dummy run's stop() merged its
+        # live (load-dependent) measurements into the sidecar, and the
+        # fleet run must plan from the same ledger to ventilate the same
+        # order
+        ledger.save(path)
         with ServiceFleet(workers=1) as fleet:
             ids = []
             got_rows = []
