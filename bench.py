@@ -158,7 +158,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
                  'resilience', 'pipecheck', 'tracing', 'service', 'autotune',
                  'device_decode', 'observability', 'schedule', 'lineage',
-                 'incidents')
+                 'incidents', 'chaos')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -170,7 +170,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'incidents',
                      'lineage',
                      'schedule', 'autotune', 'device_decode', 'decode_bench',
-                     'service', 'wire_bench', 'telemetry', 'tracing',
+                     'service', 'chaos', 'wire_bench', 'telemetry', 'tracing',
                      'resilience', 'mnist_scan_stream', 'flash', 'moe',
                      'imagenet_scan', 'imagenet_stream', 'decode_delta',
                      'bare_reader', 'mnist_stream')
@@ -2125,6 +2125,82 @@ def child_main():
             'service_workers': service_workers,
         })
 
+    def run_chaos():
+        """Epoch-survivable control plane (host-only; docs/service.md
+        "Restarting with a ledger"): the ISSUE-16 numbers. Three localhost
+        fleet epochs on the bench store: ledger-off vs ledger-armed (the
+        journal's happy-path cost — the <=3% acceptance guard), then a
+        ledger-armed epoch with the dispatcher hard-crashed mid-epoch —
+        rows must stay exact and the recovery gap (crash to the first
+        post-restart batch; optimistic by whatever the client had
+        prefetched) is the headline robustness number."""
+        import shutil as _shutil
+        from petastorm_tpu.service.fleet import ServiceFleet
+
+        os.environ.setdefault('PETASTORM_TPU_SERVICE_RESPONSE_TIMEOUT_S',
+                              '2.0')
+        service_workers = min(WORKERS, 2)
+
+        def epoch(fleet, crash_at=None):
+            reader = make_reader(url, service_url=fleet.service_url,
+                                 num_epochs=1, shuffle_row_groups=False)
+            rows = 0
+            crash_t = None
+            recovery_s = None
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                if crash_t is not None and recovery_s is None:
+                    recovery_s = time.perf_counter() - crash_t
+                rows += batch.num_rows
+                if crash_at is not None and rows >= crash_at \
+                        and crash_t is None:
+                    crash_t = time.perf_counter()
+                    fleet.crash_dispatcher()
+            elapsed = time.perf_counter() - start
+            reader.stop()
+            reader.join()
+            return rows, rows / elapsed, recovery_s
+
+        def fleet_epoch(ledger_dir=None, crash_at=None):
+            cache_dir = tempfile.mkdtemp(prefix='petastorm_tpu_bench_chaos_')
+            try:
+                with ServiceFleet(workers=service_workers,
+                                  cache_dir=cache_dir,
+                                  ledger=bool(ledger_dir)) as fleet:
+                    rows, rate, recovery_s = epoch(fleet, crash_at=crash_at)
+                    epoch_n = fleet.dispatcher.ledger_state().get('epoch', 0)
+                return rows, rate, recovery_s, epoch_n
+            finally:
+                _shutil.rmtree(cache_dir, ignore_errors=True)
+
+        plain_rows, plain_rate, _, _ = fleet_epoch()
+        armed_rows, armed_rate, _, _ = fleet_epoch(ledger_dir=True)
+        crash_rows, crash_rate, recovery_s, ledger_epoch = fleet_epoch(
+            ledger_dir=True, crash_at=max(1, plain_rows // 2))
+        overhead_pct = (plain_rate - armed_rate) / plain_rate * 100.0
+        rows_exact = (armed_rows == plain_rows and crash_rows == plain_rows)
+        log('chaos: ledger-armed epoch {:.1f} rows/s vs {:.1f} rows/s '
+            'unarmed ({:+.1f}% journal overhead; acceptance <=3%); '
+            'dispatcher SIGKILL mid-epoch: {}/{} rows ({}), {:.2f}s to the '
+            'first post-restart batch, ledger epoch {}'
+            .format(armed_rate, plain_rate, overhead_pct,
+                    crash_rows, plain_rows,
+                    'exact' if rows_exact else 'LOST/DUPED',
+                    recovery_s or 0.0, ledger_epoch))
+        if overhead_pct > 3.0:
+            log('chaos: WARNING — ledger-armed overhead {:.1f}% exceeds the '
+                '3% acceptance bound'.format(overhead_pct))
+        results.update({
+            'chaos_plain_rows_per_sec': round(plain_rate, 1),
+            'chaos_ledger_rows_per_sec': round(armed_rate, 1),
+            'chaos_ledger_overhead_pct': round(overhead_pct, 2),
+            'chaos_recovery_s': round(recovery_s or 0.0, 3),
+            'chaos_crash_rows_per_sec': round(crash_rate, 1),
+            'chaos_rows_exact': rows_exact,
+            'chaos_ledger_epoch': ledger_epoch,
+            'chaos_workers': service_workers,
+        })
+
     def run_autotune():
         """Closed-loop autotuner (host-only; docs/autotuning.md): the ISSUE-9
         acceptance numbers. Uses a dedicated heavier store (the mnist bench
@@ -2468,6 +2544,7 @@ def child_main():
         'schedule': run_schedule,
         'lineage': run_lineage,
         'incidents': run_incidents,
+        'chaos': run_chaos,
     }
     for name in SECTION_RUN_ORDER:
         run_section(name, section_fns[name])

@@ -65,6 +65,13 @@ STAGE_CATALOG_SUFFIX: str = 'telemetry/spans.py'
 #: where the declared quarantine-reason registry lives (path suffix)
 QUARANTINE_REGISTRY_SUFFIX: str = 'resilience.py'
 
+#: where the durable dispatcher ledger's declared record-kind registry
+#: lives (path suffix): every ``append_record('x')`` / ``_journal('x')``
+#: call site and every ``kind == 'x'`` replay compare must name a kind in
+#: its ``LEDGER_RECORD_KINDS`` tuple (protocol-conformance rule,
+#: docs/service.md "Failure modes")
+LEDGER_FILE_SUFFIX: str = 'ledger.py'
+
 #: where the cost profiler's declared stage tuple lives (path suffix); its
 #: ``COST_STAGES`` entries must be a subset of the spans catalog's ``STAGES``
 #: (telemetry-names rule, docs/observability.md "Cost profiler")
@@ -94,6 +101,7 @@ class AnalysisConfig:
     datapath_files: Tuple[str, ...] = DATAPATH_FILES
     stage_catalog_suffix: str = STAGE_CATALOG_SUFFIX
     quarantine_registry_suffix: str = QUARANTINE_REGISTRY_SUFFIX
+    ledger_file_suffix: str = LEDGER_FILE_SUFFIX
     knob_catalog_suffix: str = KNOB_CATALOG_SUFFIX
     cost_model_suffix: str = COST_MODEL_SUFFIX
     strict_flags: Tuple[str, ...] = STRICT_FLAGS

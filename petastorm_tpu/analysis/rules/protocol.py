@@ -27,6 +27,12 @@ path — the exact drift class this rule pins down statically:
   ``num_rows``/``columns`` are allowed extra reads).
 - **quarantine reasons**: every ``QuarantineRecord(..., reason='x')`` literal
   must appear in the ``QUARANTINE_REASONS`` registry in ``resilience.py``.
+- **ledger record kinds**: the durable dispatcher ledger is a wire protocol
+  with the FUTURE — the dispatcher that replays a journal may be a newer
+  build than the one that wrote it. Every kind literal journaled
+  (``.append_record('x')`` / ``._journal('x')``) by any analyzed module and
+  every ``kind == 'x'`` replay compare inside ``ledger.py`` must name a kind
+  declared in its ``LEDGER_RECORD_KINDS`` tuple (docs/service.md).
 """
 
 from __future__ import annotations
@@ -236,6 +242,8 @@ class ProtocolConformanceRule(Rule):
         findings.extend(
             self._collect_quarantine_reasons(module, state,
                                              ctx.config.quarantine_registry_suffix))
+        self._collect_ledger_kinds(module, state,
+                                   ctx.config.ledger_file_suffix)
         return findings
 
     # ------------------------------------------------------- message kinds
@@ -246,6 +254,7 @@ class ProtocolConformanceRule(Rule):
         for group_key in ('peers', 'service_peers'):
             findings.extend(self._match_peer_group(state.get(group_key, {})))
         findings.extend(self._check_quarantine_registry(ctx, state))
+        findings.extend(self._check_ledger_registry(ctx, state))
         return findings
 
     def _match_peer_group(self,
@@ -396,3 +405,84 @@ class ProtocolConformanceRule(Rule):
         except (ImportError, OSError, SyntaxError):
             return None
         return extract_string_tuple(tree, 'QUARANTINE_REASONS')
+
+    # ------------------------------------------------- ledger record kinds
+
+    def _collect_ledger_kinds(self, module: SourceModule,
+                              state: Dict[str, object],
+                              ledger_suffix: str) -> None:
+        """Gather the ledger-kind registry and its use sites (module doc):
+        journaled-kind literals everywhere, replay ``kind == 'x'`` compares
+        inside the ledger module itself."""
+        uses = state.setdefault('ledger_kind_uses', [])
+        if module.posix().endswith(ledger_suffix):
+            declared = extract_string_tuple(module.tree, 'LEDGER_RECORD_KINDS')
+            if declared is not None:
+                state['declared_ledger_kinds'] = (declared, module.display)
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Compare):
+                    continue
+                if not all(isinstance(op, (ast.Eq, ast.NotEq))
+                           for op in node.ops):
+                    continue
+                sides = [node.left] + list(node.comparators)
+                if not any(isinstance(side, ast.Name) and side.id == 'kind'
+                           for side in sides):
+                    continue
+                for side in sides:
+                    value = const_str(side)
+                    if value is not None:
+                        uses.append((value, module.display,  # type: ignore[attr-defined]
+                                     side.lineno))
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in ('append_record', '_journal'):
+                continue
+            if not node.args:
+                continue
+            value = const_str(node.args[0])
+            if value is not None:
+                uses.append((value, module.display,  # type: ignore[attr-defined]
+                             node.args[0].lineno))
+
+    def _check_ledger_registry(self, ctx: AnalysisContext,
+                               state: Dict[str, object]) -> List[Finding]:
+        uses = state.get('ledger_kind_uses') or []
+        if not uses:
+            return []
+        declared_entry = state.get('declared_ledger_kinds')
+        if declared_entry is None:
+            declared = self._installed_ledger_kinds()
+            if declared is None:
+                return []
+        else:
+            declared = declared_entry[0]  # type: ignore[index]
+        findings = []
+        for value, path, line in uses:  # type: ignore[union-attr]
+            if value not in declared:
+                findings.append(Finding(
+                    self.name, path, line,
+                    'ledger record kind {!r} is not declared in '
+                    'LEDGER_RECORD_KINDS ({}) — a replaying dispatcher '
+                    'will silently skip it and resume from wrong '
+                    'state'.format(value, tuple(declared))))
+        return findings
+
+    @staticmethod
+    def _installed_ledger_kinds() -> Optional[List[str]]:
+        """Fallback registry from the installed ledger module's source, so
+        fixture trees without a ``ledger.py`` still validate against the
+        shipped kind set."""
+        try:
+            import petastorm_tpu.service.ledger as ledger_module
+            source_path = ledger_module.__file__
+            if source_path is None:
+                return None
+            tree = ast.parse(open(source_path, encoding='utf-8').read())
+        except (ImportError, OSError, SyntaxError):
+            return None
+        return extract_string_tuple(tree, 'LEDGER_RECORD_KINDS')

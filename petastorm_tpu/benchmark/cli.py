@@ -27,7 +27,11 @@ docs/observability.md "Incident autopsy plane"); ``pipecheck`` dispatches to
 :mod:`petastorm_tpu.analysis` (AST-based data-plane invariant analyzer —
 docs/static-analysis.md); ``serve`` dispatches to
 :mod:`petastorm_tpu.service.fleet` (disaggregated input service: dispatcher +
-decode workers in one command — docs/service.md); ``doctor`` dispatches to
+decode workers in one command — docs/service.md); ``chaos`` dispatches to
+:mod:`petastorm_tpu.test_util.chaos` (seeded control-plane chaos proof:
+dispatcher/worker kills mid-epoch against a ledger-armed fleet, verdict by
+rows-exact + lineage diff — docs/service.md "Failure modes"); ``doctor``
+dispatches to
 :mod:`petastorm_tpu.tools.doctor` (environment health report); anything else
 is the legacy dataset-throughput measurement."""
 
@@ -72,6 +76,9 @@ def main(argv=None):
     if argv and argv[0] == 'serve':
         from petastorm_tpu.service.fleet import serve as serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == 'chaos':
+        from petastorm_tpu.test_util.chaos import main as chaos_main
+        return chaos_main(argv[1:])
     if argv and argv[0] == 'doctor':
         from petastorm_tpu.tools.doctor import main as doctor_main
         return doctor_main(argv[1:])

@@ -23,10 +23,48 @@ Usage::
     loader = JaxDataLoader(reader, ...)
 """
 
+import json
+
 import orbax.checkpoint as ocp
 
 _MODEL_KEY = 'train_state'
 _LOADER_KEY = 'input_pipeline'
+
+
+def _check_json_roundtrip(loader_state):
+    """Fail a save EARLY (and name the offending key) when the loader state
+    would not survive orbax's JsonSave: a non-JSON-serializable value (bytes
+    digest, numpy scalar, set) raises deep inside the async save machinery
+    with no hint of which entry is at fault — and under elastic resharding
+    the service loader state now carries nested scheduler/ledger fields that
+    make this failure mode easy to hit."""
+    try:
+        json.dumps(loader_state)
+        return
+    except (TypeError, ValueError):
+        pass
+
+    def blame(node, path):
+        if isinstance(node, dict):
+            for key, value in node.items():
+                blame(value, path + (str(key),))
+        elif isinstance(node, (list, tuple)):
+            for index, value in enumerate(node):
+                blame(value, path + (str(index),))
+        else:
+            try:
+                json.dumps(node)
+            except (TypeError, ValueError):
+                raise TypeError(
+                    'loader state is not JSON-serializable at {!r}: {!r} '
+                    '({}) — convert it before save() or drop it from '
+                    'state_dict()'.format('/'.join(path) or '<root>', node,
+                                          type(node).__name__)) from None
+
+    blame(loader_state, ())
+    # structure-level failure (circular reference): no single leaf to blame
+    raise TypeError('loader state is not JSON-serializable (circular '
+                    'reference?)')
 
 
 class TrainingCheckpointer(object):
@@ -66,6 +104,7 @@ class TrainingCheckpointer(object):
             loader_state = {'reader': loader_state}
         composite = {_MODEL_KEY: ocp.args.StandardSave(train_state)}
         if loader_state is not None:
+            _check_json_roundtrip(loader_state)
             composite[_LOADER_KEY] = ocp.args.JsonSave(loader_state)
         return self._manager.save(step, args=ocp.args.Composite(**composite),
                                   force=force)

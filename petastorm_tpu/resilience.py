@@ -273,6 +273,14 @@ class QuarantineLedger:
 #: breaker state names (the classic three-state machine)
 BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = 'closed', 'open', 'half_open'
 
+#: ledger-replay breaker (service/ledger.py, docs/service.md "Dispatcher
+#: crash with a ledger"): consecutive corrupt journal replays before a
+#: restarting dispatcher DISCARDS the journal instead of replaying it —
+#: a journal that corrupts every replay must degrade the fleet to
+#: replay-from-clients, not wedge every restart on the same bad frames
+LEDGER_REPLAY_BREAKER_THRESHOLD = 2
+LEDGER_REPLAY_BREAKER_RECOVERY_S = 60.0
+
 #: transition-notification callback: (breaker_name, old_state, new_state)
 OnBreakerTransition = Callable[[str, str, str], None]
 

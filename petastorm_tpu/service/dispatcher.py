@@ -39,6 +39,7 @@ and the ``petastorm-tpu-throughput serve`` CLI."""
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import logging
 import math
@@ -62,6 +63,12 @@ MSG_SUBMIT, MSG_ACCEPT, MSG_BUSY = b'submit', b'accept', b'busy'
 MSG_REJOIN = b'rejoin'
 MSG_RESULT, MSG_RESULT_SHM, MSG_ERROR = b'result', b'result_shm', b'error'
 MSG_SHM_FAIL, MSG_BYE, MSG_STATE = b'shm_fail', b'bye', b'state'
+#: ledger-epoch handshake (docs/service.md "Dispatcher crash with a
+#: ledger"): a client probes with ``ledger_sync``; the ``ledger_state``
+#: reply says whether this dispatcher knows the client and which ledger
+#: epoch it serves — an unknown/epoch-changed answer means the client's
+#: in-flight tokens died with the previous incarnation and must re-arm
+MSG_LEDGER_SYNC, MSG_LEDGER_STATE = b'ledger_sync', b'ledger_state'
 #: worker-side message kinds (worker ROUTER): registration/results up, work down
 MSG_REGISTER, MSG_REGISTERED = b'register', b'registered'
 MSG_W_READY, MSG_WORK, MSG_W_STOP = b'w_ready', b'work', b'w_stop'
@@ -76,6 +83,11 @@ MSG_W_METRICS = b'w_metrics'
 MSG_W_INCIDENT = b'w_incident'
 MSG_W_DONE, MSG_W_ERROR = b'w_done', b'w_error'
 MSG_W_NEED_SETUP, MSG_W_LEAVE = b'w_need_setup', b'w_leave'
+#: worker-side restart re-adoption: a ``w_ready`` from an identity this
+#: dispatcher never registered (it belongs to the previous incarnation)
+#: is answered with ``w_rejoin`` — the worker re-``register``s and the
+#: fleet heals without respawning a single process
+MSG_W_REJOIN = b'w_rejoin'
 
 #: default per-client in-flight window (queued + assigned) before ``busy``
 DEFAULT_ADMISSION_WINDOW = 16
@@ -248,6 +260,26 @@ class FairShareScheduler(object):
         self._ready_workers: Deque[bytes] = collections.deque()
         self._setups: Dict[bytes, bytes] = {}
         self._assign_time: Dict[int, float] = {}
+        # ------------------------------------------------- durable ledger
+        #: optional TokenLedger (service/ledger.py) the dispatcher arms;
+        #: every lifecycle edge below journals through ``_journal`` so a
+        #: restarted dispatcher can replay the epoch's token history
+        self.journal: Any = None
+        #: the ledger epoch this scheduler serves (0 = unarmed/first life);
+        #: reported in the ``ledger_state`` handshake so re-adopting clients
+        #: can tell a restart from a slow dispatcher
+        self.ledger_epoch = 0
+        #: pre-crash delivered tokens recovered by replay: a straggler
+        #: ``w_result`` for one of these is a duplicate even though no live
+        #: _TokenState remembers it — the dispatcher-side dedup that used to
+        #: die with the process
+        self._replay_delivered: Set[int] = set()
+        self.replay_info: Optional[Dict[str, Any]] = None
+        # -------------------------------------------- elastic resharding
+        #: token -> preferred worker id from the last reshard; honored when
+        #: that worker is ready, falls back to the normal pick otherwise
+        self._preferred_worker: Dict[int, int] = {}
+        self.resharded = 0
         # ----------------------------------------------------- aggregates
         self.busy_rejections = 0
         self.results_dropped = 0
@@ -256,6 +288,61 @@ class FairShareScheduler(object):
         self.items_served = 0
         self.workers_registered_total = 0
         self.workers_departed = 0
+
+    # -------------------------------------------------------------- ledger
+
+    def _journal(self, kind: str, **fields: Any) -> None:
+        """Append one lifecycle record to the armed TokenLedger (no-op when
+        the ledger is off; a failing journal degrades durability, never
+        dispatch — the writer already swallows I/O errors)."""
+        journal = self.journal
+        if journal is not None:
+            journal.append_record(kind, **fields)
+
+    def adopt_replay(self, replay: Any, epoch: int) -> None:
+        """Adopt a ledger replay at startup: restore token-counter
+        monotonicity and the delivered-token dedup set, and remember the
+        ledger epoch the handshake reports. Clients and setup blobs are NOT
+        rebuilt here — live clients re-adopt themselves via the
+        ``ledger_sync`` handshake (the blobs only they hold)."""
+        with self._lock:
+            self._next_token = max(self._next_token, replay.next_token)
+            self._replay_delivered = set(replay.delivered)
+            self.ledger_epoch = epoch
+            self.resharded = replay.resharded
+            self.replay_info = replay.as_dict()
+
+    # ----------------------------------------------------------- resharding
+
+    def reshard(self, reason: str) -> Optional[Dict[str, Any]]:
+        """Re-split the UNDELIVERED work across the current worker set after
+        an elastic join/leave: walk clients in sorted-name order and each
+        client's queue in ventilation order (the lineage contract — the
+        order is never reshuffled, only the token->worker placement moves)
+        and deal tokens round-robin across sorted worker ids. Returns a
+        summary for the reshard trace/incident event, or None when there is
+        nothing to re-split."""
+        with self._lock:
+            worker_ids = sorted(w.descriptor.worker_id
+                                for w in self._workers.values())
+            self._preferred_worker.clear()
+            if not worker_ids:
+                return None
+            undelivered: List[int] = []
+            for key in sorted(self._clients,
+                              key=lambda k: self._clients[k].name):
+                undelivered.extend(self._clients[key].queue)
+            if not undelivered:
+                return None
+            for index, token in enumerate(undelivered):
+                self._preferred_worker[token] = \
+                    worker_ids[index % len(worker_ids)]
+            self.resharded += 1
+            summary = {'reason': reason, 'workers': len(worker_ids),
+                       'undelivered': len(undelivered),
+                       'resharded': self.resharded}
+        self._journal('reshard', **summary)
+        return summary
 
     # ------------------------------------------------------------- autotune
 
@@ -325,6 +412,7 @@ class FairShareScheduler(object):
             self._clients[key] = _ClientState(key, name, host, effective,
                                               self._clock(),
                                               requested_window=window)
+            self._journal('client', name=name, host=host, window=effective)
             return effective
 
     def client_window(self, key: bytes) -> int:
@@ -353,6 +441,7 @@ class FairShareScheduler(object):
                 return
             for token in client.queue:
                 self._tokens.pop(token, None)
+                self._preferred_worker.pop(token, None)
             for setup_id in client.setup_ids:
                 self._setups.pop(setup_id, None)
             try:
@@ -380,6 +469,10 @@ class FairShareScheduler(object):
             if client is not None:
                 client.setup_ids.add(setup_id)
                 client.last_seen = self._clock()
+            self._journal(
+                'setup', setup=setup_id.decode('ascii', 'replace'),
+                digest=hashlib.blake2b(blob, digest_size=8).hexdigest(),
+                client=client.name if client is not None else None)
 
     def submit(self, client_key: bytes, client_token: bytes, setup_id: bytes,
                blob: bytes, cost: float = 1.0) -> Optional[int]:
@@ -404,20 +497,25 @@ class FairShareScheduler(object):
             client.queue.append(token)
             if client.key not in self._active:
                 self._active.append(client.key)
+            self._journal('issued', token=token, client=client.name,
+                          cost=cost)
             return token
 
     # ------------------------------------------------------------- workers
 
-    def add_worker(self, key: bytes, descriptor: WorkerDescriptor) -> None:
+    def add_worker(self, key: bytes, descriptor: WorkerDescriptor) -> bool:
         """Register a worker (elastic join — any time, including mid-epoch).
         Idempotent per identity: a re-sent ``register`` (slow-ack retry) must
-        neither reset the worker's assignment record nor double-count it."""
+        neither reset the worker's assignment record nor double-count it.
+        Returns True only for a NEW registration — the edge the caller
+        reshards on."""
         with self._lock:
             if key in self._workers:
-                return
+                return False
             self._workers[key] = _WorkerState(key, descriptor, self._clock())
             self._worker_id_index[descriptor.worker_id] = key
             self.workers_registered_total += 1
+            return True
 
     def remove_worker(self, key: bytes) -> List[Tuple[int, bytes, bytes]]:
         """Deregister a worker (leave, or reaped as stale) and re-queue its
@@ -448,10 +546,12 @@ class FairShareScheduler(object):
                 state.attempt += 1
                 if state.attempt >= self.max_item_attempts:
                     del self._tokens[token]
+                    self._preferred_worker.pop(token, None)
                     client = self._clients.get(state.client_key)
                     if client is not None:
                         client.assigned.discard(token)
                     self.items_failed += 1
+                    self._journal('quarantined', token=token)
                     failed.append((token, state.client_key,
                                    state.client_token))
                     continue
@@ -468,11 +568,17 @@ class FairShareScheduler(object):
                 self.items_requeued += 1
         return failed
 
-    def worker_ready(self, key: bytes) -> None:
-        """A worker announced itself idle; it may receive one assignment."""
+    def worker_ready(self, key: bytes) -> bool:
+        """A worker announced itself idle; it may receive one assignment.
+        Returns False for an UNKNOWN identity — a live worker left over from
+        a previous dispatcher incarnation, which the caller answers with
+        ``w_rejoin`` so it re-registers instead of idling forever."""
         with self._lock:
-            if key in self._workers and key not in self._ready_workers:
+            if key not in self._workers:
+                return False
+            if key not in self._ready_workers:
                 self._ready_workers.append(key)
+            return True
 
     def heartbeat(self, worker_id: int, seq: int) -> None:
         """Record a worker's liveness stamp (change-detected on our clock —
@@ -548,11 +654,12 @@ class FairShareScheduler(object):
                     if client.deficit < cost:
                         self._active.rotate(-1)
                         continue
-                worker_key = self._pick_worker(cost)
+                worker_key = self._pick_worker_for(state.token, cost)
                 if worker_key is None:
                     return None
                 client.deficit -= cost
                 token = client.queue.popleft()
+                self._preferred_worker.pop(token, None)
                 if not client.queue:
                     self._active.popleft()
                     client.deficit = 0.0
@@ -579,6 +686,19 @@ class FairShareScheduler(object):
                                   state.blob, state.attempt, colocated,
                                   setup_blob)
             return None
+
+    def _pick_worker_for(self, token: int,
+                         cost: float = 1.0) -> Optional[bytes]:
+        """Honor the last reshard's placement for ``token`` when that worker
+        is ready; fall back to the ordinary pick (FIFO / least-loaded)
+        otherwise — a reshard preference is a balance hint, never a stall."""
+        preferred = self._preferred_worker.get(token)
+        if preferred is not None:
+            key = self._worker_id_index.get(preferred)
+            if key is not None and key in self._ready_workers:
+                self._ready_workers.remove(key)
+                return key
+        return self._pick_worker(cost)
 
     def _pick_worker(self, cost: float = 1.0) -> Optional[bytes]:
         """The ready worker for one item: FIFO for ordinary items (the
@@ -626,8 +746,10 @@ class FairShareScheduler(object):
             return None
         if state.attempt >= self.max_item_attempts:
             del self._tokens[token]
+            self._preferred_worker.pop(token, None)
             client.assigned.discard(token)
             self.items_failed += 1
+            self._journal('quarantined', token=token)
             return (token, state.client_key, state.client_token)
         client.assigned.discard(token)
         if token not in client.queue:
@@ -665,13 +787,21 @@ class FairShareScheduler(object):
         counted, exactly like the pool's ``results_dropped``)."""
         with self._lock:
             state = self._tokens.get(token)
-            if state is None or state.delivered:
+            if state is None:
+                # includes tokens whose delivery the LEDGER remembers from a
+                # previous dispatcher life: a pre-crash straggler result is a
+                # duplicate even though no live record holds it
+                self._replay_delivered.discard(token)
+                self.results_dropped += 1
+                return None
+            if state.delivered:
                 self.results_dropped += 1
                 return None
             if self._clients.get(state.client_key) is None:
                 self.results_dropped += 1
                 return None
             state.delivered = True
+            self._journal('delivered', token=token)
             return state.client_key, state.client_token
 
     def retire(self, token: int, attempt: Optional[int]) -> None:
@@ -686,7 +816,10 @@ class FairShareScheduler(object):
                 return
             del self._tokens[token]
             self._assign_time.pop(token, None)
+            self._preferred_worker.pop(token, None)
             client = self._clients.get(state.client_key)
+            self._journal('retired', token=token,
+                          client=client.name if client is not None else None)
             if client is not None:
                 client.assigned.discard(token)
                 client.served += 1
@@ -705,8 +838,10 @@ class FairShareScheduler(object):
         with self._lock:
             state = self._tokens.pop(token, None)
             self._assign_time.pop(token, None)
+            self._preferred_worker.pop(token, None)
             if state is None:
                 return None
+            self._journal('failed', token=token)
             client = self._clients.get(state.client_key)
             if client is not None:
                 client.assigned.discard(token)
@@ -805,6 +940,8 @@ class FairShareScheduler(object):
                 'admission_window': self.admission_window,
                 'workers_registered_total': self.workers_registered_total,
                 'workers_departed': self.workers_departed,
+                'resharded': self.resharded,
+                'ledger_epoch': self.ledger_epoch,
             }
 
 
@@ -861,9 +998,18 @@ class Dispatcher(object):
                  client_ttl_s: float = DEFAULT_CLIENT_TTL_S,
                  autotune: Any = None,
                  metrics_port: Optional[int] = None,
-                 incidents: Any = None) -> None:
+                 incidents: Any = None,
+                 ledger: Optional[str] = None) -> None:
         self._host = host
         self._port = port
+        #: durable token ledger (service/ledger.py): a journal path arms it;
+        #: ``start`` replays the journal (behind the ledger-replay breaker)
+        #: before the first frame is served
+        self._ledger_path = ledger
+        self._ledger: Any = None
+        #: set by :meth:`crash` — the pump exits WITHOUT the stop broadcast
+        #: or the heartbeat drain, exactly like a SIGKILL would leave things
+        self._crashed = False
         # Fleet metrics plane (docs/observability.md "Live metrics plane"):
         # latest cumulative telemetry snapshot per worker (seq-guarded,
         # delivered as w_metrics frames on the heartbeat socket), merged at
@@ -929,9 +1075,14 @@ class Dispatcher(object):
 
     def start(self) -> str:
         """Bind both ROUTERs and start the pump thread; returns the
-        ``service_url`` clients connect to."""
+        ``service_url`` clients connect to. When a ledger path is armed the
+        journal is replayed FIRST (behind the ledger-replay breaker): token
+        monotonicity and the delivered-dedup set are restored before any
+        client or worker frame can race them."""
         import zmq
         from petastorm_tpu.service.wire import WORKER_PORT_OFFSET
+        if self._ledger_path:
+            self._arm_ledger()
         self._context = zmq.Context()
         self._client_socket = self._context.socket(zmq.ROUTER)
         self._worker_socket = self._context.socket(zmq.ROUTER)
@@ -978,6 +1129,45 @@ class Dispatcher(object):
             self._metrics_server.start()
         return self.service_url
 
+    def _arm_ledger(self) -> None:
+        """Open + replay the durable token ledger behind the ledger-replay
+        breaker: a journal that corrupts consecutive replays must not wedge
+        every restart — once the breaker opens, the journal is DISCARDED and
+        the fleet degrades to replay-from-clients (loud: incident bundle +
+        CRC drop counter), never to a wrong order."""
+        from petastorm_tpu.resilience import (
+            LEDGER_REPLAY_BREAKER_THRESHOLD, LEDGER_REPLAY_BREAKER_RECOVERY_S,
+            default_board)
+        from petastorm_tpu.service.ledger import TokenLedger
+        from petastorm_tpu.telemetry.tracing import trace_instant
+        breaker = default_board().breaker(
+            'ledger:replay',
+            failure_threshold=LEDGER_REPLAY_BREAKER_THRESHOLD,
+            recovery_timeout_s=LEDGER_REPLAY_BREAKER_RECOVERY_S)
+        self._ledger = TokenLedger(self._ledger_path)
+        replay = self._ledger.open(discard=not breaker.allow())
+        if replay.result == 'corrupt':
+            breaker.record_failure()
+            logger.error(
+                'dispatcher: ledger journal %s failed CRC replay (%d '
+                'frame(s) dropped, %d record(s) recovered); degrading to '
+                'replay-from-clients', self._ledger_path,
+                replay.frames_dropped, replay.records)
+            if self._incident_registry is not None:
+                self._incident_registry.inc('ledger_frames_dropped',
+                                            replay.frames_dropped)
+            if self._incident_recorder is not None:
+                path = self._incident_recorder.trigger(
+                    'ledger_corrupt', args=replay.as_dict())
+                self._correlate_incident(
+                    None, {'bundle': path, 'kind': 'ledger_corrupt',
+                           'cause': 'corruption'})
+        elif replay.result == 'ok' and replay.records:
+            breaker.record_success()
+        self.scheduler.adopt_replay(replay, self._ledger.epoch)
+        self.scheduler.journal = self._ledger
+        trace_instant('ledger_replay', args=replay.as_dict())
+
     @property
     def service_url(self) -> str:
         """The URL readers pass as ``make_reader(service_url=...)``."""
@@ -988,11 +1178,24 @@ class Dispatcher(object):
         plus the ``autotune`` controller report when retuning is armed and
         the correlated ``incidents`` view when the incident plane is."""
         state = self.scheduler.state()
+        # a reply at all means the pump is live — fetch_service_state's
+        # hello-probe path reports 'starting' for a bound-but-silent socket
+        state['state'] = 'serving'
+        state['ledger'] = self.ledger_state()
         if self._autotune is not None:
             state['autotune'] = self._autotune.report()
         if self._incident_recorder is not None:
             state['incidents'] = self.incidents_state()
         return state
+
+    def ledger_state(self) -> Dict[str, Any]:
+        """The durable-ledger status block for ``state()`` and doctor:
+        armed flag, journal path/epoch, last replay result and the frames
+        the CRC dropped."""
+        if self._ledger is None:
+            return {'armed': False}
+        out: Dict[str, Any] = self._ledger.state()
+        return out
 
     # -------------------------------------------------------- metrics plane
 
@@ -1127,11 +1330,32 @@ class Dispatcher(object):
             self._incident_recorder.close()
         self._stop_event.set()
 
+    def crash(self) -> None:
+        """Crash simulation (chaos harness / tests): stop the pump WITHOUT
+        the worker-tail drain or the ``w_stop`` broadcast — workers and
+        clients are left exactly as a SIGKILL of the dispatcher process
+        would leave them, except the sockets can be rebound in-process. The
+        ledger handle closes abruptly (no terminal record — that is the
+        crash-consistency property being exercised)."""
+        self._crashed = True
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        if self._incident_recorder is not None:
+            self._incident_recorder.close()
+        self._stop_event.set()
+        self.join()
+        if self._ledger is not None:
+            self._ledger.close()
+            self._ledger = None
+
     def join(self, timeout: float = 10.0) -> None:
         """Wait for the pump thread and release the sockets."""
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        if self._ledger is not None:
+            self._ledger.close()
         if self._context is not None:
             for sock in (self._client_socket, self._worker_socket):
                 if sock is not None:
@@ -1182,7 +1406,29 @@ class Dispatcher(object):
                     logger.exception('dispatcher: autotune step failed; '
                                      'pump keeps dispatching')
             self._dispatch_ready()
-        self._broadcast_stop()
+        if not self._crashed:
+            self._drain_worker_tail()
+            self._broadcast_stop()
+
+    def _drain_worker_tail(self) -> None:
+        """Final heartbeat-socket drain before the stop broadcast: a worker
+        mid-``w_incident`` (or mid-metrics) ship when stop lands would
+        otherwise lose those frames AND look like a straggler to the fleet
+        reaper. Bounded — shutdown must not hang on a chatty socket."""
+        import zmq
+        deadline = time.monotonic() + 0.25
+        while time.monotonic() < deadline:
+            if not self._worker_socket.poll(50, zmq.POLLIN):
+                break  # quiet socket: nothing is mid-flight
+            for _ in range(64):
+                try:
+                    frames = self._worker_socket.recv_multipart(zmq.NOBLOCK)
+                except zmq.ZMQError:
+                    break
+                try:
+                    self._handle_worker(frames)
+                except Exception:  # noqa: BLE001 - the drain is best-effort; a malformed tail frame must not block shutdown
+                    pass
 
     def _broadcast_stop(self) -> None:
         for key in self.scheduler.worker_keys():
@@ -1227,14 +1473,33 @@ class Dispatcher(object):
             name = bytes(frames[2]).decode('utf-8', 'replace')
             host = bytes(frames[3]).decode('utf-8', 'replace')
             window = int(bytes(frames[4]))
-            effective = self.scheduler.add_client(identity, name, host,
-                                                  window or None)
+            if name:
+                effective = self.scheduler.add_client(identity, name, host,
+                                                      window or None)
+            else:
+                # anonymous probe (fetch_service_state's starting-detector):
+                # answer without registering a client record
+                effective = self.scheduler.admission_window
             body = json.dumps({
                 'workers': self.scheduler.worker_count(),
                 'window': effective,
                 'host': self._host,
+                'ledger_epoch': self.scheduler.ledger_epoch,
             }).encode('utf-8')
             self._client_socket.send_multipart([identity, MSG_WELCOME, body])
+            return
+        if kind == MSG_LEDGER_SYNC:
+            # ledger-epoch handshake: the client's starvation probe (and its
+            # post-rejoin resync). 'known' False or a changed epoch tells
+            # the client its in-flight tokens died with the previous
+            # dispatcher incarnation — it re-arms them instead of waiting
+            body = json.dumps({
+                'known': self.scheduler.has_client(identity),
+                'epoch': self.scheduler.ledger_epoch,
+                'ledger': self.ledger_state(),
+            }).encode('utf-8')
+            self._client_socket.send_multipart(
+                [identity, MSG_LEDGER_STATE, body])
             return
         if kind == MSG_OPEN and len(frames) >= 4:
             self.scheduler.add_setup(identity, bytes(frames[2]), frames[3])
@@ -1308,14 +1573,19 @@ class Dispatcher(object):
                     [client_key, MSG_ERROR, client_token, frames[4]])
             return
         if kind == MSG_W_READY:
-            self.scheduler.worker_ready(identity)
+            if not self.scheduler.worker_ready(identity):
+                # a live worker from a previous dispatcher incarnation
+                # (restart): tell it to re-register — fleet heals in place
+                self._worker_socket.send_multipart([identity, MSG_W_REJOIN])
             return
         if kind == MSG_REGISTER and len(frames) >= 3:
             descriptor = WorkerDescriptor.from_bytes(bytes(frames[2]))
-            self.scheduler.add_worker(identity, descriptor)
+            newly = self.scheduler.add_worker(identity, descriptor)
             logger.info('dispatcher: worker %d (pid %d, host %s) registered',
                         descriptor.worker_id, descriptor.pid, descriptor.host)
             self._worker_socket.send_multipart([identity, MSG_REGISTERED])
+            if newly:
+                self._note_reshard('worker-join')
             return
         if kind == MSG_W_NEED_SETUP and len(frames) >= 3:
             failed = self.scheduler.forget_setups(identity,
@@ -1379,6 +1649,28 @@ class Dispatcher(object):
                          key.hex(), reason)
         for _token, client_key, client_token in failed:
             self._send_attempt_exhausted(client_key, client_token)
+        self._note_reshard('worker-leave' if reason == 'left'
+                           else 'worker-stale')
+
+    def _note_reshard(self, reason: str) -> None:
+        """Re-split undelivered work after an elastic worker-set change and
+        make the decision observable: a ``reshard`` trace instant on the
+        flight recorder plus an incident-correlatable event — repeated
+        membership churn then reads as ONE scheduling-skew incident, not
+        scattered log lines."""
+        summary = self.scheduler.reshard(reason)
+        if summary is None:
+            return
+        from petastorm_tpu.telemetry.tracing import trace_instant
+        trace_instant('reshard', args=summary)
+        logger.info('dispatcher: resharded %d undelivered item(s) across %d '
+                    'worker(s) (%s)', summary['undelivered'],
+                    summary['workers'], reason)
+        if self._incident_recorder is not None:
+            path = self._incident_recorder.trigger('reshard', args=summary)
+            self._correlate_incident(
+                None, {'bundle': path, 'kind': 'reshard',
+                       'cause': 'scheduling-skew'})
 
     def _check_stale(self) -> None:
         now = time.monotonic()

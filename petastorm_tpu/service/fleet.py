@@ -59,7 +59,11 @@ class ServiceFleet(object):
     incident autopsy plane fleet-wide: every worker captures black-box
     bundles locally and ships references up the heartbeat socket, the
     dispatcher adopts and correlates them — docs/observability.md
-    "Incident autopsy plane"."""
+    "Incident autopsy plane". ``ledger`` (True or an explicit journal
+    path) arms the dispatcher's durable token ledger — the
+    epoch-survivable control plane that lets :meth:`crash_dispatcher`
+    restart the dispatcher mid-epoch without re-delivering retired work
+    or losing in-flight items (docs/service.md "Failure modes")."""
 
     def __init__(self, workers: int = 2, host: str = '127.0.0.1',
                  port: Optional[int] = None,
@@ -75,23 +79,42 @@ class ServiceFleet(object):
                  client_ttl_s: float = DEFAULT_CLIENT_TTL_S,
                  autotune: Any = None,
                  metrics_port: Optional[int] = None,
-                 incidents: Any = None) -> None:
+                 incidents: Any = None,
+                 ledger: Any = None) -> None:
         self._initial_workers = workers
         self._cache_dir = cache_dir
         self._cache_size_limit = cache_size_limit
         self._shm_results = shm_results
         self._heartbeat_interval_s = heartbeat_interval_s
         self._incidents = incidents
-        self.dispatcher = Dispatcher(
+        self._ledger_path = self._resolve_ledger(ledger)
+        # the dispatcher's construction arguments, kept so crash_dispatcher
+        # can rebuild an identical incarnation on the same port
+        self._dispatcher_kwargs: Dict[str, Any] = dict(
             host=host, port=port, admission_window=admission_window,
             quantum=quantum, stale_timeout_s=stale_timeout_s,
             max_item_attempts=max_item_attempts,
             item_deadline_s=item_deadline_s, client_ttl_s=client_ttl_s,
             autotune=autotune, metrics_port=metrics_port,
-            incidents=incidents)
+            incidents=incidents, ledger=self._ledger_path)
+        self.dispatcher = Dispatcher(**self._dispatcher_kwargs)
         self.processes: List[subprocess.Popen] = []
         self._next_worker_id = 0
         self.service_url: Optional[str] = None
+
+    def _resolve_ledger(self, ledger: Any) -> Optional[str]:
+        """``None``/``False`` → no ledger; a str → that journal path;
+        ``True`` → the fleet cache directory (or a private temp directory
+        when the fleet runs cacheless)."""
+        if not ledger:
+            return None
+        if isinstance(ledger, str):
+            return ledger
+        from petastorm_tpu.service.ledger import LEDGER_BASENAME
+        home = self._cache_dir or tempfile.mkdtemp(
+            prefix='petastorm-tpu-ledger-')
+        os.makedirs(home, exist_ok=True)
+        return os.path.join(home, LEDGER_BASENAME)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -155,6 +178,33 @@ class ServiceFleet(object):
         process.kill()
         process.wait(timeout=10)
         return process.pid
+
+    def crash_dispatcher(self) -> str:
+        """Hard-stop the dispatcher WITHOUT the goodbye choreography (no
+        ``w_stop`` broadcast, no worker-tail drain — the moral equivalent of
+        SIGKILL for the in-process thread) and start a fresh incarnation on
+        the same port. With a ledger armed the replacement replays the
+        journal, re-adopts the live workers via the ``w_rejoin`` handshake
+        and resumes the epoch without re-delivering retired tokens; without
+        one it comes up empty and the clients' starvation re-arm recovers
+        the in-flight work the slow way. Returns the (unchanged)
+        ``service_url``."""
+        if self.service_url is None:
+            raise RuntimeError('start() the fleet before crashing it')
+        # the replacement must bind the SAME client port or nobody finds it:
+        # recover the actual bound port for fleets started with port=None
+        port = int(self.service_url.rsplit(':', 1)[1])
+        self.dispatcher.crash()
+        kwargs = dict(self._dispatcher_kwargs)
+        kwargs['port'] = port
+        self.dispatcher = Dispatcher(**kwargs)
+        self.service_url = self.dispatcher.start()
+        return self.service_url
+
+    @property
+    def ledger_path(self) -> Optional[str]:
+        """The durable ledger journal path (None when the ledger is off)."""
+        return self._ledger_path
 
     def state(self) -> Dict[str, Any]:
         """The dispatcher's scheduler snapshot (clients/workers/queues)."""
@@ -246,6 +296,13 @@ def serve(argv: Optional[List[str]] = None) -> int:
                              'edges and ship references to the dispatcher, '
                              'which correlates them into state() — '
                              'docs/observability.md "Incident autopsy plane"')
+    parser.add_argument('--ledger', nargs='?', const=True, default=None,
+                        metavar='PATH',
+                        help='arm the durable dispatcher ledger: journal '
+                             'token lifecycle to PATH (bare --ledger uses '
+                             'the cache dir) so a restarted dispatcher '
+                             'resumes mid-epoch — docs/service.md '
+                             '"Failure modes"')
     parser.add_argument('--state-interval', type=float, default=30.0,
                         help='seconds between state summaries (0 = quiet)')
     parser.add_argument('--json', action='store_true',
@@ -258,7 +315,8 @@ def serve(argv: Optional[List[str]] = None) -> int:
         cache_dir=args.cache_dir, cache_size_limit=args.cache_size_limit,
         shm_results=not args.no_shm, admission_window=args.admission_window,
         item_deadline_s=args.item_deadline_s, autotune=args.autotune,
-        metrics_port=args.metrics_port, incidents=args.incidents or None)
+        metrics_port=args.metrics_port, incidents=args.incidents or None,
+        ledger=args.ledger)
     url = fleet.start()
     print('petastorm-tpu input service running at {} ({} worker(s); '
           'workers register on port {}). Point readers at '

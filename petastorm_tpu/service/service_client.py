@@ -67,6 +67,9 @@ BUSY_BACKOFF_S = 0.05
 #: fails fast, and the cooldown before a retry probe
 TRANSPORT_BREAKER_THRESHOLD = 3
 TRANSPORT_BREAKER_RECOVERY_S = 30.0
+#: env override for the response timeout (chaos harness / tests shrink it
+#: so dispatcher-crash recovery is detected in seconds, not the 10s default)
+RESPONSE_TIMEOUT_ENV = 'PETASTORM_TPU_SERVICE_RESPONSE_TIMEOUT_S'
 
 
 def fetch_service_state(service_url: str,
@@ -74,17 +77,44 @@ def fetch_service_state(service_url: str,
     """One ``state`` request/reply against a dispatcher: the scheduler
     snapshot (clients, workers, queue depths, fair-share debts). Raises
     :class:`TransientIOError` when the service does not answer in time —
-    doctor turns that into its unreachable WARNING."""
+    doctor turns that into its unreachable WARNING.
+
+    A HALF-UP dispatcher — socket bound, pump not started yet (the
+    start-sequence window, or a wedged pump thread) — accepts the TCP
+    connection but answers nothing. Instead of blocking the full timeout,
+    an anonymous ``hello`` probe rides behind the ``state`` request after a
+    short grace; if the TCP link is up but both stay unanswered at the
+    deadline, the caller gets ``{'state': 'starting'}`` rather than an
+    exception (doctor renders that as a starting service, not a dead
+    one)."""
     import zmq
     context = zmq.Context()
     socket = context.socket(zmq.DEALER)
     socket.setsockopt(zmq.LINGER, 0)
+    monitor = socket.get_monitor_socket(
+        zmq.EVENT_CONNECTED | zmq.EVENT_CONNECT_DELAYED
+        | zmq.EVENT_CONNECT_RETRIED)
+    connected = False
+    probe_sent = False
     try:
         socket.connect(client_endpoint(service_url))
         socket.send_multipart([b'state'])
         deadline = time.monotonic() + timeout_s
+        probe_at = time.monotonic() + min(0.5, timeout_s / 2.0)
         while time.monotonic() < deadline:
-            if not socket.poll(100, zmq.POLLIN):
+            if not connected and monitor.poll(0, zmq.POLLIN):
+                event = monitor.recv_multipart()
+                if int.from_bytes(event[0][:2], 'little') \
+                        == zmq.EVENT_CONNECTED:
+                    connected = True
+            if connected and not probe_sent \
+                    and time.monotonic() >= probe_at:
+                # cheap liveness probe: an empty-name hello is answered
+                # without registering a client (dispatcher probe path)
+                socket.send_multipart([b'hello', b'',
+                                       host_token().encode('utf-8'), b'0'])
+                probe_sent = True
+            if not socket.poll(50, zmq.POLLIN):
                 continue
             frames = socket.recv_multipart()
             kind = frames[0]
@@ -92,10 +122,20 @@ def fetch_service_state(service_url: str,
                 out = json.loads(frames[1].decode('utf-8'))
                 assert isinstance(out, dict)
                 return out
+            if kind == b'welcome':
+                # the probe answered but state has not: keep waiting for it
+                continue
+        if connected:
+            return {'state': 'starting', 'service_url': service_url}
         raise TransientIOError(
             'input service at {} did not answer a state request within {}s'
             .format(service_url, timeout_s))
     finally:
+        try:
+            socket.disable_monitor()
+        except Exception:  # noqa: BLE001 - monitor teardown is best-effort across pyzmq versions
+            pass
+        monitor.close(linger=0)
         socket.close(linger=0)
         context.term()
 
@@ -123,6 +163,13 @@ class ServicePool(object):
                             else ArrowIpcSerializer())
         self._client_name = client_name or 'reader-{}-{}'.format(
             os.getpid(), uuid.uuid4().hex[:6])
+        env_timeout = os.environ.get(RESPONSE_TIMEOUT_ENV)
+        if env_timeout:
+            try:
+                response_timeout_s = float(env_timeout)
+            except ValueError:
+                logger.warning('ignoring non-numeric %s=%r',
+                               RESPONSE_TIMEOUT_ENV, env_timeout)
         self._response_timeout_s = response_timeout_s
         # On the process-global board (not instance-owned like the pool's shm
         # breaker): its tripped state then rides the existing breakers
@@ -175,6 +222,12 @@ class ServicePool(object):
         self._unacked_timeouts = 0
         self._starvation_resubmits = 0
         self._rejoins = 0
+        #: ledger-epoch handshake state (docs/service.md "Dispatcher crash
+        #: with a ledger"): the epoch the dispatcher reported at welcome;
+        #: a ``ledger_state`` reply with a DIFFERENT epoch (or known=False)
+        #: means our in-flight tokens died with a previous incarnation
+        self._ledger_epoch: Optional[int] = None
+        self._ledger_rearms = 0
 
         import zmq
         self._context = zmq.Context()
@@ -194,6 +247,8 @@ class ServicePool(object):
                                                  connect_timeout_s))
         body = json.loads(welcome[1].decode('utf-8'))
         self._window = int(body['window'])
+        if 'ledger_epoch' in body:
+            self._ledger_epoch = int(body['ledger_epoch'])
         #: registered decode workers at hello time (fleet may grow/shrink);
         #: the Reader sizes its in-flight ventilation window from this
         self.workers_count = max(1, int(body['workers']))
@@ -329,15 +384,32 @@ class ServicePool(object):
                 '({} unacknowledged); transport breaker is {}'.format(
                     self.service_url, len(overdue), self._breaker.state))
 
+    def _rearm_inflight(self) -> None:
+        """Re-pend every in-flight token (front of the queue, ventilation
+        order preserved). The dispatcher restart / starvation paths call
+        this when those tokens died with a previous dispatcher incarnation;
+        a straggler result for an old token is dropped by the token dedup
+        on whichever side sees it first, so re-arming is duplicate-safe."""
+        with self._lock:
+            for token in sorted(self._inflight, reverse=True):
+                if token in self._items:
+                    self._pending.appendleft(token)
+            self._inflight.clear()
+            self._await_ack.clear()
+
     def _check_starvation(self) -> None:
         """Dead-dispatcher detector for the post-accept phase: submit acks
         alone cannot see a dispatcher that died (or restarted) AFTER
         accepting our window. When nothing at all has arrived for one
-        response window while we hold in-flight work, send a cheap ``state``
-        probe; a live dispatcher's reply resets the clock. After a second
-        silent window, assume the in-flight items are lost: re-arm them
-        (duplicates are dropped server-side), record a transport-breaker
-        failure, and fail the read fast once the breaker opens."""
+        response window while we hold in-flight work, send a ``ledger_sync``
+        probe — a RESTARTED dispatcher's ``ledger_state`` reply says it does
+        not know us (or serves a new ledger epoch) and triggers the precise
+        re-arm in ``get_results``, while a merely-slow dispatcher's reply
+        resets the clock. After a second fully-silent window (a DEAD
+        dispatcher answers nothing, not even the probe), assume the
+        in-flight items are lost: re-arm them (duplicates are dropped
+        server-side), record a transport-breaker failure, and fail the read
+        fast once the breaker opens."""
         with self._lock:
             inflight = len(self._inflight)
         if not inflight:
@@ -348,17 +420,12 @@ class ServicePool(object):
         if silent <= self._response_timeout_s:
             return
         if not self._starvation_probe_sent:
-            self._socket.send_multipart([b'state'])
+            self._socket.send_multipart([b'ledger_sync'])
             self._starvation_probe_sent = True
             return
         if silent <= 2 * self._response_timeout_s:
             return
-        with self._lock:
-            for token in sorted(self._inflight, reverse=True):
-                if token in self._items:
-                    self._pending.appendleft(token)
-            self._inflight.clear()
-            self._await_ack.clear()
+        self._rearm_inflight()
         self._starvation_resubmits += 1
         self._starvation_probe_sent = False
         self._last_reply = now
@@ -422,15 +489,31 @@ class ServicePool(object):
                     self.telemetry.inc('service_busy')
                 continue
             if kind == b'rejoin':
-                # the dispatcher does not know us (restart / TTL collection):
-                # re-hello + re-open, then resubmit the bounced item
-                token = int(bytes(frames[1]))
-                with self._lock:
-                    self._await_ack.pop(token, None)
-                    self._inflight.discard(token)
-                    if token in self._items:
-                        self._pending.appendleft(token)
+                # the dispatcher does not know us (restart / TTL collection).
+                # A dispatcher that does not know us cannot hold ANY of our
+                # tokens (TTL collection requires an empty in-flight set;
+                # a restart lost them all) — re-arm every in-flight item,
+                # not just the bounced one, then re-hello + re-open before
+                # the resubmits flush
+                self._rearm_inflight()
                 self._rejoin()
+                continue
+            if kind == b'ledger_state' and len(frames) >= 2:
+                # ledger-epoch handshake reply (our starvation probe): a
+                # dispatcher that does not know us, or one serving a new
+                # ledger epoch, is a fresh incarnation — its predecessor
+                # took our in-flight tokens with it
+                body = json.loads(frames[1].decode('utf-8'))
+                epoch = body.get('epoch')
+                restarted = (not body.get('known')
+                             or (self._ledger_epoch is not None
+                                 and epoch != self._ledger_epoch))
+                if epoch is not None:
+                    self._ledger_epoch = int(epoch)
+                if restarted:
+                    self._ledger_rearms += 1
+                    self._rearm_inflight()
+                    self._rejoin()
                 continue
             if kind == b'result':
                 result = self._handle_result(int(bytes(frames[1])),
@@ -458,7 +541,16 @@ class ServicePool(object):
                 self.stop()
                 raise exc
             # welcome/opened/state stragglers from handshake retries: ignore
+            # (but adopt a straggler welcome's ledger epoch — it is the
+            # freshest statement of which dispatcher incarnation we talk to)
             if kind == b'welcome' or kind == b'opened' or kind == b'state':
+                if kind == b'welcome' and len(frames) >= 2:
+                    try:
+                        body = json.loads(frames[1].decode('utf-8'))
+                        if 'ledger_epoch' in body:
+                            self._ledger_epoch = int(body['ledger_epoch'])
+                    except (ValueError, KeyError):
+                        pass
                 continue
 
     def _resolve_token(self, token: int) -> bool:
@@ -613,6 +705,8 @@ class ServicePool(object):
                 'unacked_timeouts': self._unacked_timeouts,
                 'starvation_resubmits': self._starvation_resubmits,
                 'rejoins': self._rejoins,
+                'ledger_epoch': self._ledger_epoch,
+                'ledger_rearms': self._ledger_rearms,
                 'service_breaker': self._breaker.as_dict(),
                 'sidecar_columns': serializer_stats.get('sidecar_columns', 0),
             }

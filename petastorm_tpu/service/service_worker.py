@@ -373,11 +373,23 @@ def main(bootstrap_path: str) -> None:
     import dill
     socket.send_multipart([b'w_ready'])
     stopping = False
+    idle_polls = 0
     while not stopping:
         if not socket.poll(1000, zmq.POLLIN):
             if shm_publisher is not None:
                 shm_publisher.janitor()
+            # Idle re-announce (docs/service.md "Restarting with a ledger"):
+            # a dispatcher that restarted while we sat idle never sees a
+            # w_ready from us and so never learns we exist. Periodically
+            # re-offer readiness — a live dispatcher that already knows us
+            # treats the duplicate as a no-op (identity already in its ready
+            # set), a restarted one answers with w_rejoin below.
+            idle_polls += 1
+            if idle_polls >= 5:
+                idle_polls = 0
+                socket.send_multipart([b'w_ready'])
             continue
+        idle_polls = 0
         frames = socket.recv_multipart()
         kind = frames[0]
         if kind == b'w_stop':
@@ -385,6 +397,13 @@ def main(bootstrap_path: str) -> None:
             continue
         if kind == b'registered':
             continue  # duplicate ack from the registration retry loop
+        if kind == b'w_rejoin':
+            # a restarted dispatcher does not know this identity: replay the
+            # registration handshake inline (no blocking retry loop — the
+            # dispatcher is demonstrably alive, it just answered us)
+            socket.send_multipart([b'register', descriptor.to_bytes()])
+            socket.send_multipart([b'w_ready'])
+            continue
         if kind != b'work' or len(frames) < 7:
             continue  # unknown kind from a newer dispatcher: ignore
         token, setup_id, blob = frames[1], frames[2], frames[3]

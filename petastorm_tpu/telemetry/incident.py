@@ -82,6 +82,11 @@ TRIGGER_KINDS: Tuple[str, ...] = (
     'slo_breach',          # input-efficiency fell below the SLO target
     'lineage_divergence',  # a delivered item broke the lineage stream
     'service_poison_item',  # a service item exhausted its attempt budget
+    'reshard',             # the service re-split undelivered work after an
+                           # elastic worker join/leave (service/dispatcher.py)
+    'ledger_corrupt',      # the dispatcher's durable token ledger failed CRC
+                           # replay and the fleet degraded to
+                           # replay-from-clients (service/ledger.py)
 )
 
 #: ranked-cause classes the autopsy report can name, with their CLI exit
@@ -105,6 +110,8 @@ _CAUSE_FOR_TRIGGER: Dict[str, str] = {
     'slo_breach': 'scheduling-skew',
     'lineage_divergence': 'divergence',
     'service_poison_item': 'hang',
+    'reshard': 'scheduling-skew',
+    'ledger_corrupt': 'corruption',
 }
 
 #: bundle directory name prefix (retention and the doctor scan key off it)

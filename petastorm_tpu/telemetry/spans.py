@@ -94,6 +94,9 @@ COUNTERS = (
                                # "Incident autopsy plane")
     'incidents_rate_limited',  # an incident trigger was dropped by the
                                # per-kind token bucket (telemetry/incident.py)
+    'ledger_frames_dropped',   # dispatcher-ledger journal frames that failed
+                               # CRC replay (service/ledger.py — the loud
+                               # half of degrade-to-replay-from-clients)
 )
 
 #: declared size histograms (``registry.observe(name, n, unit=BYTES_UNIT)``
@@ -121,6 +124,8 @@ TRACE_INSTANTS = (
     'schedule_plan',       # the cost-aware scheduler planned one epoch's ventilation order (ventilator thread; schedule/cost_schedule.py)
     'lineage_divergence',  # a delivered item broke the expected lineage stream (consumer; telemetry/lineage.py)
     'incident_captured',   # an incident bundle was written at this point on the timeline (telemetry/incident.py)
+    'reshard',             # undelivered service work was re-split across a changed worker set (dispatcher; service/dispatcher.py)
+    'ledger_replay',       # a restarting dispatcher replayed its durable token ledger (service/ledger.py)
 )
 
 #: declared gauge ids (``registry.gauge(name)`` call sites with literal

@@ -1,0 +1,328 @@
+"""Deterministic chaos harness for the epoch-survivable control plane.
+
+Where :mod:`~petastorm_tpu.test_util.fault_injection` damages the DATA plane
+(opens that fail, hang, or kill a decode worker), this module damages the
+CONTROL plane on a seeded schedule: SIGKILL the dispatcher at row N of an
+epoch, SIGKILL worker k mid-item, silence a client long enough to be
+TTL-collected, or corrupt one frame of the durable dispatcher ledger before
+the restart replays it. The point is not that the run survives — it is that
+it survives *provably*: the chaos epoch must deliver exactly the baseline's
+rows and its lineage order digest must be byte-identical to a same-seed
+undisturbed run (``lineage diff`` exit 0), which is what the
+``petastorm-tpu-throughput chaos`` verdict enforces.
+
+Trigger state lives in ``state_dir`` as atomically created marker files —
+the same ``O_CREAT|O_EXCL`` once-only-global idiom as
+:class:`~petastorm_tpu.test_util.fault_injection.FaultSchedule` — so a rule
+fires exactly once no matter how many processes or retries observe its
+trigger row. Rules without an explicit ``at`` row resolve it from the
+schedule seed and the epoch's row horizon, so two runs with the same seed
+injure the epoch at the same rows.
+
+Usage::
+
+    schedule = ChaosSchedule(state_dir, [
+        ChaosRule('kill_dispatcher'),            # at a seeded mid-epoch row
+        ChaosRule('kill_worker', at=120),        # SIGKILL worker 0 at row 120
+        ChaosRule('partition_client', pause_s=3.0),
+        ChaosRule('corrupt_ledger', after_kind='kill_dispatcher'),
+    ], seed=7)
+    schedule.resolve(horizon=total_rows)
+    report = run_chaos_epoch(reader, fleet, schedule)
+
+CLI: ``petastorm-tpu-throughput chaos <dataset_url>`` — runs the undisturbed
+baseline epoch, re-runs it under the schedule against a ledger-armed
+:class:`~petastorm_tpu.service.fleet.ServiceFleet`, and exits nonzero unless
+rows are exact, ``lineage verify`` passes, and the two manifests diff clean
+(docs/service.md "Failure modes", docs/robustness.md).
+"""
+
+import json
+import logging
+import os
+import random
+import time
+
+logger = logging.getLogger(__name__)
+
+CHAOS_KINDS = ('kill_dispatcher', 'kill_worker', 'partition_client',
+               'corrupt_ledger')
+
+#: chaos runs want dispatcher-crash recovery in seconds: the harness
+#: defaults the client response window down to this unless the caller
+#: already pinned PETASTORM_TPU_SERVICE_RESPONSE_TIMEOUT_S
+_CHAOS_RESPONSE_TIMEOUT_S = '2.0'
+
+
+class ChaosRule(object):
+    """One seeded control-plane injury, fired once at a trigger row.
+
+    :param kind: one of :data:`CHAOS_KINDS` — ``'kill_dispatcher'`` hard-
+        stops the in-process dispatcher and starts a fresh incarnation on
+        the same port (:meth:`ServiceFleet.crash_dispatcher`);
+        ``'kill_worker'`` SIGKILLs worker ``worker_index`` mid-item;
+        ``'partition_client'`` silences the consumer for ``pause_s``
+        (submits and acks stop flowing — the dispatcher-side view of a
+        network partition); ``'corrupt_ledger'`` bit-flips one frame of the
+        fleet's durable ledger journal so the NEXT dispatcher restart must
+        degrade loudly instead of replaying silently wrong.
+    :param at: 1-based row count that triggers the rule; None resolves a
+        seeded mid-epoch row at :meth:`ChaosSchedule.resolve` time.
+    :param worker_index: which fleet worker ``'kill_worker'`` targets.
+    :param pause_s: silence duration for ``'partition_client'``.
+    :param corrupt_mode: file-damage mode for ``'corrupt_ledger'``
+        (:func:`~petastorm_tpu.test_util.fault_injection.corrupt_file`).
+    """
+
+    def __init__(self, kind, at=None, worker_index=0, pause_s=2.0,
+                 corrupt_mode='flip'):
+        if kind not in CHAOS_KINDS:
+            raise ValueError('kind must be one of {}, got {!r}'
+                             .format(CHAOS_KINDS, kind))
+        if at is not None and at < 1:
+            raise ValueError('at must be >= 1 or None (seeded)')
+        self.kind = kind
+        self.at = at
+        self.worker_index = worker_index
+        self.pause_s = pause_s
+        self.corrupt_mode = corrupt_mode
+
+    def as_dict(self):
+        return {'kind': self.kind, 'at': self.at,
+                'worker_index': self.worker_index, 'pause_s': self.pause_s,
+                'corrupt_mode': self.corrupt_mode}
+
+
+class ChaosSchedule(object):
+    """Ordered chaos rules plus once-only trigger state (marker files in
+    ``state_dir``, the :class:`FaultSchedule` idiom). ``seed`` makes the
+    unresolved trigger rows deterministic: rule i with ``at=None`` lands at
+    a mid-epoch row drawn from ``Random(seed * 1000003 + i)`` over the
+    middle half of the horizon, so same seed + same horizon = same injury
+    rows on every run."""
+
+    def __init__(self, state_dir, rules, seed=0):
+        self.state_dir = str(state_dir)
+        self.rules = list(rules)
+        self.seed = int(seed)
+        os.makedirs(self.state_dir, exist_ok=True)
+
+    def resolve(self, horizon):
+        """Pin every unresolved rule's trigger row against an epoch of
+        ``horizon`` rows (the middle half: injuries land mid-epoch, after
+        the pipeline is flowing and before the natural drain)."""
+        if horizon < 4:
+            raise ValueError('horizon must be >= 4 rows to seed mid-epoch '
+                             'trigger rows, got {}'.format(horizon))
+        for index, rule in enumerate(self.rules):
+            if rule.at is None:
+                rng = random.Random(self.seed * 1000003 + index)
+                rule.at = rng.randrange(horizon // 4, 3 * horizon // 4)
+        return self
+
+    def _claim(self, rule_index):
+        """Atomically claim rule ``rule_index``'s single firing slot; False
+        when another observer already fired it."""
+        marker = os.path.join(self.state_dir,
+                              'chaos-{}.fired'.format(rule_index))
+        try:
+            fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        os.close(fd)
+        return True
+
+    def due(self, row_count):
+        """Claim and return the ``(rule_index, rule)`` pairs whose trigger
+        row has been reached and whose once-only slot this caller won."""
+        fired = []
+        for index, rule in enumerate(self.rules):
+            if rule.at is None or row_count < rule.at:
+                continue
+            if self._claim(index):
+                fired.append((index, rule))
+        return fired
+
+    def fired_count(self):
+        """Rules that have fired so far (marker-file census)."""
+        return sum(1 for index in range(len(self.rules))
+                   if os.path.exists(os.path.join(
+                       self.state_dir, 'chaos-{}.fired'.format(index))))
+
+
+def _fire(rule, fleet):
+    """Execute one claimed rule against the running fleet."""
+    if rule.kind == 'kill_dispatcher':
+        fleet.crash_dispatcher()
+    elif rule.kind == 'kill_worker':
+        index = min(rule.worker_index, len(fleet.processes) - 1)
+        fleet.kill_worker(index)
+    elif rule.kind == 'partition_client':
+        # consumer-side silence: no submits, no acks, no probes leave this
+        # client for pause_s — from the dispatcher it is indistinguishable
+        # from a partitioned host, and a pause past the client TTL forces
+        # the full collect-then-rejoin choreography
+        time.sleep(rule.pause_s)
+    elif rule.kind == 'corrupt_ledger':
+        from petastorm_tpu.test_util.fault_injection import corrupt_file
+        if fleet.ledger_path and os.path.exists(fleet.ledger_path):
+            corrupt_file(fleet.ledger_path, rule.corrupt_mode)
+        else:
+            logger.warning('corrupt_ledger fired but the fleet has no '
+                           'ledger journal to damage')
+
+
+def run_chaos_epoch(reader, fleet, schedule):
+    """Consume ``reader`` to exhaustion, firing ``schedule``'s due rules
+    after each delivered row. Returns ``{'rows', 'fired'}`` where
+    ``fired`` lists ``{'row', **rule}`` in firing order."""
+    rows = 0
+    fired = []
+    for _ in reader:
+        rows += 1
+        for index, rule in schedule.due(rows):
+            logger.info('chaos: firing rule %d (%s) at row %d',
+                        index, rule.kind, rows)
+            _fire(rule, fleet)
+            fired.append(dict(rule.as_dict(), row=rows))
+    return {'rows': rows, 'fired': fired}
+
+
+# ---------------------------------------------------------------------------
+# CLI: petastorm-tpu-throughput chaos
+# ---------------------------------------------------------------------------
+
+def _run_epoch(dataset_url, service_url, seed, manifest_path, fleet=None,
+               schedule=None):
+    """One lineage-armed epoch against the fleet; chaos-driven when a
+    schedule is given."""
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.telemetry.lineage import LineagePolicy
+    policy = LineagePolicy(manifest_path=manifest_path)
+    with make_reader(dataset_url, service_url=service_url, num_epochs=1,
+                     seed=seed, shuffle_row_groups=True,
+                     lineage=policy) as reader:
+        if schedule is None:
+            rows = sum(1 for _ in reader)
+            return {'rows': rows, 'fired': []}
+        return run_chaos_epoch(reader, fleet, schedule)
+
+
+def main(argv=None):
+    """``petastorm-tpu-throughput chaos`` entry (module docstring): baseline
+    epoch, then the same seed under a chaos schedule against a ledger-armed
+    fleet; exit 0 only when rows are exact, the chaos manifest dry-replay
+    verifies, and the two manifests diff byte-identical."""
+    import argparse
+    import tempfile
+    parser = argparse.ArgumentParser(
+        description='Prove an epoch survives seeded control-plane chaos '
+                    '(dispatcher kill, worker kill, client partition, '
+                    'ledger corruption) with rows exact and the lineage '
+                    'digest unchanged')
+    parser.add_argument('dataset_url')
+    parser.add_argument('--workers', type=int, default=2,
+                        help='decode workers in the chaos fleet')
+    parser.add_argument('--seed', type=int, default=1234,
+                        help='reader shuffle seed AND chaos-schedule seed')
+    parser.add_argument('--workdir', default=None,
+                        help='scratch home for manifests, the ledger and '
+                             'trigger markers (default: a fresh tempdir)')
+    parser.add_argument('--kill-dispatcher-at', type=int, default=None,
+                        metavar='ROW', help='pin the dispatcher kill to ROW '
+                                            '(default: seeded mid-epoch)')
+    parser.add_argument('--kill-worker-at', type=int, default=None,
+                        metavar='ROW', help='pin the worker SIGKILL to ROW '
+                                            '(default: seeded mid-epoch)')
+    parser.add_argument('--partition-s', type=float, default=0.0,
+                        help='also silence the client this long mid-epoch '
+                             '(0 = no partition rule)')
+    parser.add_argument('--corrupt-ledger', action='store_true',
+                        help='also bit-flip one ledger frame BEFORE the '
+                             'dispatcher kill: the restart must degrade '
+                             'loudly (CRC drop counter), never replay '
+                             'silently wrong')
+    parser.add_argument('--json', action='store_true',
+                        help='print the verdict as one JSON object')
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    os.environ.setdefault('PETASTORM_TPU_SERVICE_RESPONSE_TIMEOUT_S',
+                          _CHAOS_RESPONSE_TIMEOUT_S)
+    from petastorm_tpu.service.fleet import ServiceFleet
+    from petastorm_tpu.telemetry.lineage import diff_manifests, verify_manifest
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix='petastorm-tpu-chaos-')
+    os.makedirs(workdir, exist_ok=True)
+    manifest_a = os.path.join(workdir, 'baseline-manifest.jsonl')
+    manifest_b = os.path.join(workdir, 'chaos-manifest.jsonl')
+    ledger_path = os.path.join(workdir, 'dispatcher-ledger.bin')
+
+    # baseline: an undisturbed same-seed epoch is both the row-exactness
+    # oracle and the lineage reference stream
+    with ServiceFleet(workers=args.workers,
+                      cache_dir=os.path.join(workdir, 'cache-a')) as fleet:
+        baseline = _run_epoch(args.dataset_url, fleet.service_url,
+                              args.seed, manifest_a)
+    logger.info('chaos: baseline epoch delivered %d rows', baseline['rows'])
+
+    rules = []
+    if args.corrupt_ledger:
+        # fires on the row BEFORE the dispatcher kill: the damage must be
+        # on disk when the replacement replays the journal
+        corrupt_at = (max(1, args.kill_dispatcher_at - 1)
+                      if args.kill_dispatcher_at else None)
+        rules.append(ChaosRule('corrupt_ledger', at=corrupt_at))
+    rules.append(ChaosRule('kill_dispatcher', at=args.kill_dispatcher_at))
+    rules.append(ChaosRule('kill_worker', at=args.kill_worker_at,
+                           worker_index=0))
+    if args.partition_s > 0:
+        rules.append(ChaosRule('partition_client', pause_s=args.partition_s))
+    schedule = ChaosSchedule(os.path.join(workdir, 'chaos-markers'), rules,
+                             seed=args.seed)
+    schedule.resolve(horizon=baseline['rows'])
+    if args.corrupt_ledger and rules[0].at >= rules[1].at:
+        rules[0].at = max(1, rules[1].at - 1)
+
+    with ServiceFleet(workers=args.workers,
+                      cache_dir=os.path.join(workdir, 'cache-b'),
+                      ledger=ledger_path) as fleet:
+        chaos = _run_epoch(args.dataset_url, fleet.service_url, args.seed,
+                           manifest_b, fleet=fleet, schedule=schedule)
+        ledger_state = fleet.dispatcher.ledger_state()
+
+    verify = verify_manifest(manifest_b)
+    diff = diff_manifests(manifest_a, manifest_b)
+    rows_exact = chaos['rows'] == baseline['rows']
+    verdict = {
+        'rows_baseline': baseline['rows'],
+        'rows_chaos': chaos['rows'],
+        'rows_exact': rows_exact,
+        'fired': chaos['fired'],
+        'verify_exit_code': verify.get('exit_code'),
+        'diff_exit_code': diff.get('exit_code'),
+        'ledger': ledger_state,
+        'manifests': {'baseline': manifest_a, 'chaos': manifest_b},
+    }
+    ok = (rows_exact and verify.get('exit_code') == 0
+          and diff.get('exit_code') == 0 and len(chaos['fired']) == len(rules))
+    if args.corrupt_ledger:
+        # loud-degrade proof: the corrupted frame must have been COUNTED
+        ok = ok and ledger_state.get('frames_dropped', 0) >= 1
+    verdict['ok'] = ok
+    if args.json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        print('chaos: {} — {} of {} rule(s) fired, rows {}/{}, lineage '
+              'verify exit {}, diff exit {}'.format(
+                  'SURVIVED' if ok else 'FAILED', len(chaos['fired']),
+                  len(rules), chaos['rows'], baseline['rows'],
+                  verify.get('exit_code'), diff.get('exit_code')))
+        if not ok:
+            print(json.dumps(verdict, indent=2, sort_keys=True))
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    import sys
+    sys.exit(main())

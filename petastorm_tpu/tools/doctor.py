@@ -413,6 +413,14 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
         report['service'] = check_service(service_url)
     except Exception as exc:  # noqa: BLE001 - the report must always complete
         report['service'] = {'status': 'fail', 'detail': repr(exc)}
+    # Durable-ledger block (docs/service.md "Failure modes"): when the
+    # probed dispatcher journals its token lifecycle, how did its last
+    # restart go — journal present, last replay result, frames dropped by
+    # CRC? Always present so --json consumers find one stable key.
+    try:
+        report['ledger'] = check_ledger(report.get('service'))
+    except Exception as exc:  # noqa: BLE001 - the report must always complete
+        report['ledger'] = {'status': 'fail', 'detail': repr(exc)}
     # Incident-bundle block (docs/observability.md "Incident autopsy
     # plane"): retained black-box bundles in the default incident home (or
     # PETASTORM_TPU_INCIDENT_HOME) — each one is a captured failure edge
@@ -424,6 +432,25 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
         report['incidents'] = {'status': 'fail', 'detail': repr(exc)}
     report['healthy'] = report['store_roundtrip'].get('status') == 'ok'
     return report
+
+
+def check_ledger(service_report=None):
+    """The probed dispatcher's durable-ledger health (docs/service.md
+    "Failure modes"), derived from the ``check_service`` state snapshot:
+    ``{'status': 'unarmed'}`` when no service is configured or the
+    dispatcher runs without a ledger, else journal path, ledger epoch,
+    the last replay result (``ok`` / ``corrupt`` / ``absent`` /
+    ``discarded``) and the CRC-dropped frame count — a nonzero drop count
+    means a past restart degraded to replay-from-clients."""
+    state = ((service_report or {}).get('state') or {}).get('ledger') or {}
+    if not state.get('armed'):
+        return {'status': 'unarmed'}
+    return {'status': 'ok',
+            'path': state.get('path'),
+            'epoch': state.get('epoch'),
+            'last_replay': state.get('last_replay'),
+            'frames_dropped': state.get('frames_dropped', 0),
+            'records_replayed': state.get('records_replayed', 0)}
 
 
 def check_incidents(home=None):
@@ -568,6 +595,21 @@ def _print_human(report):
               'with this service_url will fail their hello; is the '
               'dispatcher running? (docs/service.md)'.format(
                   service.get('service_url'), service.get('detail', '')))
+    ledger = report.get('ledger') or {}
+    if ledger.get('status') == 'ok':
+        print('  ledger: armed at {} — epoch {}, last replay {} ({} '
+              'record(s), {} frame(s) CRC-dropped) (docs/service.md '
+              '"Failure modes")'.format(
+                  ledger.get('path'), ledger.get('epoch'),
+                  ledger.get('last_replay'),
+                  ledger.get('records_replayed', 0),
+                  ledger.get('frames_dropped', 0)))
+        if ledger.get('frames_dropped'):
+            print('  WARNING: the dispatcher ledger dropped {} journal '
+                  'frame(s) on its last replay — a restart degraded to '
+                  'replay-from-clients; inspect the journal and any '
+                  'ledger_corrupt incident bundle'.format(
+                      ledger.get('frames_dropped')))
     incidents = report.get('incidents') or {}
     if incidents.get('retained'):
         newest = (incidents.get('bundles') or [{}])[0]

@@ -1,0 +1,26 @@
+"""Known-bad fixture: ledger record kinds drifting from the declared
+registry — a journaled kind the replay never folds (``'retierd'``) and a
+replay arm for a kind nothing journals (``'vanished'``), neither declared
+in ``LEDGER_RECORD_KINDS``."""
+
+LEDGER_RECORD_KINDS = ('epoch', 'issued', 'delivered', 'retired')
+
+
+class MiniLedger(object):
+    def __init__(self):
+        self.records = []
+
+    def append_record(self, kind, **fields):
+        self.records.append(dict(fields, kind=kind))
+
+    def retire(self, token):
+        # typo'd journaled kind: written to disk, skipped forever on replay
+        self.append_record('retierd', token=token)
+
+    def apply(self, record):
+        kind = record.get('kind')
+        if kind == 'issued':
+            pass
+        elif kind == 'vanished':
+            # dead replay arm: no writer ever journals this kind
+            pass

@@ -84,6 +84,8 @@ BAD_FIXTURES = [
      ["b'w_metrics'", "b'w_metricz'"]),
     ('protocol/service_bad_incident', ['protocol-conformance'], 2,
      ["b'w_incident'", "b'w_incidnet'"]),
+    ('protocol/ledger_bad_kind', ['protocol-conformance'], 2,
+     ["'retierd'", "'vanished'", 'LEDGER_RECORD_KINDS']),
 ]
 
 GOOD_FIXTURES = [
