@@ -43,8 +43,16 @@ DESCRIPTOR_FILES: Tuple[str, ...] = ('shm_ring.py', 'wire.py')
 #: cost-aware schedule must be a pure function of (ledger, policy, seed) —
 #: a wall-clock read anywhere in it would make epoch order irreproducible
 #: (docs/performance.md "Cost-aware scheduling").
+#: the storage ingest engine joins the discipline: hedge-deadline and
+#: fetch-duration arithmetic must flow through the injected ``clock`` so
+#: the hedging tests stay deterministic (docs/performance.md "Object-store
+#: ingest engine")
 CLOCK_DISCIPLINED_FILES: Tuple[str, ...] = ('resilience.py',
-                                            'cost_schedule.py')
+                                            'cost_schedule.py',
+                                            'range_planner.py',
+                                            'fetcher.py',
+                                            'metadata_cache.py',
+                                            'engine.py')
 
 #: directory name marking worker/data-plane process code, where the
 #: exception-hygiene bar is highest: a broad except that can swallow needs an
@@ -55,7 +63,9 @@ WORKER_DIR: str = 'workers'
 #: ``raise BaseException(...)`` are findings (use the errors.py taxonomy)
 DATAPATH_FILES: Tuple[str, ...] = ('reader_worker.py', 'reader.py',
                                    'cache.py', 'fs_utils.py',
-                                   'resilience.py', 'cost_schedule.py')
+                                   'resilience.py', 'cost_schedule.py',
+                                   'range_planner.py', 'fetcher.py',
+                                   'metadata_cache.py', 'engine.py')
 
 #: where the telemetry stage/counter catalog lives (path suffix); the rule
 #: falls back to the installed ``petastorm_tpu.telemetry.spans`` when the

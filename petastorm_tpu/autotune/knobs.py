@@ -41,6 +41,8 @@ KNOB_IDS: Tuple[str, ...] = (
     'service_admission_window',   # dispatcher: per-client admission cap
     'service_client_window',      # dispatcher: live per-client in-flight depth
     'schedule_interleave',        # cost-aware heavy/light ventilation interleave
+    'storage_fetch_window',       # storage engine: parallel range-GET window
+                                  # (PETASTORM_TPU_STORAGE_FETCH_WINDOW)
 )
 
 #: actuation costs: ``cheap`` knobs act instantly, ``moderate`` knobs take a
@@ -167,6 +169,11 @@ class KnobCatalog:
 _PRISTINE_DECODE_THREADS_ENV: Optional[str] = os.environ.get(
     'PETASTORM_TPU_DECODE_THREADS')
 
+#: same pristine-capture contract for the storage engine's fetch window
+#: (restore returns the process to the value it imported with)
+_PRISTINE_FETCH_WINDOW_ENV: Optional[str] = os.environ.get(
+    'PETASTORM_TPU_STORAGE_FETCH_WINDOW')
+
 
 def _set_decode_threads(value: float) -> float:
     """Apply the decode-threads knob through its env contract
@@ -242,6 +249,39 @@ def build_reader_knobs(reader: Any) -> List[Knob]:
             get=lambda: float(decode_thread_count()),
             apply=_apply_decode_threads,
             restore=_restore_decode_threads))
+    storage_policy = getattr(reader, '_storage_policy', None)
+    if storage_policy is not None and in_process_work:
+        # the fetch window actuates through the same env contract as decode
+        # threads: storage/fetcher.py re-reads it per fetch, so a turn takes
+        # effect on the next planned rowgroup (docs/performance.md
+        # "Object-store ingest engine")
+        from petastorm_tpu.storage.fetcher import fetch_window
+        storage_touched: List[bool] = []
+
+        def _apply_fetch_window(value: float) -> float:
+            storage_touched.append(True)
+            window = min(max(int(value), 1), 128)
+            os.environ['PETASTORM_TPU_STORAGE_FETCH_WINDOW'] = str(window)
+            return float(window)
+
+        def _restore_fetch_window() -> None:
+            if not storage_touched:
+                return
+            if _PRISTINE_FETCH_WINDOW_ENV is None:
+                os.environ.pop('PETASTORM_TPU_STORAGE_FETCH_WINDOW', None)
+            else:
+                os.environ['PETASTORM_TPU_STORAGE_FETCH_WINDOW'] = \
+                    _PRISTINE_FETCH_WINDOW_ENV
+
+        knobs.append(Knob(
+            'storage_fetch_window',
+            'parallel range-GET window of the storage ingest engine '
+            '(PETASTORM_TPU_STORAGE_FETCH_WINDOW)',
+            minimum=1.0, maximum=128.0, step=2.0, cost='moderate',
+            stages=('range_fetch',), unit='requests',
+            get=lambda: float(fetch_window(storage_policy)),
+            apply=_apply_fetch_window,
+            restore=_restore_fetch_window))
     if pool is not None and hasattr(pool, 'set_shm_slot_config'):
         knobs.append(Knob(
             'shm_slots_per_worker',

@@ -132,8 +132,15 @@ def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None, filesyst
     schemes = {_scheme_of(u) for u in urls}
     netlocs = {urlparse(u).netloc for u in urls}
     if len(schemes) > 1 or len(netlocs) > 1:
+        # Name the first offender: with dozens of shard URLs, "schemes {...}"
+        # alone sends the user diffing the whole list by hand.
+        first_key = (_scheme_of(urls[0]), urlparse(urls[0]).netloc)
+        mismatched = next(
+            u for u in urls[1:] if (_scheme_of(u), urlparse(u).netloc) != first_key)
         raise ValueError('All dataset URLs must share one filesystem; got schemes {} '
-                         'netlocs {}'.format(sorted(schemes), sorted(netlocs)))
+                         'netlocs {}; first mismatch: {!r} does not match {!r}'
+                         .format(sorted(schemes), sorted(netlocs), mismatched,
+                                 urls[0]))
     if filesystem is None:
         filesystem = _resolve_filesystem(urls[0], storage_options)
     paths = [_extract_path(u) for u in urls]

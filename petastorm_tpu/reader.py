@@ -124,7 +124,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 heartbeat_interval_s=None, trace=None, service_url=None,
                 autotune=None, device_decode_fields=None, metrics_port=None,
                 slo_policy=None, cost_schedule=None, lineage=None,
-                incidents=None):
+                incidents=None, storage_policy=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -257,7 +257,19 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     report) and :meth:`Reader.incident_report` / ``diagnostics
     ['incidents']``. ``True`` (default policy), or an
     :class:`~petastorm_tpu.telemetry.incident.IncidentPolicy`. Unset (None,
-    the default) builds no recorder and keeps every path byte-identical."""
+    the default) builds no recorder and keeps every path byte-identical.
+
+    Object-store ingest engine (docs/performance.md "Object-store ingest
+    engine"): ``storage_policy`` arms planned byte-range I/O in the workers
+    — column-chunk ranges planned from a cached Parquet footer, coalesced
+    into merged GETs, fetched by a parallel bounded-window pool with
+    tail-latency request hedging. ``None`` (default) auto-engages only for
+    non-local URL schemes (s3/gs/abfs/...) and keeps local/HDFS reads
+    byte-identical to the seed path; ``False`` never engages; ``True`` or a
+    :class:`~petastorm_tpu.storage.StoragePolicy` always does. Counters and
+    ``range_fetch``/``range_hedge`` stage timings land in
+    :meth:`Reader.telemetry_snapshot`; per-rowgroup fetch costs flow into
+    the cost ledger so ``cost_schedule`` prices network I/O too."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -323,7 +335,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   autotune=autotune, device_decode_fields=device_decode_fields,
                   metrics_port=metrics_port, slo_policy=slo_policy,
                   cost_schedule=cost_schedule, lineage=lineage,
-                  incidents=incidents)
+                  incidents=incidents, storage_policy=storage_policy)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -340,13 +352,14 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       heartbeat_interval_s=None, trace=None, service_url=None,
                       autotune=None, device_decode_fields=None,
                       metrics_port=None, slo_policy=None, cost_schedule=None,
-                      lineage=None, incidents=None):
+                      lineage=None, incidents=None, storage_policy=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
     ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
     ``service_url`` / ``autotune`` / ``metrics_port`` / ``slo_policy`` /
-    ``cost_schedule`` / ``lineage`` / ``incidents`` behave exactly as in
+    ``cost_schedule`` / ``lineage`` / ``incidents`` / ``storage_policy``
+    behave exactly as in
     :func:`make_reader`.
     ``device_decode_fields`` (docs/performance.md "Device-resident decode
     tail") requires the store's Unischema codec registry: on a Unischema
@@ -425,7 +438,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   autotune=autotune, device_decode_fields=device_decode_fields,
                   metrics_port=metrics_port, slo_policy=slo_policy,
                   cost_schedule=cost_schedule, lineage=lineage,
-                  incidents=incidents)
+                  incidents=incidents, storage_policy=storage_policy)
 
 
 class Reader(object):
@@ -441,7 +454,7 @@ class Reader(object):
                  on_error='raise', retry_policy=None, initial_io_retries=0,
                  autotune=None, device_decode_fields=None, metrics_port=None,
                  slo_policy=None, cost_schedule=None, lineage=None,
-                 incidents=None):
+                 incidents=None, storage_policy=None):
         from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
         retry_policy = resolve_retry_policy(on_error, retry_policy)
         construction_retries = [initial_io_retries]
@@ -592,6 +605,14 @@ class Reader(object):
                                      'which has no schema entry'.format(name))
                 decode_engine.validate_device_field(field)
 
+        # Object-store ingest engine (docs/performance.md): resolve the
+        # storage_policy kwarg ONCE against the dataset URL — None stays None
+        # on local/HDFS schemes, so the seed path pays nothing, not even an
+        # attribute lookup in the workers' hot loop.
+        from petastorm_tpu.storage import resolve_storage_policy
+        self._storage_policy = resolve_storage_policy(storage_policy,
+                                                      dataset_url_or_urls)
+
         url_for_factory = dataset_url_or_urls if not isinstance(dataset_url_or_urls, list) \
             else dataset_url_or_urls[0]
         # Workers feed this filesystem into Arrow C++ — unwrap any HA failover proxy
@@ -620,7 +641,8 @@ class Reader(object):
             device_decode_fields=self.device_decode_fields,
             lineage_fingerprint_every=(self._lineage_policy.fingerprint_every
                                        if self._lineage_policy is not None
-                                       else 0))
+                                       else 0),
+            storage_policy=self._storage_policy)
         # Single source of truth for the emitted schema: the workers' own derivation.
         self.result_schema = worker_setup.result_schema
         #: the dataset identity the disk cache and the cost ledger key on
@@ -1297,10 +1319,21 @@ class Reader(object):
         :func:`petastorm_tpu.telemetry.export.to_prometheus_text`."""
         from petastorm_tpu.telemetry import merge_snapshots
         pool_registry = getattr(self._pool, 'telemetry', None)
-        if pool_registry is None:
+        storage_snapshot = None
+        if self._storage_policy is not None:
+            # the ingest engine's process-local counters (footer cache /
+            # coalescing / hedging); armed-only so unarmed readers stay
+            # byte-identical, and populated in-process for thread/dummy
+            # pools (process-pool workers keep them worker-side, like the
+            # other worker counters)
+            from petastorm_tpu.storage import storage_metrics_snapshot
+            storage_snapshot = storage_metrics_snapshot()
+        if pool_registry is None and storage_snapshot is None:
             return self._telemetry.snapshot()
         return merge_snapshots(self._telemetry.snapshot(),
-                               pool_registry.snapshot())
+                               pool_registry.snapshot()
+                               if pool_registry is not None else None,
+                               storage_snapshot)
 
     # ------------------------------------------------------- efficiency SLO
 
@@ -1545,6 +1578,30 @@ class Reader(object):
         # Incident autopsy block only when armed, same contract.
         if self._incidents is not None:
             diag['incidents'] = self._incidents.report()
+        # Storage ingest-engine block only when armed, same contract: the
+        # counter roll-up doctor and dashboards read (footer-cache hits,
+        # ranges coalesced, hedges fired/won — docs/performance.md
+        # "Object-store ingest engine").
+        if self._storage_policy is not None:
+            counters = snapshot.get('counters') or {}
+            diag['storage'] = {
+                'policy': {
+                    'coalesce_gap_bytes':
+                        self._storage_policy.coalesce_gap_bytes,
+                    'max_in_flight': self._storage_policy.max_in_flight,
+                    'hedge_enabled': self._storage_policy.hedge_enabled,
+                },
+                'footer_cache_hits':
+                    int(counters.get('storage_footer_cache_hit', 0)),
+                'footer_cache_misses':
+                    int(counters.get('storage_footer_cache_miss', 0)),
+                'ranges_coalesced':
+                    int(counters.get('storage_ranges_coalesced', 0)),
+                'hedges_fired':
+                    int(counters.get('storage_hedge_fired', 0)),
+                'hedges_won':
+                    int(counters.get('storage_hedge_won', 0)),
+            }
         return diag
 
     def __enter__(self):

@@ -116,13 +116,13 @@ class WorkerSetup(object):
                  'result_schema', 'transform_spec', 'batched_output', 'decode', 'ngram',
                  'cache', 'shuffle_rows', 'seed', 'partition_field_names', 'dataset_token',
                  'on_error', 'retry_policy', 'device_decode_fields',
-                 'lineage_fingerprint_every')
+                 'lineage_fingerprint_every', 'storage_policy')
 
     def __init__(self, dataset_path_or_paths, filesystem_factory, schema, fields_to_read,
                  transform_spec=None, batched_output=False, decode=True, ngram=None,
                  cache=None, shuffle_rows=False, seed=None, partition_field_names=(),
                  on_error='raise', retry_policy=None, device_decode_fields=(),
-                 lineage_fingerprint_every=0):
+                 lineage_fingerprint_every=0, storage_policy=None):
         from petastorm_tpu.resilience import resolve_retry_policy
         self.on_error = on_error
         # One normalization for the whole stack: 'raise' means today's exact behavior
@@ -150,6 +150,11 @@ class WorkerSetup(object):
         #: A pure function of the piece identity, so every pool and the
         #: service fleet sample the SAME pieces.
         self.lineage_fingerprint_every = int(lineage_fingerprint_every)
+        #: resolved StoragePolicy arming the object-store ingest engine, or
+        #: None for the seed fragment.to_table() path (docs/performance.md
+        #: "Object-store ingest engine"; the reader resolved the
+        #: make_reader(storage_policy=) kwarg before shipping the setup)
+        self.storage_policy = storage_policy
         # Cache key token covers the dataset identity AND the read configuration
         # (the ONE shared derivation — dataset_state.derive_dataset_token — that
         # the cache, the cost ledger and the lineage manifest all key on).
@@ -185,6 +190,9 @@ class RowGroupWorker(WorkerBase):
         # re-compile per piece — items may carry fresh unpickled instances, and
         # compilation is closure-building only (no IO)
         self._decode_plans = {}
+        # shared footer/metadata cache for the storage ingest engine (one per
+        # worker process; every rowgroup piece of a file reuses its footer)
+        self._metadata_cache = None
 
     def _fs(self):
         if self._filesystem is None:
@@ -403,6 +411,30 @@ class RowGroupWorker(WorkerBase):
         return [name for name in field_names
                 if name not in self._setup.partition_field_names]
 
+    def _storage_source(self, fragment_path, row_group_id):
+        """A planned :class:`~petastorm_tpu.storage.engine.RowGroupSource`
+        when the object-store ingest engine is armed, else None (seed
+        ``fragment.to_table()`` path — docs/performance.md "Object-store
+        ingest engine"). Built inside the load closure, so footer reads and
+        range fetches sit under the same retry/breaker wrapping as seed
+        reads, and a reconnect (``self._filesystem = None``) gives the next
+        attempt a fresh source over the fresh filesystem."""
+        policy = getattr(self._setup, 'storage_policy', None)
+        if policy is None:
+            return None
+        from petastorm_tpu.storage.engine import RowGroupSource
+        if self._metadata_cache is None:
+            from petastorm_tpu.dataset_state import cache_state_home
+            from petastorm_tpu.storage.metadata_cache import MetadataCache
+            # the shared disk-cache directory (when one is configured) makes
+            # footers fleet-shared: every co-located service worker reads
+            # the same sidecars
+            disk_dir = policy.cache_dir or cache_state_home(self._setup.cache)
+            self._metadata_cache = MetadataCache(
+                capacity=policy.cache_capacity, disk_dir=disk_dir)
+        return RowGroupSource(fragment_path, self._fs(), policy,
+                              row_group_id, self._metadata_cache)
+
     def _load_and_decode(self, fragment_path, row_group_id, partition_keys,
                          worker_predicate, shuffle_row_drop_partition,
                          row_range=None):
@@ -413,9 +445,15 @@ class RowGroupWorker(WorkerBase):
                                                        partition_keys, worker_predicate,
                                                        all_fields)
         else:
-            fragment = self._make_fragment(fragment_path, row_group_id)
-            with stage_span('rowgroup_read'):
-                table = fragment.to_table(columns=self._storage_columns(all_fields))
+            source = self._storage_source(fragment_path, row_group_id)
+            if source is not None:
+                # planned byte-range read: the source times range_fetch
+                # (network) and rowgroup_read (Parquet decode) disjointly
+                table = source.read_columns(self._storage_columns(all_fields))
+            else:
+                fragment = self._make_fragment(fragment_path, row_group_id)
+                with stage_span('rowgroup_read'):
+                    table = fragment.to_table(columns=self._storage_columns(all_fields))
             keep_indices = None
         num_rows = table.num_rows if keep_indices is None else len(keep_indices)
 
@@ -459,10 +497,16 @@ class RowGroupWorker(WorkerBase):
                    if f not in setup.schema.fields and f not in setup.partition_field_names]
         if unknown:
             raise ValueError('Predicate references unknown fields {}'.format(unknown))
-        fragment = self._make_fragment(fragment_path, row_group_id)
-        with stage_span('rowgroup_read'):
-            predicate_table = fragment.to_table(
-                columns=self._storage_columns(predicate_fields))
+        source = self._storage_source(fragment_path, row_group_id)
+        if source is not None:
+            fragment = None
+            predicate_table = source.read_columns(
+                self._storage_columns(predicate_fields))
+        else:
+            fragment = self._make_fragment(fragment_path, row_group_id)
+            with stage_span('rowgroup_read'):
+                predicate_table = fragment.to_table(
+                    columns=self._storage_columns(predicate_fields))
         compiled = decode_engine.compile_predicate(
             worker_predicate, setup.schema,
             partition_field_names=setup.partition_field_names,
@@ -484,18 +528,24 @@ class RowGroupWorker(WorkerBase):
         all_storage = self._storage_columns(all_fields)
         if not len(keep):
             # No survivors: build an empty table from the schema without reading data.
-            physical = fragment.physical_schema
+            physical = (source.schema_arrow() if source is not None
+                        else fragment.physical_schema)
             empty = pa.table({name: pa.array([], type=physical.field(name).type)
                               for name in all_storage})
             return empty, np.array([], dtype=np.int64)
         # Single-read assembly: reuse the predicate columns already in memory and
         # read only what the output still needs; downstream sees one consistent
-        # table in the output column order.
+        # table in the output column order. The storage source keeps the same
+        # invariant — columns fetched for the predicate phase are never
+        # re-fetched (engine.RowGroupSource tracks them).
         have = set(predicate_table.column_names)
         remaining = [name for name in all_storage if name not in have]
         if remaining:
-            with stage_span('rowgroup_read'):
-                remaining_table = fragment.to_table(columns=remaining)
+            if source is not None:
+                remaining_table = source.read_columns(remaining)
+            else:
+                with stage_span('rowgroup_read'):
+                    remaining_table = fragment.to_table(columns=remaining)
             full_table = pa.table(
                 {name: (predicate_table.column(name) if name in have
                         else remaining_table.column(name))

@@ -306,7 +306,15 @@ class FairShareScheduler(object):
         rebuilt here — live clients re-adopt themselves via the
         ``ledger_sync`` handshake (the blobs only they hold)."""
         with self._lock:
-            self._next_token = max(self._next_token, replay.next_token)
+            # Epoch-scope the token space: a corrupt journal replays only the
+            # prefix before the bad frame, so ``replay.next_token`` can be
+            # stale — a restarted dispatcher would reissue token numbers, and
+            # a ZMQ-buffered straggler w_result from the dead incarnation
+            # would then route to the wrong client request. Basing each
+            # incarnation at ``epoch << 40`` keeps token ranges disjoint
+            # across restarts (the ledger bumps the epoch on every open).
+            self._next_token = max(self._next_token, replay.next_token,
+                                   epoch << 40)
             self._replay_delivered = set(replay.delivered)
             self.ledger_epoch = epoch
             self.resharded = replay.resharded

@@ -45,7 +45,7 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 #: ``spans.STAGES`` (pipecheck's telemetry-names rule enforces it); the sum
 #: over these IS a rowgroup's cost (``decode_field`` nests inside ``decode``
 #: and is tracked separately per field, never added to the total)
-COST_STAGES = ('rowgroup_read', 'decode')
+COST_STAGES = ('rowgroup_read', 'decode', 'range_fetch')
 
 #: the per-field span name (emitted by the decode plan while tracing is on)
 FIELD_STAGE = 'decode_field'
@@ -89,6 +89,11 @@ class CostLedger(object):
 
     Entries are keyed ``'<fragment_path>#<row_group_id>'`` and hold per-stage
     ``{count, sum_s, max_s}`` plus per-field ``{count, sum_s}`` decode costs.
+    When the storage engine is armed, ``range_fetch`` spans additionally
+    carry per-fetch totals in their trace args, folded into an optional
+    ``fetch`` cell per entry: ``{bytes, ranges, hedges_fired, hedges_won,
+    sum_s, count}`` — so the measured-cost DRR scheduler prices network I/O,
+    not just decode (docs/performance.md "Object-store ingest engine").
     All mutation is additive, so ledgers merge across runs, processes and
     re-dispatched attempts exactly like histogram snapshots do."""
 
@@ -151,8 +156,26 @@ class CostLedger(object):
                 cell['count'] += 1
                 cell['sum_s'] += seconds
                 cell['max_s'] = max(float(cell['max_s']), seconds)
+                if name == 'range_fetch':
+                    self._fold_fetch(entry, event.get('args') or {}, seconds)
             ingested += 1
         return ingested
+
+    @staticmethod
+    def _fold_fetch(entry: Dict[str, Any], args: Mapping[str, Any],
+                    seconds: float) -> None:
+        """Fold one ``range_fetch`` span's trace args (bytes / ranges /
+        hedge totals from storage/fetcher.py) into the entry's additive
+        ``fetch`` cell."""
+        cell = entry.setdefault('fetch', {
+            'bytes': 0, 'ranges': 0, 'hedges_fired': 0, 'hedges_won': 0,
+            'sum_s': 0.0, 'count': 0})
+        cell['bytes'] += int(args.get('bytes', 0))
+        cell['ranges'] += int(args.get('ranges', 0))
+        cell['hedges_fired'] += int(args.get('hedges_fired', 0))
+        cell['hedges_won'] += int(args.get('hedges_won', 0))
+        cell['sum_s'] += seconds
+        cell['count'] += 1
 
     def merge(self, other: 'CostLedger') -> None:
         """Fold another ledger in additively (same dataset token required —
@@ -175,6 +198,15 @@ class CostLedger(object):
                     field, {'count': 0, 'sum_s': 0.0})
                 acc['count'] += int(cell['count'])
                 acc['sum_s'] += float(cell['sum_s'])
+            fetch = entry.get('fetch')
+            if fetch:
+                acc = mine.setdefault('fetch', {
+                    'bytes': 0, 'ranges': 0, 'hedges_fired': 0,
+                    'hedges_won': 0, 'sum_s': 0.0, 'count': 0})
+                for k in ('bytes', 'ranges', 'hedges_fired', 'hedges_won',
+                          'count'):
+                    acc[k] += int(fetch.get(k, 0))
+                acc['sum_s'] += float(fetch.get('sum_s', 0.0))
 
     # ------------------------------------------------------------- analysis
 
@@ -208,7 +240,7 @@ class CostLedger(object):
             fields = sorted(((float(cell['sum_s']), field)
                              for field, cell in entry['fields'].items()),
                             key=lambda item: (-item[0], item[1]))
-            rows.append({
+            row = {
                 'rowgroup': key,
                 'seconds': round(seconds, 6),
                 'share': round(seconds / total, 4) if total else 0.0,
@@ -216,7 +248,17 @@ class CostLedger(object):
                            for stage, cell in sorted(entry['stages'].items())},
                 'top_fields': [{'field': field, 'seconds': round(s, 6)}
                                for s, field in fields[:3]],
-            })
+            }
+            fetch = entry.get('fetch')
+            if fetch:
+                row['fetch'] = {
+                    'bytes': int(fetch['bytes']),
+                    'ranges': int(fetch['ranges']),
+                    'hedges_fired': int(fetch['hedges_fired']),
+                    'hedges_won': int(fetch['hedges_won']),
+                    'seconds': round(float(fetch['sum_s']), 6),
+                }
+            rows.append(row)
         return rows
 
     def what_if(self) -> List[Dict[str, Any]]:
@@ -311,6 +353,17 @@ class CostLedger(object):
                 mine['fields'][str(field)] = {
                     'count': int(cell['count']),
                     'sum_s': float(cell['sum_s'])}
+            fetch = entry.get('fetch')
+            if fetch:
+                # optional additive cell (absent in pre-storage-engine
+                # ledgers — same LEDGER_VERSION, purely additive schema)
+                mine['fetch'] = {
+                    'bytes': int(fetch.get('bytes', 0)),
+                    'ranges': int(fetch.get('ranges', 0)),
+                    'hedges_fired': int(fetch.get('hedges_fired', 0)),
+                    'hedges_won': int(fetch.get('hedges_won', 0)),
+                    'sum_s': float(fetch.get('sum_s', 0.0)),
+                    'count': int(fetch.get('count', 0))}
         return ledger
 
     def save(self, path: str) -> str:

@@ -63,6 +63,14 @@ STAGES = (
                       # flight-recorder timeline only (never a histogram),
                       # and only while tracing is armed: the per-field leg of
                       # the cost profiler (telemetry/cost_model.py)
+    'range_fetch',    # one planned multi-range fetch of a rowgroup's column
+                      # chunks (storage/fetcher.py) — disjoint from
+                      # 'rowgroup_read', which covers only the Parquet
+                      # decode of the already-fetched bytes when the storage
+                      # engine is armed (docs/performance.md "Object-store
+                      # ingest engine")
+    'range_hedge',    # lifetime of one hedged duplicate GET, win or lose
+                      # (storage/fetcher.py)
 )
 
 #: stages whose span ENVELOPES other recorded stages (cache_miss wraps
@@ -97,6 +105,17 @@ COUNTERS = (
     'ledger_frames_dropped',   # dispatcher-ledger journal frames that failed
                                # CRC replay (service/ledger.py — the loud
                                # half of degrade-to-replay-from-clients)
+    'storage_footer_cache_hit',   # a Parquet footer was served from the
+                                  # metadata cache (storage/metadata_cache.py)
+    'storage_footer_cache_miss',  # a footer had to be read from storage
+    'storage_ranges_coalesced',   # raw column-chunk ranges merged away by
+                                  # gap-threshold coalescing (storage/
+                                  # range_planner.py; count = raw - merged)
+    'storage_hedge_fired',        # a hedged duplicate GET was launched
+                                  # (storage/fetcher.py)
+    'storage_hedge_won',          # the hedge returned before the primary
+                                  # (its bytes were committed; the primary's
+                                  # were dropped)
 )
 
 #: declared size histograms (``registry.observe(name, n, unit=BYTES_UNIT)``
@@ -199,13 +218,18 @@ class StageRecorder(object):
 _process_recorder = StageRecorder()
 
 
-def record_stage(stage: str, seconds: float) -> None:
+def record_stage(stage: str, seconds: float,
+                 trace_args: Optional[Dict[str, Any]] = None) -> None:
     """Record one observation into the process-wide stage recorder (and, when
     the flight recorder is armed, a matching trace event back-dated by the
-    measured duration — docs/observability.md "Flight recorder")."""
+    measured duration — docs/observability.md "Flight recorder").
+    ``trace_args`` rides only the trace event (never the histogram) — the
+    storage engine uses it to ship per-fetch byte/range/hedge totals to the
+    cost ledger (telemetry/cost_model.py)."""
     _process_recorder.record(stage, seconds)
     if _tracing.trace_enabled():
-        _tracing.trace_complete(stage, time.perf_counter() - seconds, seconds)
+        _tracing.trace_complete(stage, time.perf_counter() - seconds, seconds,
+                                args=trace_args)
 
 
 def drain_stage_times() -> Optional[Dict[str, Dict[str, Any]]]:

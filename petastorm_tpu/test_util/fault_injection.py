@@ -17,6 +17,8 @@ Usage::
         FaultRule('part_2', kind='kill'),                   # SIGKILL the opening process
         FaultRule('part_3', kind='hang', times=1),          # opener sleeps "forever"
         FaultRule('part_4', kind='corrupt', times=1),       # bit-flip the file first
+        FaultRule('part_5', kind='latency', latency_s=0.01, # p99-style tail: every
+                  tail_latency_s=0.5, tail_every_n=10),     # 10th open/read stalls
     ])
     fs = fault_injecting_filesystem(schedule)               # wraps LocalFileSystem
     make_reader('file:///data', filesystem=fs, on_error='retry', ...)
@@ -29,6 +31,15 @@ staleness reap catches it; the watchdog's SIGKILL terminates a stopped
 process). ``kind='corrupt'`` damages the target FILE in place before the open
 proceeds (``corrupt_mode='flip'`` bit-flips the middle byte,
 ``'truncate'`` halves it) — deterministic bit-rot for self-heal tests.
+
+``kind='latency'`` with ``tail_every_n > 0`` models a latency *distribution*
+rather than a constant: every matching open AND every read on the opened file
+claims a marker-file sequence number and sleeps ``latency_s``, with
+``tail_latency_s`` added on every ``tail_every_n``-th event globally. That is
+a reproducible p99 tail — the storage engine's hedging tests
+(docs/performance.md "Object-store ingest engine") assert that hedged fetches
+beat it deterministically. ``tail_every_n == 0`` (the default) preserves the
+original open-only constant sleep exactly.
 
 The wrapper is picklable (ships to process-pool workers through the dill bootstrap) and
 rebuilds its wrapped filesystem on unpickle.
@@ -57,6 +68,13 @@ class FaultRule(object):
     :param after: skip the first ``after`` matching opens before triggering
         (``after=n-1, times=1`` = classic fail-Nth-open).
     :param latency_s: sleep duration for ``'latency'``.
+    :param tail_latency_s: for ``'latency'``: extra sleep added on every
+        ``tail_every_n``-th matching event (opens and reads share one global
+        counter), turning the constant delay into a distribution with a
+        deterministic tail.
+    :param tail_every_n: for ``'latency'``: 0 (default) keeps the original
+        open-only constant sleep; N > 0 also intercepts reads on the opened
+        file and fires the tail on every N-th event.
     :param exception_type: exception class for ``'fail'`` — default
         :class:`TransientIOError` (retryable); pass e.g. ``ValueError`` to model a
         permanent fault.
@@ -72,7 +90,8 @@ class FaultRule(object):
 
     def __init__(self, path_substring, kind='fail', times=None, after=0,
                  latency_s=0.0, exception_type=TransientIOError,
-                 hang_mode='sleep', hang_s=3600.0, corrupt_mode='flip'):
+                 hang_mode='sleep', hang_s=3600.0, corrupt_mode='flip',
+                 tail_latency_s=0.0, tail_every_n=0):
         if kind not in _FAULT_KINDS:
             raise ValueError('kind must be one of {}, got {!r}'.format(_FAULT_KINDS, kind))
         if times is not None and times < 1:
@@ -85,6 +104,10 @@ class FaultRule(object):
         if corrupt_mode not in _CORRUPT_MODES:
             raise ValueError('corrupt_mode must be one of {}, got {!r}'
                              .format(_CORRUPT_MODES, corrupt_mode))
+        if tail_every_n < 0:
+            raise ValueError('tail_every_n must be >= 0')
+        if tail_latency_s < 0:
+            raise ValueError('tail_latency_s must be >= 0')
         self.path_substring = path_substring
         self.kind = kind
         self.times = times
@@ -94,6 +117,8 @@ class FaultRule(object):
         self.hang_mode = hang_mode
         self.hang_s = hang_s
         self.corrupt_mode = corrupt_mode
+        self.tail_latency_s = tail_latency_s
+        self.tail_every_n = tail_every_n
 
     def matches(self, path):
         return self.path_substring in path
@@ -135,7 +160,7 @@ class FaultSchedule(object):
             if rule.times is not None and seq >= rule.after + rule.times:
                 continue
             if rule.kind == 'latency':
-                time.sleep(rule.latency_s)
+                self._latency_sleep(rule, seq)
             elif rule.kind == 'kill':
                 import signal
                 os.kill(os.getpid(), signal.SIGKILL)
@@ -153,6 +178,40 @@ class FaultSchedule(object):
                 raise rule.exception_type(
                     'injected fault #{} for {!r} (rule {}: open of {})'
                     .format(seq + 1, rule.path_substring, rule_index, path))
+
+    @staticmethod
+    def _latency_sleep(rule, seq):
+        """Sleep per the rule's latency distribution: the base delay always,
+        plus the tail on every ``tail_every_n``-th global event (1-based, so
+        ``tail_every_n=10`` stalls events 10, 20, ...)."""
+        delay = rule.latency_s
+        if rule.tail_every_n and (seq + 1) % rule.tail_every_n == 0:
+            delay += rule.tail_latency_s
+        if delay > 0:
+            time.sleep(delay)
+
+    def wants_read_latency(self, path):
+        """True when some latency rule with a tail distribution matches ``path``
+        — the wrapper then intercepts reads on the opened file too."""
+        return any(rule.kind == 'latency' and rule.tail_every_n and
+                   rule.matches(path) for rule in self.rules)
+
+    def on_read(self, path):
+        """Run the read-side of every tail-distribution latency rule for one
+        read call. Reads claim from the SAME marker prefix as opens, so the
+        every-N-th-event tail is global across both — what makes the injected
+        p99 reproducible regardless of open/read interleaving."""
+        for rule_index, rule in enumerate(self.rules):
+            if rule.kind != 'latency' or not rule.tail_every_n:
+                continue
+            if not rule.matches(path):
+                continue
+            seq = self._claim('calls-{}'.format(rule_index))
+            if seq < rule.after:
+                continue
+            if rule.times is not None and seq >= rule.after + rule.times:
+                continue
+            self._latency_sleep(rule, seq)
 
     def trigger_count(self, rule_index=None):
         """Opens observed so far (for a single rule, or summed) — lets tests assert the
@@ -190,6 +249,49 @@ def corrupt_file(path, corrupt_mode='flip'):
             f.write(bytes([byte[0] ^ 0xFF]))
 
 
+class _TailLatencyFile(object):
+    """File-like over an opened NativeFile that runs the schedule's read-side
+    latency distribution before every read — the injected "slow GET" the
+    storage engine's hedging races against. Wrapped in ``pa.PythonFile`` by
+    the handler so pyarrow sees a normal random-access input file."""
+
+    def __init__(self, raw, schedule, path):
+        self._raw = raw
+        self._schedule = schedule
+        self._path = path
+
+    def read(self, nbytes=None):
+        self._schedule.on_read(self._path)
+        if nbytes is None:
+            return self._raw.read()
+        return self._raw.read(nbytes)
+
+    def seek(self, position, whence=0):
+        return self._raw.seek(position, whence)
+
+    def tell(self):
+        return self._raw.tell()
+
+    def readable(self):
+        return True
+
+    def seekable(self):
+        return True
+
+    def writable(self):
+        return False
+
+    def flush(self):
+        pass
+
+    def close(self):
+        self._raw.close()
+
+    @property
+    def closed(self):
+        return self._raw.closed
+
+
 class FaultInjectingHandler(pafs.FileSystemHandler):
     """pyarrow FileSystemHandler delegating everything to a wrapped C++ filesystem,
     with the schedule's faults injected on input opens (the calls Parquet reads make)."""
@@ -202,7 +304,12 @@ class FaultInjectingHandler(pafs.FileSystemHandler):
     # -------------------------------------------------------------- intercepted
     def open_input_file(self, path):
         self._schedule.on_open(path)
-        return self._base.open_input_file(path)
+        raw = self._base.open_input_file(path)
+        if self._schedule.wants_read_latency(path):
+            import pyarrow as pa
+            return pa.PythonFile(_TailLatencyFile(raw, self._schedule, path),
+                                 mode='r')
+        return raw
 
     def open_input_stream(self, path):
         self._schedule.on_open(path)

@@ -283,6 +283,66 @@ def check_store_roundtrip(rows=200, workers=2):
             }}
 
 
+def check_storage(rows=64, workers=1):
+    """Force-arm the object-store ingest engine (docs/performance.md
+    "Object-store ingest engine") over a tiny local store and report its
+    counters: footer-cache hits/misses, ranges coalesced away, hedges
+    fired/won. On a healthy local disk hedges should essentially never
+    fire — the human report WARNS when the hedge-win rate exceeds 50%,
+    because storage that tail-heavy means every other fetch is racing a
+    straggler and the hedge deadline is doing the store's job."""
+    import numpy as np
+    import pyarrow as pa
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_rows
+    from petastorm_tpu.storage import (reset_storage_metrics,
+                                       storage_metrics_snapshot)
+    from petastorm_tpu.telemetry.registry import (set_telemetry_enabled,
+                                                  telemetry_enabled)
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('DoctorStorageSchema', [
+        UnischemaField('idx', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('val', np.float64, (), ScalarCodec(pa.float64()), False),
+    ])
+    was_enabled = telemetry_enabled()
+    set_telemetry_enabled(True)   # counters are gated on the kill switch
+    reset_storage_metrics()       # this probe's reads only
+    try:
+        with tempfile.TemporaryDirectory(prefix='petastorm_tpu_doctor_') as tmp:
+            url = 'file://' + tmp
+            write_rows(url, schema,
+                       ({'idx': i, 'val': float(i)} for i in range(rows)),
+                       rowgroup_size_mb=1)
+            seen = []
+            # storage_policy=True force-arms the engine on the local store
+            # (auto-engage is non-local-schemes only); two epochs so the
+            # second one exercises the footer cache's hit path.
+            with make_reader(url, workers_count=workers, num_epochs=2,
+                             storage_policy=True) as reader:
+                for row in reader:
+                    seen.append(int(row.idx))
+        counters = storage_metrics_snapshot().get('counters', {})
+    finally:
+        set_telemetry_enabled(was_enabled)
+        reset_storage_metrics()   # don't leak probe counts into real reads
+    if sorted(set(seen)) != list(range(rows)):
+        return {'status': 'fail',
+                'detail': 'engine-armed read returned {} distinct rows, '
+                          'expected {}'.format(len(set(seen)), rows)}
+    fired = int(counters.get('storage_hedge_fired', 0))
+    won = int(counters.get('storage_hedge_won', 0))
+    return {'status': 'ok',
+            'footer_cache_hits': int(counters.get('storage_footer_cache_hit', 0)),
+            'footer_cache_misses': int(counters.get('storage_footer_cache_miss', 0)),
+            'ranges_coalesced': int(counters.get('storage_ranges_coalesced', 0)),
+            'hedges_fired': fired,
+            'hedges_won': won,
+            'hedge_win_rate': round(won / fired, 3) if fired else 0.0}
+
+
 def check_service(service_url=None, timeout_s=2.0):
     """Probe the disaggregated input service (docs/service.md) when one is
     configured — ``service_url`` argument or the ``PETASTORM_TPU_SERVICE_URL``
@@ -430,6 +490,14 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
         report['incidents'] = check_incidents()
     except Exception as exc:  # noqa: BLE001 - the report must always complete
         report['incidents'] = {'status': 'fail', 'detail': repr(exc)}
+    # Object-store ingest block (docs/performance.md "Object-store ingest
+    # engine"): a force-armed engine read over a local store — footer-cache
+    # hit/miss, ranges coalesced, hedges fired/won. Always present so --json
+    # consumers find one stable key.
+    try:
+        report['storage'] = check_storage()
+    except Exception as exc:  # noqa: BLE001 - the report must always complete
+        report['storage'] = {'status': 'fail', 'detail': repr(exc)}
     report['healthy'] = report['store_roundtrip'].get('status') == 'ok'
     return report
 
@@ -621,6 +689,27 @@ def _print_human(report):
                   incidents.get('retained'), incidents.get('home'),
                   newest.get('bundle'), newest.get('cause'),
                   newest.get('path', '<bundle>')))
+    storage = report.get('storage') or {}
+    if storage.get('status') == 'ok':
+        print('  storage engine: footer cache {} hit(s) / {} miss(es), {} '
+              'range(s) coalesced, hedges {} fired / {} won '
+              '(docs/performance.md "Object-store ingest engine")'.format(
+                  storage.get('footer_cache_hits', 0),
+                  storage.get('footer_cache_misses', 0),
+                  storage.get('ranges_coalesced', 0),
+                  storage.get('hedges_fired', 0),
+                  storage.get('hedges_won', 0)))
+        if storage.get('hedges_fired', 0) and \
+                storage.get('hedge_win_rate', 0.0) > 0.5:
+            print('  WARNING: hedge-win rate is {:.0%} — storage is '
+                  'tail-heavy; more than half the hedged duplicates beat '
+                  'the primary GET, so the hedge deadline is doing the '
+                  "store's job. Investigate the backing filesystem before "
+                  'trusting throughput numbers'.format(
+                      storage.get('hedge_win_rate', 0.0)))
+    elif storage:
+        print('  storage engine: FAIL ({}) — the force-armed probe read '
+              'errored'.format(storage.get('detail', 'unknown')))
     pipecheck = report.get('pipecheck') or {}
     if pipecheck.get('status') == 'ok':
         print('  pipecheck: clean — {} files, {} suppression(s) honored '

@@ -69,6 +69,24 @@ def test_collect_report_healthy_and_json_clean(capsys, monkeypatch):
     assert autotune['frozen_by_breaker'] is False
     assert 'pool_workers' in autotune['knobs']
     assert autotune['decisions'] == []
+    # storage ingest-engine block (ISSUE 17): always present; the probe
+    # forces the engine over a local store, so the footer cache sees a
+    # miss (epoch 1) and a hit (epoch 2) while local disk fires no hedges
+    storage = report['storage']
+    assert storage['status'] == 'ok'
+    assert storage['footer_cache_misses'] >= 1
+    assert storage['footer_cache_hits'] >= 1
+    assert storage['hedges_fired'] == 0
+
+
+def test_check_storage_probe_counters():
+    s = doctor.check_storage(rows=64, workers=1)
+    assert s['status'] == 'ok'
+    assert s['footer_cache_hits'] >= 1 and s['footer_cache_misses'] >= 1
+    assert s['hedge_win_rate'] == 0.0
+    from petastorm_tpu.storage import storage_metrics_snapshot
+    # the probe cleans up after itself: global registry left reset
+    assert not (storage_metrics_snapshot().get('counters') or {})
 
 
 def test_service_unconfigured_by_default(monkeypatch):

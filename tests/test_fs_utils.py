@@ -46,6 +46,51 @@ def test_url_list_mixed_schemes_raises(tmp_path):
         get_filesystem_and_path_or_paths(['file:///a', 's3://bucket/b'])
 
 
+def test_url_list_mismatch_names_first_offender():
+    # with dozens of shard URLs the old "schemes {...}" summary sent the
+    # user diffing the whole list by hand; the error must name the URL
+    with pytest.raises(ValueError, match='first mismatch') as info:
+        get_filesystem_and_path_or_paths(
+            ['file:///a', 'file:///b', 's3://bucket/c', 's3://bucket/d'])
+    assert "'s3://bucket/c'" in str(info.value)
+    assert "'file:///a'" in str(info.value)
+
+
+def test_url_list_threads_storage_options_to_fsspec(monkeypatch):
+    """The list-of-URLs path resolves ONE filesystem from the first URL and
+    hands storage_options through to fsspec (the single-URL path was the
+    only one exercised before)."""
+    import fsspec
+    calls = []
+    real = fsspec.filesystem
+
+    def spy(scheme, **kwargs):
+        calls.append((scheme, kwargs))
+        return real('memory')
+
+    monkeypatch.setattr(fsspec, 'filesystem', spy)
+    fs, paths = get_filesystem_and_path_or_paths(
+        ['s3://bucket/a', 's3://bucket/b'],
+        storage_options={'key': 'k', 'secret': 's'})
+    assert isinstance(fs, pafs.PyFileSystem)
+    assert paths == ['bucket/a', 'bucket/b']
+    assert calls == [('s3', {'key': 'k', 'secret': 's'})]  # resolved once
+
+
+def test_url_list_explicit_filesystem_skips_resolution(tmp_path):
+    fs, paths = get_filesystem_and_path_or_paths(
+        ['file:///a', 'file:///b'], filesystem=pafs.LocalFileSystem())
+    assert isinstance(fs, pafs.LocalFileSystem)
+    assert paths == ['/a', '/b']
+
+
+def test_url_list_mismatched_netlocs_same_scheme_raises():
+    with pytest.raises(ValueError, match='first mismatch') as info:
+        get_filesystem_and_path_or_paths(
+            ['hdfs://nn1/a', 'hdfs://nn2/b'])
+    assert "'hdfs://nn2/b'" in str(info.value)
+
+
 def test_path_exists_and_delete(tmp_path):
     fs = pafs.LocalFileSystem()
     target = tmp_path / 'f.txt'

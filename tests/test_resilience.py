@@ -206,6 +206,69 @@ class TestFaultSchedule(object):
         with pytest.raises(ValueError):
             fs.open_input_file(str(tmp_path / 'x.bin'))
 
+    def test_tail_latency_every_nth_event_shared_by_opens_and_reads(
+            self, tmp_path, monkeypatch):
+        """The tail distribution fires on every Nth GLOBAL event — opens and
+        reads claim one counter, so the injected p99 is reproducible
+        regardless of how they interleave."""
+        import petastorm_tpu.test_util.fault_injection as fi
+        delays = []
+        monkeypatch.setattr(fi.time, 'sleep', delays.append)
+        sched = FaultSchedule(tmp_path / 'state', [
+            FaultRule('x', kind='latency', latency_s=0.01,
+                      tail_latency_s=0.5, tail_every_n=3)])
+        fs = fault_injecting_filesystem(sched)
+        target = tmp_path / 'x.bin'
+        target.write_bytes(b'abcdefgh')
+        assert sched.wants_read_latency(str(target))
+        handle = fs.open_input_file(str(target))      # event 1: base only
+        assert handle.read(4) == b'abcd'              # event 2: base only
+        assert handle.read(4) == b'efgh'              # event 3: TAIL
+        fs.open_input_file(str(target))               # event 4: base only
+        assert delays == [0.01, 0.01, 0.51, 0.01]
+        assert sched.trigger_count(0) == 4
+
+    def test_tail_zero_preserves_constant_latency_behavior(self, tmp_path,
+                                                           monkeypatch):
+        """``tail_every_n=0`` is the pre-distribution contract byte-for-byte:
+        constant sleep on opens only, reads not intercepted."""
+        import pyarrow as pa
+        import petastorm_tpu.test_util.fault_injection as fi
+        delays = []
+        monkeypatch.setattr(fi.time, 'sleep', delays.append)
+        sched = FaultSchedule(tmp_path / 'state', [
+            FaultRule('x', kind='latency', latency_s=0.02)])
+        fs = fault_injecting_filesystem(sched)
+        target = tmp_path / 'x.bin'
+        target.write_bytes(b'abc')
+        assert not sched.wants_read_latency(str(target))
+        handle = fs.open_input_file(str(target))
+        assert not isinstance(handle, pa.PythonFile)  # no read wrapper
+        assert handle.read() == b'abc'
+        assert delays == [0.02]                       # the open, nothing else
+
+    def test_tail_honors_after_and_times_budget(self, tmp_path, monkeypatch):
+        import petastorm_tpu.test_util.fault_injection as fi
+        delays = []
+        monkeypatch.setattr(fi.time, 'sleep', delays.append)
+        sched = FaultSchedule(tmp_path / 'state', [
+            FaultRule('x', kind='latency', latency_s=0.01,
+                      tail_latency_s=0.5, tail_every_n=2, after=1, times=2)])
+        fs = fault_injecting_filesystem(sched)
+        target = tmp_path / 'x.bin'
+        target.write_bytes(b'abc')
+        for _ in range(4):
+            fs.open_input_file(str(target))
+        # event 1 skipped (after), event 2 tails (2 % 2 == 0), event 3
+        # base-only, event 4 past the budget
+        assert delays == [0.51, 0.01]
+
+    def test_negative_tail_params_rejected(self):
+        with pytest.raises(ValueError):
+            FaultRule('x', kind='latency', tail_latency_s=-1.0)
+        with pytest.raises(ValueError):
+            FaultRule('x', kind='latency', tail_every_n=-2)
+
 
 # ---------------------------------------------------------------------------
 # End-to-end over make_reader, all three pools

@@ -1,0 +1,737 @@
+"""Object-store ingest engine tests (docs/performance.md "Object-store
+ingest engine"): policy resolution and scheme-based auto-engage, the
+range-planner coalescing matrix over synthetic and real Parquet footers, the
+hedge-cancellation race (winner commits once, loser's late bytes dropped,
+counters exact), metadata-cache invalidation on ``(mtime, size)`` change plus
+sidecar sharing/corruption, the segmented-file fallback net, the faultinject
+e2e proving a hedged epoch is rows-exact with a byte-identical lineage
+digest, the CostLedger ``fetch`` cell (fold/merge/persist/``costs --json``),
+fetch-heavy DRR routing, and the ``storage_fetch_window`` autotune knob."""
+
+import glob
+import json
+import os
+import threading
+import types
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.fs as pafs
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.errors import MetadataError, TransientIOError
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.schedule import CostAwareScheduler, SchedulePolicy
+from petastorm_tpu.service.dispatcher import (HEAVY_ITEM_COST,
+                                              FairShareScheduler)
+from petastorm_tpu.service.wire import WorkerDescriptor
+from petastorm_tpu.storage import (StoragePolicy, reset_storage_metrics,
+                                   resolve_storage_policy,
+                                   storage_metrics_snapshot)
+from petastorm_tpu.storage.engine import RowGroupSource, _SegmentedFile
+from petastorm_tpu.storage.fetcher import (FETCH_WINDOW_ENV, RangeFetcher,
+                                           fetch_window)
+from petastorm_tpu.storage.metadata_cache import (MetadataCache,
+                                                  read_footer_bytes)
+from petastorm_tpu.storage.range_planner import (ByteRange, _chunk_range,
+                                                 coalesce_ranges,
+                                                 plan_ranges)
+from petastorm_tpu.telemetry.cost_model import CostLedger
+from petastorm_tpu.telemetry.registry import (set_telemetry_enabled,
+                                              telemetry_enabled)
+from petastorm_tpu.test_util.fault_injection import (FaultRule, FaultSchedule,
+                                                     fault_injecting_filesystem)
+
+from test_common import create_test_dataset
+
+
+@pytest.fixture
+def counters():
+    """Telemetry on + a clean storage registry; yields a snapshot callable
+    and restores the kill switch after."""
+    was = telemetry_enabled()
+    set_telemetry_enabled(True)
+    reset_storage_metrics()
+    try:
+        yield lambda: (storage_metrics_snapshot().get('counters') or {})
+    finally:
+        set_telemetry_enabled(was)
+        reset_storage_metrics()
+
+
+def write_parquet(path, num_rows=100, row_group_size=50, columns=('a', 'b',
+                                                                  'c')):
+    table = pa.table({name: np.arange(num_rows, dtype=np.int64) + i
+                      for i, name in enumerate(columns)})
+    pq.write_table(table, path, row_group_size=row_group_size)
+    return pq.read_metadata(path)
+
+
+# ------------------------------------------------------- policy resolution
+
+class TestResolvePolicy(object):
+    def test_false_disables_everywhere(self):
+        assert resolve_storage_policy(False, 's3://bucket/data') is None
+
+    def test_true_engages_default_policy(self):
+        policy = resolve_storage_policy(True, '/local/data')
+        assert isinstance(policy, StoragePolicy)
+        assert policy.hedge_enabled
+
+    def test_instance_passes_through(self):
+        mine = StoragePolicy(coalesce_gap_bytes=1)
+        assert resolve_storage_policy(mine, 's3://b/x') is mine
+
+    def test_none_stays_off_on_local_schemes(self):
+        for url in ('/plain/path', 'file:///tmp/x', 'hdfs://nn/x'):
+            assert resolve_storage_policy(None, url) is None
+
+    def test_none_auto_engages_on_object_stores(self):
+        for url in ('s3://bucket/x', 'gs://bucket/x'):
+            assert isinstance(resolve_storage_policy(None, url),
+                              StoragePolicy)
+
+    def test_url_list_decided_by_first(self):
+        assert isinstance(resolve_storage_policy(None, ['s3://b/x', 's3://b/y']),
+                          StoragePolicy)
+        assert resolve_storage_policy(None, ['/a', '/b']) is None
+
+    def test_garbage_raises(self):
+        with pytest.raises(TypeError):
+            resolve_storage_policy(42, '/x')
+
+
+# --------------------------------------------------------- range planning
+
+class TestCoalesce(object):
+    def test_empty(self):
+        assert coalesce_ranges([], 5) == ()
+
+    def test_adjacent_merge(self):
+        assert coalesce_ranges([ByteRange(0, 10), ByteRange(10, 20)], 0) == \
+            (ByteRange(0, 20),)
+
+    def test_overlap_merge(self):
+        assert coalesce_ranges([ByteRange(0, 15), ByteRange(10, 20)], 0) == \
+            (ByteRange(0, 20),)
+
+    def test_contained_range_absorbed(self):
+        assert coalesce_ranges([ByteRange(0, 100), ByteRange(10, 20)], 0) == \
+            (ByteRange(0, 100),)
+
+    def test_gap_at_threshold_merges_above_does_not(self):
+        pair = [ByteRange(0, 10), ByteRange(14, 20)]
+        assert coalesce_ranges(pair, 4) == (ByteRange(0, 20),)
+        assert coalesce_ranges(pair, 3) == tuple(pair)
+
+    def test_unsorted_input_sorted_first(self):
+        assert coalesce_ranges([ByteRange(30, 40), ByteRange(0, 10),
+                                ByteRange(10, 30)], 0) == (ByteRange(0, 40),)
+
+    def test_negative_gap_treated_as_zero(self):
+        assert coalesce_ranges([ByteRange(0, 10), ByteRange(10, 20)], -7) == \
+            (ByteRange(0, 20),)
+
+
+class TestChunkRange(object):
+    def _chunk(self, dict_off, data_off, size=50):
+        return types.SimpleNamespace(dictionary_page_offset=dict_off,
+                                     data_page_offset=data_off,
+                                     total_compressed_size=size,
+                                     path_in_schema='x')
+
+    def test_dictionary_page_starts_the_chunk(self):
+        assert _chunk_range(self._chunk(40, 100)) == ByteRange(40, 90)
+
+    def test_zero_dictionary_offset_filtered(self):
+        # offset 0 is the 4-byte magic, never a chunk start — some writers
+        # report 0 for "no dictionary page"
+        assert _chunk_range(self._chunk(0, 100)) == ByteRange(100, 150)
+
+    def test_no_valid_offsets_is_metadata_error(self):
+        with pytest.raises(MetadataError):
+            _chunk_range(self._chunk(None, 0))
+
+
+class TestPlanRanges(object):
+    @pytest.fixture(scope='class')
+    def footer(self, tmp_path_factory):
+        path = str(tmp_path_factory.mktemp('plan') / 'f.parquet')
+        return write_parquet(path)
+
+    def test_huge_gap_coalesces_to_one_request(self, footer):
+        plan = plan_ranges(footer, [0, 1], ['a', 'b', 'c'],
+                           gap_bytes=1 << 30)
+        assert len(plan.ranges) == 1
+        assert plan.raw_ranges == 6                 # 2 rowgroups x 3 columns
+        assert plan.coalesced_away == 5
+        assert plan.total_bytes == plan.ranges[0].length
+
+    def test_projection_subset_fetches_fewer_bytes(self, footer):
+        everything = plan_ranges(footer, [0, 1], ['a', 'b', 'c'], 0)
+        only_a = plan_ranges(footer, [0, 1], ['a'], 0)
+        assert only_a.raw_ranges == 2
+        assert only_a.total_bytes < everything.total_bytes
+        assert only_a.columns == ('a',)
+
+    def test_single_rowgroup_plan(self, footer):
+        plan = plan_ranges(footer, [1], ['b'], 0)
+        assert plan.raw_ranges == 1
+        assert len(plan.ranges) == 1
+
+    def test_empty_projection_plans_nothing(self, footer):
+        plan = plan_ranges(footer, [0, 1], [], 0)
+        assert plan.ranges == () and plan.total_bytes == 0
+
+    def test_missing_column_is_metadata_error(self, footer):
+        with pytest.raises(MetadataError, match='nope'):
+            plan_ranges(footer, [0], ['a', 'nope'], 0)
+
+    def test_missing_column_with_no_rowgroups_is_empty(self, footer):
+        assert plan_ranges(footer, [], ['nope'], 0).ranges == ()
+
+
+# ------------------------------------------------------------ fetcher
+
+class _Handle(object):
+    """Scripted read handle over a bytes buffer: optional entry/exit events
+    let a test sequence the hedge race deterministically."""
+
+    def __init__(self, data, wait_for=None, signal_on_read=None,
+                 corrupt=False, error=None):
+        self._data = data
+        self._pos = 0
+        self._wait_for = wait_for
+        self._signal = signal_on_read
+        self._corrupt = corrupt
+        self._error = error
+
+    def seek(self, pos):
+        self._pos = pos
+
+    def read(self, n):
+        if self._signal is not None:
+            self._signal.set()
+        if self._wait_for is not None:
+            self._wait_for.wait(timeout=10.0)
+        if self._error is not None:
+            raise self._error
+        chunk = self._data[self._pos:self._pos + n]
+        if self._corrupt:
+            return b'\xff' * len(chunk)
+        return chunk
+
+
+DATA = bytes(range(200)) + bytes(reversed(range(56)))   # 256 distinct-ish
+
+
+def no_hedge(**kwargs):
+    return StoragePolicy(hedge_enabled=False, **kwargs)
+
+
+class TestRangeFetcher(object):
+    def test_fetch_assembles_exact_segments(self, counters):
+        fetcher = RangeFetcher(lambda: _Handle(DATA), no_hedge())
+        plan = plan_for(ByteRange(0, 8), ByteRange(100, 140))
+        result = fetcher.fetch(plan)
+        assert result.segments[ByteRange(0, 8)] == DATA[0:8]
+        assert result.segments[ByteRange(100, 140)] == DATA[100:140]
+        assert result.bytes_fetched == 48 and result.ranges == 2
+        assert result.hedges_fired == 0 and result.hedges_won == 0
+        assert result.trace_args() == {'bytes': 48, 'ranges': 2,
+                                       'hedges_fired': 0, 'hedges_won': 0}
+        assert counters().get('storage_hedge_fired', 0) == 0
+
+    def test_short_read_raises_transient(self):
+        fetcher = RangeFetcher(lambda: _Handle(DATA[:4]), no_hedge())
+        with pytest.raises(TransientIOError, match='short read'):
+            fetcher.fetch(plan_for(ByteRange(0, 8)))
+
+    def test_hedge_wins_and_losers_late_bytes_dropped(self, counters):
+        release_primary = threading.Event()
+        opened = []
+        lock = threading.Lock()
+
+        def open_fn():
+            with lock:
+                opened.append(True)
+                first = len(opened) == 1
+            if first:
+                # the primary leg: a straggler returning CORRUPT bytes when
+                # finally released — committing them would prove the race
+                # let the loser through
+                return _Handle(DATA, wait_for=release_primary, corrupt=True)
+            return _Handle(DATA)
+
+        fetcher = RangeFetcher(open_fn, StoragePolicy(hedge_min_s=0.02))
+        try:
+            result = fetcher.fetch(plan_for(ByteRange(10, 30)))
+        finally:
+            release_primary.set()
+        assert result.segments[ByteRange(10, 30)] == DATA[10:30]
+        assert result.hedges_fired == 1 and result.hedges_won == 1
+        snap = counters()
+        assert snap.get('storage_hedge_fired') == 1
+        assert snap.get('storage_hedge_won') == 1
+
+    def test_primary_wins_race_after_hedge_fires(self, counters):
+        release_primary = threading.Event()
+        block_hedge = threading.Event()
+        opened = []
+        lock = threading.Lock()
+
+        def open_fn():
+            with lock:
+                opened.append(True)
+                first = len(opened) == 1
+            if first:
+                return _Handle(DATA, wait_for=release_primary)
+            # the hedge leg releases the primary on entry, then stalls:
+            # deterministic "primary finishes first after the hedge fired"
+            return _Handle(DATA, wait_for=block_hedge,
+                           signal_on_read=release_primary, corrupt=True)
+
+        fetcher = RangeFetcher(open_fn, StoragePolicy(hedge_min_s=0.02))
+        try:
+            result = fetcher.fetch(plan_for(ByteRange(0, 16)))
+        finally:
+            block_hedge.set()
+        assert result.segments[ByteRange(0, 16)] == DATA[0:16]
+        assert result.hedges_fired == 1 and result.hedges_won == 0
+        assert counters().get('storage_hedge_won', 0) == 0
+
+    def test_single_leg_failure_is_papered_over(self, counters):
+        release_primary = threading.Event()
+        opened = []
+        lock = threading.Lock()
+
+        def open_fn():
+            with lock:
+                opened.append(True)
+                first = len(opened) == 1
+            if first:
+                return _Handle(DATA, wait_for=release_primary,
+                               error=OSError('primary died'))
+            return _Handle(DATA, signal_on_read=release_primary)
+
+        fetcher = RangeFetcher(open_fn, StoragePolicy(hedge_min_s=0.02))
+        result = fetcher.fetch(plan_for(ByteRange(0, 8)))
+        assert result.segments[ByteRange(0, 8)] == DATA[0:8]
+        assert result.hedges_fired == 1
+
+    def test_both_legs_failing_reraises(self):
+        release_primary = threading.Event()
+        opened = []
+        lock = threading.Lock()
+
+        def open_fn():
+            with lock:
+                opened.append(True)
+                first = len(opened) == 1
+            if first:
+                return _Handle(DATA, wait_for=release_primary,
+                               error=OSError('primary died'))
+            return _Handle(DATA, signal_on_read=release_primary,
+                           error=OSError('hedge died'))
+
+        fetcher = RangeFetcher(open_fn, StoragePolicy(hedge_min_s=0.02))
+        with pytest.raises(OSError, match='died'):
+            fetcher.fetch(plan_for(ByteRange(0, 8)))
+
+    def test_deadline_adaptive_with_floor(self):
+        policy = StoragePolicy(hedge_quantile=0.5, hedge_factor=2.0,
+                               hedge_min_s=0.01)
+        fetcher = RangeFetcher(lambda: _Handle(DATA), policy)
+        assert fetcher._deadline() == 0.01          # no samples: floor rules
+        for _ in range(10):
+            fetcher._note_sample(0.1)
+        assert fetcher._deadline() == pytest.approx(0.2)
+
+    def test_deadline_none_when_hedging_off(self):
+        assert RangeFetcher(lambda: _Handle(DATA),
+                            no_hedge())._deadline() is None
+
+    def test_fetch_window_env_override_and_clamp(self, monkeypatch):
+        policy = StoragePolicy(max_in_flight=8)
+        monkeypatch.delenv(FETCH_WINDOW_ENV, raising=False)
+        assert fetch_window(policy) == 8
+        monkeypatch.setenv(FETCH_WINDOW_ENV, '4')
+        assert fetch_window(policy) == 4
+        monkeypatch.setenv(FETCH_WINDOW_ENV, '999')
+        assert fetch_window(policy) == 128
+        monkeypatch.setenv(FETCH_WINDOW_ENV, '0')
+        assert fetch_window(policy) == 1
+        monkeypatch.setenv(FETCH_WINDOW_ENV, 'garbage')
+        assert fetch_window(policy) == 8
+
+
+def plan_for(*ranges):
+    from petastorm_tpu.storage.range_planner import RangePlan
+    return RangePlan(ranges=tuple(ranges), raw_ranges=len(ranges),
+                     total_bytes=sum(r.length for r in ranges),
+                     columns=('x',))
+
+
+# ------------------------------------------------------- metadata cache
+
+class _CountingFs(object):
+    """Local filesystem wrapper counting storage opens — how the sidecar
+    tests prove "the footer came from disk, not from the store"."""
+
+    def __init__(self):
+        self._fs = pafs.LocalFileSystem()
+        self.opens = 0
+
+    def get_file_info(self, path):
+        return self._fs.get_file_info(path)
+
+    def open_input_file(self, path):
+        self.opens += 1
+        return self._fs.open_input_file(path)
+
+
+class TestMetadataCache(object):
+    def test_hit_then_invalidate_on_rewrite(self, tmp_path, counters):
+        path = str(tmp_path / 'f.parquet')
+        write_parquet(path, num_rows=100)
+        fs = pafs.LocalFileSystem()
+        cache = MetadataCache()
+        assert cache.get(fs, path).metadata.num_rows == 100
+        assert cache.get(fs, path).metadata.num_rows == 100   # LRU hit
+        snap = counters()
+        assert snap.get('storage_footer_cache_hit') == 1
+        assert snap.get('storage_footer_cache_miss') == 1
+        write_parquet(path, num_rows=150)                     # (mtime, size)
+        assert cache.get(fs, path).metadata.num_rows == 150   # key changed
+        assert counters().get('storage_footer_cache_miss') == 2
+
+    def test_sidecar_shared_across_instances_spares_storage(self, tmp_path,
+                                                            counters):
+        path = str(tmp_path / 'f.parquet')
+        write_parquet(path, num_rows=100)
+        disk_dir = str(tmp_path)
+        warm_fs = _CountingFs()
+        MetadataCache(disk_dir=disk_dir).get(warm_fs, path)
+        assert warm_fs.opens >= 1
+        cold_fs = _CountingFs()
+        entry = MetadataCache(disk_dir=disk_dir).get(cold_fs, path)
+        assert entry.metadata.num_rows == 100
+        assert cold_fs.opens == 0          # footer served by the sidecar
+        # a sidecar fill is still a MISS: storage spared, footer re-parsed
+        assert counters().get('storage_footer_cache_miss') == 2
+
+    def test_corrupt_sidecar_is_a_miss_not_an_error(self, tmp_path):
+        path = str(tmp_path / 'f.parquet')
+        write_parquet(path, num_rows=100)
+        disk_dir = str(tmp_path / 'cache')
+        os.makedirs(disk_dir)
+        MetadataCache(disk_dir=disk_dir).get(pafs.LocalFileSystem(), path)
+        (sidecar,) = glob.glob(os.path.join(disk_dir,
+                                            '_petastorm_tpu_footer_*.bin'))
+        with open(sidecar, 'wb') as f:
+            f.write(b'\x00garbage')
+        fs = _CountingFs()
+        entry = MetadataCache(disk_dir=disk_dir).get(fs, path)
+        assert entry.metadata.num_rows == 100
+        assert fs.opens >= 1               # fell back to the real tail read
+
+    def test_lru_eviction_at_capacity(self, tmp_path, counters):
+        paths = []
+        for name in ('a', 'b'):
+            path = str(tmp_path / (name + '.parquet'))
+            write_parquet(path, num_rows=10)
+            paths.append(path)
+        fs = pafs.LocalFileSystem()
+        cache = MetadataCache(capacity=1)
+        cache.get(fs, paths[0])
+        cache.get(fs, paths[1])            # evicts a
+        cache.get(fs, paths[0])            # miss again
+        snap = counters()
+        assert snap.get('storage_footer_cache_miss') == 3
+        assert snap.get('storage_footer_cache_hit', 0) == 0
+
+    def test_non_parquet_tail_is_metadata_error(self, tmp_path):
+        path = str(tmp_path / 'junk.bin')
+        with open(path, 'wb') as f:
+            f.write(b'not parquet at all, definitely' * 4)
+        size = os.path.getsize(path)
+        with pytest.raises(MetadataError):
+            read_footer_bytes(pafs.LocalFileSystem(), path, size)
+
+    def test_footer_longer_than_file_is_metadata_error(self, tmp_path):
+        path = str(tmp_path / 'lying.parquet')
+        with open(path, 'wb') as f:
+            f.write(b'\x00' * 10 + (1000).to_bytes(4, 'little') + b'PAR1')
+        with pytest.raises(MetadataError):
+            read_footer_bytes(pafs.LocalFileSystem(), path,
+                              os.path.getsize(path))
+
+
+# ------------------------------------------------------- segmented file
+
+class TestSegmentedFile(object):
+    def _file(self, fallback=None):
+        segments = [(0, DATA[0:50]), (100, DATA[100:150])]
+        return _SegmentedFile(200, segments,
+                              fallback or (lambda s, n: DATA[s:s + n]))
+
+    def test_covered_read_no_fallback(self):
+        f = self._file()
+        f.seek(10)
+        assert f.read(20) == DATA[10:30] and f.fallback_reads == 0
+
+    def test_gap_read_fills_via_fallback(self):
+        f = self._file()
+        f.seek(40)
+        assert f.read(70) == DATA[40:110]
+        assert f.fallback_reads == 1       # exactly the [50, 100) gap
+
+    def test_seek_whence_and_tail_read(self):
+        f = self._file()
+        assert f.seek(-10, 2) == 190
+        assert f.seek(5, 1) == 195
+        assert f.read() == DATA[195:200]
+        assert f.fallback_reads == 1
+
+    def test_short_fallback_raises(self):
+        f = self._file(fallback=lambda s, n: b'')
+        f.seek(60)
+        with pytest.raises(TransientIOError, match='short fallback'):
+            f.read(4)
+
+
+class TestRowGroupSource(object):
+    def test_single_rowgroup_matches_pyarrow(self, tmp_path, counters):
+        path = str(tmp_path / 'f.parquet')
+        write_parquet(path, num_rows=100, row_group_size=50)
+        source = RowGroupSource(path, pafs.LocalFileSystem(),
+                                no_hedge(coalesce_gap_bytes=1 << 20),
+                                row_group_id=0,
+                                metadata_cache=MetadataCache())
+        table = source.read_columns(['a', 'b'])
+        expected = pq.ParquetFile(path).read_row_group(0).select(['a', 'b'])
+        assert table.equals(expected)
+        assert counters().get('storage_ranges_coalesced', 0) >= 1
+
+    def test_whole_file_and_no_refetch_of_seen_columns(self, tmp_path):
+        path = str(tmp_path / 'f.parquet')
+        write_parquet(path, num_rows=100, row_group_size=50)
+        source = RowGroupSource(path, pafs.LocalFileSystem(), no_hedge(),
+                                row_group_id=None,
+                                metadata_cache=MetadataCache())
+        assert source.read_columns(['a', 'c']).equals(
+            pq.read_table(path).select(['a', 'c']))
+        seen = set(source._have)
+        assert source.read_columns(['a']).equals(
+            pq.read_table(path).select(['a']))
+        assert source._have == seen        # nothing re-planned or re-fetched
+        assert source.schema_arrow().names == ['a', 'b', 'c']
+
+
+# --------------------------------------------------------------- e2e reader
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp('storage_e2e') / 'dataset')
+    rows = create_test_dataset(url, num_rows=40)
+    return {'url': url, 'rows': rows}
+
+
+def read_ids_and_digest(url, **kwargs):
+    kwargs.setdefault('reader_pool_type', 'dummy')
+    kwargs.setdefault('num_epochs', 1)
+    kwargs.setdefault('seed', 7)
+    kwargs.setdefault('shuffle_row_groups', True)
+    with make_reader(url, **kwargs) as reader:
+        ids = [int(row.id) for row in reader]
+        return ids, reader.order_digest(), reader.diagnostics
+
+
+class TestReaderIntegration(object):
+    def test_engine_byte_identical_to_seed_path(self, dataset, counters):
+        seed_ids, seed_digest, seed_diag = read_ids_and_digest(
+            dataset['url'], storage_policy=None)
+        engine_ids, engine_digest, engine_diag = read_ids_and_digest(
+            dataset['url'], storage_policy=True)
+        assert engine_ids == seed_ids
+        assert engine_digest == seed_digest
+        assert 'storage' not in seed_diag            # unarmed: zero surface
+        assert engine_diag['storage']['footer_cache_misses'] >= 1
+
+    def test_telemetry_snapshot_merges_storage_counters(self, dataset,
+                                                        counters):
+        with make_reader(dataset['url'], reader_pool_type='dummy',
+                         num_epochs=1, shuffle_row_groups=False,
+                         storage_policy=True) as reader:
+            for _ in reader:
+                pass
+            merged = reader.telemetry_snapshot().get('counters') or {}
+        assert merged.get('storage_footer_cache_miss', 0) >= 1
+
+    def test_hedged_epoch_rows_exact_digest_identical(self, tmp_path,
+                                                      counters):
+        url = str(tmp_path / 'dataset')
+        create_test_dataset(url, num_rows=40)
+        truth_ids, truth_digest, _ = read_ids_and_digest(
+            url, shuffle_row_groups=False, storage_policy=None)
+
+        def tail_schedule(name):
+            # fresh state dir per run: each arm faces the IDENTICAL
+            # deterministic distribution (every 4th event +0.2s)
+            return FaultSchedule(tmp_path / name, [
+                FaultRule('part_', kind='latency', latency_s=0.002,
+                          tail_latency_s=0.2, tail_every_n=4)])
+
+        hedged = StoragePolicy(hedge_quantile=0.5, hedge_factor=2.0,
+                               hedge_min_s=0.02)
+        reset_storage_metrics()
+        hedged_ids, hedged_digest, _ = read_ids_and_digest(
+            url, shuffle_row_groups=False,
+            filesystem=fault_injecting_filesystem(tail_schedule('hedged')),
+            storage_policy=hedged)
+        hedged_snap = counters()
+        reset_storage_metrics()
+        unhedged_ids, unhedged_digest, _ = read_ids_and_digest(
+            url, shuffle_row_groups=False,
+            filesystem=fault_injecting_filesystem(tail_schedule('unhedged')),
+            storage_policy=no_hedge())
+        unhedged_snap = counters()
+        assert hedged_ids == truth_ids == unhedged_ids
+        assert hedged_digest == truth_digest == unhedged_digest
+        assert hedged_snap.get('storage_hedge_fired', 0) > 0
+        assert unhedged_snap.get('storage_hedge_fired', 0) == 0
+
+
+# ----------------------------------------------------- cost ledger: fetch
+
+def fetch_event(piece, seconds, **args):
+    args.setdefault('bytes', 0)
+    args.setdefault('ranges', 0)
+    args.setdefault('hedges_fired', 0)
+    args.setdefault('hedges_won', 0)
+    return {'ph': 'X', 'name': 'range_fetch', 'ctx': [0, piece],
+            'dur_us': seconds * 1e6, 'args': args}
+
+
+PIECE_MAP = {3: ('frag.parquet', 2)}
+
+
+class TestCostLedgerFetchCell(object):
+    def _fetch_row(self, ledger):
+        (row,) = ledger.ranking(1)
+        return row
+
+    def test_fold_is_additive_per_rowgroup(self):
+        ledger = CostLedger('tok')
+        assert ledger.ingest_trace({'events': [
+            fetch_event(3, 0.5, bytes=1024, ranges=2, hedges_fired=1,
+                        hedges_won=1),
+            fetch_event(3, 0.25, bytes=512, ranges=1),
+        ]}, PIECE_MAP) == 2
+        row = self._fetch_row(ledger)
+        assert row['rowgroup'] == 'frag.parquet#2'
+        assert row['fetch'] == {'bytes': 1536, 'ranges': 3,
+                                'hedges_fired': 1, 'hedges_won': 1,
+                                'seconds': 0.75}
+        # range_fetch is a COST_STAGE: the fetch time counts as rowgroup cost
+        assert ledger.rowgroup_cost('frag.parquet#2') == pytest.approx(0.75)
+
+    def test_merge_and_persist_preserve_fetch(self, tmp_path):
+        a = CostLedger('tok')
+        a.ingest_trace({'events': [fetch_event(3, 0.5, bytes=100, ranges=1)]},
+                       PIECE_MAP)
+        b = CostLedger('tok')
+        b.ingest_trace({'events': [
+            fetch_event(3, 0.5, bytes=100, ranges=1, hedges_fired=2,
+                        hedges_won=1)]}, PIECE_MAP)
+        a.merge(b)
+        path = str(tmp_path / 'ledger.json')
+        a.save(path)
+        row = self._fetch_row(CostLedger.load(path))
+        assert row['fetch'] == {'bytes': 200, 'ranges': 2, 'hedges_fired': 2,
+                                'hedges_won': 1, 'seconds': 1.0}
+
+    def test_costs_cli_json_surfaces_fetch(self, tmp_path, capsys):
+        from petastorm_tpu.telemetry.cost_model import main as costs_main
+        ledger = CostLedger('tok')
+        ledger.ingest_trace({'events': [
+            fetch_event(3, 0.5, bytes=2048, ranges=4, hedges_fired=1)]},
+            PIECE_MAP)
+        path = str(tmp_path / 'ledger.json')
+        ledger.save(path)
+        assert costs_main(['ignored-url', '--no-read', '--ledger', path,
+                           '--json']) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc['ranking'][0]['fetch']['bytes'] == 2048
+        assert doc['ranking'][0]['fetch']['hedges_fired'] == 1
+
+    def test_drr_spreads_fetch_heavy_items(self):
+        # a fetch-skewed ledger makes its rowgroups heavy for the scheduler...
+        ledger = CostLedger('tok')
+        for piece in range(4):
+            ledger.ingest_trace({'events': [fetch_event(piece, 3.0,
+                                                        bytes=1 << 20,
+                                                        ranges=1)]},
+                                {piece: ('frag.parquet', piece)})
+        for piece in range(4, 12):
+            ledger.ingest_trace({'events': [fetch_event(piece, 0.05,
+                                                        bytes=1024,
+                                                        ranges=1)]},
+                                {piece: ('frag.parquet', piece)})
+        planner = CostAwareScheduler('tok', SchedulePolicy(), ledger=ledger)
+        hints = [planner.normalized_cost('frag.parquet#{}'.format(i))
+                 for i in range(4)]
+        assert all(hint >= HEAVY_ITEM_COST for hint in hints)
+        # ...and the DRR dispatcher routes consecutive heavy items onto
+        # distinct workers instead of FIFO-piling them on one
+        sched = FairShareScheduler(clock=lambda: 0.0)
+        sched.add_client(b'c', 'c', 'h', None)
+        sched.add_worker(b'w1', WorkerDescriptor(1, 1, 'h'))
+        sched.add_worker(b'w2', WorkerDescriptor(2, 2, 'h'))
+        for i, hint in enumerate(hints):
+            sched.submit(b'c', b'%d' % i, b's', b'x', cost=hint)
+        by_worker = {}
+        while True:
+            for key in (b'w1', b'w2'):
+                sched.worker_ready(key)
+            assignment = sched.next_assignment()
+            if assignment is None:
+                break
+            by_worker.setdefault(assignment.worker_key, 0)
+            by_worker[assignment.worker_key] += 1
+            sched.retire(assignment.token, assignment.attempt)
+        assert sum(by_worker.values()) == 4
+        assert len(by_worker) == 2
+
+
+# ------------------------------------------------------------ autotune knob
+
+class TestFetchWindowKnob(object):
+    def test_knob_present_only_when_armed(self, dataset):
+        from petastorm_tpu.autotune.knobs import build_reader_knobs
+        with make_reader(dataset['url'], reader_pool_type='dummy',
+                         num_epochs=1, shuffle_row_groups=False) as reader:
+            assert 'storage_fetch_window' not in [
+                k.knob_id for k in build_reader_knobs(reader)]
+            for _ in reader:
+                pass
+
+    def test_apply_actuates_env_and_restore_undoes(self, dataset,
+                                                   monkeypatch):
+        from petastorm_tpu.autotune.knobs import build_reader_knobs
+        monkeypatch.delenv(FETCH_WINDOW_ENV, raising=False)
+        with make_reader(dataset['url'], reader_pool_type='dummy',
+                         num_epochs=1, shuffle_row_groups=False,
+                         storage_policy=True) as reader:
+            knobs = {k.knob_id: k for k in build_reader_knobs(reader)}
+            knob = knobs['storage_fetch_window']
+            assert knob.get() == float(StoragePolicy().max_in_flight)
+            assert knob.apply(4.0) == 4.0
+            assert os.environ[FETCH_WINDOW_ENV] == '4'
+            assert knob.get() == 4.0
+            knob.restore()
+            assert fetch_window(StoragePolicy()) == \
+                StoragePolicy().max_in_flight
+            for _ in reader:
+                pass
