@@ -158,7 +158,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
                  'resilience', 'pipecheck', 'tracing', 'service', 'autotune',
                  'device_decode', 'observability', 'schedule', 'storage',
-                 'lineage', 'incidents', 'chaos')
+                 'lineage', 'incidents', 'chaos', 'history')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -168,7 +168,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
 SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'incidents',
-                     'lineage',
+                     'history', 'lineage',
                      'schedule', 'storage', 'autotune', 'device_decode',
                      'decode_bench',
                      'service', 'chaos', 'wire_bench', 'telemetry', 'tracing',
@@ -229,6 +229,10 @@ def normalize_headline(result):
 _RATE_KEY_MARKERS = ('_per_sec', '_speedup')
 
 
+#: trailing rounds the perf-drift line folds into its median baseline
+BASELINE_WINDOW = int(os.environ.get('BENCH_BASELINE_WINDOW', 3))
+
+
 def newest_bench_baseline(bench_dir=None):
     """Path of the newest committed ``BENCH_*.json`` (mtime, name tiebreak),
     or None when no prior round exists."""
@@ -237,6 +241,58 @@ def newest_bench_baseline(bench_dir=None):
     if not paths:
         return None
     return max(paths, key=lambda p: (os.path.getmtime(p), p))
+
+
+def trailing_bench_baselines(bench_dir=None, window=None):
+    """Paths of the newest ``window`` committed ``BENCH_*.json`` rounds,
+    newest first (mtime, name tiebreak) — the trailing set the perf-drift
+    line compares against."""
+    bench_dir = bench_dir or os.path.dirname(os.path.abspath(__file__))
+    paths = glob.glob(os.path.join(bench_dir, 'BENCH_*.json'))
+    paths.sort(key=lambda p: (os.path.getmtime(p), p), reverse=True)
+    return paths[:max(window if window is not None else BASELINE_WINDOW, 1)]
+
+
+def trailing_median_baseline(new, paths):
+    """Fold up to ``len(paths)`` prior rounds into ONE synthetic baseline:
+    the per-key MEDIAN of every rate-shaped metric across the same-platform
+    rounds, so a single outlier round (noisy runner, half-salvaged partial)
+    can no longer define the reference the drift line warns against — the
+    same robust-trailing-baseline discipline the history CLI applies to run
+    records (telemetry/history.py). Returns ``(baseline_dict,
+    used_basenames)``; ``(None, [])`` when no comparable round exists."""
+    rounds, used = [], []
+    for path in paths:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as exc:
+            log('baseline compare: unreadable {}: {!r}'.format(path, exc))
+            continue
+        parsed = data.get('parsed') if isinstance(data, dict) else None
+        if isinstance(parsed, dict):
+            data = parsed
+        if not isinstance(data, dict):
+            continue
+        if (new.get('platform') and data.get('platform')
+                and new['platform'] != data['platform']):
+            continue  # cross-platform rounds compare to nothing
+        rounds.append(data)
+        used.append(os.path.basename(path))
+    if not rounds:
+        return None, []
+    baseline = {'platform': new.get('platform')}
+    keys = set()
+    for data in rounds:
+        keys.update(key for key in data
+                    if any(marker in key for marker in _RATE_KEY_MARKERS))
+    for key in sorted(keys):
+        values = [data[key] for data in rounds
+                  if isinstance(data.get(key), (int, float))
+                  and not isinstance(data.get(key), bool) and data[key] > 0]
+        if values:
+            baseline[key] = float(np.median(values))
+    return baseline, used
 
 
 def compare_to_baseline(new, old, threshold_pct=10.0):
@@ -575,24 +631,21 @@ def orchestrate():
         return
     if 'platform' not in result:
         log('WARNING: child JSON carries no platform field')
-    # Perf-drift line (warn-only): diff rate metrics against the newest
-    # committed round so a >10% drop is visible in THIS run's artifact — the
-    # exit code never changes, the driver decides what to do with it.
-    baseline_path = newest_bench_baseline()
-    if baseline_path is not None:
-        try:
-            with open(baseline_path) as f:
-                baseline = json.load(f)
-        except (OSError, ValueError) as exc:
-            log('baseline compare: unreadable {}: {!r}'.format(
-                baseline_path, exc))
-        else:
-            result['baseline_compared'] = os.path.basename(baseline_path)
+    # Perf-drift line (warn-only): diff rate metrics against the MEDIAN of
+    # the trailing BASELINE_WINDOW committed rounds so a single noisy round
+    # can't define the reference — the exit code never changes, the driver
+    # decides what to do with it.
+    baseline_paths = trailing_bench_baselines()
+    if baseline_paths:
+        baseline, used = trailing_median_baseline(result, baseline_paths)
+        if baseline is not None:
+            result['baseline_compared'] = used
             result['regressions'] = compare_to_baseline(result, baseline)
             for reg in result['regressions']:
-                log('WARNING: {} regressed {:.1f}% vs {} ({} -> {})'.format(
-                    reg['key'], reg['drop_pct'], result['baseline_compared'],
-                    reg['old'], reg['new']))
+                log('WARNING: {} regressed {:.1f}% vs trailing median of '
+                    '{} ({} -> {})'.format(
+                        reg['key'], reg['drop_pct'], ','.join(used),
+                        reg['old'], reg['new']))
     # Salvaged partials come from PARTIAL_JSON lines emitted BEFORE the child's final
     # normalization — enforce the one-JSON-line contract ({metric, value, unit,
     # vs_baseline}) here for every path. Printed unconditionally: the final line
@@ -1313,6 +1366,10 @@ def child_main():
     def run_section(name, fn):
         if section_allowlist and name not in section_allowlist:
             log('section {} skipped (BENCH_SECTIONS)'.format(name))
+            # the JSON line names what DIDN'T run: a subset round must never
+            # read downstream as "those paths measured 0" (it reads as
+            # sections_skipped) — same no-silent-caps rule as the salvage tag
+            results.setdefault('sections_skipped', []).append(name)
             return
         try:
             fn()
@@ -1838,6 +1895,66 @@ def child_main():
             'incidents_rate_limited': int(probe.get('rate_limited', 0)),
             'incidents_autopsy_exit_code': autopsy.get('exit_code'),
             'incidents_retention_ok': bool(retention_ok),
+        })
+
+    def run_history():
+        """Longitudinal observatory (host-only, fast; docs/observability.md
+        "Longitudinal observatory"): (1) historian-overhead guard — a
+        history+sentinel-armed process-pool epoch vs a bare one, min-of-3
+        interleaved pairs; the overhead percentage is the BENCH-history
+        guard for the ISSUE-18 acceptance (<= 3%); (2) store round-trip
+        probe — both armed epochs land CRC-intact run records whose
+        trailing-median compare of the last run verdicts within-noise
+        against its sibling (same config, same host)."""
+        from petastorm_tpu.telemetry.history import (compare_against_history,
+                                                     load_records)
+        history_root = tempfile.mkdtemp(prefix='bench_history_')
+        store = os.path.join(history_root, 'run_history.bin')
+
+        def epoch(history):
+            reader = make_reader(url, reader_pool_type='process',
+                                 workers_count=min(WORKERS, 2), num_epochs=1,
+                                 seed=13, shuffle_row_groups=True,
+                                 history=history)
+            rows = 0
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            reader.stop()
+            reader.join()
+            return rows / elapsed
+
+        bare_rates, armed_rates = [], []
+        for _ in range(3):  # interleaved pairs: shared-host drift cancels
+            bare_rates.append(epoch(None))
+            armed_rates.append(epoch(store))
+        bare_rate = max(bare_rates)
+        armed_rate = max(armed_rates)
+        overhead_pct = (bare_rate - armed_rate) / bare_rate * 100.0
+
+        records, dropped = load_records(store)
+        report = (compare_against_history(records, records[-1])
+                  if records else {})
+        # identically-configured same-host runs must not read as a change
+        compare_ok = (len(records) == 3 and dropped == 0
+                      and report.get('verdict') in ('within-noise',
+                                                    'improved',
+                                                    'insufficient-history'))
+
+        log('history: armed {:.1f} rows/s vs bare {:.1f} rows/s ({:+.2f}% '
+            'historian+sentinel overhead); store round-trip {} ({} records, '
+            '{} dropped, self-compare verdict {})'.format(
+                armed_rate, bare_rate, overhead_pct,
+                'ok' if compare_ok else 'FAIL', len(records), dropped,
+                report.get('verdict')))
+        results.update({
+            'history_armed_rows_per_sec': round(armed_rate, 1),
+            'history_bare_rows_per_sec': round(bare_rate, 1),
+            'history_overhead_pct': round(overhead_pct, 2),
+            'history_records_written': len(records),
+            'history_frames_dropped': int(dropped),
+            'history_compare_ok': bool(compare_ok),
         })
 
     def run_schedule():
@@ -2728,6 +2845,7 @@ def child_main():
         'storage': run_storage,
         'lineage': run_lineage,
         'incidents': run_incidents,
+        'history': run_history,
         'chaos': run_chaos,
     }
     for name in SECTION_RUN_ORDER:

@@ -233,6 +233,44 @@ class AutotuneController(object):
                 except Exception:  # noqa: BLE001 - teardown must never raise out of stop()
                     pass
 
+    def warm_start(self, knob_values: Dict[str, float]) -> Dict[str, Any]:
+        """Seed the catalog's knobs from a prior run's recorded values
+        (``AutotunePolicy(warm_start=True)`` — the knob dict of a
+        longitudinal run record, telemetry/history.py). Each known knob is
+        clamped into its declared bounds and applied; unknown ids (a record
+        from a differently-shaped run) are skipped. Every seed lands in the
+        decision log as a ``warm_start`` action, so the report shows where
+        this run's starting point came from. Returns ``{knob_id: {'from',
+        'to'}}`` for the knobs that actually moved."""
+        applied: Dict[str, Any] = {}
+        with self._lock:
+            for knob_id in sorted(knob_values):
+                if knob_id not in self.catalog:
+                    continue
+                knob = self.catalog.knob(knob_id)
+                try:
+                    old = float(knob.get())
+                    target = knob.clamp(float(knob_values[knob_id]))
+                    if target == old:
+                        continue
+                    new = float(knob.apply(target))
+                except Exception:  # noqa: BLE001 - a dead knob target must not kill the seeding of the rest
+                    import logging
+                    logging.getLogger(__name__).debug(
+                        'warm start: knob %s failed to apply', knob_id,
+                        exc_info=True)
+                    continue
+                if new == old:
+                    continue  # pinned knob: apply() refused the turn
+                applied[knob_id] = {'from': old, 'to': new}
+                self._record('warm_start', knob_id=knob_id, from_value=old,
+                             to_value=new, reason='seeded from run history')
+            to_emit = self._pending_emits
+            self._pending_emits = []
+        for recorded in to_emit:
+            self._emit(recorded)
+        return applied
+
     def maybe_step(self) -> Optional[Decision]:
         """Window-gated :meth:`step` for host event loops (the dispatcher pump
         calls this per tick): runs at most once per ``policy.window_s``."""

@@ -44,6 +44,13 @@ class AutotunePolicy:
         ``None`` = every live knob in the catalog. An empty tuple yields a
         measure-only controller (samples and reports, never actuates) — what
         the bench overhead guard runs.
+    :param warm_start: seed the knobs from the newest same-dataset,
+        same-platform run record in the longitudinal history store before
+        the first window, so a retuned run starts from last run's converged
+        values instead of re-climbing from the defaults
+        (docs/observability.md "Longitudinal observatory"). Requires
+        ``history`` to be armed on the owner; gated off silently when the
+        store holds no comparable record.
     """
 
     window_s: float = 2.0
@@ -54,6 +61,7 @@ class AutotunePolicy:
     freeze_cooldown_windows: int = 2
     max_decisions: int = 64
     knob_ids: Optional[Tuple[str, ...]] = None
+    warm_start: bool = False
 
     def __post_init__(self) -> None:
         if self.window_s <= 0:

@@ -32,8 +32,11 @@ decode workers in one command — docs/service.md); ``chaos`` dispatches to
 dispatcher/worker kills mid-epoch against a ledger-armed fleet, verdict by
 rows-exact + lineage diff — docs/service.md "Failure modes"); ``doctor``
 dispatches to
-:mod:`petastorm_tpu.tools.doctor` (environment health report); anything else
-is the legacy dataset-throughput measurement."""
+:mod:`petastorm_tpu.tools.doctor` (environment health report); ``history``
+dispatches to :mod:`petastorm_tpu.telemetry.history` (longitudinal
+observatory: list/show/compare the cross-run goodput records, exit-coded by
+regression verdict — docs/observability.md "Longitudinal observatory");
+anything else is the legacy dataset-throughput measurement."""
 
 import argparse
 import logging
@@ -82,6 +85,9 @@ def main(argv=None):
     if argv and argv[0] == 'doctor':
         from petastorm_tpu.tools.doctor import main as doctor_main
         return doctor_main(argv[1:])
+    if argv and argv[0] == 'history':
+        from petastorm_tpu.telemetry.history import main as history_main
+        return history_main(argv[1:])
     parser = argparse.ArgumentParser(
         description='Measure petastorm_tpu reader throughput on a dataset')
     parser.add_argument('dataset_url')

@@ -22,6 +22,7 @@ import queue
 import sys
 import threading
 import time
+import warnings
 
 import numpy as np
 
@@ -216,6 +217,17 @@ class JaxDataLoader(object):
         (``make_reader(incidents=...)``) the loader reuses it instead of
         building a second one — docs/observability.md "Incident autopsy
         plane".
+    :param history: arm the longitudinal observatory at the loader layer
+        (docs/observability.md "Longitudinal observatory"): one ``owner:
+        'loader'`` run record (whole-pipeline rows/s, efficiency, stage
+        shares) is appended at :meth:`stop`, and a live regression sentinel
+        watches the training loop's own rows/s + wait-share series, firing a
+        ``perf_regression`` incident on a mid-run collapse. ``True``
+        (default policy), a store path string, or a
+        :class:`~petastorm_tpu.telemetry.history.HistoryPolicy`. With no
+        explicit path the loader records into the reader's store
+        (``make_reader(history=...)``); ``True`` with an unarmed reader
+        warns and disables (the loader has no dataset home of its own).
     """
 
     def __init__(self, reader, batch_size, mesh=None, partition_spec=None,
@@ -223,7 +235,7 @@ class JaxDataLoader(object):
                  pad_ragged=None, prefetch=2, drop_last=True, device_put=True,
                  coalesce_fields=None, device_transforms=None,
                  device_buffer_depth=2, metrics_port=None, slo_policy=None,
-                 incidents=None):
+                 incidents=None, history=None):
         if batch_size < 1:
             raise ValueError('batch_size must be >= 1')
         self.reader = reader
@@ -338,6 +350,47 @@ class JaxDataLoader(object):
                 self._incidents.on_breaker_transition)
         if self._incidents is not None:
             self._slo.observe_breaches(self._on_slo_breach)
+        # Longitudinal observatory at the loader layer (docs/observability.md
+        # "Longitudinal observatory"): an owner='loader' run record of the
+        # WHOLE pipeline at stop(), plus a loader-side regression sentinel
+        # over the training loop's own goodput series. With no explicit path
+        # the record lands in the reader's store (same journal, two owners).
+        from petastorm_tpu.telemetry.history import resolve_history_policy
+        self._history = None
+        self._history_policy = resolve_history_policy(history)
+        self._history_written = False
+        self._sentinel = None
+        if self._history_policy is not None:
+            from petastorm_tpu.telemetry.history import RunHistorian
+            from petastorm_tpu.telemetry.sentinel import (
+                RegressionSentinel, resolve_sentinel_policy)
+            history_path = self._history_policy.path
+            if history_path is None:
+                reader_history = getattr(reader, '_history', None)
+                history_path = getattr(reader_history, 'path', None)
+            if history_path is not None:
+                self._history = RunHistorian(history_path,
+                                             self._history_policy,
+                                             registry=self.telemetry)
+            else:
+                warnings.warn(
+                    'JaxDataLoader(history=...) has no store path: pass a '
+                    'path/HistoryPolicy(path=...), or arm the reader with '
+                    'make_reader(history=...) so the loader can record into '
+                    'its store — recording disabled for this run')
+            sentinel_policy = resolve_sentinel_policy(
+                self._history_policy.sentinel)
+            if sentinel_policy is not None:
+                self._sentinel = RegressionSentinel(
+                    sentinel_policy, owner='loader',
+                    registry=self.telemetry, incidents=self._incidents,
+                    dataset_token=getattr(reader, 'dataset_token', None))
+                if (self._incidents is not None
+                        and getattr(reader, '_sentinel', None) is None):
+                    # the bundle's 'sentinel' evidence slot belongs to the
+                    # reader's sentinel when one is armed there
+                    self._incidents.add_source('sentinel',
+                                               self._sentinel.report)
         # Live metrics plane (docs/observability.md): one scrape endpoint
         # over the whole-pipeline snapshot; closed by stop(). Started LAST —
         # a constructor raise after binding would leak the port and serve a
@@ -414,11 +467,18 @@ class JaxDataLoader(object):
                 if self._telemetry_jsonl is not None and self._telemetry_jsonl.due():
                     # one snapshot serves both legs: the periodic interval
                     # line AND the SLO evaluation (whose ok->breach
-                    # transition appends its own slo_breach line)
+                    # transition appends its own slo_breach line; the
+                    # regression sentinel rides the same evaluation)
                     snapshot = self.telemetry_snapshot()
                     self._evaluate_slo(snapshot)
                     self._telemetry_jsonl.emit(snapshot,
                                                event='loader_interval')
+                elif self._sentinel is not None:
+                    # no JSONL armed: the sentinel still needs its windows —
+                    # one float compare per batch between them
+                    from petastorm_tpu.telemetry.slo import slo_clock
+                    if self._sentinel.due(slo_clock() - self._started_at):
+                        self._evaluate_slo(self.telemetry_snapshot())
                 last_emit = now
                 self._mark_delivered(local_rows)
                 self._lineage_steps += 1
@@ -994,9 +1054,15 @@ class JaxDataLoader(object):
 
     def _evaluate_slo(self, snapshot):
         from petastorm_tpu.telemetry.slo import slo_clock
-        return self._slo.evaluate(snapshot, slo_clock() - self._started_at,
-                                  rows=self.stats.rows,
-                                  registry=self.telemetry)
+        report = self._slo.evaluate(snapshot, slo_clock() - self._started_at,
+                                    rows=self.stats.rows,
+                                    registry=self.telemetry)
+        if self._sentinel is not None:
+            # loader-side drift watch over the same cumulative series the
+            # SLO report carries (min_window_s enforced by the sentinel)
+            self._sentinel.observe(report)
+            self._sentinel.export_gauges()
+        return report
 
     def efficiency_report(self):
         """One input-efficiency SLO evaluation over this loader's lifetime
@@ -1017,6 +1083,11 @@ class JaxDataLoader(object):
         if report['efficiency'] is not None:
             gauges['slo_efficiency'] = report['efficiency']
         gauges['slo_target_efficiency'] = report['target_efficiency']
+        if self._sentinel is not None:
+            gauges.update(self._sentinel.gauges())
+        # the SLO tracker's trailing ring buffer rides the /vars document
+        # (a list, not a gauge — the text scrape ignores it)
+        snapshot['slo_history'] = report.get('history', [])
         return snapshot
 
     def _on_slo_breach(self, report):
@@ -1048,9 +1119,65 @@ class JaxDataLoader(object):
 
     # ------------------------------------------------------------------ lifecycle
 
+    def _write_history_record(self):
+        """Append the loader-layer run record (owner='loader': whole-pipeline
+        rows/s + shuffle_wait shares) to the longitudinal store. Idempotent;
+        advisory — a run that delivered its batches must not fail over its
+        memory."""
+        if self._history is None or self._history_written:
+            return
+        self._history_written = True
+        from petastorm_tpu.telemetry.history import (
+            build_run_record, fingerprint as _history_fingerprint)
+        from petastorm_tpu.telemetry.slo import (efficiency_from_snapshot,
+                                                 slo_clock)
+        try:
+            elapsed = slo_clock() - self._started_at
+            snapshot = self.telemetry_snapshot()
+            rows = self.stats.rows
+            slo_report = efficiency_from_snapshot(snapshot, elapsed,
+                                                  rows=rows)
+            reader_record = None
+            build_reader_record = getattr(self.reader, 'build_history_record',
+                                          None)
+            if build_reader_record is not None:
+                reader_record = build_reader_record()
+            fingerprints = dict((reader_record or {}).get('fingerprints', {}))
+            fingerprints['loader'] = _history_fingerprint({
+                'batch_size': self.batch_size,
+                'prefetch': self._prefetch,
+                'drop_last': self._drop_last,
+                'shuffling_queue_capacity': self._shuffling_queue_capacity,
+                'device_stage': self._device_stage is not None})
+            record = build_run_record(
+                'loader',
+                str(getattr(self.reader, 'dataset_token', 'unknown')),
+                elapsed, rows, snapshot=snapshot, slo_report=slo_report,
+                fingerprints=fingerprints,
+                knobs=dict((reader_record or {}).get('knobs', {})),
+                incidents=self.incident_report(),
+                quarantined=(reader_record or {}).get('quarantined', 0))
+            self._history.append(record)
+        except Exception:  # noqa: BLE001 - the historian is advisory
+            import logging
+            logging.getLogger(__name__).warning(
+                'could not record this run in the history store',
+                exc_info=True)
+
+    def history_report(self):
+        """The loader-layer historian's store status; None when built
+        without ``history`` (docs/observability.md "Longitudinal
+        observatory")."""
+        if self._history is None:
+            return None
+        return self._history.state()
+
     def stop(self):
         if self._metrics_server is not None:
             self._metrics_server.stop()
+        # the loader's run record first: the reader's own stop() below
+        # appends its reader-layer record to the same store
+        self._write_history_record()
         if self._owns_incidents and self._incidents is not None:
             # reader-owned recorders are the reader's to close
             self._incidents.close()

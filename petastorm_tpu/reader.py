@@ -124,7 +124,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 heartbeat_interval_s=None, trace=None, service_url=None,
                 autotune=None, device_decode_fields=None, metrics_port=None,
                 slo_policy=None, cost_schedule=None, lineage=None,
-                incidents=None, storage_policy=None):
+                incidents=None, storage_policy=None, history=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -269,7 +269,23 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     :class:`~petastorm_tpu.storage.StoragePolicy` always does. Counters and
     ``range_fetch``/``range_hedge`` stage timings land in
     :meth:`Reader.telemetry_snapshot`; per-rowgroup fetch costs flow into
-    the cost ledger so ``cost_schedule`` prices network I/O too."""
+    the cost ledger so ``cost_schedule`` prices network I/O too.
+
+    Longitudinal observatory (docs/observability.md "Longitudinal
+    observatory"): ``history`` arms the cross-run goodput historian — one
+    structured run record (config/knob/storage/schedule fingerprints,
+    rows/s, goodput efficiency, per-stage time shares, storage counters,
+    incident/quarantine counts) is appended at ``stop()`` to an append-only
+    CRC-framed store keyed by :attr:`Reader.dataset_token`, which
+    ``petastorm-tpu-throughput history list|show|compare`` diffs against a
+    robust trailing baseline with change-point attribution. Arming history
+    also arms the live regression sentinel (an EWMA + Page–Hinkley drift
+    test over the run's own rows/s and wait-share series) that fires a
+    ``perf_regression`` incident on a mid-run goodput collapse. ``True``
+    (default policy), a store path string, or a
+    :class:`~petastorm_tpu.telemetry.history.HistoryPolicy` (its
+    ``sentinel`` field tunes/disables the sentinel). Unset (None, the
+    default) records nothing and keeps every path byte-identical."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -335,7 +351,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   autotune=autotune, device_decode_fields=device_decode_fields,
                   metrics_port=metrics_port, slo_policy=slo_policy,
                   cost_schedule=cost_schedule, lineage=lineage,
-                  incidents=incidents, storage_policy=storage_policy)
+                  incidents=incidents, storage_policy=storage_policy,
+                  history=history)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -352,13 +369,15 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       heartbeat_interval_s=None, trace=None, service_url=None,
                       autotune=None, device_decode_fields=None,
                       metrics_port=None, slo_policy=None, cost_schedule=None,
-                      lineage=None, incidents=None, storage_policy=None):
+                      lineage=None, incidents=None, storage_policy=None,
+                      history=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
     ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
     ``service_url`` / ``autotune`` / ``metrics_port`` / ``slo_policy`` /
-    ``cost_schedule`` / ``lineage`` / ``incidents`` / ``storage_policy``
+    ``cost_schedule`` / ``lineage`` / ``incidents`` / ``storage_policy`` /
+    ``history``
     behave exactly as in
     :func:`make_reader`.
     ``device_decode_fields`` (docs/performance.md "Device-resident decode
@@ -438,7 +457,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   autotune=autotune, device_decode_fields=device_decode_fields,
                   metrics_port=metrics_port, slo_policy=slo_policy,
                   cost_schedule=cost_schedule, lineage=lineage,
-                  incidents=incidents, storage_policy=storage_policy)
+                  incidents=incidents, storage_policy=storage_policy,
+                  history=history)
 
 
 class Reader(object):
@@ -454,7 +474,7 @@ class Reader(object):
                  on_error='raise', retry_policy=None, initial_io_retries=0,
                  autotune=None, device_decode_fields=None, metrics_port=None,
                  slo_policy=None, cost_schedule=None, lineage=None,
-                 incidents=None, storage_policy=None):
+                 incidents=None, storage_policy=None, history=None):
         from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
         retry_policy = resolve_retry_policy(on_error, retry_policy)
         construction_retries = [initial_io_retries]
@@ -514,6 +534,16 @@ class Reader(object):
         from petastorm_tpu.telemetry.incident import resolve_incident_policy
         self._incidents = None
         self._incident_policy = resolve_incident_policy(incidents)
+        # Longitudinal observatory (docs/observability.md "Longitudinal
+        # observatory"): policy resolved up front; the historian + sentinel
+        # are built after the incident plane so the sentinel can file its
+        # perf_regression bundles there. Unset => nothing is built.
+        from petastorm_tpu.telemetry.history import resolve_history_policy
+        self._history = None
+        self._history_policy = resolve_history_policy(history)
+        self._history_written = False
+        self._history_fingerprints = {}
+        self._sentinel = None
         # edge-detection state for the poll-based triggers (all consumed
         # under _accounting_lock in _note_item_consumed)
         self._incident_last_divergence = 0
@@ -957,6 +987,60 @@ class Reader(object):
                 self._incidents.on_breaker_transition)
             self._slo.observe_breaches(self._on_slo_breach)
 
+        # Longitudinal observatory (docs/observability.md "Longitudinal
+        # observatory"): the historian appends one run record at stop();
+        # the sentinel watches this run's own rows/s + wait-share series and
+        # fires the edge-triggered perf_regression anomaly into the
+        # incident plane on a mid-run collapse.
+        if self._history_policy is not None:
+            from petastorm_tpu.dataset_state import cache_state_home
+            from petastorm_tpu.telemetry.history import (RunHistorian,
+                                                         default_history_path,
+                                                         fingerprint)
+            from petastorm_tpu.telemetry.sentinel import (
+                RegressionSentinel, resolve_sentinel_policy)
+            url_for_history = dataset_url_or_urls if not isinstance(
+                dataset_url_or_urls, list) else dataset_url_or_urls[0]
+            history_path = (self._history_policy.path
+                            or default_history_path(url_for_history,
+                                                    cache_state_home(cache)))
+            if history_path is not None:
+                self._history = RunHistorian(history_path,
+                                             self._history_policy,
+                                             registry=self._telemetry)
+            # the run's configuration identity, frozen now so the record
+            # written at stop() attributes with construction-time truth
+            self._history_fingerprints = {
+                'config': fingerprint({
+                    'seed': seed, 'num_epochs': num_epochs,
+                    'shuffle_row_groups': bool(shuffle_row_groups),
+                    'shuffle_rows': bool(shuffle_rows),
+                    'cur_shard': cur_shard, 'shard_count': shard_count,
+                    'on_error': on_error,
+                    'pool': type(reader_pool).__name__,
+                    'batched': bool(is_batched_reader),
+                    'transform': transform_spec is not None,
+                    'device_decode_fields': sorted(self.device_decode_fields),
+                    'items_per_epoch': self._items_per_epoch}),
+                'storage': (fingerprint(repr(self._storage_policy))
+                            if self._storage_policy is not None else None),
+                'schedule': (self._cost_scheduler.plan_fingerprint()
+                             if self._cost_scheduler is not None else None),
+            }
+            sentinel_policy = resolve_sentinel_policy(
+                self._history_policy.sentinel)
+            if sentinel_policy is not None:
+                self._sentinel = RegressionSentinel(
+                    sentinel_policy, owner='reader',
+                    registry=self._telemetry, incidents=self._incidents,
+                    dataset_token=self.dataset_token)
+                if self._incidents is not None:
+                    self._incidents.add_source('sentinel',
+                                               self._sentinel.report)
+            if (self._autotune is not None and self._history is not None
+                    and getattr(autotune_policy, 'warm_start', False)):
+                self._warm_start_autotune()
+
         # Live metrics plane (docs/observability.md): one scrape endpoint
         # over this reader's cross-process snapshot; SLO gauges refresh per
         # scrape. Started last so a scrape can never observe a half-built
@@ -1142,6 +1226,13 @@ class Reader(object):
                 self._incidents.trigger(
                     'shm_crc_drop',
                     args={'shm_crc_failures': crc_failures})
+        if self._sentinel is not None:
+            # live drift watch (docs/observability.md "Longitudinal
+            # observatory"): one float compare per batch between windows;
+            # the snapshot + evaluation only run when a window is due
+            from petastorm_tpu.telemetry.slo import slo_clock
+            if self._sentinel.due(slo_clock() - self._started_at):
+                self._evaluate_slo(self.telemetry_snapshot())
         item_id = getattr(batch, 'item_id', None)
         if item_id is None:
             return
@@ -1339,9 +1430,16 @@ class Reader(object):
 
     def _evaluate_slo(self, snapshot):
         from petastorm_tpu.telemetry.slo import slo_clock
-        return self._slo.evaluate(snapshot, slo_clock() - self._started_at,
-                                  rows=self.rows_consumed,
-                                  registry=self._telemetry)
+        report = self._slo.evaluate(snapshot, slo_clock() - self._started_at,
+                                    rows=self.rows_consumed,
+                                    registry=self._telemetry)
+        if self._sentinel is not None:
+            # the regression sentinel windows the same cumulative series the
+            # SLO report carries; it enforces its own min_window_s, so extra
+            # evaluations (scrapes, diagnostics) cannot shrink a window
+            self._sentinel.observe(report)
+            self._sentinel.export_gauges()
+        return report
 
     def efficiency_report(self):
         """One input-efficiency SLO evaluation over this reader's lifetime
@@ -1416,6 +1514,95 @@ class Reader(object):
             return None
         return self._incidents.report()
 
+    # ------------------------------------------- longitudinal observatory
+
+    def build_history_record(self):
+        """The structured run record this reader would append at ``stop()``
+        (docs/observability.md "Longitudinal observatory"): fingerprints,
+        headline rows/s + efficiency, per-stage time shares, storage
+        counters, incident/quarantine counts. None when built without
+        ``history``. Knob values are read live, so call before ``stop()``
+        restores the autotuner's knobs to see what the run actually ran
+        with."""
+        if self._history_policy is None:
+            return None
+        from petastorm_tpu.telemetry.history import build_run_record, fingerprint
+        from petastorm_tpu.telemetry.slo import (efficiency_from_snapshot,
+                                                 slo_clock)
+        elapsed = slo_clock() - self._started_at
+        snapshot = self.telemetry_snapshot()
+        rows = self.rows_consumed
+        slo_report = efficiency_from_snapshot(snapshot, elapsed, rows=rows)
+        knobs = {}
+        try:
+            from petastorm_tpu.autotune.knobs import build_reader_knobs
+            knobs = {knob.knob_id: float(knob.get())
+                     for knob in build_reader_knobs(self)}
+        except Exception:  # noqa: BLE001 - the record is advisory; a dead knob target must not fail stop()
+            logger.debug('history: knob capture failed', exc_info=True)
+        fingerprints = dict(self._history_fingerprints)
+        fingerprints['knobs'] = fingerprint(knobs) if knobs else None
+        cost_skew = None
+        if self._cost_scheduler is not None:
+            cost_skew = self._cost_scheduler.cost_skew()
+        return build_run_record(
+            'reader', self.dataset_token, elapsed, rows,
+            snapshot=snapshot, slo_report=slo_report,
+            fingerprints=fingerprints, knobs=knobs,
+            incidents=self.incident_report(),
+            quarantined=len(self.quarantine), cost_skew=cost_skew)
+
+    def _warm_start_autotune(self):
+        """``AutotunePolicy(warm_start=True)``: seed the live knobs from the
+        newest same-token, same-platform run record before the controller's
+        first window, so this run starts from last run's converged values
+        instead of re-climbing from the defaults. Gated off — with a debug
+        line, never an error — when the store holds no comparable record
+        (first run, or the platform changed)."""
+        from petastorm_tpu.telemetry.history import (last_good_record,
+                                                     load_records,
+                                                     run_platform)
+        try:
+            records, _dropped = load_records(self._history.path)
+            record = last_good_record(records, self.dataset_token,
+                                      run_platform())
+            if record is None:
+                logger.debug('autotune warm start: no comparable run record '
+                             'in %s; starting from defaults',
+                             self._history.path)
+                return
+            applied = self._autotune.warm_start(record.get('knobs') or {})
+            if applied:
+                logger.info('autotune warm start: seeded %s from the run '
+                            'recorded at %s',
+                            {k: v['to'] for k, v in applied.items()},
+                            record.get('recorded_unix_s'))
+        except Exception:  # noqa: BLE001 - warm start is an optimization; failure means defaults, not a dead reader
+            logger.warning('autotune warm start failed; starting from '
+                           'defaults', exc_info=True)
+
+    def _write_history_record(self):
+        """Append this run's record to the longitudinal store — called from
+        ``stop()`` BEFORE the autotuner restores its knobs (the record must
+        capture the values the run actually ran with). Idempotent."""
+        if self._history is None or self._history_written:
+            return
+        self._history_written = True
+        try:
+            record = self.build_history_record()
+            if record is not None:
+                self._history.append(record)
+        except Exception:  # noqa: BLE001 - the historian is advisory; a read that succeeded must not fail over its memory
+            logger.warning('could not record this run in the history store',
+                           exc_info=True)
+
+    def history_report(self):
+        """The historian's store status (path, appended count, dropped
+        frames); None when the reader was built without ``history``."""
+        if self._history is None:
+            return None
+        return self._history.state()
+
     # ------------------------------------------------------- metrics plane
 
     def _snapshot_with_slo(self):
@@ -1435,6 +1622,13 @@ class Reader(object):
             lineage = self._lineage.report()
             gauges['lineage_items_folded'] = lineage['items_folded']
             gauges['lineage_pending_items'] = lineage['pending_items']
+        if self._sentinel is not None:
+            # the smoothed drift series (sentinel_rate_ewma /
+            # sentinel_wait_share_ewma) ride the same scrape
+            gauges.update(self._sentinel.gauges())
+        # the SLO tracker's trailing ring buffer rides the /vars document
+        # (a list, not a gauge — the text scrape ignores it)
+        snapshot['slo_history'] = report.get('history', [])
         return snapshot, report
 
     def _scrape_snapshot(self):
@@ -1493,6 +1687,10 @@ class Reader(object):
             # the scrape plane goes first: a scrape against a tearing-down
             # pool would race the very state it reports
             self._metrics_server.stop()
+        # the longitudinal run record is written BEFORE the autotuner stops:
+        # autotune.stop() restores the pre-tuning knob values, and the
+        # record must capture what the run actually ran with
+        self._write_history_record()
         if self._autotune is not None:
             # the controller must stop turning knobs before the pool they
             # actuate starts tearing down
@@ -1578,6 +1776,11 @@ class Reader(object):
         # Incident autopsy block only when armed, same contract.
         if self._incidents is not None:
             diag['incidents'] = self._incidents.report()
+        # Longitudinal observatory blocks only when armed, same contract.
+        if self._history is not None:
+            diag['history'] = self._history.state()
+        if self._sentinel is not None:
+            diag['sentinel'] = self._sentinel.report()
         # Storage ingest-engine block only when armed, same contract: the
         # counter roll-up doctor and dashboards read (footer-cache hits,
         # ranges coalesced, hedges fired/won — docs/performance.md

@@ -573,6 +573,18 @@ class CostAwareScheduler(object):
 
     # -------------------------------------------------------------- report
 
+    def cost_skew(self) -> Optional[float]:
+        """p95-over-median skew of the ledger's per-rowgroup costs — the
+        longitudinal run record's ``cost_skew_p95_over_median`` field
+        (docs/observability.md "Longitudinal observatory"); None on a cold
+        start (no ledger, nothing to skew)."""
+        totals = sorted(self._totals.values())
+        if not totals or self._median <= 0.0:
+            return None
+        p95 = totals[min(len(totals) - 1,
+                         int(round(0.95 * (len(totals) - 1))))]
+        return p95 / self._median
+
     def report(self) -> Dict[str, Any]:
         """JSON-safe schedule view for ``Reader.diagnostics['schedule']``:
         the policy, ledger coverage, split decisions, heavy count, recent

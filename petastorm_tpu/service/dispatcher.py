@@ -124,6 +124,10 @@ DEFAULT_MAX_ITEM_ATTEMPTS = 5
 #: before the dispatcher garbage-collects its record + setups — an alive
 #: client that got collected anyway simply ``rejoin``s on its next submit
 DEFAULT_CLIENT_TTL_S = 900.0
+#: dataset token the dispatcher's run-history records are keyed by — the
+#: service serves many datasets, so its longitudinal series is keyed by the
+#: service itself, not any one dataset (telemetry/history.py)
+SERVICE_DATASET_TOKEN = 'service'
 
 
 class _ClientState(object):
@@ -1007,7 +1011,8 @@ class Dispatcher(object):
                  autotune: Any = None,
                  metrics_port: Optional[int] = None,
                  incidents: Any = None,
-                 ledger: Optional[str] = None) -> None:
+                 ledger: Optional[str] = None,
+                 history: Any = None) -> None:
         self._host = host
         self._port = port
         #: durable token ledger (service/ledger.py): a journal path arms it;
@@ -1078,6 +1083,38 @@ class Dispatcher(object):
                 policy=autotune_policy,
                 choose_fn=choose_service_knob,
                 name='service')
+        # Longitudinal observatory (docs/observability.md "Longitudinal
+        # observatory"): one structured run record at stop() plus a live
+        # regression sentinel over the pump's items-served series. The
+        # dispatcher has no dataset home, so persisting records needs an
+        # explicit store path (``history=HistoryPolicy(path=...)`` or a
+        # path string); ``history=True`` still arms the sentinel.
+        self._history: Any = None
+        self._sentinel: Any = None
+        self._history_written = False
+        self._started_at: Optional[float] = None
+        from petastorm_tpu.telemetry.history import resolve_history_policy
+        self._history_policy = resolve_history_policy(history)
+        if self._history_policy is not None:
+            from petastorm_tpu.telemetry.sentinel import (
+                RegressionSentinel, resolve_sentinel_policy)
+            if self._history_policy.path:
+                from petastorm_tpu.telemetry.history import RunHistorian
+                self._history = RunHistorian(
+                    self._history_policy.path,
+                    policy=self._history_policy,
+                    registry=self._incident_registry)
+            sentinel_policy = resolve_sentinel_policy(
+                self._history_policy.sentinel)
+            if sentinel_policy is not None:
+                self._sentinel = RegressionSentinel(
+                    sentinel_policy, owner='dispatcher',
+                    registry=self._incident_registry,
+                    incidents=self._incident_recorder,
+                    dataset_token=SERVICE_DATASET_TOKEN)
+                if self._incident_recorder is not None:
+                    self._incident_recorder.add_source(
+                        'sentinel', self._sentinel.report)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -1118,6 +1155,7 @@ class Dispatcher(object):
             else:
                 raise RuntimeError('could not find an adjacent free port '
                                    'pair: {!r}'.format(last_error))
+        self._started_at = time.monotonic()
         self._thread = threading.Thread(target=self._pump, daemon=True,
                                         name='petastorm-tpu-dispatcher')
         self._thread.start()
@@ -1194,6 +1232,10 @@ class Dispatcher(object):
             state['autotune'] = self._autotune.report()
         if self._incident_recorder is not None:
             state['incidents'] = self.incidents_state()
+        if self._history is not None:
+            state['history'] = self._history.state()
+        if self._sentinel is not None:
+            state['sentinel'] = self._sentinel.report()
         return state
 
     def ledger_state(self) -> Dict[str, Any]:
@@ -1203,6 +1245,75 @@ class Dispatcher(object):
         if self._ledger is None:
             return {'armed': False}
         out: Dict[str, Any] = self._ledger.state()
+        return out
+
+    # ----------------------------------------------------- run history plane
+
+    def build_history_record(self) -> Optional[Dict[str, Any]]:
+        """The structured run record this dispatcher would append at
+        ``stop()`` (docs/observability.md "Longitudinal observatory"):
+        service config/knob fingerprints, items-served rate, incident
+        counters. None when built without ``history``. Knob values are read
+        live — call before the autotuner would restore anything."""
+        if self._history_policy is None:
+            return None
+        from petastorm_tpu.telemetry.history import (build_run_record,
+                                                     fingerprint)
+        elapsed = 0.0
+        if self._started_at is not None:
+            elapsed = max(time.monotonic() - self._started_at, 0.0)
+        scheduler = self.scheduler
+        knobs: Dict[str, float] = {}
+        try:
+            from petastorm_tpu.autotune.knobs import build_service_knobs
+            knobs = {knob.knob_id: float(knob.get())
+                     for knob in build_service_knobs(scheduler)}
+        except Exception:  # noqa: BLE001 - the record is advisory; a dead knob target must not fail stop()
+            logger.debug('history: service knob capture failed',
+                         exc_info=True)
+        fingerprints: Dict[str, Optional[str]] = {
+            'config': fingerprint({
+                'admission_window': scheduler.admission_window,
+                'quantum': scheduler.quantum,
+                'stale_timeout_s': scheduler.stale_timeout_s,
+                'max_item_attempts': scheduler.max_item_attempts,
+                'item_deadline_s': scheduler.item_deadline_s,
+                'client_ttl_s': scheduler.client_ttl_s,
+                'ledger': bool(self._ledger_path),
+            }),
+            'knobs': fingerprint(knobs) if knobs else None,
+        }
+        incidents: Optional[Dict[str, Any]] = None
+        if self._incident_recorder is not None:
+            incidents = self._incident_recorder.report()
+        return build_run_record(
+            'dispatcher', SERVICE_DATASET_TOKEN, elapsed,
+            int(scheduler.items_served),
+            snapshot=self.fleet_metrics_snapshot(),
+            fingerprints=fingerprints, knobs=knobs,
+            incidents=incidents)
+
+    def _write_history_record(self) -> None:
+        """Append this run's record to the longitudinal store — idempotent,
+        best-effort, skipped entirely without an explicit store path (the
+        dispatcher has no dataset home to default into)."""
+        if self._history is None or self._history_written:
+            return
+        self._history_written = True
+        try:
+            record = self.build_history_record()
+            if record is not None:
+                self._history.append(record)
+        except Exception:  # noqa: BLE001 - history is advisory; a service that served must not fail over its memory
+            logger.warning('dispatcher: could not record this run in the '
+                           'history store', exc_info=True)
+
+    def history_report(self) -> Optional[Dict[str, Any]]:
+        """The historian's store status (path, appended count, dropped
+        frames); None when built without a history store path."""
+        if self._history is None:
+            return None
+        out: Dict[str, Any] = self._history.state()
         return out
 
     # -------------------------------------------------------- metrics plane
@@ -1334,6 +1445,10 @@ class Dispatcher(object):
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None
+        # record BEFORE the incident recorder closes so the record's
+        # incident counters see the final capture totals, and before the
+        # pump exits so items_served is this run's true total
+        self._write_history_record()
         if self._incident_recorder is not None:
             self._incident_recorder.close()
         self._stop_event.set()
@@ -1413,6 +1528,18 @@ class Dispatcher(object):
                 except Exception:  # noqa: BLE001 - the tuner must never kill the dispatch loop it tunes
                     logger.exception('dispatcher: autotune step failed; '
                                      'pump keeps dispatching')
+            if self._sentinel is not None and self._started_at is not None:
+                # items-served is the service's rows analog; between window
+                # closes this costs one float compare per pump tick
+                elapsed = time.monotonic() - self._started_at
+                if self._sentinel.due(elapsed):
+                    try:
+                        self._sentinel.observe_sample(
+                            elapsed, int(self.scheduler.items_served))
+                        self._sentinel.export_gauges()
+                    except Exception:  # noqa: BLE001 - the sentinel must never kill the dispatch loop it watches
+                        logger.exception('dispatcher: sentinel window '
+                                         'failed; pump keeps dispatching')
             self._dispatch_ready()
         if not self._crashed:
             self._drain_worker_tail()

@@ -63,7 +63,12 @@ class ServiceFleet(object):
     path) arms the dispatcher's durable token ledger — the
     epoch-survivable control plane that lets :meth:`crash_dispatcher`
     restart the dispatcher mid-epoch without re-delivering retired work
-    or losing in-flight items (docs/service.md "Failure modes")."""
+    or losing in-flight items (docs/service.md "Failure modes").
+    ``history`` (True, a store path, or a
+    :class:`~petastorm_tpu.telemetry.history.HistoryPolicy`) arms the
+    longitudinal observatory: the dispatcher records one run record at
+    stop and watches its items-served rate with the live regression
+    sentinel — docs/observability.md "Longitudinal observatory"."""
 
     def __init__(self, workers: int = 2, host: str = '127.0.0.1',
                  port: Optional[int] = None,
@@ -80,7 +85,8 @@ class ServiceFleet(object):
                  autotune: Any = None,
                  metrics_port: Optional[int] = None,
                  incidents: Any = None,
-                 ledger: Any = None) -> None:
+                 ledger: Any = None,
+                 history: Any = None) -> None:
         self._initial_workers = workers
         self._cache_dir = cache_dir
         self._cache_size_limit = cache_size_limit
@@ -88,6 +94,7 @@ class ServiceFleet(object):
         self._heartbeat_interval_s = heartbeat_interval_s
         self._incidents = incidents
         self._ledger_path = self._resolve_ledger(ledger)
+        self._history_policy = self._resolve_history(history)
         # the dispatcher's construction arguments, kept so crash_dispatcher
         # can rebuild an identical incarnation on the same port
         self._dispatcher_kwargs: Dict[str, Any] = dict(
@@ -96,7 +103,8 @@ class ServiceFleet(object):
             max_item_attempts=max_item_attempts,
             item_deadline_s=item_deadline_s, client_ttl_s=client_ttl_s,
             autotune=autotune, metrics_port=metrics_port,
-            incidents=incidents, ledger=self._ledger_path)
+            incidents=incidents, ledger=self._ledger_path,
+            history=self._history_policy)
         self.dispatcher = Dispatcher(**self._dispatcher_kwargs)
         self.processes: List[subprocess.Popen] = []
         self._next_worker_id = 0
@@ -115,6 +123,32 @@ class ServiceFleet(object):
             prefix='petastorm-tpu-ledger-')
         os.makedirs(home, exist_ok=True)
         return os.path.join(home, LEDGER_BASENAME)
+
+    def _resolve_history(self, history: Any) -> Any:
+        """``None``/``False`` → off; a path (or path-carrying policy) passes
+        through; ``True`` / a path-less policy gets a store under the fleet
+        cache directory (or a private temp directory when cacheless) —
+        unlike a bare dispatcher, the fleet always has a home to persist
+        its longitudinal series in."""
+        import dataclasses
+        from petastorm_tpu.telemetry.history import (HISTORY_BASENAME,
+                                                     resolve_history_policy)
+        policy = resolve_history_policy(history)
+        if policy is None or policy.path:
+            return policy
+        home = self._cache_dir or tempfile.mkdtemp(
+            prefix='petastorm-tpu-history-')
+        os.makedirs(home, exist_ok=True)
+        return dataclasses.replace(
+            policy, path=os.path.join(home, HISTORY_BASENAME))
+
+    @property
+    def history_path(self) -> Optional[str]:
+        """The run-history store path (None when the observatory is off)."""
+        if self._history_policy is None:
+            return None
+        path: Optional[str] = self._history_policy.path
+        return path
 
     # ------------------------------------------------------------ lifecycle
 
@@ -303,6 +337,14 @@ def serve(argv: Optional[List[str]] = None) -> int:
                              'the cache dir) so a restarted dispatcher '
                              'resumes mid-epoch — docs/service.md '
                              '"Failure modes"')
+    parser.add_argument('--history', nargs='?', const=True, default=None,
+                        metavar='PATH',
+                        help='arm the longitudinal observatory: record one '
+                             'run record per dispatcher life to PATH (bare '
+                             '--history uses the cache dir) and watch the '
+                             'items-served rate with the live regression '
+                             'sentinel — docs/observability.md '
+                             '"Longitudinal observatory"')
     parser.add_argument('--state-interval', type=float, default=30.0,
                         help='seconds between state summaries (0 = quiet)')
     parser.add_argument('--json', action='store_true',
@@ -316,7 +358,7 @@ def serve(argv: Optional[List[str]] = None) -> int:
         shm_results=not args.no_shm, admission_window=args.admission_window,
         item_deadline_s=args.item_deadline_s, autotune=args.autotune,
         metrics_port=args.metrics_port, incidents=args.incidents or None,
-        ledger=args.ledger)
+        ledger=args.ledger, history=args.history)
     url = fleet.start()
     print('petastorm-tpu input service running at {} ({} worker(s); '
           'workers register on port {}). Point readers at '

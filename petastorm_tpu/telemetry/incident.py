@@ -87,6 +87,11 @@ TRIGGER_KINDS: Tuple[str, ...] = (
     'ledger_corrupt',      # the dispatcher's durable token ledger failed CRC
                            # replay and the fleet degraded to
                            # replay-from-clients (service/ledger.py)
+    'perf_regression',     # the live regression sentinel's drift test fired
+                           # on a mid-run goodput collapse or wait-share
+                           # growth (telemetry/sentinel.py,
+                           # docs/observability.md "Longitudinal
+                           # observatory")
 )
 
 #: ranked-cause classes the autopsy report can name, with their CLI exit
@@ -112,6 +117,7 @@ _CAUSE_FOR_TRIGGER: Dict[str, str] = {
     'service_poison_item': 'hang',
     'reshard': 'scheduling-skew',
     'ledger_corrupt': 'corruption',
+    'perf_regression': 'scheduling-skew',
 }
 
 #: bundle directory name prefix (retention and the doctor scan key off it)
@@ -739,6 +745,19 @@ def analyze_bundle(bundle: str) -> Dict[str, Any]:
     if n:
         score('scheduling-skew', 0.5, '{} slo_breach instant(s) in the '
                                       'trace window'.format(n))
+    n = _instant_count(events, 'perf_regression')
+    if n:
+        score('scheduling-skew', 1.0, '{} perf_regression instant(s) in the '
+                                      'trace window'.format(n))
+    sentinel = evidence.get('sentinel')
+    if isinstance(sentinel, dict) and sentinel.get('alarms'):
+        evidence_doc = sentinel.get('last_alarm') or {}
+        score('scheduling-skew', 1.0,
+              'regression sentinel fired {} time(s); last: {} {} -> {}'
+              .format(sentinel.get('alarms'),
+                      evidence_doc.get('series', 'rate'),
+                      evidence_doc.get('pre_rate_rows_per_sec'),
+                      evidence_doc.get('post_rate_rows_per_sec')))
 
     # divergence: lineage report, divergence instants
     lineage = evidence.get('lineage')

@@ -36,11 +36,12 @@ scrape (the gauges refresh per scrape).
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Deque, Dict, Optional
 
 from petastorm_tpu.telemetry import registry as _registry
 from petastorm_tpu.telemetry import tracing as _tracing
@@ -147,9 +148,15 @@ class SloTracker(object):
     refresh. Thread-safe — ``diagnostics`` and a scrape thread may evaluate
     concurrently."""
 
+    #: evaluation points the in-process ring buffer retains (the short
+    #: longitudinal tail ``efficiency_report()['history']`` / ``/vars``
+    #: expose — docs/observability.md "Longitudinal observatory")
+    HISTORY_SIZE = 32
+
     def __init__(self, policy: Optional[SloPolicy] = None,
                  jsonl: Optional[JsonlEventLogger] = None,
-                 on_breach: Optional[Callable[[Dict[str, Any]], None]] = None) -> None:
+                 on_breach: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 history_size: int = HISTORY_SIZE) -> None:
         self.policy = policy if policy is not None else SloPolicy()
         self._jsonl = jsonl
         self._on_breach = on_breach
@@ -157,6 +164,8 @@ class SloTracker(object):
         self._breaches = 0
         self._evaluations = 0
         self._in_breach = False
+        self._history: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=max(int(history_size), 1))
 
     def observe_breaches(self, callback: Callable[[Dict[str, Any]], None]) -> None:
         """Attach (or replace) the ok→breach edge observer: called once per
@@ -171,14 +180,26 @@ class SloTracker(object):
         with self._lock:
             return self._breaches
 
+    def history(self) -> list:
+        """The trailing evaluated points (oldest first, bounded by
+        ``history_size``): ``{'elapsed_s', 'efficiency',
+        'goodput_rows_per_sec', 'wait_seconds', 'breached'}`` each — the
+        in-process tail of the longitudinal series the run historian
+        persists across runs (telemetry/history.py). Also carried on every
+        :meth:`evaluate` report as ``report['history']`` and in the
+        ``/vars`` document as ``slo_history``."""
+        with self._lock:
+            return [dict(point) for point in self._history]
+
     def evaluate(self, snapshot: Dict[str, Any], elapsed_s: float,
                  rows: int = 0,
                  registry: Optional[MetricsRegistry] = None) -> Dict[str, Any]:
         """One SLO evaluation: the efficiency report plus breach state.
 
         Adds ``{'target_efficiency', 'met', 'breached', 'evaluated',
-        'breaches', 'evaluations'}`` to the :func:`efficiency_from_snapshot`
-        fields. ``evaluated`` is False below ``min_elapsed_s``: the report
+        'breaches', 'evaluations', 'history'}`` to the
+        :func:`efficiency_from_snapshot` fields (``history`` is the
+        tracker's trailing ring buffer — :meth:`history`). ``evaluated`` is False below ``min_elapsed_s``: the report
         then carries the explicit not-enough-data shape — ``efficiency``
         (and ``starvation_fraction``) are ``None``, ``reason`` says
         ``'not_enough_data'``, no breach is counted and no gauge is set, so
@@ -200,10 +221,20 @@ class SloTracker(object):
             is_transition = breached and not self._in_breach
             if evaluated:
                 self._in_breach = breached
+                # ring-buffer tail of evaluated points (warmup windows carry
+                # no efficiency and would only pad the series with Nones)
+                self._history.append({
+                    'elapsed_s': report['elapsed_s'],
+                    'efficiency': report['efficiency'],
+                    'goodput_rows_per_sec': report['goodput_rows_per_sec'],
+                    'wait_seconds': report['wait_seconds'],
+                    'breached': breached,
+                })
             if is_transition:
                 self._breaches += 1
             breaches = self._breaches
             evaluations = self._evaluations
+            history = [dict(point) for point in self._history]
         report.update({
             'target_efficiency': target,
             'met': not breached,
@@ -211,6 +242,7 @@ class SloTracker(object):
             'evaluated': evaluated,
             'breaches': breaches,
             'evaluations': evaluations,
+            'history': history,
         })
         if registry is not None and _registry.telemetry_enabled():
             if evaluated:

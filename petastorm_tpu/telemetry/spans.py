@@ -116,6 +116,18 @@ COUNTERS = (
     'storage_hedge_won',          # the hedge returned before the primary
                                   # (its bytes were committed; the primary's
                                   # were dropped)
+    'perf_regression',            # the live regression sentinel's drift test
+                                  # fired on a goodput collapse / wait-share
+                                  # growth (edge-triggered: one count per
+                                  # alarm — telemetry/sentinel.py,
+                                  # docs/observability.md "Longitudinal
+                                  # observatory")
+    'history_record_written',     # one run record was appended to the
+                                  # longitudinal run-history store
+                                  # (telemetry/history.py)
+    'history_frames_dropped',     # run-history journal frames that failed
+                                  # CRC replay (torn tail / flipped byte —
+                                  # telemetry/history.py)
 )
 
 #: declared size histograms (``registry.observe(name, n, unit=BYTES_UNIT)``
@@ -145,6 +157,7 @@ TRACE_INSTANTS = (
     'incident_captured',   # an incident bundle was written at this point on the timeline (telemetry/incident.py)
     'reshard',             # undelivered service work was re-split across a changed worker set (dispatcher; service/dispatcher.py)
     'ledger_replay',       # a restarting dispatcher replayed its durable token ledger (service/ledger.py)
+    'perf_regression',     # the live regression sentinel fired mid-run (consumer/dispatcher; telemetry/sentinel.py)
 )
 
 #: declared gauge ids (``registry.gauge(name)`` call sites with literal
@@ -163,6 +176,10 @@ GAUGES = (
                                  # (reader scrape; telemetry/lineage.py)
     'lineage_pending_items',     # delivered-out-of-order items awaiting
                                  # their fold slot (reader scrape)
+    'sentinel_rate_ewma',        # the regression sentinel's smoothed windowed
+                                 # rows/s (telemetry/sentinel.py)
+    'sentinel_wait_share_ewma',  # the sentinel's smoothed primary-wait share
+                                 # of each window (telemetry/sentinel.py)
 )
 
 

@@ -229,9 +229,15 @@ def check_store_roundtrip(rows=200, workers=2):
             # digest with zero divergence on this install, without leaving
             # a manifest file in the temp store.
             from petastorm_tpu.telemetry.lineage import LineagePolicy
+            # history armed into a temp store (docs/observability.md
+            # "Longitudinal observatory"): the block proves the run
+            # historian's append + CRC replay on this install without
+            # leaving a store behind.
+            hist_path = os.path.join(tmp, 'run_history.bin')
             with make_reader(url, workers_count=workers, num_epochs=1,
                              on_error='retry',
                              lineage=LineagePolicy(manifest=False),
+                             history=hist_path,
                              autotune=AutotunePolicy(window_s=3600.0)) as reader:
                 for row in reader:
                     seen.append(int(row.idx))
@@ -244,7 +250,9 @@ def check_store_roundtrip(rows=200, workers=2):
                 autotune = reader.autotune_report()
                 slo = reader.efficiency_report()
                 lineage = diag.get('lineage')
+                sentinel = diag.get('sentinel')
             elapsed = time.perf_counter() - start
+            history = check_history(hist_path, sentinel)
     finally:
         tracing.set_trace_enabled(trace_was_enabled)
         if not trace_was_enabled:
@@ -271,6 +279,10 @@ def check_store_roundtrip(rows=200, workers=2):
             # lifted to report['lineage'] by collect_report — the sample-
             # lineage audit of docs/observability.md "Sample lineage"
             'lineage': lineage,
+            # lifted to report['history'] by collect_report — the run
+            # historian + regression sentinel of docs/observability.md
+            # "Longitudinal observatory"
+            'history': history,
             # lifted to report['resilience'] by collect_report — the hang/
             # integrity/breaker view of docs/robustness.md
             'resilience': {
@@ -457,6 +469,14 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
     lineage = report['store_roundtrip'].pop('lineage', None)
     report['lineage'] = lineage if lineage is not None else {
         'enabled': False}
+    # Longitudinal-observatory block (docs/observability.md "Longitudinal
+    # observatory"): the roundtrip's run-history store replayed — record
+    # landed, zero CRC drops, sentinel armed. Always present so --json
+    # consumers find one stable key.
+    history = report['store_roundtrip'].pop('history', None)
+    report['history'] = history if history is not None else {
+        'status': 'unprobed', 'records': 0, 'frames_dropped': 0,
+        'sentinel_armed': False}
     # Static-analysis block (docs/static-analysis.md): does the installed
     # package still satisfy its own data-plane invariants? Always present so
     # --json consumers find one stable key; failures of the analyzer itself
@@ -519,6 +539,24 @@ def check_ledger(service_report=None):
             'last_replay': state.get('last_replay'),
             'frames_dropped': state.get('frames_dropped', 0),
             'records_replayed': state.get('records_replayed', 0)}
+
+
+def check_history(path, sentinel=None):
+    """Replay the roundtrip's run-history store (docs/observability.md
+    "Longitudinal observatory"): record count, CRC-dropped frames, the
+    newest record's headline rows/s, and the sentinel's armed state — a
+    nonzero drop count means a past append was torn and the store healed
+    around it."""
+    from petastorm_tpu.telemetry.history import load_records
+    records, dropped = load_records(path)
+    block = {'status': 'ok' if records and not dropped else 'degraded',
+             'records': len(records), 'frames_dropped': dropped,
+             'sentinel_armed': bool(sentinel)}
+    if records:
+        newest = records[-1]
+        block['rows_per_sec'] = newest.get('rows_per_sec')
+        block['platform'] = newest.get('platform')
+    return block
 
 
 def check_incidents(home=None):
@@ -605,6 +643,18 @@ def _print_human(report):
                   '(docs/observability.md "Sample lineage")'.format(
                       lineage.get('divergence'), last.get('reason'),
                       last.get('detail')))
+    history = report.get('history') or {}
+    if history.get('status') != 'unprobed':
+        print('  history: {} run record(s) replayed ({} CRC-dropped '
+              'frame(s)), sentinel {}'.format(
+                  history.get('records', 0),
+                  history.get('frames_dropped', 0),
+                  'armed' if history.get('sentinel_armed') else 'unarmed'))
+        if history.get('frames_dropped'):
+            print('  WARNING: the run-history store dropped torn frame(s) '
+                  'on replay — a past append was interrupted; the store '
+                  'heals on the next append (docs/observability.md '
+                  '"Longitudinal observatory")')
     trace = report.get('trace') or {}
     if trace.get('events'):
         anomalies = trace.get('anomaly_instants') or []

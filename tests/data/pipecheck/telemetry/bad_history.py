@@ -1,0 +1,8 @@
+"""Known-bad fixture: observatory telemetry names off the spans.py catalogs."""
+from petastorm_tpu.telemetry.tracing import trace_instant
+
+
+def work(registry):
+    registry.inc('history_record_writen')    # typo: should be 'history_record_written'
+    trace_instant('perf_regresion')          # typo: should be 'perf_regression'
+    registry.gauge('sentinel_rate_emwa').set(42.0)  # typo: should be 'sentinel_rate_ewma'

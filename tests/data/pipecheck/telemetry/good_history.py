@@ -1,0 +1,11 @@
+"""Known-good fixture: observatory telemetry names off the catalogs."""
+from petastorm_tpu.telemetry.tracing import trace_instant
+
+
+def work(registry):
+    registry.inc('history_record_written')
+    registry.inc('history_frames_dropped')
+    registry.inc('perf_regression')
+    trace_instant('perf_regression', args={'series': 'rate'})
+    registry.gauge('sentinel_rate_ewma').set(1234.5)
+    registry.gauge('sentinel_wait_share_ewma').set(0.25)

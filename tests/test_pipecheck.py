@@ -60,6 +60,9 @@ BAD_FIXTURES = [
     ('telemetry/bad_incident.py', ['telemetry-names'], 2,
      ['incidents_cpatured', 'COUNTERS', 'incident_captrued',
       'TRACE_INSTANTS']),
+    ('telemetry/bad_history.py', ['telemetry-names'], 3,
+     ['history_record_writen', 'COUNTERS', 'perf_regresion',
+      'TRACE_INSTANTS', 'sentinel_rate_emwa', 'GAUGES']),
     ('clock/bad', ['clock-discipline'], 1, ['time.monotonic']),
     ('exceptions/bad_swallow.py', ['exception-hygiene'], 1, ['swallows']),
     ('exceptions/workers/bad_worker_swallow.py', ['exception-hygiene'], 1,
@@ -96,6 +99,7 @@ GOOD_FIXTURES = [
     ('telemetry/good_lineage.py', ['telemetry-names']),
     ('telemetry/good_cost/telemetry/cost_model.py', ['telemetry-names']),
     ('telemetry/good_incident.py', ['telemetry-names']),
+    ('telemetry/good_history.py', ['telemetry-names']),
     ('clock/good', ['clock-discipline']),
     ('exceptions/good_swallow.py', ['exception-hygiene']),
     ('locks/good_lock.py', ['lock-discipline']),
@@ -128,6 +132,7 @@ def test_known_good_fixture_is_clean(path, rules):
     ('telemetry/suppressed_gauge.py', ['telemetry-names']),
     ('telemetry/suppressed_lineage.py', ['telemetry-names']),
     ('telemetry/suppressed_incident.py', ['telemetry-names']),
+    ('telemetry/suppressed_history.py', ['telemetry-names']),
     ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
     ('protocol/service_suppressed_kinds', ['protocol-conformance']),
 ])
