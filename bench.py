@@ -158,7 +158,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
                  'flash', 'moe', 'wire_bench', 'decode_bench', 'telemetry',
                  'resilience', 'pipecheck', 'tracing', 'service', 'autotune',
                  'device_decode', 'observability', 'schedule', 'storage',
-                 'lineage', 'incidents', 'chaos', 'history')
+                 'lineage', 'incidents', 'chaos', 'history', 'topology')
 
 # Execution order for a full run. Sections emit cumulative PARTIAL_JSON after
 # each completes, so on a slow-tunnel day (2026-07-31: a full run blew the
@@ -168,7 +168,7 @@ SECTION_NAMES = ('mnist_stream', 'mnist_scan_stream', 'bare_reader',
 # already-TPU-proven streaming paths last. test_tools_and_benchmark guards
 # the headline-first invariant.
 SECTION_RUN_ORDER = ('mnist_inmem', 'pipecheck', 'observability', 'incidents',
-                     'history', 'lineage',
+                     'history', 'topology', 'lineage',
                      'schedule', 'storage', 'autotune', 'device_decode',
                      'decode_bench',
                      'service', 'chaos', 'wire_bench', 'telemetry', 'tracing',
@@ -1957,6 +1957,83 @@ def child_main():
             'history_compare_ok': bool(compare_ok),
         })
 
+    def run_topology():
+        """Elastic pod-scale sharding (host-only; docs/robustness.md
+        "Elastic pod-scale sharding"): (1) negotiation-overhead guard — a
+        topology-armed single-host epoch (journal + per-item progress
+        appends) vs a static epoch, min-of-3 interleaved pairs, the <=3%
+        acceptance guard; (2) host-kill recovery probe — a 2-host pod with
+        one host abandoned mid-shard must recover rows-exact with the
+        composed digest byte-identical to an undisturbed pod, and the
+        survivor's reshard decision (journal replay + remainder re-deal)
+        is timed as the recovery-latency headline."""
+        from petastorm_tpu.parallel.topology import (TopologyPolicy,
+                                                     replay_topology_journal,
+                                                     reshard_assignments,
+                                                     undelivered_items)
+        from petastorm_tpu.test_util.chaos import run_host_chaos
+        topo_root = tempfile.mkdtemp(prefix='bench_topology_')
+
+        def epoch(policy):
+            reader = make_reader(url, reader_pool_type='process',
+                                 workers_count=min(WORKERS, 2), num_epochs=1,
+                                 seed=13, shuffle_row_groups=True,
+                                 topology=policy)
+            rows = 0
+            start = time.perf_counter()
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+            elapsed = time.perf_counter() - start
+            reader.stop()
+            reader.join()
+            return rows / elapsed
+
+        journal = os.path.join(topo_root, 'membership-journal.bin')
+        bare_rates, armed_rates = [], []
+        for _ in range(3):  # interleaved pairs: shared-host drift cancels
+            bare_rates.append(epoch(None))
+            armed_rates.append(epoch(TopologyPolicy(journal_path=journal,
+                                                    process_index=0,
+                                                    process_count=1)))
+        bare_rate = max(bare_rates)
+        armed_rate = max(armed_rates)
+        overhead_pct = (bare_rate - armed_rate) / bare_rate * 100.0
+
+        verdict = run_host_chaos(url, os.path.join(topo_root, 'kill'),
+                                 hosts=2, seed=13, kill_host=True)
+        # the survivor-side reshard decision, re-timed on the journal the
+        # probe left behind: replay + undelivered remainder + re-deal is
+        # everything a survivor computes before its recovery epoch starts
+        kill_journal = verdict['journal']['path']
+        start = time.perf_counter()
+        replay = replay_topology_journal(kill_journal)
+        remainder = undelivered_items(verdict['global_rowgroups'], 0,
+                                      replay.delivered)
+        if remainder:
+            reshard_assignments(remainder, ['host-0'])
+        reshard_decision_ms = (time.perf_counter() - start) * 1000.0
+
+        log('topology: armed {:.1f} rows/s vs bare {:.1f} rows/s ({:+.2f}% '
+            'negotiation overhead; acceptance <=3%); 2-host kill probe: '
+            'rows {} ({}/{}), composed digest {}, {} undelivered item(s) '
+            're-dealt, reshard decision {:.2f} ms'.format(
+                armed_rate, bare_rate, overhead_pct,
+                'exact' if verdict['rows_exact'] else 'LOST/DUPED',
+                verdict['rows_chaos'], verdict['rows_baseline'],
+                'EXACT' if verdict['digest_exact'] else 'DIVERGED',
+                verdict['undelivered_resharded'], reshard_decision_ms))
+        results.update({
+            'topology_armed_rows_per_sec': round(armed_rate, 1),
+            'topology_bare_rows_per_sec': round(bare_rate, 1),
+            'topology_overhead_pct': round(overhead_pct, 2),
+            'topology_kill_rows_exact': bool(verdict['rows_exact']),
+            'topology_kill_digest_exact': bool(verdict['digest_exact']),
+            'topology_kill_verdict_ok': bool(verdict['ok']),
+            'topology_undelivered_resharded':
+                int(verdict['undelivered_resharded']),
+            'topology_reshard_decision_ms': round(reshard_decision_ms, 2),
+        })
+
     def run_schedule():
         """Cost-aware scheduling (host-only; docs/performance.md "Cost-aware
         scheduling"): on a deliberately skewed store (heavy random-payload
@@ -2846,6 +2923,7 @@ def child_main():
         'lineage': run_lineage,
         'incidents': run_incidents,
         'history': run_history,
+        'topology': run_topology,
         'chaos': run_chaos,
     }
     for name in SECTION_RUN_ORDER:

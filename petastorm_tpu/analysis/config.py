@@ -82,6 +82,12 @@ QUARANTINE_REGISTRY_SUFFIX: str = 'resilience.py'
 #: docs/service.md "Failure modes")
 LEDGER_FILE_SUFFIX: str = 'ledger.py'
 
+#: where the topology membership journal's declared record-kind registry
+#: lives (path suffix): the same two-sided conformance contract as the
+#: dispatcher ledger, against ``TOPOLOGY_RECORD_KINDS`` (protocol-
+#: conformance rule, docs/robustness.md "Elastic pod-scale sharding")
+TOPOLOGY_FILE_SUFFIX: str = 'topology.py'
+
 #: where the cost profiler's declared stage tuple lives (path suffix); its
 #: ``COST_STAGES`` entries must be a subset of the spans catalog's ``STAGES``
 #: (telemetry-names rule, docs/observability.md "Cost profiler")
@@ -112,6 +118,7 @@ class AnalysisConfig:
     stage_catalog_suffix: str = STAGE_CATALOG_SUFFIX
     quarantine_registry_suffix: str = QUARANTINE_REGISTRY_SUFFIX
     ledger_file_suffix: str = LEDGER_FILE_SUFFIX
+    topology_file_suffix: str = TOPOLOGY_FILE_SUFFIX
     knob_catalog_suffix: str = KNOB_CATALOG_SUFFIX
     cost_model_suffix: str = COST_MODEL_SUFFIX
     strict_flags: Tuple[str, ...] = STRICT_FLAGS

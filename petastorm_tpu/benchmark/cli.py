@@ -30,7 +30,11 @@ docs/static-analysis.md); ``serve`` dispatches to
 decode workers in one command — docs/service.md); ``chaos`` dispatches to
 :mod:`petastorm_tpu.test_util.chaos` (seeded control-plane chaos proof:
 dispatcher/worker kills mid-epoch against a ledger-armed fleet, verdict by
-rows-exact + lineage diff — docs/service.md "Failure modes"); ``doctor``
+rows-exact + lineage diff — docs/service.md "Failure modes"; ``chaos
+--hosts N [--kill-host|--join-host]`` proves the elastic-sharding plane
+instead: a simulated pod over a shared membership journal, verdict by
+rows-exact + topology-invariant composed digest — docs/robustness.md
+"Elastic pod-scale sharding"); ``doctor``
 dispatches to
 :mod:`petastorm_tpu.tools.doctor` (environment health report); ``history``
 dispatches to :mod:`petastorm_tpu.telemetry.history` (longitudinal

@@ -6,10 +6,21 @@ from petastorm_tpu.parallel.device_stage import DeviceTransform  # noqa: F401
 from petastorm_tpu.parallel.inmem_loader import InMemJaxLoader  # noqa: F401
 from petastorm_tpu.parallel.loader import JaxDataLoader, make_jax_loader  # noqa: F401
 
+#: elastic pod-scale sharding surface (parallel/topology.py) — lazy like
+#: TrainingCheckpointer so importing the package stays cheap
+_TOPOLOGY_EXPORTS = ('TopologyPolicy', 'resolve_topology_policy',
+                     'deal_assignment', 'compose_global_digest',
+                     'merge_topology_states', 'policy_from_state',
+                     'replay_topology_journal')
+
+
 def __getattr__(name):  # lazy: orbax import is heavy and optional at runtime
     if name == 'TrainingCheckpointer':
         from petastorm_tpu.parallel.checkpoint import TrainingCheckpointer
         return TrainingCheckpointer
+    if name in _TOPOLOGY_EXPORTS:
+        from petastorm_tpu.parallel import topology
+        return getattr(topology, name)
     raise AttributeError(name)
 
 from petastorm_tpu.parallel.mesh import (  # noqa: F401

@@ -21,6 +21,13 @@ Usage::
     state, loader_state = ckpt.restore(state)      # template for structure
     reader = make_reader(url, ..., resume_state=loader_state['reader'])
     loader = JaxDataLoader(reader, ...)
+
+Cross-topology restore (save on 4 hosts, resume on 2): collect every host's
+restored ``loader_state['reader']`` and re-deal them with
+:func:`restore_across_topology` — each merged state pins the new host's
+identity and shard assignment, so the resumed pod covers exactly the
+unconsumed remainder regardless of the new host count
+(docs/robustness.md "Elastic pod-scale sharding").
 """
 
 import json
@@ -29,6 +36,26 @@ import orbax.checkpoint as ocp
 
 _MODEL_KEY = 'train_state'
 _LOADER_KEY = 'input_pipeline'
+
+
+def restore_across_topology(reader_states, new_count):
+    """Re-deal a full pod's saved reader states onto ``new_count`` hosts.
+
+    ``reader_states`` is every old host's ``loader_state['reader']`` (all of
+    them — a partial pod cannot prove coverage). Returns one merged reader
+    state per NEW host; feed state ``i`` to new host ``i`` as::
+
+        from petastorm_tpu.parallel.topology import policy_from_state
+        state = merged[jax.process_index()]
+        reader = make_reader(url, ...,
+                             topology=policy_from_state(state, journal_path),
+                             resume_state=state)
+
+    Thin bridge over :func:`petastorm_tpu.parallel.topology.
+    merge_topology_states`, which refuses mid-batch cursors, mismatched
+    epochs, and states not saved by a topology-armed reader."""
+    from petastorm_tpu.parallel.topology import merge_topology_states
+    return merge_topology_states(reader_states, new_count)
 
 
 def _check_json_roundtrip(loader_state):

@@ -65,13 +65,20 @@ def batch_sharding(mesh, partition_spec=None, batch_axis='data'):
 def distributed_shard_info(cur_shard=None, shard_count=None):
     """Resolve this process's (cur_shard, shard_count) for reader construction.
 
-    Priority: explicit kwargs > initialized JAX distributed runtime > single process.
-    Legacy Horovod/MPI env vars are honored as a compatibility fallback, mirroring the
-    reference's detection (spark_dataset_converter.py:116-129)."""
+    Priority: explicit kwargs > PETASTORM_TPU_PROCESS_INDEX/_COUNT env pair (the
+    topology plane's CPU-test override — parallel/topology.py) > initialized JAX
+    distributed runtime > single process. Legacy Horovod/MPI env vars are honored as a
+    compatibility fallback, mirroring the reference's detection
+    (spark_dataset_converter.py:116-129)."""
     if cur_shard is not None or shard_count is not None:
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be given together')
         return cur_shard, shard_count
+    from petastorm_tpu.parallel.topology import (PROCESS_COUNT_ENV,
+                                                 PROCESS_INDEX_ENV)
+    if PROCESS_INDEX_ENV in os.environ and PROCESS_COUNT_ENV in os.environ:
+        return (int(os.environ[PROCESS_INDEX_ENV]),
+                int(os.environ[PROCESS_COUNT_ENV]))
     import jax
     if jax.process_count() > 1:
         return jax.process_index(), jax.process_count()

@@ -124,7 +124,8 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                 heartbeat_interval_s=None, trace=None, service_url=None,
                 autotune=None, device_decode_fields=None, metrics_port=None,
                 slo_policy=None, cost_schedule=None, lineage=None,
-                incidents=None, storage_policy=None, history=None):
+                incidents=None, storage_policy=None, history=None,
+                topology=None):
     """Reader for datasets written with a Unischema (petastorm_tpu or petastorm stores):
     rows decoded through codecs, emitted one namedtuple per ``next()`` (reference:
     petastorm/reader.py:62-204). ``schema_fields`` may be a list of field names / regexes,
@@ -285,7 +286,23 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
     (default policy), a store path string, or a
     :class:`~petastorm_tpu.telemetry.history.HistoryPolicy` (its
     ``sentinel`` field tunes/disables the sentinel). Unset (None, the
-    default) records nothing and keeps every path byte-identical."""
+    default) records nothing and keeps every path byte-identical.
+
+    Elastic pod-scale sharding (docs/robustness.md "Elastic pod-scale
+    sharding"): ``topology`` replaces static ``cur_shard``/``shard_count``
+    with a shard map negotiated from the process topology
+    (``jax.process_index()``/``process_count()``, env-overridable with
+    ``PETASTORM_TPU_PROCESS_INDEX/_COUNT``) and recorded in a durable
+    CRC-framed membership journal on shared storage; on a host
+    join/leave/lease expiry the survivors re-deal ONLY the undelivered
+    rowgroups, and per-host lineage digests compose into a
+    topology-invariant global digest
+    (:func:`~petastorm_tpu.parallel.topology.compose_global_digest`).
+    ``True`` (default policy), a journal path string, or a
+    :class:`~petastorm_tpu.parallel.topology.TopologyPolicy`. Mutually
+    exclusive with ``cur_shard``/``shard_count``/``shard_seed`` and
+    ``cost_schedule``. Unset (None, the default) keeps the static-shard
+    path byte-identical."""
     from petastorm_tpu.resilience import resolve_retry_policy
     if trace is not None:
         set_trace_enabled(bool(trace))
@@ -352,7 +369,7 @@ def make_reader(dataset_url_or_urls, schema_fields=None,
                   metrics_port=metrics_port, slo_policy=slo_policy,
                   cost_schedule=cost_schedule, lineage=lineage,
                   incidents=incidents, storage_policy=storage_policy,
-                  history=history)
+                  history=history, topology=topology)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type='thread',
@@ -370,14 +387,14 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       autotune=None, device_decode_fields=None,
                       metrics_port=None, slo_policy=None, cost_schedule=None,
                       lineage=None, incidents=None, storage_policy=None,
-                      history=None):
+                      history=None, topology=None):
     """Reader for arbitrary Parquet stores: native columns only (no codec decode), one
     namedtuple of column arrays per rowgroup batch (reference: petastorm/reader.py:207-346).
     ``on_error`` / ``retry_policy`` / ``cache_format`` / ``shm_transport`` /
     ``item_deadline_s`` / ``heartbeat_interval_s`` / ``trace`` /
     ``service_url`` / ``autotune`` / ``metrics_port`` / ``slo_policy`` /
     ``cost_schedule`` / ``lineage`` / ``incidents`` / ``storage_policy`` /
-    ``history``
+    ``history`` / ``topology``
     behave exactly as in
     :func:`make_reader`.
     ``device_decode_fields`` (docs/performance.md "Device-resident decode
@@ -458,7 +475,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                   metrics_port=metrics_port, slo_policy=slo_policy,
                   cost_schedule=cost_schedule, lineage=lineage,
                   incidents=incidents, storage_policy=storage_policy,
-                  history=history)
+                  history=history, topology=topology)
 
 
 class Reader(object):
@@ -474,7 +491,8 @@ class Reader(object):
                  on_error='raise', retry_policy=None, initial_io_retries=0,
                  autotune=None, device_decode_fields=None, metrics_port=None,
                  slo_policy=None, cost_schedule=None, lineage=None,
-                 incidents=None, storage_policy=None, history=None):
+                 incidents=None, storage_policy=None, history=None,
+                 topology=None):
         from petastorm_tpu.resilience import QuarantineLedger, resolve_retry_policy
         retry_policy = resolve_retry_policy(on_error, retry_policy)
         construction_retries = [initial_io_retries]
@@ -548,11 +566,32 @@ class Reader(object):
         # under _accounting_lock in _note_item_consumed)
         self._incident_last_divergence = 0
         self._incident_last_crc_failures = 0
+        # Elastic pod-scale sharding (docs/robustness.md "Elastic pod-scale
+        # sharding"): policy resolved up front; the HostTopology itself is
+        # built once the filtered rowgroup list exists, so the negotiated
+        # deal covers exactly what this read will ventilate. Unset => no
+        # journal, no negotiation — the static path stays byte-identical.
+        from petastorm_tpu.parallel.topology import resolve_topology_policy
+        self._topology = None
+        self._topology_policy = resolve_topology_policy(topology)
+        self._shard_skew = None
 
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
             raise ValueError('cur_shard must be in [0, shard_count)')
+        if self._topology_policy is not None:
+            if cur_shard is not None or shard_seed is not None:
+                raise ValueError(
+                    'topology= and static cur_shard/shard_count/shard_seed '
+                    'are mutually exclusive — the topology plane negotiates '
+                    'the shard map (docs/robustness.md "Elastic pod-scale '
+                    'sharding")')
+            if cost_schedule is not None:
+                raise ValueError(
+                    'topology= is not compatible with cost_schedule — a '
+                    're-planned interleave would shift the global item '
+                    'coordinates a reshard re-deals')
         if predicate is not None and schema_fields is not None and _is_ngram(schema_fields):
             raise ValueError('Predicates are not supported together with NGram '
                              '(reference semantics: reader.py:430-434)')
@@ -728,14 +767,71 @@ class Reader(object):
                           if _eval_partition_predicate(main_process_predicate, rg)]
         self._row_groups = row_groups
 
-        shard_row_groups = self._partition_row_groups(row_groups, cur_shard, shard_count,
-                                                      shard_seed)
+        if self._topology_policy is not None:
+            # Negotiated sharding: the deal is computed over GLOBAL rowgroup
+            # indices, journaled for the rest of the pod, and replaces the
+            # static modulo split (generation-0 deals match it exactly).
+            from petastorm_tpu.parallel.topology import (
+                HostTopology, default_topology_journal_path)
+            from petastorm_tpu.dataset_state import cache_state_home
+            url_for_topology = dataset_url_or_urls if not isinstance(
+                dataset_url_or_urls, list) else dataset_url_or_urls[0]
+            journal_path = self._topology_policy.journal_path or \
+                default_topology_journal_path(url_for_topology,
+                                              cache_state_home(cache))
+            if journal_path is None:
+                raise ValueError(
+                    'topology= needs a membership journal on shared storage, '
+                    'but this dataset has no local state home (remote store, '
+                    'no cache) — pass TopologyPolicy(journal_path=...)')
+            self._topology = HostTopology(self._topology_policy, journal_path,
+                                          len(row_groups),
+                                          registry=self._telemetry)
+            bad = [i for i in self._topology.assignment
+                   if not 0 <= i < len(row_groups)]
+            if bad:
+                raise ValueError(
+                    'topology assignment names global rowgroup indices {} '
+                    'outside this dataset\'s {} filtered rowgroup(s) — the '
+                    'policy was dealt against a different dataset or filter '
+                    'config'.format(bad, len(row_groups)))
+            effective_count = self._topology.process_count
+            shard_row_groups = [row_groups[i]
+                                for i in self._topology.assignment]
+        else:
+            effective_count = shard_count
+            shard_row_groups = self._partition_row_groups(
+                row_groups, cur_shard, shard_count, shard_seed)
+        # Degenerate-sharding detector (docs/robustness.md): a shard count
+        # above the filtered rowgroup count leaves >= 1 sibling empty — THIS
+        # shard may look healthy while the pod's split is silently skewed.
+        # Detected here on every shard so pods see it before training starts.
+        if effective_count is not None and effective_count > len(row_groups):
+            self._shard_skew = {
+                'shard_count': effective_count,
+                'rowgroups': len(row_groups),
+                'empty_shards': effective_count - len(row_groups),
+            }
+            warnings.warn(
+                'shard_skew: {} shard(s) over {} rowgroup(s) leaves {} '
+                'shard(s) empty and the split skewed — use fewer shards or '
+                'more files (diagnostics["shard_skew"])'.format(
+                    effective_count, len(row_groups),
+                    effective_count - len(row_groups)))
         if not shard_row_groups:
             raise NoDataAvailableError(
                 'No rowgroups available for shard {} of {} (dataset has {} rowgroups '
                 'after filtering). Use fewer shards or more files.'
-                .format(cur_shard, shard_count, len(row_groups)))
+                .format(self._topology.process_index
+                        if self._topology is not None else cur_shard,
+                        effective_count, len(row_groups)))
         self._shard_row_groups = shard_row_groups
+        #: the frozen shard configuration a checkpoint must match on resume
+        #: (satellite: silent wrong-stream replay on config drift)
+        self._shard_config = {'cur_shard': cur_shard,
+                              'shard_count': shard_count,
+                              'shard_seed': shard_seed,
+                              'topology': self._topology is not None}
 
         items = []
         for piece_index, rg in enumerate(shard_row_groups):
@@ -885,6 +981,11 @@ class Reader(object):
                 'schedule': (self._cost_scheduler.plan_fingerprint()
                              if self._cost_scheduler is not None else None),
             }
+            if self._topology is not None:
+                # negotiated-topology provenance (parallel/topology.py):
+                # written ONLY when armed so a static-shard recording stays
+                # byte-identical to the seed manifest format
+                header['topology'] = self._topology.header()
             if skip_by_iteration:
                 header['skip_by_iteration'] = {
                     str(k): sorted(list(item) for item in v)
@@ -972,12 +1073,30 @@ class Reader(object):
                 self._incidents.add_source('lineage', self._lineage.report)
             if self._autotune is not None:
                 self._incidents.add_source('autotune', self._autotune.report)
+            if self._topology is not None:
+                self._incidents.add_source('topology', self._topology.report)
+                # construction-time edges: a corrupt membership journal and
+                # a reshard-survivor join are both capture-worthy evidence
+                if self._topology.frames_dropped:
+                    self._incidents.trigger(
+                        'ledger_corrupt',
+                        args={'journal': self._topology.journal.path,
+                              'frames_dropped': self._topology.frames_dropped,
+                              'plane': 'topology'})
+                if self._topology.generation > 0:
+                    self._incidents.trigger(
+                        'host_reshard',
+                        args={'generation': self._topology.generation,
+                              'host_id': self._topology.host_id,
+                              'assignment': list(self._topology.assignment)})
             provenance = {
                 'dataset_url': str(url_for_incidents),
                 'dataset_token': self.dataset_token,
                 'seed': seed, 'num_epochs': num_epochs,
                 'shuffle_row_groups': bool(shuffle_row_groups),
                 'cur_shard': cur_shard, 'shard_count': shard_count,
+                'topology': (self._topology.header()
+                             if self._topology is not None else None),
                 'on_error': on_error,
                 'pool': type(reader_pool).__name__,
                 'items_per_epoch': self._items_per_epoch,
@@ -1245,7 +1364,7 @@ class Reader(object):
                 fingerprint=getattr(batch, 'lineage', None),
                 quarantined=record is not None)
             if self._incidents is not None:
-                divergences = self._lineage.divergence_count()
+                divergences = self._lineage.divergence_count
                 if divergences > self._incident_last_divergence:
                     self._incident_last_divergence = divergences
                     self._incidents.trigger(
@@ -1257,6 +1376,11 @@ class Reader(object):
             # pool/transport, so a trace always ends on the consumer track
             trace_instant('rowgroup_consumed', ctx=(epoch, piece, 0),
                           args={'rows': getattr(batch, 'num_rows', 0)})
+        if self._topology is not None:
+            # journal the delivery under its GLOBAL rowgroup index — the set
+            # a reshard subtracts to re-deal only the undelivered remainder
+            # (docs/robustness.md "Elastic pod-scale sharding")
+            self._topology.note_progress(epoch, piece, drop)
         with self._accounting_lock:
             self._rows_consumed += getattr(batch, 'num_rows', 0) or 0
             self._consumed_by_epoch.setdefault(epoch, set()).add((piece, drop))
@@ -1270,6 +1394,39 @@ class Reader(object):
     def _load_resume_state(self, state):
         if not isinstance(state, dict) or state.get('version') != 1:
             raise ValueError('Unrecognized resume_state {!r}'.format(state))
+        saved_shard = state.get('shard_config')
+        if saved_shard is not None and saved_shard != self._shard_config:
+            # Silent wrong-stream guard: a checkpoint replayed under a
+            # different shard split skips/duplicates rows without any error
+            # — refuse loudly, naming both configs (the split-plan refusal
+            # discipline). Cross-topology restore goes through the
+            # negotiated path only (topology.merge_topology_states).
+            raise ValueError(
+                'resume_state was captured under shard config {!r}, but '
+                'this reader is configured with {!r} — resuming would '
+                'silently replay the wrong row stream. Rebuild with the '
+                'original sharding, or restore across topologies via '
+                'petastorm_tpu.parallel.topology.merge_topology_states'
+                .format(saved_shard, self._shard_config))
+        saved_topology = state.get('topology')
+        if saved_topology is not None:
+            if self._topology is None:
+                raise ValueError(
+                    'resume_state was captured by a topology-armed reader '
+                    '(identity {}/{}), but this reader is static-sharded — '
+                    'restore through make_reader(topology=...) (see '
+                    'topology.policy_from_state)'.format(
+                        saved_topology.get('process_index'),
+                        saved_topology.get('process_count')))
+            if list(saved_topology.get('assignment') or []) != \
+                    list(self._topology.assignment):
+                raise ValueError(
+                    'resume_state was dealt assignment {!r}, but this '
+                    'reader negotiated {!r} — re-deal the checkpoint with '
+                    'topology.merge_topology_states before resuming on a '
+                    'changed topology'.format(
+                        saved_topology.get('assignment'),
+                        list(self._topology.assignment)))
         if state['items_per_epoch'] != self._items_per_epoch:
             raise ValueError(
                 'resume_state was captured from a reader with {} work items per epoch, '
@@ -1353,7 +1510,15 @@ class Reader(object):
                 'consumed_by_epoch': {
                     epoch - self._epochs_consumed: sorted(ids)
                     for epoch, ids in self._consumed_by_epoch.items()},
+                # the shard configuration this position is only valid under
+                # — resume validates it and refuses a drifted config loudly
+                'shard_config': dict(self._shard_config),
             }
+            if self._topology is not None:
+                # the negotiated identity + explicit global assignment that
+                # cross-topology restore (topology.merge_topology_states)
+                # re-deals onto a different host count
+                state['topology'] = self._topology.state_block()
             if cursor is not None:
                 (epoch, piece, drop), next_row = cursor
                 # Deferred acknowledgment guarantees epoch >= _epochs_consumed: the
@@ -1711,6 +1876,10 @@ class Reader(object):
             # the recorder only detaches its sources — retained bundles are
             # the whole point and stay on disk for the autopsy CLI
             self._incidents.close()
+        if self._topology is not None:
+            # journal a clean leave so survivors re-deal immediately rather
+            # than waiting out the lease (idempotent)
+            self._topology.close()
         self._pool.stop()
 
     def join(self):
@@ -1805,6 +1974,14 @@ class Reader(object):
                 'hedges_won':
                     int(counters.get('storage_hedge_won', 0)),
             }
+        # Degenerate-sharding detector, only when one fired at construction
+        # (docs/robustness.md): shard_count/rowgroups/empty_shards.
+        if self._shard_skew is not None:
+            diag['shard_skew'] = dict(self._shard_skew)
+        # Elastic-topology block only when armed, same contract: negotiated
+        # identity, assignment, membership-journal state, stale leases.
+        if self._topology is not None:
+            diag['topology'] = self._topology.report()
         return diag
 
     def __enter__(self):

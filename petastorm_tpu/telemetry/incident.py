@@ -92,6 +92,11 @@ TRIGGER_KINDS: Tuple[str, ...] = (
                            # growth (telemetry/sentinel.py,
                            # docs/observability.md "Longitudinal
                            # observatory")
+    'host_reshard',        # a reader came up as a host-reshard survivor —
+                           # undelivered rowgroups were re-dealt after a
+                           # host join/leave/lease expiry
+                           # (parallel/topology.py, docs/robustness.md
+                           # "Elastic pod-scale sharding")
 )
 
 #: ranked-cause classes the autopsy report can name, with their CLI exit
@@ -118,6 +123,7 @@ _CAUSE_FOR_TRIGGER: Dict[str, str] = {
     'reshard': 'scheduling-skew',
     'ledger_corrupt': 'corruption',
     'perf_regression': 'scheduling-skew',
+    'host_reshard': 'scheduling-skew',
 }
 
 #: bundle directory name prefix (retention and the doctor scan key off it)

@@ -89,6 +89,7 @@ EXIT_SHARD_CONFIG = 4
 EXIT_SCHEDULE_PLAN = 5
 EXIT_QUARANTINE = 6
 EXIT_CONTENT = 7
+EXIT_TOPOLOGY = 8
 
 #: ``lineage diff`` attribution -> exit code (documented in docs/api.md)
 ATTRIBUTION_EXIT_CODES: Dict[str, int] = {
@@ -98,6 +99,7 @@ ATTRIBUTION_EXIT_CODES: Dict[str, int] = {
     'schedule_plan': EXIT_SCHEDULE_PLAN,
     'quarantine': EXIT_QUARANTINE,
     'content': EXIT_CONTENT,
+    'topology': EXIT_TOPOLOGY,
     'unknown': EXIT_DIVERGED,
 }
 
@@ -696,6 +698,16 @@ def _shard_config(header: Mapping[str, Any]) -> Dict[str, Any]:
             'drop_partitions': header.get('drop_partitions', 1)}
 
 
+def _topology_of(header: Mapping[str, Any]) -> Any:
+    """JSON-normalized negotiated-topology block (process count / index /
+    shard map / reshard generation — parallel/topology.py); absent for a
+    static-shard recording, so static-vs-static runs never attribute here."""
+    topology = header.get('topology')
+    if topology is None:
+        return None
+    return json.loads(json.dumps(topology, sort_keys=True))
+
+
 def verify_manifest(manifest_path: str,
                     dataset_url: Optional[str] = None) -> Dict[str, Any]:
     """The dry replay verifier: prove a recorded run's order digest from
@@ -838,9 +850,10 @@ def diff_manifests(path_a: str, path_b: str) -> Dict[str, Any]:
     fingerprint / quarantine flag) differs and attributes the divergence to
     the responsible subsystem by comparing the run headers — ``seed``,
     ``shard_config``, ``schedule_plan`` (a cost-ledger delta reordering the
-    interleave, a split-plan change), ``quarantine``, or ``content``
-    (identical stream, different bytes). ``exit_code`` is distinct per
-    attribution (:data:`ATTRIBUTION_EXIT_CODES`)."""
+    interleave, a split-plan change), ``topology`` (a negotiated shard map
+    / reshard generation changed — parallel/topology.py), ``quarantine``,
+    or ``content`` (identical stream, different bytes). ``exit_code`` is
+    distinct per attribution (:data:`ATTRIBUTION_EXIT_CODES`)."""
     seg_a = load_manifest(path_a)[-1]
     seg_b = load_manifest(path_b)[-1]
     header_a = seg_a['header'] or {}
@@ -853,6 +866,8 @@ def diff_manifests(path_a: str, path_b: str) -> Dict[str, Any]:
         causes.append('seed')
     if _shard_config(header_a) != _shard_config(header_b):
         causes.append('shard_config')
+    if _topology_of(header_a) != _topology_of(header_b):
+        causes.append('topology')
     if _schedule_plan_of(header_a) != _schedule_plan_of(header_b):
         causes.append('schedule_plan')
     if sorted(header_a.get('quarantined_fragments') or []) != \

@@ -128,6 +128,15 @@ COUNTERS = (
     'history_frames_dropped',     # run-history journal frames that failed
                                   # CRC replay (torn tail / flipped byte —
                                   # telemetry/history.py)
+    'host_reshard',               # a reader joined as a reshard survivor —
+                                  # undelivered rowgroups were re-dealt
+                                  # after a host join/leave/lease expiry
+                                  # (parallel/topology.py,
+                                  # docs/robustness.md "Elastic pod-scale
+                                  # sharding")
+    'topology_frames_dropped',    # membership-journal frames that failed
+                                  # CRC replay (torn tail / flipped byte —
+                                  # parallel/topology.py)
 )
 
 #: declared size histograms (``registry.observe(name, n, unit=BYTES_UNIT)``
@@ -158,6 +167,7 @@ TRACE_INSTANTS = (
     'reshard',             # undelivered service work was re-split across a changed worker set (dispatcher; service/dispatcher.py)
     'ledger_replay',       # a restarting dispatcher replayed its durable token ledger (service/ledger.py)
     'perf_regression',     # the live regression sentinel fired mid-run (consumer/dispatcher; telemetry/sentinel.py)
+    'host_reshard',        # a reader joined as a host-reshard survivor after a topology change (consumer; parallel/topology.py)
 )
 
 #: declared gauge ids (``registry.gauge(name)`` call sites with literal

@@ -35,6 +35,19 @@ baseline epoch, re-runs it under the schedule against a ledger-armed
 :class:`~petastorm_tpu.service.fleet.ServiceFleet`, and exits nonzero unless
 rows are exact, ``lineage verify`` passes, and the two manifests diff clean
 (docs/service.md "Failure modes", docs/robustness.md).
+
+``chaos --hosts N`` switches to the TOPOLOGY plane (docs/robustness.md
+"Elastic pod-scale sharding"): N topology-armed hosts run sequentially
+in-process over one shared membership journal (simulated multi-host — the
+determinism contract makes sequential and concurrent hosts equivalent),
+``--kill-host`` abandons a seeded host mid-shard WITHOUT a leave record (a
+SIGKILL, to every replay), ``--join-host`` pauses the pod and adds host N,
+and in either case the survivors re-deal only the undelivered remainder at
+generation 1. The verdict demands rows exact versus an undisturbed same-seed
+baseline, the composed global digest (:func:`compose_global_digest`)
+byte-identical, zero duplicate deliveries, ``lineage verify`` exit 0 on the
+recovery manifests, and ``lineage diff`` attributing the survivor's
+divergence to ``topology`` (exit 8).
 """
 
 import json
@@ -45,8 +58,10 @@ import time
 
 logger = logging.getLogger(__name__)
 
+#: the last two are topology-plane injuries fired by the ``--hosts`` engine
+#: (:func:`run_host_chaos`), not by row-triggered :class:`ChaosRule` firing
 CHAOS_KINDS = ('kill_dispatcher', 'kill_worker', 'partition_client',
-               'corrupt_ledger')
+               'corrupt_ledger', 'kill_host', 'join_host')
 
 #: chaos runs want dispatcher-crash recovery in seconds: the harness
 #: defaults the client response window down to this unless the caller
@@ -66,6 +81,9 @@ class ChaosRule(object):
         network partition); ``'corrupt_ledger'`` bit-flips one frame of the
         fleet's durable ledger journal so the NEXT dispatcher restart must
         degrade loudly instead of replaying silently wrong.
+        ``'kill_host'`` / ``'join_host'`` are topology-plane kinds executed
+        by the ``--hosts`` engine (:func:`run_host_chaos`) rather than by
+        row-triggered firing against a fleet.
     :param at: 1-based row count that triggers the rule; None resolves a
         seeded mid-epoch row at :meth:`ChaosSchedule.resolve` time.
     :param worker_index: which fleet worker ``'kill_worker'`` targets.
@@ -170,6 +188,9 @@ def _fire(rule, fleet):
         else:
             logger.warning('corrupt_ledger fired but the fleet has no '
                            'ledger journal to damage')
+    else:
+        logger.warning('%s is a topology-plane kind — it fires from the '
+                       '--hosts engine, not against a fleet', rule.kind)
 
 
 def run_chaos_epoch(reader, fleet, schedule):
@@ -208,6 +229,250 @@ def _run_epoch(dataset_url, service_url, seed, manifest_path, fleet=None,
         return run_chaos_epoch(reader, fleet, schedule)
 
 
+# ---------------------------------------------------------------------------
+# Topology plane: --hosts N (simulated multi-host, shared membership journal)
+# ---------------------------------------------------------------------------
+
+def _run_host_epoch(dataset_url, policy, seed, manifest_path, stop_after=None):
+    """One simulated host's topology-armed, lineage-armed epoch.
+
+    ``stop_after=k`` kills the host at the k-th BATCH boundary via
+    :meth:`HostTopology.abandon` (journal closed with no leave record — a
+    crash, to every later replay); ``stop_after=0`` kills it before any
+    delivery. Batch boundaries matter: with the dummy pool a popped batch IS
+    one work item, and ``_note_item_consumed`` (which journals topology
+    progress) fires exactly when a batch is popped — breaking mid-batch would
+    leave the item unacknowledged and double-deliver it after the reshard.
+    """
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.telemetry.lineage import LineagePolicy
+    reader = make_reader(dataset_url, reader_pool_type='dummy', num_epochs=1,
+                         seed=seed, shuffle_row_groups=True,
+                         lineage=LineagePolicy(manifest_path=manifest_path),
+                         topology=policy)
+    rows = 0
+    batches = 0
+    killed = False
+    info = {'host_id': reader._topology.host_id,
+            'assignment': list(reader._topology.assignment),
+            'global_rowgroups': reader._topology.num_rowgroups}
+    try:
+        if stop_after is not None and stop_after <= 0:
+            killed = True
+            reader._topology.abandon()
+        else:
+            for batch in reader.iter_columnar():
+                rows += batch.num_rows
+                batches += 1
+                if stop_after is not None and batches >= stop_after:
+                    killed = True
+                    reader._topology.abandon()
+                    break
+    finally:
+        reader.stop()
+        reader.join()
+    info.update(rows=rows, batches=batches, killed=killed)
+    return info
+
+
+def run_host_chaos(dataset_url, workdir, hosts, seed, kill_host=False,
+                   join_host=False):
+    """Prove elastic pod-scale sharding survives a topology mutation.
+
+    Three acts, all sequential in-process (determinism makes the serial
+    schedule equivalent to a concurrent pod):
+
+    1. **Baseline** — an undisturbed same-seed pod (``hosts`` hosts for the
+       kill/join modes, ONE host in steady mode so ``--hosts N`` alone
+       proves the any-topology-invariance of the composed digest).
+    2. **Chaos** — the same pod over a fresh shared journal; ``kill_host``
+       abandons one seeded host mid-shard (no leave record), ``join_host``
+       pauses every host mid-shard so host N can join the re-deal.
+    3. **Recovery** — replay the journal, compute the undelivered remainder,
+       round-robin it over the survivors (plus the joiner), journal the
+       generation-1 reshard, and run each survivor's pinned-assignment
+       recovery epoch.
+
+    The verdict (returned dict, ``'ok'`` key) demands phase-1 + recovery
+    rows exactly equal the baseline, the composed global digest
+    byte-identical with zero duplicate deliveries, ``lineage verify`` exit 0
+    on a recovery manifest, and ``lineage diff`` of a survivor's baseline vs
+    recovery manifest attributing the divergence to ``topology`` (exit 8).
+    """
+    if kill_host and join_host:
+        raise ValueError('kill_host and join_host are mutually exclusive')
+    if hosts < 1:
+        raise ValueError('hosts must be >= 1, got {}'.format(hosts))
+    from petastorm_tpu.parallel.topology import (
+        MembershipJournal, TopologyPolicy, compose_global_digest,
+        deal_assignment, replay_topology_journal, reshard_assignments,
+        undelivered_items)
+    from petastorm_tpu.telemetry.lineage import (EXIT_TOPOLOGY,
+                                                 diff_manifests,
+                                                 verify_manifest)
+    os.makedirs(workdir, exist_ok=True)
+    mode = 'kill_host' if kill_host else ('join_host' if join_host
+                                          else 'steady')
+    rng = random.Random(seed * 1000003 + 1)
+
+    # --- act 1: the undisturbed oracle -----------------------------------
+    baseline_hosts = hosts if mode != 'steady' else 1
+    baseline_journal = os.path.join(workdir, 'baseline-topology-journal.bin')
+    baseline_manifests = []
+    baseline_rows = 0
+    num_rowgroups = None
+    for index in range(baseline_hosts):
+        if num_rowgroups is not None and not deal_assignment(
+                index, baseline_hosts, num_rowgroups):
+            baseline_manifests.append(None)  # empty shard: nothing to read
+            continue
+        manifest = os.path.join(workdir,
+                                'baseline-host{}.jsonl'.format(index))
+        result = _run_host_epoch(
+            dataset_url,
+            TopologyPolicy(journal_path=baseline_journal,
+                           process_index=index,
+                           process_count=baseline_hosts),
+            seed, manifest)
+        num_rowgroups = result['global_rowgroups']
+        baseline_rows += result['rows']
+        baseline_manifests.append(manifest)
+    baseline_digest = compose_global_digest(
+        [m for m in baseline_manifests if m])
+    logger.info('chaos --hosts: baseline (%d host(s)) delivered %d rows, '
+                'digest %s', baseline_hosts, baseline_rows,
+                baseline_digest['digest'])
+
+    # --- act 2: the injured pod ------------------------------------------
+    journal = os.path.join(workdir, 'chaos-topology-journal.bin')
+    killed_index = rng.randrange(hosts) if kill_host else None
+    phase1_manifests = []
+    phase1_rows = 0
+    fired = []
+    for index in range(hosts):
+        if not deal_assignment(index, hosts, num_rowgroups):
+            continue
+        pieces = len(deal_assignment(index, hosts, num_rowgroups))
+        stop_after = None
+        if kill_host and index == killed_index:
+            # seeded mid-shard batch boundary (middle-half idiom collapses
+            # to any interior boundary for small shards)
+            stop_after = rng.randrange(1, pieces) if pieces >= 2 else 0
+            fired.append({'kind': 'kill_host',
+                          'host': 'host-{}'.format(index),
+                          'after_batches': stop_after})
+        elif join_host:
+            # every incumbent pauses mid-shard so the joiner has a
+            # remainder to be dealt into
+            stop_after = pieces // 2
+            fired.append({'kind': 'join_host',
+                          'host': 'host-{}'.format(index),
+                          'after_batches': stop_after})
+        manifest = os.path.join(workdir, 'chaos-host{}.jsonl'.format(index))
+        result = _run_host_epoch(
+            dataset_url,
+            TopologyPolicy(journal_path=journal, process_index=index,
+                           process_count=hosts),
+            seed, manifest, stop_after=stop_after)
+        phase1_rows += result['rows']
+        if result['rows']:
+            phase1_manifests.append(manifest)
+
+    # --- act 3: replay, re-deal, recover ---------------------------------
+    replay = replay_topology_journal(journal)
+    undelivered = undelivered_items(num_rowgroups, 0, replay.delivered)
+    new_count = hosts + 1 if join_host else hosts
+    survivors = ['host-{}'.format(index) for index in range(new_count)
+                 if not (kill_host and index == killed_index)]
+    recovery_rows = 0
+    recovery_pairs = []
+    resharded = {}
+    if undelivered:
+        resharded = reshard_assignments(undelivered, survivors)
+        writer = MembershipJournal(journal)
+        writer.open()
+        writer.note_reshard(1, resharded, mode)
+        writer.close()
+        for host in survivors:
+            assignment = resharded.get(host, ())
+            if not assignment:
+                continue
+            index = int(host.rsplit('-', 1)[1])
+            manifest = os.path.join(workdir,
+                                    'recovery-host{}.jsonl'.format(index))
+            result = _run_host_epoch(
+                dataset_url,
+                TopologyPolicy(journal_path=journal, process_index=index,
+                               process_count=new_count,
+                               assignment=assignment, generation=1),
+                seed, manifest)
+            recovery_rows += result['rows']
+            recovery_pairs.append((index, manifest))
+        logger.info('chaos --hosts: generation-1 reshard re-dealt %d '
+                    'undelivered item(s) over %d survivor(s)',
+                    len(undelivered), len(survivors))
+
+    # --- verdict ----------------------------------------------------------
+    # re-replay so the verdict reports the journal's FINAL state (the
+    # generation-1 reshard record and the recovery epochs included), not
+    # the pre-reshard snapshot act 3 dealt from
+    final_replay = replay_topology_journal(journal)
+    chaos_manifests = phase1_manifests + [m for _, m in recovery_pairs]
+    chaos_digest = compose_global_digest(chaos_manifests)
+    rows_chaos = phase1_rows + recovery_rows
+    rows_exact = rows_chaos == baseline_rows
+    digest_exact = (chaos_digest['digest'] == baseline_digest['digest']
+                    and not chaos_digest['duplicates'])
+    verify = (verify_manifest(recovery_pairs[0][1]) if recovery_pairs
+              else verify_manifest(chaos_manifests[0]))
+    # the attribution probe: a survivor's recovery stream vs its own
+    # baseline must diff to 'topology' (exit 8) — in steady mode the
+    # 1-host baseline vs an N-host shard carries the same attribution
+    diff = None
+    expected_diff_exit = EXIT_TOPOLOGY
+    diff_pair = next(((index, manifest) for index, manifest in recovery_pairs
+                      if index < len(baseline_manifests)
+                      and baseline_manifests[index]), None)
+    if diff_pair is not None:
+        diff = diff_manifests(baseline_manifests[diff_pair[0]], diff_pair[1])
+    elif mode == 'steady' and phase1_manifests:
+        diff = diff_manifests(baseline_manifests[0], phase1_manifests[0])
+        if hosts == 1:
+            expected_diff_exit = 0  # same topology both sides
+    verdict = {
+        'mode': mode,
+        'hosts': hosts,
+        'global_rowgroups': num_rowgroups,
+        'rows_baseline': baseline_rows,
+        'rows_chaos': rows_chaos,
+        'rows_exact': rows_exact,
+        'fired': fired,
+        'digest_baseline': baseline_digest['digest'],
+        'digest_chaos': chaos_digest['digest'],
+        'digest_exact': digest_exact,
+        'duplicates': chaos_digest['duplicates'],
+        'undelivered_resharded': len(undelivered),
+        'reshard_assignments': {host: list(indices) for host, indices
+                                in sorted(resharded.items())},
+        'verify_exit_code': verify.get('exit_code'),
+        'diff_exit_code': diff.get('exit_code') if diff else None,
+        'diff_attribution': diff.get('attribution') if diff else None,
+        'journal': {'path': journal, 'generation': final_replay.generation,
+                    'frames_dropped': final_replay.frames_dropped,
+                    'records': final_replay.records},
+        'manifests': {'baseline': [m for m in baseline_manifests if m],
+                      'chaos': chaos_manifests},
+    }
+    ok = rows_exact and digest_exact and verify.get('exit_code') == 0
+    if mode != 'steady':
+        # an injury must actually have fired and been re-dealt
+        ok = ok and bool(fired) and bool(undelivered)
+    if diff is not None:
+        ok = ok and diff.get('exit_code') == expected_diff_exit
+    verdict['ok'] = ok
+    return verdict
+
+
 def main(argv=None):
     """``petastorm-tpu-throughput chaos`` entry (module docstring): baseline
     epoch, then the same seed under a chaos schedule against a ledger-armed
@@ -242,11 +507,51 @@ def main(argv=None):
                              'dispatcher kill: the restart must degrade '
                              'loudly (CRC drop counter), never replay '
                              'silently wrong')
+    parser.add_argument('--hosts', type=int, default=0, metavar='N',
+                        help='topology mode: run N simulated topology-armed '
+                             'hosts over a shared membership journal '
+                             'instead of a service fleet '
+                             '(docs/robustness.md "Elastic pod-scale '
+                             'sharding")')
+    parser.add_argument('--kill-host', action='store_true',
+                        help='with --hosts: abandon a seeded host mid-shard '
+                             'with NO leave record; survivors must re-deal '
+                             'only its undelivered remainder')
+    parser.add_argument('--join-host', action='store_true',
+                        help='with --hosts: pause the pod mid-shard and '
+                             'deal host N into the generation-1 reshard')
     parser.add_argument('--json', action='store_true',
                         help='print the verdict as one JSON object')
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    if args.kill_host and args.join_host:
+        parser.error('--kill-host and --join-host are mutually exclusive')
+    if (args.kill_host or args.join_host) and not args.hosts:
+        parser.error('--kill-host/--join-host require --hosts N')
+    if args.hosts:
+        import tempfile as _tempfile
+        workdir = args.workdir or _tempfile.mkdtemp(
+            prefix='petastorm-tpu-chaos-hosts-')
+        verdict = run_host_chaos(args.dataset_url, workdir, args.hosts,
+                                 args.seed, kill_host=args.kill_host,
+                                 join_host=args.join_host)
+        if args.json:
+            print(json.dumps(verdict, indent=2, sort_keys=True))
+        else:
+            print('chaos --hosts {}: {} — mode {}, rows {}/{}, digest {}, '
+                  'verify exit {}, diff exit {} ({})'.format(
+                      args.hosts,
+                      'SURVIVED' if verdict['ok'] else 'FAILED',
+                      verdict['mode'], verdict['rows_chaos'],
+                      verdict['rows_baseline'],
+                      'EXACT' if verdict['digest_exact'] else 'DIVERGED',
+                      verdict['verify_exit_code'],
+                      verdict['diff_exit_code'],
+                      verdict['diff_attribution']))
+            if not verdict['ok']:
+                print(json.dumps(verdict, indent=2, sort_keys=True))
+        return 0 if verdict['ok'] else 1
     os.environ.setdefault('PETASTORM_TPU_SERVICE_RESPONSE_TIMEOUT_S',
                           _CHAOS_RESPONSE_TIMEOUT_S)
     from petastorm_tpu.service.fleet import ServiceFleet

@@ -25,6 +25,11 @@ in order:
    service (docs/service.md), probe its dispatcher: reachable? workers
    registered? queue depth? An unreachable configured service prints a
    WARNING (``report['service']``) — readers pointed at it will fail.
+7. **Topology** — when ``--topology-journal`` (or the
+   ``PETASTORM_TPU_TOPOLOGY_JOURNAL`` env var) names an elastic-sharding
+   membership journal (docs/robustness.md "Elastic pod-scale sharding"),
+   replay it: generation, members, stale leases (WARNING — a host crashed
+   without a leave record), torn frames dropped by CRC (WARNING).
 
 Prints a human-readable report; with ``--json``, one machine-readable JSON
 line (the same dict :func:`collect_report` returns). Exit code 0 iff the
@@ -412,7 +417,7 @@ def check_pipecheck():
 
 
 def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
-                   service_url=None):
+                   service_url=None, topology_journal=None):
     """Run every check; returns the full report dict (no printing)."""
     report = {'versions': check_versions()}
     report['backend'] = check_backend(timeout_s=probe_timeout_s)
@@ -501,6 +506,15 @@ def collect_report(probe_timeout_s=60, link=True, link_timeout_s=180,
         report['ledger'] = check_ledger(report.get('service'))
     except Exception as exc:  # noqa: BLE001 - the report must always complete
         report['ledger'] = {'status': 'fail', 'detail': repr(exc)}
+    # Topology block (docs/robustness.md "Elastic pod-scale sharding"): when
+    # PETASTORM_TPU_TOPOLOGY_JOURNAL (or --topology-journal) names a
+    # membership journal, the replayed pod view — generation, members, stale
+    # leases, CRC drops. Always present so --json consumers find one stable
+    # key; an unarmed topology is a healthy install.
+    try:
+        report['topology'] = check_topology(topology_journal)
+    except Exception as exc:  # noqa: BLE001 - the report must always complete
+        report['topology'] = {'status': 'fail', 'detail': repr(exc)}
     # Incident-bundle block (docs/observability.md "Incident autopsy
     # plane"): retained black-box bundles in the default incident home (or
     # PETASTORM_TPU_INCIDENT_HOME) — each one is a captured failure edge
@@ -557,6 +571,33 @@ def check_history(path, sentinel=None):
         block['rows_per_sec'] = newest.get('rows_per_sec')
         block['platform'] = newest.get('platform')
     return block
+
+
+def check_topology(journal_path=None):
+    """Replay the elastic-sharding membership journal (docs/robustness.md
+    "Elastic pod-scale sharding") when one is named — ``journal_path``
+    argument or the ``PETASTORM_TPU_TOPOLOGY_JOURNAL`` env var. Returns
+    ``{'status': 'unarmed'}`` when no journal is configured,
+    ``{'status': 'absent', ...}`` when the path does not exist yet, else
+    the replayed membership view: generation, live members, stale leases
+    (hosts whose lease expired without a leave — reshard candidates) and
+    the CRC-dropped frame count."""
+    path = journal_path or os.environ.get('PETASTORM_TPU_TOPOLOGY_JOURNAL')
+    if not path:
+        return {'status': 'unarmed'}
+    from petastorm_tpu.parallel.topology import replay_topology_journal
+    replay = replay_topology_journal(path)
+    if replay.result == 'absent':
+        return {'status': 'absent', 'path': path}
+    stale = replay.stale_leases(time.time())
+    return {'status': replay.result, 'path': path,
+            'generation': replay.generation,
+            'members': sorted(replay.members),
+            'stale_leases': stale,
+            'delivered': len(replay.delivered),
+            'resharded': replay.resharded,
+            'frames_dropped': replay.frames_dropped,
+            'records': replay.records}
 
 
 def check_incidents(home=None):
@@ -728,6 +769,32 @@ def _print_human(report):
                   'replay-from-clients; inspect the journal and any '
                   'ledger_corrupt incident bundle'.format(
                       ledger.get('frames_dropped')))
+    topology = report.get('topology') or {}
+    if topology.get('status') in ('ok', 'corrupt'):
+        print('  topology: journal {} — generation {}, {} member(s), {} '
+              'item(s) journaled delivered, {} reshard(s) '
+              '(docs/robustness.md "Elastic pod-scale sharding")'.format(
+                  topology.get('path'), topology.get('generation'),
+                  len(topology.get('members') or []),
+                  topology.get('delivered', 0),
+                  topology.get('resharded', 0)))
+        if topology.get('stale_leases'):
+            print('  WARNING: topology member(s) with EXPIRED leases and no '
+                  'leave record: {} — they look crashed or partitioned; '
+                  'survivors should reshard their undelivered remainder '
+                  '(`petastorm-tpu-throughput chaos --hosts N --kill-host` '
+                  'rehearses exactly this)'.format(
+                      ', '.join(sorted(topology.get('stale_leases')))))
+        if topology.get('frames_dropped'):
+            print('  WARNING: the membership journal dropped {} torn '
+                  'frame(s) on replay — a past append was interrupted; '
+                  'membership resumed from the intact prefix '
+                  '(docs/robustness.md)'.format(
+                      topology.get('frames_dropped')))
+    elif topology.get('status') == 'absent':
+        print('  topology: journal {} configured but not created yet — no '
+              'topology-armed reader has opened it'.format(
+                  topology.get('path')))
     incidents = report.get('incidents') or {}
     if incidents.get('retained'):
         newest = (incidents.get('bundles') or [{}])[0]
@@ -795,11 +862,17 @@ def main(argv=None):
                         help='probe this input-service dispatcher (default: '
                              'the PETASTORM_TPU_SERVICE_URL env var; unset = '
                              'skip)')
+    parser.add_argument('--topology-journal', default=None,
+                        help='replay this elastic-sharding membership '
+                             'journal (default: the '
+                             'PETASTORM_TPU_TOPOLOGY_JOURNAL env var; '
+                             'unset = skip)')
     args = parser.parse_args(argv)
     report = collect_report(probe_timeout_s=args.probe_timeout,
                             link=not args.no_link,
                             link_timeout_s=args.link_timeout,
-                            service_url=args.service_url)
+                            service_url=args.service_url,
+                            topology_journal=args.topology_journal)
     if args.json:
         print(json.dumps(report))
     else:

@@ -1,0 +1,28 @@
+"""Known-bad fixture: topology record kinds drifting from the declared
+registry — a journaled kind the replay never folds (``'jion'``) and a
+replay arm for a kind nothing journals (``'vanished'``), neither declared
+in ``TOPOLOGY_RECORD_KINDS``."""
+
+TOPOLOGY_RECORD_KINDS = ('epoch', 'join', 'leave', 'lease', 'progress',
+                         'reshard')
+
+
+class MiniJournal(object):
+    def __init__(self):
+        self.records = []
+
+    def append_record(self, kind, **fields):
+        self.records.append(dict(fields, kind=kind))
+
+    def note_join(self, host):
+        # typo'd journaled kind: written to shared storage, skipped forever
+        # by every survivor's replay
+        self.append_record('jion', host=host)
+
+    def apply(self, record):
+        kind = record.get('kind')
+        if kind == 'join':
+            pass
+        elif kind == 'vanished':
+            # dead replay arm: no writer ever journals this kind
+            pass

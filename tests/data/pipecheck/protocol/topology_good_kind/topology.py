@@ -1,0 +1,26 @@
+"""Known-good fixture: every journaled topology kind and every replay arm
+names an entry of the declared ``TOPOLOGY_RECORD_KINDS`` registry."""
+
+TOPOLOGY_RECORD_KINDS = ('epoch', 'join', 'leave', 'lease', 'progress',
+                         'reshard')
+
+
+class MiniJournal(object):
+    def __init__(self):
+        self.records = []
+
+    def append_record(self, kind, **fields):
+        self.records.append(dict(fields, kind=kind))
+
+    def note_join(self, host):
+        self.append_record('join', host=host)
+
+    def note_leave(self, host):
+        self.append_record('leave', host=host)
+
+    def apply(self, record):
+        kind = record.get('kind')
+        if kind == 'join':
+            pass
+        elif kind == 'progress':
+            pass

@@ -1,0 +1,23 @@
+"""Suppression fixture: an undeclared replay arm explicitly waived with a
+reasoned ``pipecheck: disable`` directive."""
+
+TOPOLOGY_RECORD_KINDS = ('epoch', 'join', 'leave', 'lease', 'progress',
+                         'reshard')
+
+
+class MiniJournal(object):
+    def __init__(self):
+        self.records = []
+
+    def append_record(self, kind, **fields):
+        self.records.append(dict(fields, kind=kind))
+
+    def note_join(self, host):
+        self.append_record('join', host=host)
+
+    def apply(self, record):
+        kind = record.get('kind')
+        if kind == 'join':
+            pass
+        elif kind == 'rebalance':  # pipecheck: disable=protocol-conformance -- kept one release for journals written by the renamed pre-reshard builds
+            pass

@@ -89,6 +89,8 @@ BAD_FIXTURES = [
      ["b'w_incident'", "b'w_incidnet'"]),
     ('protocol/ledger_bad_kind', ['protocol-conformance'], 2,
      ["'retierd'", "'vanished'", 'LEDGER_RECORD_KINDS']),
+    ('protocol/topology_bad_kind', ['protocol-conformance'], 2,
+     ["'jion'", "'vanished'", 'TOPOLOGY_RECORD_KINDS']),
 ]
 
 GOOD_FIXTURES = [
@@ -105,6 +107,7 @@ GOOD_FIXTURES = [
     ('locks/good_lock.py', ['lock-discipline']),
     ('protocol/good_kinds', ['protocol-conformance']),
     ('protocol/service_good_kinds', ['protocol-conformance']),
+    ('protocol/topology_good_kind', ['protocol-conformance']),
 ]
 
 
@@ -135,6 +138,7 @@ def test_known_good_fixture_is_clean(path, rules):
     ('telemetry/suppressed_history.py', ['telemetry-names']),
     ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
     ('protocol/service_suppressed_kinds', ['protocol-conformance']),
+    ('protocol/topology_suppressed_kind', ['protocol-conformance']),
 ])
 def test_suppression_comment_is_honored_and_counted(path, rules):
     report = run([FIXTURES / path], rules=rules)

@@ -1,0 +1,620 @@
+"""Elastic pod-scale data parallelism tests (ISSUE 19, docs/robustness.md
+"Elastic pod-scale sharding").
+
+Three layers, mirroring tests/test_chaos.py:
+
+- **topology units** (no dataset): identity negotiation (explicit pair > env
+  pair > single-host default, half-specified pairs refused), the generation-0
+  deal matching the static modulo split exactly, membership-journal
+  round-trip/compaction/torn-tail tolerance with the intact prefix kept,
+  undelivered-remainder math and deterministic round-robin resharding, and
+  cross-topology state merging (4 hosts -> 2) with its refusal surface;
+- **reader integration**: ``topology=`` mutual exclusion with static
+  sharding, the 1-host generation-0 digest matching the static path, the
+  shard_skew detector (warning + diagnostics), resume refusing a drifted
+  shard config / a topology checkpoint on a static reader / a changed
+  assignment — loudly, naming both sides — and a corrupted journal degrading
+  LOUDLY (counted frame drop) while the read completes;
+- **end-to-end chaos** (marker ``chaos``): the any-topology determinism
+  matrix (1/2/4 simulated hosts composing to one byte-identical global
+  digest), a SIGKILL'd host mid-shard recovered rows-exact with ``lineage
+  diff`` attributing the divergence to ``topology`` (exit 8), an elastic
+  join absorbing re-dealt work, and a full cross-topology restore (save on
+  2 hosts, resume on 1) delivering every row exactly once.
+"""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.parallel.topology import (
+    MembershipJournal, TopologyPolicy, compose_global_digest, deal_assignment,
+    merge_topology_states, policy_from_state, read_frames,
+    replay_topology_journal, reshard_assignments, resolve_process_identity,
+    resolve_topology_policy, undelivered_items)
+from petastorm_tpu.telemetry.lineage import EXIT_TOPOLOGY, LineagePolicy
+from petastorm_tpu.test_util.chaos import run_host_chaos
+from petastorm_tpu.test_util.fault_injection import corrupt_file
+from test_common import create_test_dataset
+
+NUM_ROWS = 60
+ROWS_PER_FILE = 6  # -> 10 rowgroup work items per epoch
+INDEX_ENV = 'PETASTORM_TPU_PROCESS_INDEX'
+COUNT_ENV = 'PETASTORM_TPU_PROCESS_COUNT'
+
+
+@pytest.fixture(scope='module')
+def topo_store(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp('topology') / 'dataset')
+    url = 'file://' + path
+    create_test_dataset(url, num_rows=NUM_ROWS, rows_per_file=ROWS_PER_FILE)
+    return url
+
+
+@pytest.fixture(autouse=True)
+def _no_identity_env(monkeypatch):
+    """Tests pin identity explicitly; a leaked env pair must not leak in."""
+    monkeypatch.delenv(INDEX_ENV, raising=False)
+    monkeypatch.delenv(COUNT_ENV, raising=False)
+
+
+# ---------------------------------------------------------------------------
+# Identity + policy units (no dataset)
+# ---------------------------------------------------------------------------
+
+class TestIdentityAndDeal(object):
+    def test_deal_matches_static_modulo(self):
+        for count in (1, 2, 3, 5):
+            for num_rowgroups in (0, 1, 7, 10):
+                dealt = [deal_assignment(i, count, num_rowgroups)
+                         for i in range(count)]
+                for index, assignment in enumerate(dealt):
+                    assert assignment == tuple(
+                        g for g in range(num_rowgroups) if g % count == index)
+                # the deals partition the global index space exactly
+                union = sorted(g for a in dealt for g in a)
+                assert union == list(range(num_rowgroups))
+
+    def test_identity_defaults_to_single_host(self):
+        assert resolve_process_identity() == (0, 1)
+
+    def test_identity_env_pair(self, monkeypatch):
+        monkeypatch.setenv(INDEX_ENV, '2')
+        monkeypatch.setenv(COUNT_ENV, '5')
+        assert resolve_process_identity() == (2, 5)
+        # an explicit pair outranks the env pair
+        assert resolve_process_identity(0, 3) == (0, 3)
+
+    def test_identity_half_set_env_refused(self, monkeypatch):
+        monkeypatch.setenv(INDEX_ENV, '2')
+        with pytest.raises(ValueError, match='must be set together'):
+            resolve_process_identity()
+
+    def test_identity_validation(self):
+        with pytest.raises(ValueError, match='must be passed together'):
+            resolve_process_identity(process_index=1)
+        with pytest.raises(ValueError, match='process_count'):
+            resolve_process_identity(0, 0)
+        with pytest.raises(ValueError, match='process_index'):
+            resolve_process_identity(3, 2)
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError, match='set together'):
+            TopologyPolicy(process_index=1)
+        with pytest.raises(ValueError, match='process_index'):
+            TopologyPolicy(process_index=3, process_count=2)
+        with pytest.raises(ValueError, match='lease_s'):
+            TopologyPolicy(lease_s=0)
+        with pytest.raises(ValueError, match='generation'):
+            TopologyPolicy(generation=-1)
+        assert TopologyPolicy(assignment=[3, 1]).assignment == (3, 1)
+
+    def test_resolve_topology_policy_forms(self):
+        assert resolve_topology_policy(None) is None
+        assert resolve_topology_policy(False) is None
+        assert resolve_topology_policy(True) == TopologyPolicy()
+        assert resolve_topology_policy('/x/j.bin').journal_path == '/x/j.bin'
+        policy = TopologyPolicy(process_index=1, process_count=2)
+        assert resolve_topology_policy(policy) is policy
+        with pytest.raises(TypeError, match='topology='):
+            resolve_topology_policy(123)
+
+
+# ---------------------------------------------------------------------------
+# Membership-journal units
+# ---------------------------------------------------------------------------
+
+class TestMembershipJournal(object):
+    def _path(self, tmp_path):
+        return str(tmp_path / 'journal.bin')
+
+    def test_roundtrip_replay(self, tmp_path):
+        path = self._path(tmp_path)
+        journal = MembershipJournal(path, clock=lambda: 100.0)
+        assert journal.open().result == 'absent'
+        journal.note_join('host-0', 0, 2, 0, lease_s=30.0)
+        journal.note_join('host-1', 1, 2, 0, lease_s=30.0)
+        for index in (0, 2, 4):
+            journal.note_progress('host-0', 0, index, 0)
+        journal.note_lease('host-0', lease_s=30.0)
+        journal.note_leave('host-1')
+        journal.close()
+        replay = replay_topology_journal(path)
+        assert replay.result == 'ok'
+        assert replay.frames_dropped == 0
+        assert replay.delivered == frozenset({(0, 0, 0), (0, 2, 0), (0, 4, 0)})
+        assert replay.members['host-0']['alive']
+        assert replay.members['host-0']['expiry'] == 130.0
+        assert not replay.members['host-1']['alive']
+        # lease math: host-0 renewed at t=100 with 30s lease
+        assert replay.stale_leases(now=120.0) == []
+        assert replay.stale_leases(now=131.0) == ['host-0']
+
+    def test_clean_close_writes_no_terminal_record(self, tmp_path):
+        """A clean stop and a crash must replay identically (the ledger's
+        crash-equivalence rule) — close() appends NOTHING."""
+        path = self._path(tmp_path)
+        journal = MembershipJournal(path)
+        journal.open()
+        journal.note_join('host-0', 0, 1, 0, lease_s=30.0)
+        size_before = os.path.getsize(path)
+        journal.close()
+        assert os.path.getsize(path) == size_before
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        path = self._path(tmp_path)
+        journal = MembershipJournal(path)
+        journal.open()
+        journal.note_join('host-0', 0, 1, 0, lease_s=30.0)
+        journal.note_progress('host-0', 0, 0, 0)
+        journal.close()
+        with open(path, 'ab') as stream:
+            stream.write(b'\x00\x00\x00')  # torn header from a crashed append
+        records, dropped = read_frames(path)
+        assert dropped == 1
+        assert [r['kind'] for r in records] == ['epoch', 'join', 'progress']
+        replay = replay_topology_journal(path)
+        assert replay.result == 'corrupt'
+        assert replay.frames_dropped == 1
+        # the intact prefix still replayed — membership degraded, not lost
+        assert replay.delivered == frozenset({(0, 0, 0)})
+        assert replay.members['host-0']['alive']
+
+    def test_flipped_byte_detected_by_crc(self, tmp_path):
+        path = self._path(tmp_path)
+        journal = MembershipJournal(path)
+        journal.open()
+        for index in range(8):
+            journal.note_progress('host-0', 0, index, 0)
+        journal.close()
+        intact = replay_topology_journal(path)
+        corrupt_file(path)  # XOR the middle byte — lands in a frame body
+        replay = replay_topology_journal(path)
+        assert replay.result == 'corrupt'
+        assert replay.frames_dropped == 1
+        assert replay.records < intact.records
+
+    def test_compaction_at_open_preserves_generation(self, tmp_path):
+        path = self._path(tmp_path)
+        journal = MembershipJournal(path, rotate_bytes=256)
+        journal.open()
+        journal.note_reshard(3, {'host-0': [0, 1, 2]}, reason='test')
+        for index in range(50):
+            journal.note_progress('host-0', 0, index, 0)
+        journal.close()
+        size_before = os.path.getsize(path)
+        assert size_before >= 256
+        second = MembershipJournal(path, rotate_bytes=256)
+        replay = second.open()
+        second.close()
+        # open() replays the FULL pre-compaction journal ...
+        assert replay.generation == 3
+        assert len(replay.delivered) == 50
+        # ... then collapses it to one snapshot (+ the new epoch record)
+        assert os.path.getsize(path) < size_before
+        compacted = replay_topology_journal(path)
+        assert compacted.result == 'ok'
+        assert compacted.generation == 3
+        assert compacted.records == 2
+
+    def test_state_block(self, tmp_path):
+        journal = MembershipJournal(self._path(tmp_path))
+        journal.open()
+        journal.note_join('host-0', 0, 1, 0, lease_s=30.0)
+        state = journal.state()
+        journal.close()
+        assert state['armed']
+        assert state['appended'] == 2  # epoch + join
+        assert state['last_replay'] == 'absent'
+        assert state['frames_dropped'] == 0
+
+
+# ---------------------------------------------------------------------------
+# Reshard math units
+# ---------------------------------------------------------------------------
+
+class TestReshardMath(object):
+    def test_undelivered_items(self):
+        delivered = frozenset({(0, 0, 0), (0, 3, 0), (1, 1, 0)})
+        assert undelivered_items(6, 0, delivered) == \
+            [(1, 0), (2, 0), (4, 0), (5, 0)]
+        # epoch 1's deliveries don't pay epoch 0's debt (and vice versa)
+        assert (1, 0) not in undelivered_items(6, 1, delivered)
+        assert undelivered_items(3, 0, frozenset()) == [(0, 0), (1, 0), (2, 0)]
+
+    def test_undelivered_items_drop_partitions(self):
+        delivered = frozenset({(0, 0, 0), (0, 1, 1)})
+        remainder = undelivered_items(2, 0, delivered, drop_partitions=2)
+        assert remainder == [(0, 1), (1, 0)]
+
+    def test_reshard_round_robin_is_deterministic_and_complete(self):
+        undelivered = [(3, 0), (5, 0), (6, 0), (8, 1), (9, 0)]
+        dealt = reshard_assignments(undelivered, ['host-0', 'host-2'])
+        assert dealt == reshard_assignments(undelivered, ['host-0', 'host-2'])
+        redealt = sorted(i for indices in dealt.values() for i in indices)
+        assert redealt == [3, 5, 6, 8, 9]
+
+    def test_reshard_refuses_empty_survivors(self):
+        with pytest.raises(ValueError):
+            reshard_assignments([(0, 0)], [])
+
+
+# ---------------------------------------------------------------------------
+# Cross-topology merge units (synthetic states)
+# ---------------------------------------------------------------------------
+
+def _synthetic_state(index, count, rowgroups, consumed_pieces, epochs=0):
+    assignment = list(deal_assignment(index, count, rowgroups))
+    return {'version': 1, 'items_per_epoch': len(assignment),
+            'epochs_consumed': epochs,
+            'consumed_by_epoch': {'0': [[piece, 0]
+                                        for piece in consumed_pieces]},
+            'topology': {'process_index': index, 'process_count': count,
+                         'generation': 0, 'assignment': assignment,
+                         'global_rowgroups': rowgroups}}
+
+
+class TestMergeTopologyStates(object):
+    def test_merge_4_to_2(self):
+        # 4 hosts x 8 rowgroups; each host consumed its FIRST piece, so the
+        # globally-consumed set is rowgroups {0, 1, 2, 3}
+        states = [_synthetic_state(i, 4, 8, [0]) for i in range(4)]
+        merged = merge_topology_states(states, 2)
+        assert len(merged) == 2
+        host0, host1 = merged
+        assert host0['topology']['assignment'] == [0, 2, 4, 6]
+        assert host1['topology']['assignment'] == [1, 3, 5, 7]
+        # global {0, 2} land on host-0 as local pieces 0 and 1
+        assert host0['consumed_by_epoch'] == {'0': [[0, 0], [1, 0]]}
+        assert host1['consumed_by_epoch'] == {'0': [[0, 0], [1, 0]]}
+        assert host0['items_per_epoch'] == 4
+        assert host0['row_cursor'] is None
+
+    def test_merge_refusals(self):
+        good = _synthetic_state(0, 2, 4, [0])
+        with pytest.raises(ValueError, match='no states'):
+            merge_topology_states([], 1)
+        with pytest.raises(ValueError, match='new_count'):
+            merge_topology_states([good], 0)
+        static = dict(good)
+        static.pop('topology')
+        with pytest.raises(ValueError, match='topology-armed'):
+            merge_topology_states([static], 1)
+        mid_batch = dict(good, row_cursor={'piece': 0})
+        with pytest.raises(ValueError, match='row_cursor'):
+            merge_topology_states([mid_batch], 1)
+        with pytest.raises(ValueError, match='epochs_consumed'):
+            merge_topology_states(
+                [good, _synthetic_state(1, 2, 4, [], epochs=3)], 1)
+        with pytest.raises(ValueError, match='rowgroup count'):
+            merge_topology_states([good, _synthetic_state(1, 2, 6, [])], 1)
+
+    def test_policy_from_state(self):
+        policy = policy_from_state(_synthetic_state(1, 2, 8, []),
+                                   journal_path='/x/j.bin')
+        assert policy.process_index == 1
+        assert policy.process_count == 2
+        assert policy.assignment == (1, 3, 5, 7)
+        assert policy.generation == 0
+        assert policy.journal_path == '/x/j.bin'
+        with pytest.raises(ValueError, match='topology'):
+            policy_from_state({'version': 1})
+
+    def test_restore_across_topology_delegates(self):
+        from petastorm_tpu.parallel.checkpoint import restore_across_topology
+        merged = restore_across_topology(
+            [_synthetic_state(i, 2, 4, [0]) for i in range(2)], 1)
+        assert len(merged) == 1
+        assert merged[0]['topology']['assignment'] == [0, 1, 2, 3]
+
+    def test_parallel_package_lazy_exports(self):
+        import petastorm_tpu.parallel as parallel
+        from petastorm_tpu.parallel import topology
+        assert parallel.TopologyPolicy is topology.TopologyPolicy
+        assert parallel.compose_global_digest is topology.compose_global_digest
+        assert parallel.merge_topology_states is topology.merge_topology_states
+        with pytest.raises(AttributeError):
+            parallel.no_such_export
+
+
+# ---------------------------------------------------------------------------
+# Reader integration
+# ---------------------------------------------------------------------------
+
+def _policy(journal, index=0, count=1, **kwargs):
+    return TopologyPolicy(journal_path=str(journal), process_index=index,
+                          process_count=count, **kwargs)
+
+
+def _read_ids(reader):
+    ids = []
+    for batch in reader.iter_columnar():
+        ids.extend(int(i) for i in batch.columns['id'])
+    return ids
+
+
+class TestReaderTopology(object):
+    def test_mutually_exclusive_with_static_sharding(self, topo_store,
+                                                     tmp_path):
+        with pytest.raises(ValueError, match='mutually exclusive'):
+            make_reader(topo_store, reader_pool_type='dummy',
+                        cur_shard=0, shard_count=2,
+                        topology=_policy(tmp_path / 'j.bin'))
+
+    def test_generation0_matches_static_digest(self, topo_store, tmp_path):
+        """An undisturbed 1-host topology pod reads the same stream as the
+        static path — the composed global digest matches by construction."""
+        digests = []
+        for name, topology in (('static', None),
+                               ('topo', _policy(tmp_path / 'j.bin'))):
+            manifest = str(tmp_path / (name + '.manifest'))
+            reader = make_reader(topo_store, reader_pool_type='dummy',
+                                 num_epochs=1, seed=31,
+                                 shuffle_row_groups=True,
+                                 lineage=LineagePolicy(manifest_path=manifest),
+                                 topology=topology)
+            try:
+                assert len(_read_ids(reader)) == NUM_ROWS
+            finally:
+                reader.stop()
+                reader.join()
+            digests.append(compose_global_digest([manifest]))
+        static, topo = digests
+        assert static['digest'] == topo['digest']
+        assert topo['rows'] == NUM_ROWS
+        assert topo['duplicates'] == []
+
+    def test_shard_skew_warns_static_and_topology(self, topo_store, tmp_path):
+        with pytest.warns(UserWarning, match='shard_skew'):
+            reader = make_reader(topo_store, reader_pool_type='dummy',
+                                 cur_shard=0, shard_count=16)
+        try:
+            assert reader.diagnostics['shard_skew'] == {
+                'shard_count': 16, 'rowgroups': 10, 'empty_shards': 6}
+        finally:
+            reader.stop()
+            reader.join()
+        with pytest.warns(UserWarning, match='shard_skew'):
+            reader = make_reader(topo_store, reader_pool_type='dummy',
+                                 topology=_policy(tmp_path / 'j.bin',
+                                                  index=0, count=16))
+        try:
+            diag = reader.diagnostics
+            assert diag['shard_skew']['empty_shards'] == 6
+            assert diag['topology']['process_count'] == 16
+        finally:
+            reader.stop()
+            reader.join()
+
+    def test_diagnostics_and_state_block(self, topo_store, tmp_path):
+        reader = make_reader(topo_store, reader_pool_type='dummy',
+                             num_epochs=1, seed=5,
+                             topology=_policy(tmp_path / 'j.bin'))
+        try:
+            assert len(_read_ids(reader)) == NUM_ROWS
+            diag = reader.diagnostics['topology']
+            assert diag['host_id'] == 'host-0'
+            assert diag['assignment'] == list(range(10))
+            assert diag['journal']['armed']
+            assert diag['stale_leases'] == []
+            state = reader.state_dict()
+        finally:
+            reader.stop()
+            reader.join()
+        assert state['shard_config']['topology'] is True
+        assert state['topology']['assignment'] == list(range(10))
+        assert state['topology']['global_rowgroups'] == 10
+
+    def test_resume_refuses_drifted_shard_config(self, topo_store):
+        reader = make_reader(topo_store, reader_pool_type='dummy',
+                             num_epochs=1, seed=5, cur_shard=0, shard_count=2)
+        try:
+            _read_ids(reader)
+            state = reader.state_dict()
+        finally:
+            reader.stop()
+            reader.join()
+        # same checkpoint, different shard: a silently-wrong row stream —
+        # the reader must refuse loudly, naming both configs
+        with pytest.raises(ValueError) as excinfo:
+            make_reader(topo_store, reader_pool_type='dummy', num_epochs=1,
+                        seed=5, cur_shard=1, shard_count=2,
+                        resume_state=state)
+        assert "'cur_shard': 0" in str(excinfo.value)
+        assert "'cur_shard': 1" in str(excinfo.value)
+
+    def test_resume_refuses_topology_state_on_static_reader(
+            self, topo_store, tmp_path):
+        reader = make_reader(topo_store, reader_pool_type='dummy',
+                             num_epochs=1, seed=5,
+                             topology=_policy(tmp_path / 'j.bin'))
+        try:
+            _read_ids(reader)
+            state = reader.state_dict()
+        finally:
+            reader.stop()
+            reader.join()
+        with pytest.raises(ValueError, match='shard config|topology-armed'):
+            make_reader(topo_store, reader_pool_type='dummy', num_epochs=1,
+                        seed=5, resume_state=state)
+
+    def test_resume_refuses_changed_assignment(self, topo_store, tmp_path):
+        reader = make_reader(topo_store, reader_pool_type='dummy',
+                             num_epochs=1, seed=5,
+                             topology=_policy(tmp_path / 'j.bin'))
+        try:
+            _read_ids(reader)
+            state = reader.state_dict()
+        finally:
+            reader.stop()
+            reader.join()
+        # a 2-host identity negotiates a different deal than the saved
+        # 1-host assignment — resume must demand merge_topology_states
+        with pytest.raises(ValueError, match='merge_topology_states'):
+            make_reader(topo_store, reader_pool_type='dummy', num_epochs=1,
+                        seed=5, resume_state=state,
+                        topology=_policy(tmp_path / 'j2.bin',
+                                         index=0, count=2))
+
+    def test_corrupt_journal_degrades_loudly(self, topo_store, tmp_path,
+                                             caplog):
+        journal = tmp_path / 'j.bin'
+        reader = make_reader(topo_store, reader_pool_type='dummy',
+                             num_epochs=1, seed=5, topology=_policy(journal))
+        try:
+            _read_ids(reader)
+        finally:
+            reader.stop()
+            reader.join()
+        corrupt_file(str(journal))
+        with caplog.at_level(logging.WARNING,
+                             logger='petastorm_tpu.parallel.topology'):
+            reader = make_reader(topo_store, reader_pool_type='dummy',
+                                 num_epochs=1, seed=5,
+                                 topology=_policy(journal))
+        try:
+            assert reader._topology.frames_dropped >= 1
+            diag = reader.diagnostics['topology']
+            assert diag['journal']['frames_dropped'] >= 1
+            assert diag['journal']['last_replay'] == 'corrupt'
+            # degraded LOUDLY — and the read itself still completes
+            assert any('dropped' in record.getMessage()
+                       for record in caplog.records)
+            assert len(_read_ids(reader)) == NUM_ROWS
+        finally:
+            reader.stop()
+            reader.join()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end chaos: determinism matrix, host kill/join, cross-topology restore
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestHostChaos(object):
+    @pytest.mark.parametrize('hosts', [1, 2, 4])
+    def test_any_topology_determinism_matrix(self, topo_store, tmp_path,
+                                             hosts):
+        """N same-seed hosts compose to the SAME global digest as one host —
+        the any-topology invariance the lineage plane proves."""
+        verdict = run_host_chaos(topo_store, str(tmp_path / 'steady'),
+                                 hosts=hosts, seed=101)
+        assert verdict['ok'], verdict
+        assert verdict['rows_chaos'] == NUM_ROWS
+        assert verdict['digest_exact']
+        assert verdict['duplicates'] == []
+        # topology blocks differ across host counts, streams don't: lineage
+        # diff pins the divergence on topology (exit 8); 1 host == baseline
+        assert verdict['diff_exit_code'] == \
+            (0 if hosts == 1 else EXIT_TOPOLOGY)
+
+    def test_kill_host_recovers_rows_exact(self, topo_store, tmp_path):
+        verdict = run_host_chaos(topo_store, str(tmp_path / 'kill'),
+                                 hosts=3, seed=1234, kill_host=True)
+        assert verdict['ok'], verdict
+        assert verdict['rows_exact']
+        assert verdict['rows_chaos'] == NUM_ROWS
+        assert verdict['digest_exact']
+        assert verdict['duplicates'] == []
+        assert verdict['fired'] and verdict['fired'][0]['kind'] == 'kill_host'
+        assert verdict['undelivered_resharded'] >= 1
+        assert verdict['verify_exit_code'] == 0
+        assert verdict['diff_exit_code'] == EXIT_TOPOLOGY
+        assert verdict['diff_attribution'] == 'topology'
+        assert verdict['journal']['generation'] == 1
+
+    def test_join_host_absorbs_redealt_work(self, topo_store, tmp_path):
+        verdict = run_host_chaos(topo_store, str(tmp_path / 'join'),
+                                 hosts=2, seed=77, join_host=True)
+        assert verdict['ok'], verdict
+        assert verdict['rows_exact']
+        assert verdict['digest_exact']
+        assert verdict['duplicates'] == []
+        assert verdict['fired'][0]['kind'] == 'join_host'
+        assert verdict['undelivered_resharded'] >= 1
+        # the joiner is a reshard-generation survivor in the journal
+        assert verdict['journal']['generation'] == 1
+
+    def test_thread_pool_matches_dummy_digest(self, topo_store, tmp_path):
+        """The composed digest is pool-invariant too: a 2-host thread-pool
+        pod folds to the 1-host dummy-pool digest."""
+        manifests = []
+        for index, pool, count in ((0, 'dummy', 1), (0, 'thread', 2),
+                                   (1, 'thread', 2)):
+            manifest = str(tmp_path / 'm-{}-{}.manifest'.format(pool, index))
+            journal = tmp_path / 'j-{}.bin'.format(count)
+            reader = make_reader(topo_store, reader_pool_type=pool,
+                                 workers_count=2, num_epochs=1, seed=13,
+                                 shuffle_row_groups=True,
+                                 lineage=LineagePolicy(manifest_path=manifest),
+                                 topology=_policy(journal, index=index,
+                                                  count=count))
+            try:
+                _read_ids(reader)
+            finally:
+                reader.stop()
+                reader.join()
+            manifests.append(manifest)
+        single = compose_global_digest(manifests[:1])
+        pod = compose_global_digest(manifests[1:])
+        assert single['digest'] == pod['digest']
+        assert pod['rows'] == NUM_ROWS
+        assert pod['duplicates'] == []
+
+    def test_cross_topology_restore_rows_exact(self, topo_store, tmp_path):
+        """Save a 2-host pod at a batch boundary, merge, resume on ONE host:
+        every row delivered exactly once across the topology change."""
+        states, phase1_ids = [], []
+        for index in range(2):
+            reader = make_reader(topo_store, reader_pool_type='dummy',
+                                 num_epochs=1, seed=7,
+                                 shuffle_row_groups=True,
+                                 topology=_policy(tmp_path / 'j2.bin',
+                                                  index=index, count=2))
+            try:
+                batches = 0
+                for batch in reader.iter_columnar():
+                    phase1_ids.extend(int(i) for i in batch.columns['id'])
+                    batches += 1
+                    if batches == 2:
+                        break
+                states.append(reader.state_dict())
+            finally:
+                reader.stop()
+                reader.join()
+        merged = merge_topology_states(states, 1)
+        assert len(merged) == 1
+        policy = policy_from_state(merged[0],
+                                   journal_path=str(tmp_path / 'j1.bin'))
+        reader = make_reader(topo_store, reader_pool_type='dummy',
+                             num_epochs=1, seed=7, shuffle_row_groups=True,
+                             topology=policy, resume_state=merged[0])
+        try:
+            phase2_ids = _read_ids(reader)
+        finally:
+            reader.stop()
+            reader.join()
+        assert len(phase1_ids) + len(phase2_ids) == NUM_ROWS
+        assert sorted(phase1_ids + phase2_ids) == list(range(NUM_ROWS))
