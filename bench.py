@@ -2856,12 +2856,17 @@ def child_main():
         recorded in the BENCH json — perf history that rides on code whose
         producer/consumer protocol has drifted is not trustworthy perf
         history."""
+        import time as _time
         from petastorm_tpu.analysis import run_pipecheck as pipecheck
+        started = _time.perf_counter()
         report = pipecheck()
+        elapsed_s = _time.perf_counter() - started
         by_rule = report.by_rule()
-        log('pipecheck: {} — {} file(s), {} finding(s), {} suppressed{}'
+        log('pipecheck: {} — {} file(s), {} finding(s), {} suppressed, '
+            '{} call-graph function(s), {:.2f}s{}'
             .format('clean' if report.clean else 'FINDINGS', report.files,
                     len(report.findings), report.suppressed,
+                    report.callgraph_functions, elapsed_s,
                     '' if report.clean else '; first: ' +
                     report.findings[0].format()))
         results.update({
@@ -2869,9 +2874,21 @@ def child_main():
             'pipecheck_findings': len(report.findings),
             'pipecheck_suppressed': report.suppressed,
             'pipecheck_files': report.files,
+            'pipecheck_callgraph_functions': report.callgraph_functions,
+            'pipecheck_wall_s': round(elapsed_s, 3),
+            # the whole-program pass must stay CI-cheap: the interprocedural
+            # engine is summaries + memoized closures, not path exploration
+            'pipecheck_under_30s': elapsed_s <= 30.0,
             'pipecheck_mypy_ratchet_findings':
                 by_rule.get('mypy-ratchet', 0),
         })
+        # per-rule finding counts for the interprocedural families so a
+        # regression names its rule straight from the BENCH json
+        for rule in ('resource-lifecycle', 'determinism',
+                     'journal-discipline', 'lock-discipline',
+                     'exception-hygiene'):
+            results['pipecheck_' + rule.replace('-', '_') +
+                    '_findings'] = by_rule.get(rule, 0)
 
     def run_decode_bench():
         """Vectorized decode-engine microbench (host-only, fast): per-codec

@@ -14,9 +14,11 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
+from dataclasses import replace
 from pathlib import Path
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Set
 
 from petastorm_tpu.analysis.config import AnalysisConfig, default_config
 from petastorm_tpu.analysis.core import Report, run_analysis
@@ -33,15 +35,75 @@ def package_root() -> Path:
 def run_pipecheck(paths: Optional[Sequence[str]] = None,
                   rules: Optional[Sequence[str]] = None,
                   mypy_ini: Optional[str] = None,
-                  manifest: Optional[str] = None) -> Report:
+                  manifest: Optional[str] = None,
+                  diff_base: Optional[str] = None) -> Report:
     """Programmatic entry (doctor, bench, tests): analyze ``paths`` (default:
     the installed package) with the shipped rules and return the
-    :class:`~petastorm_tpu.analysis.core.Report`."""
+    :class:`~petastorm_tpu.analysis.core.Report`.
+
+    ``diff_base`` restricts the *reported* findings to files changed vs the
+    given git ref — the analysis itself still runs over the whole tree
+    (cross-file rules need full context), so the filter narrows the output
+    without weakening the checks."""
     config = default_config()
     if mypy_ini is not None or manifest is not None:
         config = AnalysisConfig(mypy_ini_path=mypy_ini, manifest_path=manifest)
     targets = [Path(p) for p in paths] if paths else [package_root()]
-    return run_analysis(targets, default_rules(rules), config)
+    report = run_analysis(targets, default_rules(rules), config)
+    if diff_base is not None:
+        report = _restrict_to_diff(report, diff_base, targets)
+    return report
+
+
+def _changed_paths(diff_base: str, targets: Sequence[Path]) -> Set[str]:
+    """Repo-relative posix paths changed vs ``diff_base`` in the repo(s)
+    owning ``targets``. Raises ``ValueError`` when git cannot diff (bad
+    ref, not a repository) — surfaced as a usage error (exit 2)."""
+    changed: Set[str] = set()
+    seen_tops: Set[str] = set()
+    for target in targets:
+        anchor = target if target.is_dir() else target.parent
+        try:
+            top = subprocess.run(
+                ['git', '-C', str(anchor), 'rev-parse', '--show-toplevel'],
+                capture_output=True, text=True, check=True).stdout.strip()
+            if top in seen_tops:
+                continue
+            seen_tops.add(top)
+            diff = subprocess.run(
+                ['git', '-C', top, 'diff', '--name-only', diff_base, '--'],
+                capture_output=True, text=True, check=True).stdout
+        except (OSError, subprocess.CalledProcessError) as exc:
+            stderr = getattr(exc, 'stderr', '') or ''
+            raise ValueError(
+                '--diff-base {!r}: git diff failed under {} ({})'.format(
+                    diff_base, anchor, stderr.strip() or exc))
+        changed.update(line.strip() for line in diff.splitlines()
+                       if line.strip())
+    return changed
+
+
+def _restrict_to_diff(report: Report, diff_base: str,
+                      targets: Sequence[Path]) -> Report:
+    """Drop findings whose file did not change vs ``diff_base`` (matched by
+    path suffix either way, so display paths and repo-relative git paths
+    agree without a common anchor)."""
+    changed = _changed_paths(diff_base, targets)
+
+    def touched(display: str) -> bool:
+        for path in changed:
+            if (display == path or display.endswith('/' + path)
+                    or path.endswith('/' + display)):
+                return True
+        return False
+
+    kept = [finding for finding in report.findings
+            if touched(finding.path)]
+    note = ('--diff-base {}: reporting {} of {} finding(s) in {} changed '
+            'file(s)'.format(diff_base, len(kept), len(report.findings),
+                             len(changed)))
+    return replace(report, findings=kept,
+                   notes=list(report.notes) + [note])
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -58,6 +120,10 @@ def build_parser() -> argparse.ArgumentParser:
                              'flake8-style listing')
     parser.add_argument('--rules',
                         help='comma-separated rule subset (see --list-rules)')
+    parser.add_argument('--diff-base', metavar='REF',
+                        help='report only findings in files changed vs this '
+                             'git ref (analysis still runs whole-program; '
+                             'keeps the CI gate fast as the tree grows)')
     parser.add_argument('--list-rules', action='store_true',
                         help='print the rule catalog and exit')
     parser.add_argument('--mypy-ini',
@@ -86,7 +152,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         report = run_pipecheck(paths=args.paths or None, rules=selected,
                                mypy_ini=args.mypy_ini,
-                               manifest=args.manifest)
+                               manifest=args.manifest,
+                               diff_base=args.diff_base)
     except ValueError as exc:
         print('pipecheck: {}'.format(exc), file=sys.stderr)
         return 2

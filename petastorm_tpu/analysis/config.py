@@ -104,6 +104,79 @@ STRICT_FLAGS: Tuple[str, ...] = ('disallow_untyped_defs',
                                  'no_implicit_optional',
                                  'warn_return_any')
 
+#: leakable resource table for the resource-lifecycle rule. Each row is
+#: ``(constructor, release_methods, releaser_funcs, exempt_kwargs, label,
+#: paths_sensitive)``: a call whose terminal name equals ``constructor``
+#: acquires the resource; a call of one of ``release_methods`` on the
+#: binding (or passing the binding to a function named in
+#: ``releaser_funcs``) releases it; a truthy keyword from ``exempt_kwargs``
+#: at the construction site waives tracking (``Thread(daemon=True)`` dies
+#: with the process); ``paths_sensitive`` rows must ALSO release on
+#: exception paths (finally / ``with``), the PR-2 ``/dev/shm`` leak class.
+#: The pseudo-constructors ``mkstemp:fd`` / ``mkstemp:path`` describe the
+#: two halves of ``fd, path = tempfile.mkstemp(...)``.
+LEAKABLE_TYPES: Tuple[Tuple[str, Tuple[str, ...], Tuple[str, ...],
+                            Tuple[str, ...], str, bool], ...] = (
+    ('SharedMemory', ('close', 'unlink'), (), (),
+     'shared-memory segment', True),
+    ('TemporaryDirectory', ('cleanup',), (), (),
+     'temporary directory', True),
+    ('Thread', ('join',), (), ('daemon',), 'thread', False),
+    ('Context', ('term', 'destroy'), (), (), 'zmq context', True),
+    ('socket', ('close',), (), (), 'socket', True),
+    ('TokenLedger', ('close',), (), (), 'token ledger', False),
+    ('MembershipJournal', ('close', 'abandon'), (), (),
+     'membership journal', False),
+    ('ShmRing', ('close', 'close_and_unlink', 'unlink'), (), (),
+     'shm ring', False),
+    ('mkstemp:fd', (), ('fdopen', 'close'), (),
+     'mkstemp file descriptor', True),
+    ('mkstemp:path', (), ('replace', 'unlink', 'remove', 'rename'), (),
+     'mkstemp temp path', True),
+)
+
+#: lineage-covered modules (path suffixes, ``/``-anchored) under the
+#: determinism discipline: unseeded randomness, unordered iteration feeding
+#: an order-sensitive sink, and ``id()``-keyed containers are findings —
+#: the static twin of ``compose_global_digest``'s runtime proof
+#: (docs/robustness.md "Provable determinism at any topology")
+DETERMINISM_MODULES: Tuple[str, ...] = ('reader.py',
+                                        'workers/ventilator.py',
+                                        'schedule/cost_schedule.py',
+                                        'parallel/topology.py',
+                                        'parallel/loader.py',
+                                        'parallel/inmem_loader.py',
+                                        'service/dispatcher.py',
+                                        'telemetry/lineage.py')
+
+#: call names whose argument order IS the reproducibility contract: digest
+#: folds, journal appends, shard deals, progress notes. Unordered iteration
+#: (sets, ``os.listdir``, ``glob``, raw dict views) flowing into one of
+#: these without an intervening ``sorted()`` is a determinism finding.
+ORDER_SENSITIVE_SINKS: Tuple[str, ...] = ('append_record', '_journal',
+                                          'fold_digest', 'deal_assignment',
+                                          'reshard_assignments',
+                                          'note_join', 'note_leave',
+                                          'note_progress', 'note_reshard',
+                                          'note_lease')
+
+#: the append-only CRC-framed journals and their closed record registries,
+#: for the journal-discipline rule. Each row is ``(file_suffix,
+#: registry_name, writer_call_names, kind_label, import_name)``: inside the
+#: journal module every ``kind == 'x'`` replay compare, and everywhere any
+#: literal first argument to one of ``writer_call_names``, must name an
+#: entry of ``registry_name`` (declared in the journal module; resolved
+#: from the installed ``import_name`` when the analyzed tree lacks it).
+JOURNAL_REGISTRIES: Tuple[Tuple[str, str, Tuple[str, ...], str, str],
+                          ...] = (
+    ('ledger.py', 'LEDGER_RECORD_KINDS', ('append_record', '_journal'),
+     'ledger record kind', 'petastorm_tpu.service.ledger'),
+    ('topology.py', 'TOPOLOGY_RECORD_KINDS', ('append_record', '_journal'),
+     'topology record kind', 'petastorm_tpu.parallel.topology'),
+    ('history.py', 'RUN_RECORD_OWNERS', ('build_run_record',),
+     'run-record owner', 'petastorm_tpu.telemetry.history'),
+)
+
 
 @dataclass(frozen=True)
 class AnalysisConfig:
@@ -122,6 +195,13 @@ class AnalysisConfig:
     knob_catalog_suffix: str = KNOB_CATALOG_SUFFIX
     cost_model_suffix: str = COST_MODEL_SUFFIX
     strict_flags: Tuple[str, ...] = STRICT_FLAGS
+    leakable_types: Tuple[Tuple[str, Tuple[str, ...], Tuple[str, ...],
+                                Tuple[str, ...], str, bool],
+                          ...] = LEAKABLE_TYPES
+    determinism_modules: Tuple[str, ...] = DETERMINISM_MODULES
+    order_sensitive_sinks: Tuple[str, ...] = ORDER_SENSITIVE_SINKS
+    journal_registries: Tuple[Tuple[str, str, Tuple[str, ...], str, str],
+                              ...] = JOURNAL_REGISTRIES
     #: explicit mypy.ini path; None = walk up from the analyzed roots
     mypy_ini_path: Optional[str] = None
     #: explicit ratchet manifest path; None = the packaged

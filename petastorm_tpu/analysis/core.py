@@ -35,6 +35,7 @@ import ast
 import io
 import json
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -188,6 +189,12 @@ class Report:
     files: int
     rules: List[str]
     notes: List[str] = field(default_factory=list)
+    #: wall seconds each rule spent (check_module + finalize), for the
+    #: ``--json`` CLI output and the bench wall-time guard
+    rule_seconds: Dict[str, float] = field(default_factory=dict)
+    #: functions indexed by the shared call graph this pass (0 when no
+    #: graph-backed rule ran)
+    callgraph_functions: int = 0
 
     @property
     def clean(self) -> bool:
@@ -209,6 +216,9 @@ class Report:
                 'files': self.files,
                 'rules': list(self.rules),
                 'by_rule': self.by_rule(),
+                'rule_seconds': {rule: round(seconds, 4) for rule, seconds
+                                 in sorted(self.rule_seconds.items())},
+                'callgraph_functions': self.callgraph_functions,
                 'findings': [f.as_dict() for f in self.findings],
                 'notes': list(self.notes)}
 
@@ -303,11 +313,16 @@ def run_analysis(paths: Sequence[Path], rules: Sequence[Rule],
         assert module is not None
         ctx.modules.append(module)
         by_display[module.display] = module
+    rule_seconds: Dict[str, float] = {rule.name: 0.0 for rule in rules}
     for module in ctx.modules:
         for rule in rules:
+            started = time.perf_counter()
             raw.extend(rule.check_module(module, ctx))
+            rule_seconds[rule.name] += time.perf_counter() - started
     for rule in rules:
+        started = time.perf_counter()
         raw.extend(rule.finalize(ctx))
+        rule_seconds[rule.name] += time.perf_counter() - started
 
     findings: List[Finding] = list(parse_errors)
     suppressed = 0
@@ -328,8 +343,15 @@ def run_analysis(paths: Sequence[Path], rules: Sequence[Rule],
                     'suppression without a reason: append " -- <why this is '
                     'safe>" (docs/static-analysis.md)'))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # duck-typed so core never imports callgraph (rules own that layer):
+    # whatever the graph-backed rules cached under their shared state key
+    # reports its function count here
+    graph = ctx.state.get('__callgraph__')
+    graph_functions = len(getattr(graph, 'functions', ()) or ())
     return Report(findings=findings, suppressed=suppressed, files=files,
-                  rules=[rule.name for rule in rules], notes=list(ctx.notes))
+                  rules=[rule.name for rule in rules], notes=list(ctx.notes),
+                  rule_seconds=rule_seconds,
+                  callgraph_functions=graph_functions)
 
 
 def _owning_root(path: Path, roots: Sequence[Path]) -> Optional[Path]:

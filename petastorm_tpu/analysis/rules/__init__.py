@@ -13,7 +13,10 @@ from typing import Dict, List, Optional, Sequence, Type
 
 from petastorm_tpu.analysis.core import Rule
 from petastorm_tpu.analysis.rules.clock import ClockDisciplineRule
+from petastorm_tpu.analysis.rules.determinism import DeterminismRule
 from petastorm_tpu.analysis.rules.exceptions import ExceptionHygieneRule
+from petastorm_tpu.analysis.rules.journal import JournalDisciplineRule
+from petastorm_tpu.analysis.rules.lifecycle import ResourceLifecycleRule
 from petastorm_tpu.analysis.rules.locks import LockDisciplineRule
 from petastorm_tpu.analysis.rules.protocol import ProtocolConformanceRule
 from petastorm_tpu.analysis.rules.ratchet import MypyRatchetRule
@@ -26,6 +29,9 @@ ALL_RULES: List[Type[Rule]] = [
     ClockDisciplineRule,
     ExceptionHygieneRule,
     LockDisciplineRule,
+    ResourceLifecycleRule,
+    DeterminismRule,
+    JournalDisciplineRule,
     MypyRatchetRule,
 ]
 

@@ -8,7 +8,10 @@ Two sub-checks:
 can do:
 
 - if every path through the body re-raises, it is a translation/cleanup
-  handler — fine;
+  handler — fine; since pipecheck v2 this is judged *interprocedurally*: a
+  handler whose trailing statement calls a function that (transitively)
+  always raises — a ``_fail()`` / ``_reraise_as()`` helper — counts as
+  re-raising, via the call graph's raise closure;
 - if it can *swallow* (complete without raising), it must either carry a
   trailing comment on the ``except`` line stating the reason (the house
   convention: ``except Exception:  # noqa: BLE001 - <why>``), or — outside
@@ -31,6 +34,8 @@ import ast
 import re
 from typing import Iterable, List, Optional, Sequence
 
+from petastorm_tpu.analysis.callgraph import (CallGraph, FunctionInfo,
+                                              get_callgraph)
 from petastorm_tpu.analysis.core import (AnalysisContext, Finding, Rule,
                                          SourceModule,
                                          walk_skipping_functions)
@@ -122,35 +127,72 @@ class ExceptionHygieneRule(Rule):
                      ctx: AnalysisContext) -> Iterable[Finding]:
         findings: List[Finding] = []
         in_workers = ('/' + ctx.config.worker_dir + '/') in module.posix()
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            if not is_broad_handler(node):
-                continue
-            if (comment_states_reason(module.comments.get(node.lineno))
-                    and node.lineno not in module.suppressions):
-                # reason documented at the site (house style); a bare marker
-                # or `# TODO` is not a reason, and a pipecheck directive
-                # instead flows through the framework's suppression
-                # accounting, so opt-outs stay countable
-                continue
-            if always_raises(node.body):
-                continue  # translation handler, never swallows
-            if in_workers:
-                findings.append(Finding(
-                    self.name, module.display, node.lineno,
-                    'broad except can swallow in a worker module: narrow the '
-                    'type, re-raise, or state the reason in a trailing '
-                    'comment on this line'))
-            elif not body_logs(node.body):
-                findings.append(Finding(
-                    self.name, module.display, node.lineno,
-                    'broad except swallows without logging or re-raise: '
-                    'narrow the type, log-and-continue, or add a reason '
-                    'comment'))
         if in_workers or module.name in ctx.config.datapath_files:
             findings.extend(self._check_raises(module))
         return findings
+
+    def finalize(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        """The broad-except pass runs here so the raise closure can accept
+        handlers that delegate to an always-raising helper."""
+        graph = get_callgraph(ctx)
+        findings: List[Finding] = []
+        for module in ctx.modules:
+            in_workers = ('/' + ctx.config.worker_dir
+                          + '/') in module.posix()
+            enclosing = self._handler_owners(graph, module)
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not is_broad_handler(node):
+                    continue
+                if (comment_states_reason(module.comments.get(node.lineno))
+                        and node.lineno not in module.suppressions):
+                    # reason documented at the site (house style); a bare
+                    # marker or `# TODO` is not a reason, and a pipecheck
+                    # directive instead flows through the framework's
+                    # suppression accounting, so opt-outs stay countable
+                    continue
+                caller = enclosing.get(id(node)) or FunctionInfo(
+                    module=module, node=module.tree, name='<module>',
+                    qualname='<module>', class_name=None)
+                if graph.stmts_always_raise(node.body, caller):
+                    continue  # translation handler, never swallows
+                if in_workers:
+                    findings.append(Finding(
+                        self.name, module.display, node.lineno,
+                        'broad except can swallow in a worker module: '
+                        'narrow the type, re-raise, or state the reason in '
+                        'a trailing comment on this line'))
+                elif not body_logs(node.body):
+                    findings.append(Finding(
+                        self.name, module.display, node.lineno,
+                        'broad except swallows without logging or '
+                        're-raise: narrow the type, log-and-continue, or '
+                        'add a reason comment'))
+        return findings
+
+    @staticmethod
+    def _handler_owners(graph: CallGraph, module: SourceModule
+                        ) -> dict:
+        """Map each except-handler (by ``id``) to its innermost enclosing
+        function — the resolution scope for the raise closure (smallest
+        line span wins, so a handler in a nested def resolves there)."""
+        owners: dict = {}
+        spans: dict = {}
+        for info in graph.functions.values():
+            if info.module is not module:
+                continue
+            start = int(getattr(info.node, 'lineno', 0))
+            end = int(getattr(info.node, 'end_lineno', start) or start)
+            span = end - start
+            for inner in ast.walk(info.node):  # type: ignore[arg-type]
+                if not isinstance(inner, ast.ExceptHandler):
+                    continue
+                key = id(inner)
+                if key not in owners or span < spans[key]:
+                    owners[key] = info
+                    spans[key] = span
+        return owners
 
     def _check_raises(self, module: SourceModule) -> List[Finding]:
         findings = []

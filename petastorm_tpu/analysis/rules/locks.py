@@ -22,6 +22,13 @@ findings:
   ``', '.join(parts)`` and ``os.path.join(a, b)`` out)
 - ``subprocess.run/call/check_call/check_output(...)`` and ``input()``
 
+**Interprocedural (pipecheck v2):** the same check now follows the call
+graph — a call inside the critical section that resolves (confidently —
+same module, ``self.method``, or project-unique name) to a function whose
+transitive closure reaches a blocking call is flagged too, with the chain
+spelled out (``_helper() -> _drain() -> time.sleep()``). A blocking call
+two helpers deep inside a ``with lock:`` body is no longer invisible.
+
 ``Condition.wait`` is deliberately NOT flagged: condition variables must be
 waited on with their lock held — that is their protocol, not a violation.
 """
@@ -29,23 +36,16 @@ waited on with their lock held — that is their protocol, not a violation.
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional
+from typing import Iterable, List
 
+from petastorm_tpu.analysis.callgraph import (blocking_call, get_callgraph,
+                                              terminal_name)
 from petastorm_tpu.analysis.core import (AnalysisContext, Finding, Rule,
                                          SourceModule,
                                          walk_skipping_functions)
 
-_RECV_ATTRS = frozenset({'recv', 'recv_multipart', 'recv_string',
-                         'recv_pyobj', 'recv_json', 'accept'})
-_SUBPROCESS_FUNCS = frozenset({'run', 'call', 'check_call', 'check_output'})
-
-
-def _terminal_name(node: ast.expr) -> Optional[str]:
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return None
+_blocking_call = blocking_call
+_terminal_name = terminal_name
 
 
 def is_lockish(node: ast.expr) -> bool:
@@ -58,34 +58,10 @@ def is_lockish(node: ast.expr) -> bool:
     return lowered == 'lock' or lowered.endswith('lock')
 
 
-def _blocking_call(node: ast.Call) -> Optional[str]:
-    """A human-readable description when ``node`` is a blocking call."""
-    func = node.func
-    if isinstance(func, ast.Name):
-        if func.id == 'sleep':
-            return 'sleep()'
-        if func.id == 'input':
-            return 'input()'
-        return None
-    if not isinstance(func, ast.Attribute):
-        return None
-    if func.attr == 'sleep':
-        return '{}.sleep()'.format(_terminal_name(func.value) or '?')
-    if func.attr in _RECV_ATTRS:
-        return '.{}()'.format(func.attr)
-    if (func.attr in _SUBPROCESS_FUNCS
-            and isinstance(func.value, ast.Name)
-            and func.value.id == 'subprocess'):
-        return 'subprocess.{}()'.format(func.attr)
-    if func.attr == 'join':
-        if not node.args and not node.keywords:
-            return '.join()'
-        if any(kw.arg == 'timeout' for kw in node.keywords):
-            return '.join(timeout=...)'
-        if (len(node.args) == 1 and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, (int, float))):
-            return '.join({})'.format(node.args[0].value)
-    return None
+def _lock_names(node: ast.AST) -> List[str]:
+    return [_terminal_name(item.context_expr) or 'lock'
+            for item in getattr(node, 'items', [])
+            if is_lockish(item.context_expr)]
 
 
 class LockDisciplineRule(Rule):
@@ -93,7 +69,8 @@ class LockDisciplineRule(Rule):
 
     name = 'lock-discipline'
     description = ('no sleep / blocking recv / join inside a "with lock:" '
-                   'body — critical sections must stay bookkeeping-short')
+                   'body — directly or through any resolvable helper chain; '
+                   'critical sections must stay bookkeeping-short')
 
     def check_module(self, module: SourceModule,
                      ctx: AnalysisContext) -> Iterable[Finding]:
@@ -101,9 +78,7 @@ class LockDisciplineRule(Rule):
         for node in ast.walk(module.tree):
             if not isinstance(node, (ast.With, ast.AsyncWith)):
                 continue
-            lock_names = [
-                _terminal_name(item.context_expr) or 'lock'
-                for item in node.items if is_lockish(item.context_expr)]
+            lock_names = _lock_names(node)
             if not lock_names:
                 continue
             for inner in walk_skipping_functions(node.body):
@@ -117,4 +92,35 @@ class LockDisciplineRule(Rule):
                         'outside the critical section (snapshot under the '
                         'lock, block outside)'.format(
                             blocked, lock_names[0])))
+        return findings
+
+    def finalize(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        """The interprocedural pass: calls under a lock that resolve to a
+        transitively-blocking function."""
+        graph = get_callgraph(ctx)
+        findings: List[Finding] = []
+        for info in graph.functions.values():
+            for node in walk_skipping_functions(info.body()):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                lock_names = _lock_names(node)
+                if not lock_names:
+                    continue
+                for inner in walk_skipping_functions(node.body):
+                    if not isinstance(inner, ast.Call):
+                        continue
+                    if _blocking_call(inner) is not None:
+                        continue  # direct — already flagged per-module
+                    callee = graph.resolve_call(inner, info)
+                    if callee is None or callee.key == info.key:
+                        continue
+                    chain = graph.blocking_chain(callee)
+                    if chain is None:
+                        continue
+                    findings.append(Finding(
+                        self.name, info.module.display, inner.lineno,
+                        'call while holding {!r} blocks through its helper '
+                        'chain: {} — snapshot under the lock, block '
+                        'outside'.format(lock_names[0],
+                                         ' -> '.join(chain))))
         return findings

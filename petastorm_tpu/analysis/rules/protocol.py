@@ -27,21 +27,11 @@ path — the exact drift class this rule pins down statically:
   ``num_rows``/``columns`` are allowed extra reads).
 - **quarantine reasons**: every ``QuarantineRecord(..., reason='x')`` literal
   must appear in the ``QUARANTINE_REASONS`` registry in ``resilience.py``.
-- **ledger record kinds**: the durable dispatcher ledger is a wire protocol
-  with the FUTURE — the dispatcher that replays a journal may be a newer
-  build than the one that wrote it. Every kind literal journaled
-  (``.append_record('x')`` / ``._journal('x')``) by any analyzed module and
-  every ``kind == 'x'`` replay compare inside ``ledger.py`` must name a kind
-  declared in its ``LEDGER_RECORD_KINDS`` tuple (docs/service.md).
-- **topology record kinds**: the membership journal of the elastic-sharding
-  plane carries the same writer-vs-future-replayer contract over shared
-  storage, against its own registry: inside ``topology.py`` every journaled
-  kind literal and every ``kind == 'x'`` replay compare must name a kind
-  declared in ``TOPOLOGY_RECORD_KINDS`` (docs/robustness.md "Elastic
-  pod-scale sharding"). Topology modules are exempt from the ledger check —
-  the two journals are distinct protocols with distinct registries, which is
-  why callers outside ``topology.py`` must journal through the typed
-  ``note_*`` wrappers rather than raw kind literals.
+
+The journal record-kind registries (dispatcher ledger, topology membership
+journal, run historian) moved to the dedicated ``journal-discipline`` rule
+in pipecheck v2 — one data-driven check over config ``JOURNAL_REGISTRIES``
+instead of a per-journal method here.
 """
 
 from __future__ import annotations
@@ -251,14 +241,6 @@ class ProtocolConformanceRule(Rule):
         findings.extend(
             self._collect_quarantine_reasons(module, state,
                                              ctx.config.quarantine_registry_suffix))
-        if module.posix().endswith(ctx.config.topology_file_suffix):
-            # the membership journal speaks its OWN kind registry; routing
-            # topology modules past the ledger collector keeps their
-            # append_record literals out of the ledger check
-            self._collect_topology_kinds(module, state)
-        else:
-            self._collect_ledger_kinds(module, state,
-                                       ctx.config.ledger_file_suffix)
         return findings
 
     # ------------------------------------------------------- message kinds
@@ -269,8 +251,6 @@ class ProtocolConformanceRule(Rule):
         for group_key in ('peers', 'service_peers'):
             findings.extend(self._match_peer_group(state.get(group_key, {})))
         findings.extend(self._check_quarantine_registry(ctx, state))
-        findings.extend(self._check_ledger_registry(ctx, state))
-        findings.extend(self._check_topology_registry(ctx, state))
         return findings
 
     def _match_peer_group(self,
@@ -421,165 +401,3 @@ class ProtocolConformanceRule(Rule):
         except (ImportError, OSError, SyntaxError):
             return None
         return extract_string_tuple(tree, 'QUARANTINE_REASONS')
-
-    # ------------------------------------------------- ledger record kinds
-
-    def _collect_ledger_kinds(self, module: SourceModule,
-                              state: Dict[str, object],
-                              ledger_suffix: str) -> None:
-        """Gather the ledger-kind registry and its use sites (module doc):
-        journaled-kind literals everywhere, replay ``kind == 'x'`` compares
-        inside the ledger module itself."""
-        uses = state.setdefault('ledger_kind_uses', [])
-        if module.posix().endswith(ledger_suffix):
-            declared = extract_string_tuple(module.tree, 'LEDGER_RECORD_KINDS')
-            if declared is not None:
-                state['declared_ledger_kinds'] = (declared, module.display)
-            for node in ast.walk(module.tree):
-                if not isinstance(node, ast.Compare):
-                    continue
-                if not all(isinstance(op, (ast.Eq, ast.NotEq))
-                           for op in node.ops):
-                    continue
-                sides = [node.left] + list(node.comparators)
-                if not any(isinstance(side, ast.Name) and side.id == 'kind'
-                           for side in sides):
-                    continue
-                for side in sides:
-                    value = const_str(side)
-                    if value is not None:
-                        uses.append((value, module.display,  # type: ignore[attr-defined]
-                                     side.lineno))
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            if not isinstance(func, ast.Attribute):
-                continue
-            if func.attr not in ('append_record', '_journal'):
-                continue
-            if not node.args:
-                continue
-            value = const_str(node.args[0])
-            if value is not None:
-                uses.append((value, module.display,  # type: ignore[attr-defined]
-                             node.args[0].lineno))
-
-    def _check_ledger_registry(self, ctx: AnalysisContext,
-                               state: Dict[str, object]) -> List[Finding]:
-        uses = state.get('ledger_kind_uses') or []
-        if not uses:
-            return []
-        declared_entry = state.get('declared_ledger_kinds')
-        if declared_entry is None:
-            declared = self._installed_ledger_kinds()
-            if declared is None:
-                return []
-        else:
-            declared = declared_entry[0]  # type: ignore[index]
-        findings = []
-        for value, path, line in uses:  # type: ignore[union-attr]
-            if value not in declared:
-                findings.append(Finding(
-                    self.name, path, line,
-                    'ledger record kind {!r} is not declared in '
-                    'LEDGER_RECORD_KINDS ({}) — a replaying dispatcher '
-                    'will silently skip it and resume from wrong '
-                    'state'.format(value, tuple(declared))))
-        return findings
-
-    @staticmethod
-    def _installed_ledger_kinds() -> Optional[List[str]]:
-        """Fallback registry from the installed ledger module's source, so
-        fixture trees without a ``ledger.py`` still validate against the
-        shipped kind set."""
-        try:
-            import petastorm_tpu.service.ledger as ledger_module
-            source_path = ledger_module.__file__
-            if source_path is None:
-                return None
-            tree = ast.parse(open(source_path, encoding='utf-8').read())
-        except (ImportError, OSError, SyntaxError):
-            return None
-        return extract_string_tuple(tree, 'LEDGER_RECORD_KINDS')
-
-    # ----------------------------------------------- topology record kinds
-
-    def _collect_topology_kinds(self, module: SourceModule,
-                                state: Dict[str, object]) -> None:
-        """Gather the membership journal's kind registry and both sides of
-        its wire: journaled-kind literals (``append_record('x')`` /
-        ``_journal('x')``) and replay ``kind == 'x'`` compares, all inside
-        the topology module itself (typed ``note_*`` wrappers keep the
-        literals from leaking into callers)."""
-        uses = state.setdefault('topology_kind_uses', [])
-        declared = extract_string_tuple(module.tree, 'TOPOLOGY_RECORD_KINDS')
-        if declared is not None:
-            state['declared_topology_kinds'] = (declared, module.display)
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Compare):
-                continue
-            if not all(isinstance(op, (ast.Eq, ast.NotEq))
-                       for op in node.ops):
-                continue
-            sides = [node.left] + list(node.comparators)
-            if not any(isinstance(side, ast.Name) and side.id == 'kind'
-                       for side in sides):
-                continue
-            for side in sides:
-                value = const_str(side)
-                if value is not None:
-                    uses.append((value, module.display,  # type: ignore[attr-defined]
-                                 side.lineno))
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            if not isinstance(func, ast.Attribute):
-                continue
-            if func.attr not in ('append_record', '_journal'):
-                continue
-            if not node.args:
-                continue
-            value = const_str(node.args[0])
-            if value is not None:
-                uses.append((value, module.display,  # type: ignore[attr-defined]
-                             node.args[0].lineno))
-
-    def _check_topology_registry(self, ctx: AnalysisContext,
-                                 state: Dict[str, object]) -> List[Finding]:
-        uses = state.get('topology_kind_uses') or []
-        if not uses:
-            return []
-        declared_entry = state.get('declared_topology_kinds')
-        if declared_entry is None:
-            declared = self._installed_topology_kinds()
-            if declared is None:
-                return []
-        else:
-            declared = declared_entry[0]  # type: ignore[index]
-        findings = []
-        for value, path, line in uses:  # type: ignore[union-attr]
-            if value not in declared:
-                findings.append(Finding(
-                    self.name, path, line,
-                    'topology record kind {!r} is not declared in '
-                    'TOPOLOGY_RECORD_KINDS ({}) — a survivor replaying the '
-                    'membership journal will silently skip it and re-deal '
-                    'from wrong membership'.format(value, tuple(declared))))
-        return findings
-
-    @staticmethod
-    def _installed_topology_kinds() -> Optional[List[str]]:
-        """Fallback registry from the installed topology module's source, so
-        fixture trees without a ``topology.py`` still validate against the
-        shipped kind set."""
-        try:
-            import petastorm_tpu.parallel.topology as topology_module
-            source_path = topology_module.__file__
-            if source_path is None:
-                return None
-            tree = ast.parse(open(source_path, encoding='utf-8').read())
-        except (ImportError, OSError, SyntaxError):
-            return None
-        return extract_string_tuple(tree, 'TOPOLOGY_RECORD_KINDS')

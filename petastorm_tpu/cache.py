@@ -281,12 +281,14 @@ class LocalDiskCache(CacheBase):
                 with os.fdopen(fd, 'wb') as f:
                     f.write(blob)
                 os.replace(tmp_path, file_path)
-            except OSError:
+            finally:
+                # on the normal path os.replace already consumed the temp
+                # name and this unlink is a no-op; on ANY failure (not just
+                # OSError — encoding bugs included) the orphan is removed
                 try:
                     os.unlink(tmp_path)
                 except OSError:
                     pass
-                raise
         with self._lock:
             self.stats['bytes_written'] += len(blob)
             if self._approx_bytes is None:

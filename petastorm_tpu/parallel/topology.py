@@ -458,6 +458,9 @@ class MembershipJournal:
             logger.exception('topology journal compaction failed (%s); '
                              'continuing with the uncompacted journal',
                              self.path)
+        finally:
+            # no-op after a successful os.replace; on ANY failure path
+            # (OSError or not) the orphaned temp file is removed
             try:
                 os.unlink(temp_path)
             except OSError:

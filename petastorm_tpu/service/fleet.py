@@ -191,16 +191,25 @@ class ServiceFleet(object):
             'incidents': self._incidents,
         }
         fd, path = tempfile.mkstemp(suffix='.petastorm-tpu-service-worker')
-        with os.fdopen(fd, 'wb') as f:
-            pickle.dump(bootstrap, f)
-        env = dict(os.environ)
-        parent_paths = [p for p in sys.path if p]
-        existing = env.get('PYTHONPATH')
-        env['PYTHONPATH'] = os.pathsep.join(
-            parent_paths + ([existing] if existing else []))
-        process = subprocess.Popen(
-            [sys.executable, '-m', 'petastorm_tpu.service.service_worker',
-             path], env=env)
+        try:
+            with os.fdopen(fd, 'wb') as f:
+                pickle.dump(bootstrap, f)
+            env = dict(os.environ)
+            parent_paths = [p for p in sys.path if p]
+            existing = env.get('PYTHONPATH')
+            env['PYTHONPATH'] = os.pathsep.join(
+                parent_paths + ([existing] if existing else []))
+            # after a successful spawn the WORKER owns the bootstrap file
+            # (service_worker.main unlinks it right after loading)
+            process = subprocess.Popen(
+                [sys.executable, '-m', 'petastorm_tpu.service.service_worker',
+                 path], env=env)
+        except Exception:  # noqa: BLE001 - failed spawn: reclaim the bootstrap file, then surface
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
         self.processes.append(process)
         return process
 

@@ -352,12 +352,15 @@ class TokenLedger(object):
         except OSError:
             logger.exception('ledger: rotation of %s failed; journal keeps '
                              'growing until the next attempt', self.path)
+            if self._file is None or self._file.closed:
+                self._file = open(self.path, 'ab')
+        finally:
+            # no-op after a successful os.replace; on ANY failure path
+            # (OSError or not) the orphaned temp file is removed
             try:
                 os.unlink(tmp_path)
             except OSError:
                 pass
-            if self._file is None or self._file.closed:
-                self._file = open(self.path, 'ab')
 
     # ------------------------------------------------------------- snapshot
 

@@ -171,14 +171,26 @@ class _ShmPublisher(object):
             resource_tracker.unregister(segment._name, 'shared_memory')  # type: ignore[attr-defined]
         except Exception:  # noqa: BLE001 - tracker internals shifted; janitor unlink still wins
             pass
-        offset = 0
-        for view, length in zip(views, lengths):
-            segment.buf[offset:offset + length] = view.cast('B')
-            offset += length
-        crc: Optional[int] = None
-        if checksum:
-            from petastorm_tpu.workers.integrity import payload_checksum
-            crc = payload_checksum(views)
+        try:
+            offset = 0
+            for view, length in zip(views, lengths):
+                segment.buf[offset:offset + length] = view.cast('B')
+                offset += length
+            crc: Optional[int] = None
+            if checksum:
+                from petastorm_tpu.workers.integrity import payload_checksum
+                crc = payload_checksum(views)
+        except Exception:  # noqa: BLE001 - a torn copy must not leak the segment
+            # Unregistered above, so nothing else will ever reclaim it:
+            # close AND unlink before degrading this one result to the wire.
+            logger.warning('one-shot shm segment write failed; publishing '
+                           'over the wire', exc_info=True)
+            segment.close()
+            try:
+                segment.unlink()
+            except (FileNotFoundError, OSError):
+                pass
+            return None
         name = segment.name
         segment.close()
         self._created.append((name, time.monotonic()))
@@ -195,11 +207,12 @@ class _ShmPublisher(object):
             resource_tracker.unregister(segment._name, 'shared_memory')  # type: ignore[attr-defined]
         except Exception:  # noqa: BLE001 - tracker internals shifted
             pass
-        segment.close()
         try:
             segment.unlink()
         except FileNotFoundError:
             pass
+        finally:
+            segment.close()
 
     def janitor(self) -> None:
         """Unlink segments past the grace window (nobody claimed them)."""
@@ -275,198 +288,204 @@ def main(bootstrap_path: str) -> None:
 
     context = zmq.Context()
     socket = context.socket(zmq.DEALER)
-    socket.connect(endpoint)
-
-    descriptor = WorkerDescriptor(
-        worker_id=worker_id, pid=os.getpid(), host=host_token(),
-        heartbeat_interval_s=heartbeat_interval_s, shm_results=shm_results)
-    registered = False
-    while not registered:
-        socket.send_multipart([b'register', descriptor.to_bytes()])
-        if not socket.poll(_REGISTER_TIMEOUT_MS, zmq.POLLIN):
-            continue  # dispatcher not up yet — re-announce
-        frames = socket.recv_multipart()
-        kind = frames[0]
-        if kind == b'registered':
-            registered = True
-
-    # Fleet metrics plane (module docstring): this worker's registry TEEs
-    # the stage-time sidecars of every published batch (merge_stage_times is
-    # read-only over the sidecar dict — the owning client's copy is
-    # untouched) and ships cumulative snapshots on the heartbeat socket.
-    from petastorm_tpu.telemetry import MetricsRegistry
-    worker_metrics = MetricsRegistry()
-
-    # Incident autopsy plane (docs/observability.md): when the fleet arms
-    # incidents, this worker captures bundles locally on its own anomaly
-    # edges (breaker closed->open, quarantined rowgroups) and the heartbeat
-    # thread ships each bundle's compact reference as a ``w_incident`` frame.
-    incident_recorder: Any = None
-    incident_refs_fn: Optional[Callable[[], List[Dict[str, Any]]]] = None
-    incidents = bootstrap.get('incidents')
-    if incidents:
-        from petastorm_tpu.resilience import default_board
-        from petastorm_tpu.telemetry.incident import (IncidentRecorder,
-                                                      default_incident_home,
-                                                      resolve_incident_policy)
-        policy = resolve_incident_policy(incidents)
-        # per-worker subdirectory: co-located workers must not race each
-        # other's bundle sequence numbers in one shared home
-        home = os.path.join(default_incident_home(cache_dir),
-                            'worker-{}'.format(worker_id))
-        incident_recorder = IncidentRecorder(home, policy,
-                                             registry=worker_metrics)
-        incident_recorder.add_source('metrics', worker_metrics.snapshot)
-        incident_recorder.add_source('breakers', default_board().snapshot)
-        default_board().observe_transitions(
-            incident_recorder.on_breaker_transition)
-        incident_refs_fn = incident_recorder.drain_references
-
     heartbeat_stop = threading.Event()
     heartbeat_thread: Optional[threading.Thread] = None
-    if heartbeat_interval_s > 0:
-        heartbeat_thread = threading.Thread(
-            target=_heartbeat_loop,
-            args=(heartbeat_stop, context, endpoint, worker_id,
-                  heartbeat_interval_s, worker_metrics.snapshot,
-                  incident_refs_fn),
-            daemon=True)
-        heartbeat_thread.start()
-
-    shm_publisher = _ShmPublisher() if shm_results else None
-    runtimes: 'collections.OrderedDict[bytes, _SetupRuntime]' = \
-        collections.OrderedDict()
-    current_token = [b'']
-    current_attempt = [b'0']
-    current_colocated = [False]
-    current_serializer: List[Any] = [None]
-
-    def publish(result: Any) -> None:
-        from petastorm_tpu.telemetry.spans import stage_span
-        stage_times = getattr(result, 'telemetry', None)
-        if stage_times:
-            worker_metrics.merge_stage_times(stage_times)
-        if incident_recorder is not None:
-            record = getattr(result, 'quarantine', None)
-            if record is not None:
-                # same kind split as Reader._note_item_consumed: a reaped
-                # hang and a skipped rowgroup are distinct autopsy causes
-                trigger_kind = ('watchdog_reap' if record.reason == 'hang'
-                                else 'quarantine')
-                incident_recorder.trigger(
-                    trigger_kind,
-                    ctx=(record.epoch, record.piece_index, record.attempts),
-                    args=record.as_dict())
-        with stage_span('serialize'):
-            frames = current_serializer[0].serialize(result)
-        if shm_publisher is not None and current_colocated[0]:
-            shm_descriptor = shm_publisher.write(frames)
-            if shm_descriptor is not None:
-                socket.send_multipart(
-                    [b'w_result_shm', current_token[0], current_attempt[0],
-                     shm_descriptor.to_bytes()])
-                return
-        socket.send_multipart(
-            [b'w_result', current_token[0], current_attempt[0]]
-            + list(frames))
-
-    import dill
-    socket.send_multipart([b'w_ready'])
-    stopping = False
-    idle_polls = 0
-    while not stopping:
-        if not socket.poll(1000, zmq.POLLIN):
-            if shm_publisher is not None:
-                shm_publisher.janitor()
-            # Idle re-announce (docs/service.md "Restarting with a ledger"):
-            # a dispatcher that restarted while we sat idle never sees a
-            # w_ready from us and so never learns we exist. Periodically
-            # re-offer readiness — a live dispatcher that already knows us
-            # treats the duplicate as a no-op (identity already in its ready
-            # set), a restarted one answers with w_rejoin below.
-            idle_polls += 1
-            if idle_polls >= 5:
-                idle_polls = 0
-                socket.send_multipart([b'w_ready'])
-            continue
-        idle_polls = 0
-        frames = socket.recv_multipart()
-        kind = frames[0]
-        if kind == b'w_stop':
-            stopping = True
-            continue
-        if kind == b'registered':
-            continue  # duplicate ack from the registration retry loop
-        if kind == b'w_rejoin':
-            # a restarted dispatcher does not know this identity: replay the
-            # registration handshake inline (no blocking retry loop — the
-            # dispatcher is demonstrably alive, it just answered us)
+    incident_recorder: Any = None
+    shm_publisher: Optional[_ShmPublisher] = None
+    # One try/finally over registration and the work loop: an uncaught
+    # error must still close the socket and terminate the context, or
+    # zmq teardown hangs the exiting process and the fleet only notices
+    # via the staleness watchdog instead of the exit code.
+    try:
+        socket.connect(endpoint)
+        descriptor = WorkerDescriptor(
+            worker_id=worker_id, pid=os.getpid(), host=host_token(),
+            heartbeat_interval_s=heartbeat_interval_s, shm_results=shm_results)
+        registered = False
+        while not registered:
             socket.send_multipart([b'register', descriptor.to_bytes()])
-            socket.send_multipart([b'w_ready'])
-            continue
-        if kind != b'work' or len(frames) < 7:
-            continue  # unknown kind from a newer dispatcher: ignore
-        token, setup_id, blob = frames[1], frames[2], frames[3]
-        attempt, colocate_flag = frames[4], frames[5]
-        setup_blob = frames[6]
-        runtime = runtimes.get(setup_id)
-        if runtime is None:
-            if not setup_blob:
-                # the dispatcher believed this worker knew the setup (e.g. a
-                # pre-restart identity collision) — ask for a re-ship
-                socket.send_multipart([b'w_need_setup', token])
+            if not socket.poll(_REGISTER_TIMEOUT_MS, zmq.POLLIN):
+                continue  # dispatcher not up yet — re-announce
+            frames = socket.recv_multipart()
+            kind = frames[0]
+            if kind == b'registered':
+                registered = True
+
+        # Fleet metrics plane (module docstring): this worker's registry TEEs
+        # the stage-time sidecars of every published batch (merge_stage_times is
+        # read-only over the sidecar dict — the owning client's copy is
+        # untouched) and ships cumulative snapshots on the heartbeat socket.
+        from petastorm_tpu.telemetry import MetricsRegistry
+        worker_metrics = MetricsRegistry()
+
+        # Incident autopsy plane (docs/observability.md): when the fleet arms
+        # incidents, this worker captures bundles locally on its own anomaly
+        # edges (breaker closed->open, quarantined rowgroups) and the heartbeat
+        # thread ships each bundle's compact reference as a ``w_incident`` frame.
+        incident_refs_fn: Optional[Callable[[], List[Dict[str, Any]]]] = None
+        incidents = bootstrap.get('incidents')
+        if incidents:
+            from petastorm_tpu.resilience import default_board
+            from petastorm_tpu.telemetry.incident import (IncidentRecorder,
+                                                          default_incident_home,
+                                                          resolve_incident_policy)
+            policy = resolve_incident_policy(incidents)
+            # per-worker subdirectory: co-located workers must not race each
+            # other's bundle sequence numbers in one shared home
+            home = os.path.join(default_incident_home(cache_dir),
+                                'worker-{}'.format(worker_id))
+            incident_recorder = IncidentRecorder(home, policy,
+                                                 registry=worker_metrics)
+            incident_recorder.add_source('metrics', worker_metrics.snapshot)
+            incident_recorder.add_source('breakers', default_board().snapshot)
+            default_board().observe_transitions(
+                incident_recorder.on_breaker_transition)
+            incident_refs_fn = incident_recorder.drain_references
+
+        if heartbeat_interval_s > 0:
+            heartbeat_thread = threading.Thread(
+                target=_heartbeat_loop,
+                args=(heartbeat_stop, context, endpoint, worker_id,
+                      heartbeat_interval_s, worker_metrics.snapshot,
+                      incident_refs_fn),
+                daemon=True)
+            heartbeat_thread.start()
+
+        shm_publisher = _ShmPublisher() if shm_results else None
+        runtimes: 'collections.OrderedDict[bytes, _SetupRuntime]' = \
+            collections.OrderedDict()
+        current_token = [b'']
+        current_attempt = [b'0']
+        current_colocated = [False]
+        current_serializer: List[Any] = [None]
+
+        def publish(result: Any) -> None:
+            from petastorm_tpu.telemetry.spans import stage_span
+            stage_times = getattr(result, 'telemetry', None)
+            if stage_times:
+                worker_metrics.merge_stage_times(stage_times)
+            if incident_recorder is not None:
+                record = getattr(result, 'quarantine', None)
+                if record is not None:
+                    # same kind split as Reader._note_item_consumed: a reaped
+                    # hang and a skipped rowgroup are distinct autopsy causes
+                    trigger_kind = ('watchdog_reap' if record.reason == 'hang'
+                                    else 'quarantine')
+                    incident_recorder.trigger(
+                        trigger_kind,
+                        ctx=(record.epoch, record.piece_index, record.attempts),
+                        args=record.as_dict())
+            with stage_span('serialize'):
+                frames = current_serializer[0].serialize(result)
+            if shm_publisher is not None and current_colocated[0]:
+                shm_descriptor = shm_publisher.write(frames)
+                if shm_descriptor is not None:
+                    socket.send_multipart(
+                        [b'w_result_shm', current_token[0], current_attempt[0],
+                         shm_descriptor.to_bytes()])
+                    return
+            socket.send_multipart(
+                [b'w_result', current_token[0], current_attempt[0]]
+                + list(frames))
+
+        import dill
+        socket.send_multipart([b'w_ready'])
+        stopping = False
+        idle_polls = 0
+        while not stopping:
+            if not socket.poll(1000, zmq.POLLIN):
+                if shm_publisher is not None:
+                    shm_publisher.janitor()
+                # Idle re-announce (docs/service.md "Restarting with a ledger"):
+                # a dispatcher that restarted while we sat idle never sees a
+                # w_ready from us and so never learns we exist. Periodically
+                # re-offer readiness — a live dispatcher that already knows us
+                # treats the duplicate as a no-op (identity already in its ready
+                # set), a restarted one answers with w_rejoin below.
+                idle_polls += 1
+                if idle_polls >= 5:
+                    idle_polls = 0
+                    socket.send_multipart([b'w_ready'])
+                continue
+            idle_polls = 0
+            frames = socket.recv_multipart()
+            kind = frames[0]
+            if kind == b'w_stop':
+                stopping = True
+                continue
+            if kind == b'registered':
+                continue  # duplicate ack from the registration retry loop
+            if kind == b'w_rejoin':
+                # a restarted dispatcher does not know this identity: replay the
+                # registration handshake inline (no blocking retry loop — the
+                # dispatcher is demonstrably alive, it just answered us)
+                socket.send_multipart([b'register', descriptor.to_bytes()])
                 socket.send_multipart([b'w_ready'])
                 continue
+            if kind != b'work' or len(frames) < 7:
+                continue  # unknown kind from a newer dispatcher: ignore
+            token, setup_id, blob = frames[1], frames[2], frames[3]
+            attempt, colocate_flag = frames[4], frames[5]
+            setup_blob = frames[6]
+            runtime = runtimes.get(setup_id)
+            if runtime is None:
+                if not setup_blob:
+                    # the dispatcher believed this worker knew the setup (e.g. a
+                    # pre-restart identity collision) — ask for a re-ship
+                    socket.send_multipart([b'w_need_setup', token])
+                    socket.send_multipart([b'w_ready'])
+                    continue
+                try:
+                    runtime = _build_runtime(setup_blob, worker_id, publish,
+                                             shared_cache)
+                except Exception as exc:  # noqa: BLE001 - ship to the owning client
+                    error_blob = pickle.dumps((exc, traceback.format_exc()))
+                    socket.send_multipart([b'w_error', token, attempt,
+                                           error_blob])
+                    socket.send_multipart([b'w_ready'])
+                    continue
+                runtimes[setup_id] = runtime
+                while len(runtimes) > _SETUP_CACHE_LIMIT:
+                    _, evicted = runtimes.popitem(last=False)
+                    evicted.worker.shutdown()
+            else:
+                runtimes.move_to_end(setup_id)
+            current_token[0] = token
+            current_attempt[0] = attempt
+            current_colocated[0] = colocate_flag == b'1'
+            current_serializer[0] = runtime.serializer
+            from petastorm_tpu.telemetry.tracing import set_dispatch_attempt
+            set_dispatch_attempt(int(attempt))
             try:
-                runtime = _build_runtime(setup_blob, worker_id, publish,
-                                         shared_cache)
+                # the kwargs decode belongs INSIDE the error funnel: a poison
+                # blob (dill version skew, client-only modules) must fail that
+                # one item to its owner, not kill this worker — the dispatcher
+                # would re-queue it onto the next worker and fell the whole fleet
+                kwargs = dill.loads(blob)
+                runtime.worker.process(**kwargs)
+                socket.send_multipart([b'w_done', token, attempt])
             except Exception as exc:  # noqa: BLE001 - ship to the owning client
                 error_blob = pickle.dumps((exc, traceback.format_exc()))
-                socket.send_multipart([b'w_error', token, attempt,
-                                       error_blob])
-                socket.send_multipart([b'w_ready'])
-                continue
-            runtimes[setup_id] = runtime
-            while len(runtimes) > _SETUP_CACHE_LIMIT:
-                _, evicted = runtimes.popitem(last=False)
-                evicted.worker.shutdown()
-        else:
-            runtimes.move_to_end(setup_id)
-        current_token[0] = token
-        current_attempt[0] = attempt
-        current_colocated[0] = colocate_flag == b'1'
-        current_serializer[0] = runtime.serializer
-        from petastorm_tpu.telemetry.tracing import set_dispatch_attempt
-        set_dispatch_attempt(int(attempt))
-        try:
-            # the kwargs decode belongs INSIDE the error funnel: a poison
-            # blob (dill version skew, client-only modules) must fail that
-            # one item to its owner, not kill this worker — the dispatcher
-            # would re-queue it onto the next worker and fell the whole fleet
-            kwargs = dill.loads(blob)
-            runtime.worker.process(**kwargs)
-            socket.send_multipart([b'w_done', token, attempt])
-        except Exception as exc:  # noqa: BLE001 - ship to the owning client
-            error_blob = pickle.dumps((exc, traceback.format_exc()))
-            socket.send_multipart([b'w_error', token, attempt, error_blob])
-        current_token[0] = b''
-        current_colocated[0] = False
-        if shm_publisher is not None:
-            shm_publisher.janitor()
-        socket.send_multipart([b'w_ready'])
+                socket.send_multipart([b'w_error', token, attempt, error_blob])
+            current_token[0] = b''
+            current_colocated[0] = False
+            if shm_publisher is not None:
+                shm_publisher.janitor()
+            socket.send_multipart([b'w_ready'])
 
-    socket.send_multipart([b'w_leave'])
-    for runtime in runtimes.values():
-        runtime.worker.shutdown()
-    heartbeat_stop.set()
-    if heartbeat_thread is not None:
-        heartbeat_thread.join(timeout=2 * heartbeat_interval_s + 1)
-    if incident_recorder is not None:
-        incident_recorder.close()
-    if shm_publisher is not None:
-        shm_publisher.close()
-    socket.close(linger=1000)
-    context.term()
+        socket.send_multipart([b'w_leave'])
+        for runtime in runtimes.values():
+            runtime.worker.shutdown()
+    finally:
+        heartbeat_stop.set()
+        if heartbeat_thread is not None:
+            heartbeat_thread.join(timeout=2 * heartbeat_interval_s + 1)
+        if incident_recorder is not None:
+            incident_recorder.close()
+        if shm_publisher is not None:
+            shm_publisher.close()
+        socket.close(linger=1000)
+        context.term()
 
 
 if __name__ == '__main__':

@@ -173,7 +173,11 @@ class RowGroupSource(object):
         not cover — the correctness net under pyarrow internals."""
         with self._fallback_lock:
             if self._fallback_handle is None:
-                self._fallback_handle = self._open()
+                # the blocking open stays under the lock on purpose: fallback
+                # reads share one seek+read handle, so they are serialized by
+                # design, and opening outside the lock would race a second
+                # open of the same file
+                self._fallback_handle = self._open()  # pipecheck: disable=lock-discipline -- serialized-by-design shared handle; the blocking chain is chaos-injected open latency (test_util)
             self._fallback_handle.seek(start)
             return bytes(self._fallback_handle.read(length))
 

@@ -65,6 +65,13 @@ HISTORY_BASENAME = '_petastorm_tpu_run_history.bin'
 #: skips newer-schema records instead of misreading them)
 RUN_RECORD_SCHEMA = 1
 
+#: the closed registry of recording layers: every ``build_run_record('x',
+#: ...)`` call site must name one of these, and baseline/attribution
+#: filtering groups by them — an undeclared owner would write records no
+#: comparison ever selects (pipecheck journal-discipline,
+#: docs/static-analysis.md)
+RUN_RECORD_OWNERS: Tuple[str, ...] = ('reader', 'loader', 'dispatcher')
+
 #: frame header: payload length + CRC32(payload) — the ledger.py discipline
 _FRAME_HEADER = struct.Struct('>II')
 
@@ -404,6 +411,9 @@ class RunHistorian(object):
         except OSError:
             logger.exception('history: rotation of %s failed; store keeps '
                              'growing until the next attempt', self.path)
+        finally:
+            # no-op after a successful os.replace; on ANY failure path
+            # (OSError or not) the orphaned temp file is removed
             try:
                 os.unlink(tmp_path)
             except OSError:

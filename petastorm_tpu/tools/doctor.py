@@ -412,6 +412,7 @@ def check_pipecheck():
             'findings': len(report.findings),
             'suppressed': report.suppressed,
             'files': report.files,
+            'callgraph_functions': report.callgraph_functions,
             'by_rule': report.by_rule(),
             'first': report.findings[0].format() if report.findings else None}
 
@@ -829,9 +830,11 @@ def _print_human(report):
               'errored'.format(storage.get('detail', 'unknown')))
     pipecheck = report.get('pipecheck') or {}
     if pipecheck.get('status') == 'ok':
-        print('  pipecheck: clean — {} files, {} suppression(s) honored '
-              '(docs/static-analysis.md)'.format(
-                  pipecheck.get('files', 0), pipecheck.get('suppressed', 0)))
+        print('  pipecheck: clean — {} files, {} call-graph function(s), '
+              '{} suppression(s) honored (docs/static-analysis.md)'.format(
+                  pipecheck.get('files', 0),
+                  pipecheck.get('callgraph_functions', 0),
+                  pipecheck.get('suppressed', 0)))
     elif pipecheck.get('status') == 'findings':
         print('  WARNING: pipecheck found {} data-plane invariant '
               'violation(s) ({}); first: {} — run '

@@ -107,145 +107,150 @@ def main(bootstrap_path):
 
     context = zmq.Context()
     dispatch_socket = context.socket(zmq.DEALER)
-    dispatch_socket.connect(bootstrap['dispatch_addr'])
     control_socket = context.socket(zmq.SUB)
-    control_socket.connect(bootstrap['control_addr'])
-    control_socket.setsockopt(zmq.SUBSCRIBE, b'')
     results_socket = context.socket(zmq.PUSH)
-    results_socket.connect(bootstrap['results_addr'])
-
     ring_writer = None
-    shm_spec = bootstrap.get('shm')
-    if shm_spec is not None:
-        from petastorm_tpu.workers.shm_ring import ShmRingWriter
-        try:
-            ring_writer = ShmRingWriter(shm_spec['name'], worker_id, generation,
-                                        shm_spec['slots_per_worker'],
-                                        shm_spec['slot_bytes'],
-                                        data_offset=shm_spec.get('data_offset', 0),
-                                        checksum=shm_spec.get('checksum', True))
-        except Exception:  # noqa: BLE001 - transport optional; ZMQ still works
-            import logging
-            logging.getLogger(__name__).warning(
-                'worker %d could not attach the shm ring; using ZMQ frames',
-                worker_id, exc_info=True)
-
-    heartbeat_stop = threading.Event()
     heartbeat_thread = None
+    heartbeat_stop = threading.Event()
     heartbeat_interval_s = bootstrap.get('heartbeat_interval_s', 0.5)
-    if heartbeat_interval_s and heartbeat_interval_s > 0:
-        heartbeat_thread = threading.Thread(
-            target=_heartbeat_loop,
-            args=(heartbeat_stop, ring_writer, context,
-                  bootstrap['results_addr'], worker_id, generation,
-                  heartbeat_interval_s),
-            daemon=True)
-        heartbeat_thread.start()
-
-    current_token = [b'']
-    # b'0' when the pool's shm breaker routed this item to the ZMQ wire
-    current_shm_allowed = [True]
-
-    def drain_releases(timeout_ms=0):
-        """Process queued ``release`` acks on the dispatch socket; returns any
-        out-of-band ``work`` frames that arrived interleaved (deferred by the
-        caller, never dropped)."""
-        deferred = []
-        while dispatch_socket.poll(timeout_ms, zmq.POLLIN):
-            timeout_ms = 0
-            frames = dispatch_socket.recv_multipart()
-            if frames and frames[0] == b'release' and ring_writer is not None:
-                ring_writer.release(int(frames[1]))
-            else:
-                deferred.append(frames)
-        return deferred
-
-    deferred_work = []
-
-    def publish(result):
-        # Stage spans land in the process-local recorder and ride the NEXT
-        # published batch's telemetry sidecar (this one is already serialized) —
-        # one item late, same process total (docs/observability.md).
-        from petastorm_tpu.telemetry.spans import stage_span
-        with stage_span('serialize'):
-            frames = serializer.serialize(result)
-        if ring_writer is not None and current_shm_allowed[0] \
-                and ring_writer.fits(frames):
-            descriptor = ring_writer.try_write(frames)
-            if descriptor is None:
-                # Backpressure: all our slots are in flight — wait (bounded) for
-                # the consumer's release acks before falling back to the wire.
-                deadline = time.monotonic() + _SLOT_WAIT_S
-                with stage_span('shm_slot_wait'):
-                    while descriptor is None and time.monotonic() < deadline:
-                        deferred_work.extend(drain_releases(timeout_ms=100))
-                        descriptor = ring_writer.try_write(frames)
-            if descriptor is not None:
-                results_socket.send_multipart(
-                    [b'result_shm', current_token[0], descriptor.to_bytes()])
-                return
-        results_socket.send_multipart([b'result', current_token[0]] + frames)
-
-    worker = worker_class(worker_id, publish, worker_args)
-    results_socket.send_multipart([b'started'])
-
-    poller = zmq.Poller()
-    poller.register(dispatch_socket, zmq.POLLIN)
-    poller.register(control_socket, zmq.POLLIN)
-    ready_msg = [b'ready', b'%d' % worker_id, b'%d' % generation]
-    dispatch_socket.send_multipart(ready_msg)
-    while True:
-        events = dict(poller.poll(1000))
-        if control_socket in events:
-            if control_socket.recv() == b'stop':
-                break
-        if dispatch_socket in events or deferred_work:
-            if deferred_work:
-                frames = deferred_work.pop(0)
-            else:
-                frames = dispatch_socket.recv_multipart()
-            kind = frames[0]
-            if kind == b'release':
-                if ring_writer is not None:
-                    ring_writer.release(int(frames[1]))
-                continue
-            if kind != b'work':
-                continue  # unknown kind from a newer pool: ignore
-            token, blob = frames[1], frames[2]
-            kwargs = dill.loads(blob)
-            current_token[0] = token
-            # optional 4th frame: shm transport flag (b'0' while the pool's shm
-            # circuit breaker is open — docs/robustness.md); optional 5th: the
-            # dispatch attempt number, echoed in 'done' so the pool can tell a
-            # current ack from one flushed by a since-reaped worker
-            current_shm_allowed[0] = len(frames) < 4 or frames[3] != b'0'
-            attempt = frames[4] if len(frames) >= 5 else b'0'
-            # Causal trace context, attempt leg (docs/observability.md "Flight
-            # recorder"): the dispatch attempt rides the existing work frames;
-            # installing it here lets the worker tag every span with the exact
-            # delivery attempt — no new wire protocol needed.
-            from petastorm_tpu.telemetry.tracing import set_dispatch_attempt
-            set_dispatch_attempt(int(attempt))
+    # Everything below runs under one try/finally: an uncaught error in
+    # setup or the work loop must still close the sockets and terminate
+    # the context, or the interpreter hangs in zmq teardown and the pool
+    # reaps this worker by timeout instead of by exit code.
+    try:
+        dispatch_socket.connect(bootstrap['dispatch_addr'])
+        control_socket.connect(bootstrap['control_addr'])
+        control_socket.setsockopt(zmq.SUBSCRIBE, b'')
+        results_socket.connect(bootstrap['results_addr'])
+        shm_spec = bootstrap.get('shm')
+        if shm_spec is not None:
+            from petastorm_tpu.workers.shm_ring import ShmRingWriter
             try:
-                worker.process(**kwargs)
-                results_socket.send_multipart([b'done', token, attempt])
-            except Exception as exc:  # noqa: BLE001 - ship to consumer
-                blob = pickle.dumps((exc, traceback.format_exc()))
-                results_socket.send_multipart([b'error', token, blob])
-            current_token[0] = b''
-            current_shm_allowed[0] = True
-            dispatch_socket.send_multipart(ready_msg)
-    worker.shutdown()
-    # Stop the heartbeat thread BEFORE terminating the context: its private
-    # push socket must close, or context.term() blocks forever.
-    heartbeat_stop.set()
-    if heartbeat_thread is not None:
-        heartbeat_thread.join(timeout=2 * heartbeat_interval_s + 1)
-    if ring_writer is not None:
-        ring_writer.close()
-    for sock in (dispatch_socket, control_socket, results_socket):
-        sock.close(linger=1000)
-    context.term()
+                ring_writer = ShmRingWriter(shm_spec['name'], worker_id, generation,
+                                            shm_spec['slots_per_worker'],
+                                            shm_spec['slot_bytes'],
+                                            data_offset=shm_spec.get('data_offset', 0),
+                                            checksum=shm_spec.get('checksum', True))
+            except Exception:  # noqa: BLE001 - transport optional; ZMQ still works
+                import logging
+                logging.getLogger(__name__).warning(
+                    'worker %d could not attach the shm ring; using ZMQ frames',
+                    worker_id, exc_info=True)
+
+        if heartbeat_interval_s and heartbeat_interval_s > 0:
+            heartbeat_thread = threading.Thread(
+                target=_heartbeat_loop,
+                args=(heartbeat_stop, ring_writer, context,
+                      bootstrap['results_addr'], worker_id, generation,
+                      heartbeat_interval_s),
+                daemon=True)
+            heartbeat_thread.start()
+
+        current_token = [b'']
+        # b'0' when the pool's shm breaker routed this item to the ZMQ wire
+        current_shm_allowed = [True]
+
+        def drain_releases(timeout_ms=0):
+            """Process queued ``release`` acks on the dispatch socket; returns any
+            out-of-band ``work`` frames that arrived interleaved (deferred by the
+            caller, never dropped)."""
+            deferred = []
+            while dispatch_socket.poll(timeout_ms, zmq.POLLIN):
+                timeout_ms = 0
+                frames = dispatch_socket.recv_multipart()
+                if frames and frames[0] == b'release' and ring_writer is not None:
+                    ring_writer.release(int(frames[1]))
+                else:
+                    deferred.append(frames)
+            return deferred
+
+        deferred_work = []
+
+        def publish(result):
+            # Stage spans land in the process-local recorder and ride the NEXT
+            # published batch's telemetry sidecar (this one is already serialized) —
+            # one item late, same process total (docs/observability.md).
+            from petastorm_tpu.telemetry.spans import stage_span
+            with stage_span('serialize'):
+                frames = serializer.serialize(result)
+            if ring_writer is not None and current_shm_allowed[0] \
+                    and ring_writer.fits(frames):
+                descriptor = ring_writer.try_write(frames)
+                if descriptor is None:
+                    # Backpressure: all our slots are in flight — wait (bounded) for
+                    # the consumer's release acks before falling back to the wire.
+                    deadline = time.monotonic() + _SLOT_WAIT_S
+                    with stage_span('shm_slot_wait'):
+                        while descriptor is None and time.monotonic() < deadline:
+                            deferred_work.extend(drain_releases(timeout_ms=100))
+                            descriptor = ring_writer.try_write(frames)
+                if descriptor is not None:
+                    results_socket.send_multipart(
+                        [b'result_shm', current_token[0], descriptor.to_bytes()])
+                    return
+            results_socket.send_multipart([b'result', current_token[0]] + frames)
+
+        worker = worker_class(worker_id, publish, worker_args)
+        results_socket.send_multipart([b'started'])
+
+        poller = zmq.Poller()
+        poller.register(dispatch_socket, zmq.POLLIN)
+        poller.register(control_socket, zmq.POLLIN)
+        ready_msg = [b'ready', b'%d' % worker_id, b'%d' % generation]
+        dispatch_socket.send_multipart(ready_msg)
+        while True:
+            events = dict(poller.poll(1000))
+            if control_socket in events:
+                if control_socket.recv() == b'stop':
+                    break
+            if dispatch_socket in events or deferred_work:
+                if deferred_work:
+                    frames = deferred_work.pop(0)
+                else:
+                    frames = dispatch_socket.recv_multipart()
+                kind = frames[0]
+                if kind == b'release':
+                    if ring_writer is not None:
+                        ring_writer.release(int(frames[1]))
+                    continue
+                if kind != b'work':
+                    continue  # unknown kind from a newer pool: ignore
+                token, blob = frames[1], frames[2]
+                kwargs = dill.loads(blob)
+                current_token[0] = token
+                # optional 4th frame: shm transport flag (b'0' while the pool's shm
+                # circuit breaker is open — docs/robustness.md); optional 5th: the
+                # dispatch attempt number, echoed in 'done' so the pool can tell a
+                # current ack from one flushed by a since-reaped worker
+                current_shm_allowed[0] = len(frames) < 4 or frames[3] != b'0'
+                attempt = frames[4] if len(frames) >= 5 else b'0'
+                # Causal trace context, attempt leg (docs/observability.md "Flight
+                # recorder"): the dispatch attempt rides the existing work frames;
+                # installing it here lets the worker tag every span with the exact
+                # delivery attempt — no new wire protocol needed.
+                from petastorm_tpu.telemetry.tracing import set_dispatch_attempt
+                set_dispatch_attempt(int(attempt))
+                try:
+                    worker.process(**kwargs)
+                    results_socket.send_multipart([b'done', token, attempt])
+                except Exception as exc:  # noqa: BLE001 - ship to consumer
+                    blob = pickle.dumps((exc, traceback.format_exc()))
+                    results_socket.send_multipart([b'error', token, blob])
+                current_token[0] = b''
+                current_shm_allowed[0] = True
+                dispatch_socket.send_multipart(ready_msg)
+        worker.shutdown()
+    finally:
+        # Stop the heartbeat thread BEFORE terminating the context: its
+        # private push socket must close, or context.term() blocks forever.
+        heartbeat_stop.set()
+        if heartbeat_thread is not None:
+            heartbeat_thread.join(timeout=2 * heartbeat_interval_s + 1)
+        if ring_writer is not None:
+            ring_writer.close()
+        for sock in (dispatch_socket, control_socket, results_socket):
+            sock.close(linger=1000)
+        context.term()
 
 
 if __name__ == '__main__':

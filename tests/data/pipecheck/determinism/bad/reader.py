@@ -1,0 +1,39 @@
+"""Known-bad fixture (lineage-covered module name): unseeded randomness,
+unordered iteration into order-sensitive sinks, and identity-keyed state —
+each one a silent replay-divergence source."""
+
+import os
+import random
+
+import numpy as np
+
+
+def shuffle_rowgroups(rowgroups):
+    # unseeded module-level RNG: a re-run cannot reproduce the plan
+    random.shuffle(rowgroups)
+    return rowgroups
+
+
+def permute(indices):
+    # unseeded global numpy RNG
+    return np.random.permutation(indices)
+
+
+def journal_segments(journal, root):
+    # filesystem enumeration order is not a contract
+    journal.append_record('segments', paths=os.listdir(root))
+
+
+def deal_hosts(journal, hosts):
+    # set iteration order drives an order-sensitive sink
+    alive = set(hosts)
+    for host in alive:
+        journal.note_join(host)
+
+
+def fold_progress(journal, shards):
+    table = {}
+    # id() keys: a replay maps the same logical shard to a different key
+    for shard in shards:
+        table[id(shard)] = shard.rows
+    journal.append_record('progress', table=table)

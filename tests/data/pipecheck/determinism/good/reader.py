@@ -1,0 +1,35 @@
+"""Known-good fixture: every randomness source is seeded, every unordered
+iteration is laundered through ``sorted()`` before reaching an
+order-sensitive sink, and state is keyed by stable names."""
+
+import os
+
+import numpy as np
+
+
+def shuffle_rowgroups(rowgroups, seed):
+    # seeded generator: the plan replays bit-identically
+    rng = np.random.RandomState(seed)
+    rng.shuffle(rowgroups)
+    return rowgroups
+
+
+def journal_segments(journal, root):
+    journal.append_record('segments', paths=sorted(os.listdir(root)))
+
+
+def deal_hosts(journal, hosts_set):
+    for host in sorted(hosts_set):
+        journal.note_join(host)
+
+
+def fold_progress(journal, shards):
+    table = {}
+    for shard in shards:
+        table[shard.name] = shard.rows
+    journal.append_record('progress', table=table)
+
+
+def harmless_set_use(hosts_set):
+    # sets away from the sinks are fine — only sink-bound order matters
+    return len(hosts_set | {'localhost'})

@@ -1,0 +1,8 @@
+"""Suppression fixture: an intentionally unordered journal append, waived
+with a reasoned directive."""
+
+import os
+
+
+def journal_segments(journal, root):
+    journal.append_record('segments', paths=os.listdir(root))  # pipecheck: disable=determinism -- the replayer sorts on fold; raw order preserved for forensics

@@ -1,0 +1,9 @@
+"""Known-good fixture: a module OUTSIDE the lineage-covered set
+(``DETERMINISM_MODULES``) — unseeded randomness here is not a replay
+contract and must not be flagged."""
+
+import random
+
+
+def jitter(base_s):
+    return base_s * (1.0 + random.random() * 0.1)

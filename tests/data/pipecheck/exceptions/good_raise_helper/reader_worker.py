@@ -1,0 +1,17 @@
+"""Known-good fixture: the broad handler delegates to a helper that
+always raises — the call graph proves the exception cannot be swallowed,
+so the handler is not flagged."""
+
+
+class ReaderWorker:
+    def _fail(self, exc):
+        raise RuntimeError('reader worker wedged') from exc
+
+    def step(self):
+        try:
+            return self._produce()
+        except Exception as exc:  # noqa: BLE001 - rethrown via _fail below
+            self._fail(exc)
+
+    def _produce(self):
+        return 1

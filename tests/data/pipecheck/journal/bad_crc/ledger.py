@@ -1,0 +1,18 @@
+"""Known-bad fixture: the replay loop skips a CRC-mismatched frame with a
+bare ``continue`` — corruption is read past without ever being counted."""
+
+import struct
+import zlib
+
+_FRAME_HEADER = struct.Struct('>II')
+
+LEDGER_RECORD_KINDS = ('epoch', 'issued')
+
+
+def replay(frames):
+    records = []
+    for length, crc, payload in frames:
+        if crc != zlib.crc32(payload):
+            continue  # silently reads past corruption: never accounted
+        records.append(payload)
+    return records

@@ -1,0 +1,20 @@
+"""Known-bad fixture: a frame journal (declares ``_FRAME_HEADER``) whose
+append writes the frame but never flushes — the crash-replay contract
+silently never had this record."""
+
+import struct
+import zlib
+
+_FRAME_HEADER = struct.Struct('>II')
+
+LEDGER_RECORD_KINDS = ('epoch', 'issued')
+
+
+class MiniLedger(object):
+    def __init__(self, stream):
+        self._stream = stream
+
+    def append_record(self, kind, payload):
+        frame = _FRAME_HEADER.pack(len(payload), zlib.crc32(payload))
+        self._stream.write(frame + payload)
+        # missing: self._stream.flush() / os.fsync — buffered frame only

@@ -1,0 +1,10 @@
+"""Known-bad fixture: a run record written under an owner name missing
+from the historian's closed ``RUN_RECORD_OWNERS`` registry (resolved from
+the installed module — this tree does not carry history.py) — baseline
+and attribution filtering group by owner, so these records are never
+selected by any comparison."""
+
+
+def record_run(store, build_run_record, elapsed_s, rows):
+    record = build_run_record('conductor', 'tok', elapsed_s, rows)
+    store.append(record)

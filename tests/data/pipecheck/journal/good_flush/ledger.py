@@ -1,0 +1,29 @@
+"""Known-good fixture: the frame journal flushes every append and counts
+every CRC-mismatch drop before bailing."""
+
+import struct
+import zlib
+
+_FRAME_HEADER = struct.Struct('>II')
+
+LEDGER_RECORD_KINDS = ('epoch', 'issued')
+
+
+class MiniLedger(object):
+    def __init__(self, stream):
+        self._stream = stream
+        self.frames_dropped = 0
+
+    def append_record(self, kind, payload):
+        frame = _FRAME_HEADER.pack(len(payload), zlib.crc32(payload))
+        self._stream.write(frame + payload)
+        self._stream.flush()
+
+    def replay(self, frames):
+        records = []
+        for length, crc, payload in frames:
+            if crc != zlib.crc32(payload):
+                self.frames_dropped += 1
+                continue
+            records.append(payload)
+        return records

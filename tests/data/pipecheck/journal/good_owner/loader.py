@@ -1,0 +1,6 @@
+"""Known-good fixture: the run record names a declared owner layer."""
+
+
+def record_run(store, build_run_record, elapsed_s, rows):
+    record = build_run_record('loader', 'tok', elapsed_s, rows)
+    store.append(record)

@@ -19,5 +19,5 @@ class MiniJournal(object):
         kind = record.get('kind')
         if kind == 'join':
             pass
-        elif kind == 'rebalance':  # pipecheck: disable=protocol-conformance -- kept one release for journals written by the renamed pre-reshard builds
+        elif kind == 'rebalance':  # pipecheck: disable=journal-discipline -- kept one release for journals written by the renamed pre-reshard builds
             pass

@@ -1,0 +1,28 @@
+"""Known-bad fixture: three ways to drop a leakable resource — a socket
+that is never released, a shared-memory segment released only on the
+straight-line path, and a thread that is neither joined nor a daemon."""
+
+import threading
+from multiprocessing import shared_memory
+
+
+def forgotten_socket(context):
+    # acquired, bound to a local, and simply dropped: leaks on every path
+    sock = context.socket(1)
+    sock.connect('tcp://127.0.0.1:5555')
+
+
+def normal_path_only(frames):
+    segment = shared_memory.SharedMemory(create=True, size=1024)
+    publish(frames, segment.buf)  # can raise: the close below never runs
+    segment.close()
+
+
+def unjoined_thread(target):
+    worker = threading.Thread(target=target)
+    worker.start()
+    return None
+
+
+def publish(frames, buf):
+    raise NotImplementedError

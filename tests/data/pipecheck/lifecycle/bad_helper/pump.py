@@ -1,0 +1,18 @@
+"""Known-bad fixture: the leak hides behind a helper factory — the
+acquisition happens two frames down, the drop happens here. The summary
+fixpoint propagates ``returns_spec`` so the call site is the finding."""
+
+from multiprocessing import shared_memory
+
+
+def _fresh_segment(size):
+    # acquire-and-return: NOT a leak here — ownership moves to the caller
+    segment = shared_memory.SharedMemory(create=True, size=size)
+    return segment
+
+
+def publish(frames):
+    segment = _fresh_segment(4096)  # the acquisition site, via the factory
+    for frame in frames:
+        segment.buf[:len(frame)] = frame
+    # never closed, never unlinked, never escapes: the call-site leak

@@ -1,0 +1,11 @@
+"""Known-bad fixture (escape-to-owner): the socket is handed to ``self``
+but NO method of the class ever releases it — storing a resource on the
+owner is only a transfer when the owner takes over the lifecycle."""
+
+
+class Pump(object):
+    def __init__(self, context):
+        self._socket = context.socket(1)
+
+    def send(self, frames):
+        self._socket.send_multipart(frames)

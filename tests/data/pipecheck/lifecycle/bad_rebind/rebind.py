@@ -1,0 +1,12 @@
+"""Known-bad fixture (the v2 rebinding bugfix regression): the first
+segment is rebound away while still open — the trailing ``close()`` is
+credited to the SECOND object only, never the first."""
+
+from multiprocessing import shared_memory
+
+
+def double_acquire():
+    segment = shared_memory.SharedMemory(create=True, size=1024)
+    segment = shared_memory.SharedMemory(create=True, size=2048)
+    segment.close()
+    segment.unlink()

@@ -1,0 +1,65 @@
+"""Known-good fixture: every acquisition either reaches its release on all
+paths, escapes to a caller/owner that releases it, or is exempt."""
+
+import os
+import tempfile
+import threading
+from contextlib import closing
+from multiprocessing import shared_memory
+
+
+def context_managed(frames):
+    # `with closing(...)` releases on every path
+    with closing(shared_memory.SharedMemory(create=True, size=1024)) as seg:
+        seg.buf[:len(frames)] = frames
+
+
+def finally_released(context, frames):
+    sock = context.socket(1)
+    try:
+        sock.send_multipart(frames)
+    finally:
+        sock.close()
+
+
+def daemon_thread(target):
+    # daemon=True: lifetime intentionally tied to the process
+    threading.Thread(target=target, daemon=True).start()
+
+
+def factory(size):
+    # acquire-and-return: ownership moves to the caller (analyzed there)
+    return shared_memory.SharedMemory(create=True, size=size)
+
+
+def atomic_publish(payload, final_path):
+    fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(final_path))
+    try:
+        with os.fdopen(fd, 'wb') as stream:
+            stream.write(payload)
+        os.replace(tmp_path, final_path)
+    finally:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+
+
+class OwnedPump(object):
+    def __init__(self, context):
+        # escape-to-owner is fine: close() below releases the attribute
+        self._socket = context.socket(1)
+
+    def close(self):
+        self._socket.close()
+
+
+class LoopTeardown(object):
+    def __init__(self, context):
+        self._a = context.socket(1)
+        self._b = context.socket(2)
+
+    def close(self):
+        # the teardown idiom: release through the loop alias
+        for sock in (self._a, self._b):
+            sock.close()

@@ -1,0 +1,8 @@
+"""Suppression fixture: a deliberate process-lifetime socket, waived with a
+reasoned directive."""
+
+
+def process_lifetime_socket(context):
+    sock = context.socket(1)  # pipecheck: disable=resource-lifecycle -- process-lifetime control socket; the OS reclaims it at exit by design
+    sock.connect('tcp://127.0.0.1:5555')
+    return None

@@ -1,0 +1,20 @@
+"""Known-bad fixture: the critical section looks innocent but blocks
+through a helper chain — ``_drain()`` calls ``_settle()`` which sleeps.
+Only interprocedural analysis over the call graph sees it."""
+
+import time
+
+
+class ChainedPool:
+    def __init__(self, lock):
+        self._state_lock = lock
+
+    def _settle(self):
+        time.sleep(0.2)
+
+    def _drain(self):
+        self._settle()
+
+    def rebalance(self):
+        with self._state_lock:
+            self._drain()

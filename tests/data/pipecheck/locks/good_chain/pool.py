@@ -1,0 +1,24 @@
+"""Known-good fixture: the helper chain under the lock is pure
+bookkeeping; the blocking helper runs only outside the critical
+section."""
+
+import time
+
+
+class ChainedPool:
+    def __init__(self, lock):
+        self._state_lock = lock
+        self._pending = []
+
+    def _note(self, item):
+        self._pending.append(item)
+
+    def _drain(self):
+        for item in self._pending:
+            self._note(item)
+
+    def rebalance(self, item):
+        with self._state_lock:
+            self._note(item)
+            self._drain()
+        time.sleep(0.2)

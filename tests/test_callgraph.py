@@ -1,0 +1,287 @@
+"""Unit tests for the whole-program call graph and the per-function
+resource summaries behind pipecheck's interprocedural rules
+(petastorm_tpu/analysis/callgraph.py, docs/static-analysis.md).
+
+Fixture modules are built in-memory (ast.parse over literal source) so each
+test pins exactly one resolution/summary behavior: cycle-safe memoization,
+the unique-name dynamic-dispatch fallback, escape-to-owner accounting,
+kill-on-reassign/del, alias release credit, and the finally vs broad-handler
+vs narrow-handler release split the lifecycle rule judges on.
+"""
+import ast
+import textwrap
+from pathlib import Path
+
+from petastorm_tpu.analysis.callgraph import CallGraph, build_summaries
+from petastorm_tpu.analysis.config import default_config
+from petastorm_tpu.analysis.core import AnalysisContext, SourceModule
+
+
+def make_modules(files):
+    mods = []
+    for name, text in sorted(files.items()):
+        text = textwrap.dedent(text)
+        mods.append(SourceModule(Path('/proj') / name, name, text,
+                                 ast.parse(text)))
+    return mods
+
+
+def make_context(mods):
+    ctx = AnalysisContext(default_config(), [Path('/proj')])
+    ctx.modules = list(mods)
+    return ctx
+
+
+def graph_of(files):
+    mods = make_modules(files)
+    return CallGraph.build(mods), mods
+
+
+def summaries_of(files):
+    mods = make_modules(files)
+    graph = CallGraph.build(mods)
+    return build_summaries(make_context(mods), graph), graph
+
+
+def tracked_of(summaries, key):
+    summary = summaries[key]
+    assert summary.tracked, 'no tracked acquisitions in ' + key
+    return summary.tracked
+
+
+# ------------------------------------------------------------- resolution
+
+
+def test_blocking_chain_through_cycle_terminates_and_finds_sleep():
+    graph, _ = graph_of({'cyc.py': '''
+        import time
+
+        def a():
+            b()
+
+        def b():
+            a()
+            time.sleep(1)
+        '''})
+    chain = graph.blocking_chain(graph.functions['cyc.py::a'])
+    assert chain is not None
+    assert chain[-1] == 'time.sleep()'
+    # pure cycle with no blocking call resolves to None, not recursion
+    graph2, _ = graph_of({'cyc.py': '''
+        def a():
+            b()
+
+        def b():
+            a()
+        '''})
+    assert graph2.blocking_chain(graph2.functions['cyc.py::a']) is None
+
+
+def test_resolve_same_module_function_and_self_method():
+    graph, mods = graph_of({'mod.py': '''
+        def helper():
+            pass
+
+        def caller():
+            helper()
+
+        class Box:
+            def m(self):
+                self.n()
+
+            def n(self):
+                pass
+        '''})
+    caller = graph.functions['mod.py::caller']
+    call = caller.node.body[0].value
+    assert graph.resolve_call(call, caller).qualname == 'helper'
+    method = graph.functions['mod.py::Box.m']
+    self_call = method.node.body[0].value
+    assert graph.resolve_call(self_call, method).qualname == 'Box.n'
+
+
+def test_dynamic_dispatch_falls_back_to_unique_name_only():
+    # one project-wide definition of .drain() -> resolved across modules
+    graph, _ = graph_of({
+        'a.py': '''
+        class Pump:
+            def drain(self):
+                pass
+        ''',
+        'b.py': '''
+        def run(pump):
+            pump.drain()
+        '''})
+    run_info = graph.functions['b.py::run']
+    call = run_info.node.body[0].value
+    assert graph.resolve_call(call, run_info).qualname == 'Pump.drain'
+    # two definitions -> ambiguity resolves to None (never guess)
+    graph2, _ = graph_of({
+        'a.py': '''
+        class Pump:
+            def drain(self):
+                pass
+
+        class Sink:
+            def drain(self):
+                pass
+        ''',
+        'b.py': '''
+        def run(obj):
+            obj.drain()
+        '''})
+    run2 = graph2.functions['b.py::run']
+    assert graph2.resolve_call(run2.node.body[0].value, run2) is None
+
+
+def test_owner_releases_tracks_direct_alias_and_loop_release():
+    graph, mods = graph_of({'owner.py': '''
+        class Owner:
+            def __init__(self, a, b, c, d):
+                self._direct = a
+                self._aliased = b
+                self._looped_x = c
+                self._looped_y = d
+                self._never = None
+
+            def close(self):
+                self._direct.close()
+                sock = self._aliased
+                sock.close()
+                for item in (self._looped_x, self._looped_y):
+                    item.close()
+        '''})
+    module = mods[0]
+    for attr in ('_direct', '_aliased', '_looped_x', '_looped_y'):
+        assert graph.owner_releases(module, 'Owner', attr), attr
+    assert not graph.owner_releases(module, 'Owner', '_never')
+
+
+def test_always_raises_transitively_through_helper():
+    graph, _ = graph_of({'mod.py': '''
+        def _fail(exc):
+            raise RuntimeError('wedged') from exc
+
+        def handler(exc):
+            _fail(exc)
+
+        def soft(exc):
+            return None
+        '''})
+    assert graph.always_raises_transitively(graph.functions['mod.py::handler'])
+    assert not graph.always_raises_transitively(graph.functions['mod.py::soft'])
+
+
+# -------------------------------------------------------------- summaries
+
+
+def test_summary_kills_binding_on_reassign_and_del():
+    summaries, _ = summaries_of({'mod.py': '''
+        from multiprocessing import shared_memory
+
+        def rebind():
+            seg = shared_memory.SharedMemory(create=True, size=64)
+            seg = shared_memory.SharedMemory(create=True, size=64)
+            seg.close()
+
+        def deleted():
+            seg = shared_memory.SharedMemory(create=True, size=64)
+            del seg
+        '''})
+    rebind = tracked_of(summaries, 'mod.py::rebind')
+    assert rebind[0].killed_line is not None  # first acquisition orphaned
+    assert rebind[1].released  # the rebound one is closed
+    deleted = tracked_of(summaries, 'mod.py::deleted')
+    assert deleted[0].killed_line is not None
+
+
+def test_summary_credits_release_through_local_alias():
+    summaries, _ = summaries_of({'mod.py': '''
+        from multiprocessing import shared_memory
+
+        def aliased():
+            seg = shared_memory.SharedMemory(create=True, size=64)
+            handle = seg
+            handle.close()
+        '''})
+    (tracked,) = tracked_of(summaries, 'mod.py::aliased')
+    assert tracked.released
+
+
+def test_release_position_semantics_finally_vs_handlers():
+    summaries, _ = summaries_of({'mod.py': '''
+        from multiprocessing import shared_memory
+
+        def in_finally(sink):
+            seg = shared_memory.SharedMemory(create=True, size=64)
+            try:
+                sink.push(seg.buf)
+            finally:
+                seg.close()
+
+        def broad_handler_only(sink):
+            seg = shared_memory.SharedMemory(create=True, size=64)
+            try:
+                sink.push(seg.buf)
+            except Exception:
+                seg.close()
+                raise
+
+        def narrow_handler_only(sink):
+            seg = shared_memory.SharedMemory(create=True, size=64)
+            try:
+                sink.push(seg.buf)
+            except OSError:
+                seg.close()
+                raise
+        '''})
+    (fin,) = tracked_of(summaries, 'mod.py::in_finally')
+    assert fin.released and fin.release_in_finally
+    # broad handler covers the error path but NOT the normal path
+    (broad,) = tracked_of(summaries, 'mod.py::broad_handler_only')
+    assert broad.release_in_finally and not broad.released
+    # a narrow handler earns NO finally credit: error paths of other types
+    # escape it, and the risk call before the release stays on record so
+    # the lifecycle judge can flag the normal-path-only shape
+    (narrow,) = tracked_of(summaries, 'mod.py::narrow_handler_only')
+    assert not narrow.release_in_finally
+    assert narrow.risk_line is not None
+
+
+def test_factory_return_propagates_to_call_site():
+    summaries, _ = summaries_of({'mod.py': '''
+        from multiprocessing import shared_memory
+
+        def fresh():
+            seg = shared_memory.SharedMemory(create=True, size=64)
+            return seg
+
+        def leaky():
+            seg = fresh()
+
+        def tidy():
+            seg = fresh()
+            seg.close()
+        '''})
+    assert summaries['mod.py::fresh'].returns_spec is not None
+    (factory,) = tracked_of(summaries, 'mod.py::fresh')
+    assert factory.returned  # ownership moved out: not a leak in the factory
+    (leak,) = tracked_of(summaries, 'mod.py::leaky')
+    assert not leak.released and not leak.escaped and not leak.returned
+    (ok,) = tracked_of(summaries, 'mod.py::tidy')
+    assert ok.released
+
+
+def test_escape_via_container_literal_argument():
+    summaries, _ = summaries_of({'mod.py': '''
+        import tempfile
+
+        def handoff(spawner):
+            fd, path = tempfile.mkstemp()
+            spawner.launch([path, '--flag'])
+            import os
+            os.close(fd)
+        '''})
+    tracked = tracked_of(summaries, 'mod.py::handoff')
+    assert any(t.escaped for t in tracked)  # the path handed to argv
+    assert any(t.released for t in tracked)  # the fd closed

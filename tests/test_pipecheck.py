@@ -87,10 +87,29 @@ BAD_FIXTURES = [
      ["b'w_metrics'", "b'w_metricz'"]),
     ('protocol/service_bad_incident', ['protocol-conformance'], 2,
      ["b'w_incident'", "b'w_incidnet'"]),
-    ('protocol/ledger_bad_kind', ['protocol-conformance'], 2,
+    ('journal/ledger_bad_kind', ['journal-discipline'], 2,
      ["'retierd'", "'vanished'", 'LEDGER_RECORD_KINDS']),
-    ('protocol/topology_bad_kind', ['protocol-conformance'], 2,
+    ('journal/topology_bad_kind', ['journal-discipline'], 2,
      ["'jion'", "'vanished'", 'TOPOLOGY_RECORD_KINDS']),
+    ('journal/bad_flush/ledger.py', ['journal-discipline'], 1,
+     ['without a flush/fsync']),
+    ('journal/bad_crc/ledger.py', ['journal-discipline'], 1,
+     ['CRC-mismatch branch bails without counting the drop']),
+    ('journal/bad_owner/loader.py', ['journal-discipline'], 1,
+     ["'conductor'", 'RUN_RECORD_OWNERS']),
+    ('lifecycle/bad/segment_pump.py', ['resource-lifecycle'], 3,
+     ['never released', 'normal path', 'thread acquired']),
+    ('lifecycle/bad_helper/pump.py', ['resource-lifecycle'], 1,
+     ['shared-memory segment', 'never released']),
+    ('lifecycle/bad_rebind/rebind.py', ['resource-lifecycle'], 1,
+     ['rebound/deleted at line']),
+    ('lifecycle/bad_owner/owner.py', ['resource-lifecycle'], 1,
+     ['escapes to self._socket', 'releases it']),
+    ('determinism/bad/reader.py', ['determinism'], 5,
+     ['random.shuffle', 'np.random.permutation', 'listdir',
+      'set-valued local', 'id()']),
+    ('locks/bad_chain/pool.py', ['lock-discipline'], 1,
+     ['helper chain', 'time.sleep']),
 ]
 
 GOOD_FIXTURES = [
@@ -107,7 +126,14 @@ GOOD_FIXTURES = [
     ('locks/good_lock.py', ['lock-discipline']),
     ('protocol/good_kinds', ['protocol-conformance']),
     ('protocol/service_good_kinds', ['protocol-conformance']),
-    ('protocol/topology_good_kind', ['protocol-conformance']),
+    ('journal/topology_good_kind', ['journal-discipline']),
+    ('journal/good_flush/ledger.py', ['journal-discipline']),
+    ('journal/good_owner/loader.py', ['journal-discipline']),
+    ('lifecycle/good/clean.py', ['resource-lifecycle']),
+    ('determinism/good/reader.py', ['determinism']),
+    ('determinism/unscoped/helper.py', ['determinism']),
+    ('locks/good_chain/pool.py', ['lock-discipline']),
+    ('exceptions/good_raise_helper/reader_worker.py', ['exception-hygiene']),
 ]
 
 
@@ -138,7 +164,9 @@ def test_known_good_fixture_is_clean(path, rules):
     ('telemetry/suppressed_history.py', ['telemetry-names']),
     ('exceptions/suppressed_swallow.py', ['exception-hygiene']),
     ('protocol/service_suppressed_kinds', ['protocol-conformance']),
-    ('protocol/topology_suppressed_kind', ['protocol-conformance']),
+    ('journal/topology_suppressed_kind', ['journal-discipline']),
+    ('lifecycle/suppressed/leaky.py', ['resource-lifecycle']),
+    ('determinism/suppressed/reader.py', ['determinism']),
 ])
 def test_suppression_comment_is_honored_and_counted(path, rules):
     report = run([FIXTURES / path], rules=rules)
@@ -211,7 +239,8 @@ def test_self_application_is_clean():
     report = run_pipecheck()
     assert report.clean, '\n'.join(messages(report))
     assert report.files > 60  # the walker found the real package
-    assert len(report.rules) == 6
+    assert len(report.rules) == 9
+    assert report.callgraph_functions > 300  # whole-program graph was built
 
 
 def test_cli_self_application_exit_code(capsys):
@@ -228,9 +257,55 @@ def test_cli_json_and_exit_codes(capsys):
     doc = json.loads(capsys.readouterr().out)
     assert doc['clean'] is False
     assert doc['by_rule'] == {'telemetry-names': 2}
+    # per-rule wall time + call-graph size ride along for bench/doctor
+    assert set(doc['rule_seconds']) == {'telemetry-names'}
+    assert doc['rule_seconds']['telemetry-names'] >= 0.0
+    assert doc['callgraph_functions'] == 0  # no graph-backed rule selected
     assert pipecheck_main(['--list-rules']) == 0
     assert 'protocol-conformance' in capsys.readouterr().out
     assert pipecheck_main(['--rules', 'no-such-rule', str(PKG)]) == 2
+
+
+def _git(tmp_path, *argv):
+    import subprocess
+    subprocess.run(['git', '-C', str(tmp_path)] + list(argv),
+                   check=True, capture_output=True,
+                   env=dict(os.environ,
+                            GIT_AUTHOR_NAME='t', GIT_AUTHOR_EMAIL='t@t',
+                            GIT_COMMITTER_NAME='t', GIT_COMMITTER_EMAIL='t@t'))
+
+
+def test_cli_diff_base_restricts_findings_to_changed_files(tmp_path, capsys):
+    """--diff-base keeps whole-program analysis but reports only findings
+    in files changed vs the ref — the incremental CI gate."""
+    import json
+    _git(tmp_path, 'init', '-q')
+    src = (FIXTURES / 'exceptions' / 'bad_swallow.py').read_text()
+    (tmp_path / 'old_bad.py').write_text(src)
+    _git(tmp_path, 'add', '.')
+    _git(tmp_path, 'commit', '-q', '-m', 'seed')
+    (tmp_path / 'new_bad.py').write_text(src)
+    _git(tmp_path, 'add', 'new_bad.py')
+
+    # without the filter: both files flagged
+    full = run([tmp_path], rules=['exception-hygiene'])
+    assert len(full.findings) == 2, messages(full)
+    # with --diff-base HEAD: only the newly-added file's finding remains
+    rc = pipecheck_main([str(tmp_path), '--rules', 'exception-hygiene',
+                         '--diff-base', 'HEAD', '--json'])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['finding_count'] == 1
+    assert all('new_bad.py' in f['path'] for f in doc['findings'])
+    assert any('--diff-base HEAD' in note for note in doc['notes'])
+
+
+def test_cli_diff_base_bad_ref_is_usage_error(tmp_path, capsys):
+    (tmp_path / 'mod.py').write_text('x = 1\n')
+    _git(tmp_path, 'init', '-q')
+    rc = pipecheck_main([str(tmp_path), '--diff-base', 'no-such-ref'])
+    assert rc == 2
+    assert '--diff-base' in capsys.readouterr().err
 
 
 def test_throughput_cli_dispatches_pipecheck(capsys):
@@ -245,6 +320,7 @@ def test_doctor_pipecheck_block():
     assert block['status'] == 'ok'
     assert block['findings'] == 0
     assert block['files'] > 60
+    assert block['callgraph_functions'] > 300
 
 
 # -------------------------------------------------------- seeded mutations
@@ -348,6 +424,68 @@ def test_mutation_wall_clock_call_in_resilience(tmp_path):
     # the unmutated module is clean (the baseline the mutation perturbs)
     shutil.copy(src, dst)
     assert run([tmp_path], rules=['clock-discipline']).clean
+
+
+def test_mutation_deleted_shm_close_leaks_on_normal_path(tmp_path):
+    """ISSUE-20 acceptance: delete the normal-path ``segment.close()`` in
+    the real shm publisher — the error-path close inside the broad handler
+    must NOT mask the straight-line leak."""
+    _copy_mutated(PKG / 'service' / 'service_worker.py',
+                  tmp_path / 'service_worker.py',
+                  '        name = segment.name\n        segment.close()\n',
+                  '        name = segment.name\n')
+    report = run([tmp_path], rules=['resource-lifecycle'])
+    text = '\n'.join(messages(report))
+    assert 'released only on the error path' in text, text
+    # the unmutated module is clean (the baseline the mutation perturbs)
+    shutil.copy(PKG / 'service' / 'service_worker.py',
+                tmp_path / 'service_worker.py')
+    assert run([tmp_path], rules=['resource-lifecycle']).clean
+
+
+def test_mutation_dropped_sorted_in_reshard_deal(tmp_path):
+    """ISSUE-20 acceptance: drop the ``sorted()`` laundering the reshard
+    assignment deal in the real topology journal — raw dict-view iteration
+    into an order-sensitive sink must surface."""
+    _copy_mutated(PKG / 'parallel' / 'topology.py',
+                  tmp_path / 'parallel' / 'topology.py',
+                  'in sorted(assignments.items())},',
+                  'in assignments.items()},')
+    report = run([tmp_path], rules=['determinism'])
+    text = '\n'.join(messages(report))
+    assert '.items()' in text and 'sorted' in text, text
+    # the unmutated module is clean
+    shutil.copy(PKG / 'parallel' / 'topology.py',
+                tmp_path / 'parallel' / 'topology.py')
+    assert run([tmp_path], rules=['determinism']).clean
+
+
+def test_mutation_unregistered_journal_kind(tmp_path):
+    """ISSUE-20 acceptance: append a record under a kind missing from the
+    ledger's closed ``LEDGER_RECORD_KINDS`` registry — the replay mirror
+    would silently skip it."""
+    _copy_mutated(PKG / 'service' / 'ledger.py', tmp_path / 'ledger.py',
+                  "self.append_record('epoch', epoch=self._epoch)",
+                  "self.append_record('rebalanced', epoch=self._epoch)")
+    report = run([tmp_path], rules=['journal-discipline'])
+    text = '\n'.join(messages(report))
+    assert "'rebalanced'" in text and 'LEDGER_RECORD_KINDS' in text, text
+
+
+def test_mutation_blocking_helper_under_ledger_lock(tmp_path):
+    """ISSUE-20 acceptance: a sleep inserted two frames down from the
+    lock-holding append must surface through the call-graph chain."""
+    dst = _copy_mutated(
+        PKG / 'service' / 'ledger.py', tmp_path / 'ledger.py',
+        "        snapshot = {'kind': 'epoch', 'epoch': self._epoch,",
+        "        time.sleep(0.05)\n"
+        "        snapshot = {'kind': 'epoch', 'epoch': self._epoch,")
+    report = run([tmp_path], rules=['lock-discipline'])
+    text = '\n'.join(messages(report))
+    assert '_rotate' in text and 'time.sleep' in text, text
+    # the unmutated module is clean
+    shutil.copy(PKG / 'service' / 'ledger.py', dst)
+    assert run([tmp_path], rules=['lock-discipline']).clean
 
 
 def _write_strict_ini(path, entries, weaken=None):
